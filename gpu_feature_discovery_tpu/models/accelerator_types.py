@@ -1,0 +1,128 @@
+"""Cloud TPU accelerator-type and topology string parsing.
+
+Accelerator types name a whole slice: ``v4-8``, ``v5p-128``, ``v5litepod-16``,
+``v6e-256`` — the trailing number is TensorCore count for v2-v4/v5p and chip
+count for v5e/v6e (Google's published convention). Topology strings name the
+chip grid: ``2x2x1`` (3D ICI generations) or ``4x4`` (2D generations).
+
+This module is pure parsing/arithmetic so the strategy engine and the
+interconnect labeler can derive chips/hosts/topology without touching
+hardware. It plays the role the MIG profile-name parsing plays in the
+reference (profile "1g.10gb" → slices/memory; here "v5p-128" → chips/hosts).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from gpu_feature_discovery_tpu.models.chips import ChipSpec, spec_for
+
+_ACCEL_RE = re.compile(r"^(?P<fam>[a-z0-9]+?)(?:pod)?-(?P<num>\d+)$")
+
+# Families whose accelerator-type suffix counts TensorCores, not chips.
+_CORE_COUNTED = {"v2", "v3", "v4", "v5p"}
+
+
+@dataclass(frozen=True)
+class AcceleratorType:
+    name: str                     # normalized, e.g. "v5p-128"
+    spec: ChipSpec
+    chips: int                    # total chips in the slice
+    tensorcores: int              # total TensorCores in the slice
+    hosts: int                    # TPU VM hosts backing the slice
+    topology: Tuple[int, ...]     # chip grid, e.g. (4, 4, 4)
+
+    @property
+    def topology_str(self) -> str:
+        return "x".join(str(d) for d in self.topology)
+
+    @property
+    def multi_host(self) -> bool:
+        return self.hosts > 1
+
+
+def _default_topology(spec: ChipSpec, chips: int) -> Tuple[int, ...]:
+    """Factor a chip count into the generation's default grid shape.
+
+    Matches the shapes Cloud TPU provisions for power-of-two sizes:
+    3D generations (v4/v5p): 4 → 2x2x1, 8 → 2x2x2, 16 → 2x2x4, 32 → 2x4x4,
+    64 → 4x4x4; 2D generations (v5e/v6e): 4 → 2x2, 8 → 2x4, 16 → 4x4.
+    Non-power-of-two counts fall back to a 1-padded near-cube.
+    """
+    n = max(1, chips)
+    ndims = spec.ici_dims
+    if n & (n - 1) == 0:  # power of two: distribute the exponent over axes
+        base, rem = divmod(n.bit_length() - 1, ndims)
+        dims = [1 << (base + (1 if i < rem else 0)) for i in range(ndims)]
+    else:
+        dims = [1] * (ndims - 1) + [n]
+    # Write order: non-1 axes ascending, trailing 1s last (2x2x1, 2x2x4, 2x4).
+    non_one = sorted(d for d in dims if d > 1)
+    ones = [d for d in dims if d == 1]
+    return tuple(non_one + ones) if non_one else tuple(ones)
+
+
+def parse_accelerator_type(name: str) -> Optional[AcceleratorType]:
+    """Parse e.g. "v4-8", "v5p-128", "v5litepod-16", "v6e-8"; None if the
+    string is not a TPU accelerator type."""
+    m = _ACCEL_RE.match(name.strip().lower())
+    if not m:
+        return None
+    fam = m.group("fam")
+    if fam == "v5lite":
+        fam = "v5e"
+    if fam == "v5litepod":
+        fam = "v5e"
+    spec = spec_for(fam)
+    if spec is None:
+        return None
+    num = int(m.group("num"))
+    if num <= 0:
+        return None
+
+    if spec.family in _CORE_COUNTED:
+        # Suffix counts TensorCores and must cover whole chips (v4-7 is not a
+        # real accelerator type; rejecting beats emitting inconsistent labels).
+        if num % spec.tensorcores != 0:
+            return None
+        tensorcores = num
+        chips = num // spec.tensorcores
+    else:
+        chips = num
+        tensorcores = num * spec.tensorcores
+
+    if chips <= spec.max_single_host_chips:
+        hosts = 1
+    else:
+        hosts = math.ceil(chips / spec.chips_per_host)
+    topology = _default_topology(spec, chips)
+    return AcceleratorType(
+        name=f"{spec.family}-{num}",
+        spec=spec,
+        chips=chips,
+        tensorcores=tensorcores,
+        hosts=hosts,
+        topology=topology,
+    )
+
+
+def parse_topology(topology: str) -> Optional[Tuple[int, ...]]:
+    """Parse a chip-grid string like "2x2x2" or "4x4"; None on malformed."""
+    parts = topology.strip().lower().split("x")
+    try:
+        dims = tuple(int(p) for p in parts)
+    except ValueError:
+        return None
+    if not dims or any(d <= 0 for d in dims):
+        return None
+    return dims
+
+
+def chips_in_topology(topology: str) -> Optional[int]:
+    dims = parse_topology(topology)
+    if dims is None:
+        return None
+    return math.prod(dims)

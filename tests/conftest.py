@@ -1,0 +1,17 @@
+"""Test harness configuration.
+
+Tests never touch real TPU hardware: tier 1-2 run against mock managers
+(mirroring the reference's moq-based strategy, SURVEY.md section 4), and
+JAX-based tests run on a virtual 8-device CPU mesh so multi-chip sharding
+logic is exercised without chips. Env vars must be set before jax imports.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,90 @@
+"""Tier-1 tests for the labeler core.
+
+Mirrors the coverage the reference gets from internal/lm tests plus the
+atomic-writer behavior asserted indirectly in cmd/.../main_test.go.
+"""
+
+import os
+import stat
+
+import pytest
+
+from gpu_feature_discovery_tpu.lm import Empty, Labels, Merge
+from gpu_feature_discovery_tpu.lm.labels import remove_output_file
+
+
+class StaticLabeler:
+    def __init__(self, **labels):
+        self._labels = Labels(labels)
+
+    def labels(self):
+        return self._labels
+
+
+class FailingLabeler:
+    def labels(self):
+        raise RuntimeError("probe failed")
+
+
+def test_labels_is_a_labeler():
+    l = Labels({"a": "1"})
+    assert l.labels() is l
+
+
+def test_merge_later_labels_win():
+    merged = Merge(
+        StaticLabeler(a="1", b="1"),
+        StaticLabeler(b="2", c="2"),
+        Empty(),
+        StaticLabeler(c="3"),
+    ).labels()
+    assert merged == {"a": "1", "b": "2", "c": "3"}
+
+
+def test_merge_of_nothing_is_empty():
+    assert Merge().labels() == {}
+
+
+def test_merge_propagates_errors():
+    with pytest.raises(RuntimeError):
+        Merge(StaticLabeler(a="1"), FailingLabeler()).labels()
+
+
+def test_write_to_file_format(tmp_path):
+    out = tmp_path / "tfd"
+    Labels({"google.com/tpu.count": "4", "google.com/tpu.product": "tpu-v4"}).write_to_file(str(out))
+    lines = sorted(out.read_text().splitlines())
+    assert lines == [
+        "google.com/tpu.count=4",
+        "google.com/tpu.product=tpu-v4",
+    ]
+
+
+def test_write_is_atomic_and_staged(tmp_path):
+    out = tmp_path / "tfd"
+    Labels({"k": "v1"}).write_to_file(str(out))
+    Labels({"k": "v2"}).write_to_file(str(out))
+    assert out.read_text() == "k=v2\n"
+    # Staging dir exists next to the output and holds no leftover temp files.
+    tmp_dir = tmp_path / "tfd-tmp"
+    assert tmp_dir.is_dir()
+    assert list(tmp_dir.iterdir()) == []
+
+
+def test_write_sets_mode_0644(tmp_path):
+    out = tmp_path / "tfd"
+    Labels({"k": "v"}).write_to_file(str(out))
+    assert stat.S_IMODE(os.stat(out).st_mode) == 0o644
+
+
+def test_empty_path_writes_stdout(capsys):
+    Labels({"k": "v"}).write_to_file("")
+    assert capsys.readouterr().out == "k=v\n"
+
+
+def test_remove_output_file_cleans_staging(tmp_path):
+    out = tmp_path / "tfd"
+    Labels({"k": "v"}).write_to_file(str(out))
+    remove_output_file(str(out))
+    assert not out.exists()
+    assert not (tmp_path / "tfd-tmp").exists()

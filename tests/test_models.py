@@ -1,0 +1,84 @@
+"""Tier-1 tests for the TPU hardware model tables and accelerator parsing."""
+
+import pytest
+
+from gpu_feature_discovery_tpu.models import (
+    CHIP_SPECS,
+    family_for_generation,
+    parse_accelerator_type,
+    spec_for,
+)
+from gpu_feature_discovery_tpu.models.accelerator_types import (
+    chips_in_topology,
+    parse_topology,
+)
+
+
+def test_spec_tables_are_complete():
+    for fam, spec in CHIP_SPECS.items():
+        assert spec.family == fam
+        assert spec.hbm_mb > 0
+        assert spec.tensorcores in (1, 2)
+        assert spec.ici_dims in (2, 3)
+        assert len(spec.default_topology) == 3
+
+
+def test_spec_for_device_kind_aliases():
+    assert spec_for("TPU v4").family == "v4"
+    assert spec_for("TPU v5 lite").family == "v5e"
+    assert spec_for("tpu v5p").family == "v5p"
+    assert spec_for("TPU v6 lite").family == "v6e"
+    assert spec_for("not-a-tpu") is None
+
+
+def test_family_for_generation_matches_arch_family_semantics():
+    assert family_for_generation(4, 0) == "v4"
+    assert family_for_generation(5, 0) == "v5e"
+    assert family_for_generation(5, 1) == "v5p"
+    assert family_for_generation(9, 9) == "undefined"
+
+
+@pytest.mark.parametrize(
+    "name,chips,cores,hosts,topo",
+    [
+        ("v4-8", 4, 8, 1, "2x2x1"),
+        ("v4-16", 8, 16, 2, "2x2x2"),
+        ("v4-32", 16, 32, 4, "2x2x4"),
+        ("v4-64", 32, 64, 8, "2x4x4"),
+        ("v5p-8", 4, 8, 1, "2x2x1"),
+        ("v5p-128", 64, 128, 16, "4x4x4"),
+        ("v5litepod-16", 16, 16, 4, "4x4"),
+        ("v5e-8", 8, 8, 1, "2x4"),
+        ("v6e-256", 256, 256, 64, "16x16"),
+    ],
+)
+def test_parse_accelerator_type(name, chips, cores, hosts, topo):
+    at = parse_accelerator_type(name)
+    assert at is not None, name
+    assert at.chips == chips
+    assert at.tensorcores == cores
+    assert at.hosts == hosts
+    assert at.topology_str == topo
+
+
+def test_parse_accelerator_type_rejects_garbage():
+    assert parse_accelerator_type("a100-80gb") is None
+    assert parse_accelerator_type("v4") is None
+    assert parse_accelerator_type("v4-0") is None
+    assert parse_accelerator_type("") is None
+    # core-counted families reject counts that don't cover whole chips
+    assert parse_accelerator_type("v4-7") is None
+    assert parse_accelerator_type("v5p-2") is not None  # 1 chip, 2 cores: valid
+
+
+def test_multi_host_flag():
+    assert not parse_accelerator_type("v4-8").multi_host
+    assert parse_accelerator_type("v4-16").multi_host
+
+
+def test_topology_parsing():
+    assert parse_topology("2x2x2") == (2, 2, 2)
+    assert parse_topology("4x4") == (4, 4)
+    assert parse_topology("0x2") is None
+    assert parse_topology("abc") is None
+    assert chips_in_topology("2x2x4") == 16
