@@ -18,7 +18,7 @@ import re
 from dataclasses import dataclass
 from typing import Optional, Tuple
 
-from gpu_feature_discovery_tpu.models.chips import ChipSpec, spec_for
+from gpu_feature_discovery_tpu.models.chips import ChipSpec, hosts_for, spec_for
 
 _ACCEL_RE = re.compile(r"^(?P<fam>[a-z0-9]+?)(?:pod)?-(?P<num>\d+)$")
 
@@ -94,10 +94,7 @@ def parse_accelerator_type(name: str) -> Optional[AcceleratorType]:
         chips = num
         tensorcores = num * spec.tensorcores
 
-    if chips <= spec.max_single_host_chips:
-        hosts = 1
-    else:
-        hosts = math.ceil(chips / spec.chips_per_host)
+    hosts = hosts_for(spec, chips)
     topology = _default_topology(spec, chips)
     return AcceleratorType(
         name=f"{spec.family}-{num}",

@@ -88,6 +88,14 @@ def spec_for(family_or_kind: str) -> Optional[ChipSpec]:
     return None
 
 
+def hosts_for(spec: ChipSpec, chips: int) -> int:
+    """TPU VM hosts backing a slice of ``chips`` chips: 1 while a single
+    host machine shape covers it, else ceil over the multi-host chips/VM."""
+    if chips <= spec.max_single_host_chips:
+        return 1
+    return -(-chips // spec.chips_per_host)
+
+
 def family_for_generation(generation: int, variant_rank: int) -> str:
     """Arch-family name from (generation, variant) — the direct analog of
     getArchFamily(computeMajor, computeMinor) (resource.go:261-284)."""

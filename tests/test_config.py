@@ -1,0 +1,174 @@
+"""Tier-1 tests for the config/flag system: precedence, duration parsing,
+versioned file parsing, feature gating. Mirrors the semantics of the vendored
+config/v1 spec the reference relies on (SURVEY.md section 2.4)."""
+
+import pytest
+
+from gpu_feature_discovery_tpu.config import new_config, parse_duration
+from gpu_feature_discovery_tpu.config.flags import (
+    DEFAULT_MACHINE_TYPE_FILE,
+    DEFAULT_OUTPUT_FILE,
+    DEFAULT_SLEEP_INTERVAL,
+    disable_resource_renaming,
+)
+from gpu_feature_discovery_tpu.config.spec import ConfigError, parse_config_file
+
+
+def test_defaults():
+    cfg = new_config()
+    assert cfg.version == "v1"
+    assert cfg.flags.tpu_topology_strategy == "none"
+    assert cfg.flags.fail_on_init_error is True
+    assert cfg.flags.tfd.oneshot is False
+    assert cfg.flags.tfd.no_timestamp is False
+    assert cfg.flags.tfd.sleep_interval == DEFAULT_SLEEP_INTERVAL
+    assert cfg.flags.tfd.output_file == DEFAULT_OUTPUT_FILE
+    assert cfg.flags.tfd.machine_type_file == DEFAULT_MACHINE_TYPE_FILE
+
+
+def test_env_overrides_default():
+    cfg = new_config(environ={"TFD_TPU_TOPOLOGY_STRATEGY": "single", "TFD_ONESHOT": "true"})
+    assert cfg.flags.tpu_topology_strategy == "single"
+    assert cfg.flags.tfd.oneshot is True
+
+
+def test_legacy_env_alias():
+    cfg = new_config(environ={"TPU_TOPOLOGY_STRATEGY": "mixed"})
+    assert cfg.flags.tpu_topology_strategy == "mixed"
+
+
+def test_env_alias_order_first_wins():
+    cfg = new_config(
+        environ={"TFD_TPU_TOPOLOGY_STRATEGY": "single", "TPU_TOPOLOGY_STRATEGY": "mixed"}
+    )
+    assert cfg.flags.tpu_topology_strategy == "single"
+
+
+def test_cli_beats_env_beats_file(tmp_path):
+    f = tmp_path / "cfg.yaml"
+    f.write_text(
+        "version: v1\n"
+        "flags:\n"
+        "  tpuTopologyStrategy: mixed\n"
+        "  tfd:\n"
+        "    sleepInterval: 5s\n"
+        "    outputFile: /from/file\n"
+    )
+    cfg = new_config(
+        cli_values={"tpu-topology-strategy": "single"},
+        environ={"TFD_TPU_TOPOLOGY_STRATEGY": "none", "TFD_OUTPUT_FILE": "/from/env"},
+        config_file=str(f),
+    )
+    # CLI wins over env and file
+    assert cfg.flags.tpu_topology_strategy == "single"
+    # env wins over file
+    assert cfg.flags.tfd.output_file == "/from/env"
+    # file wins over default
+    assert cfg.flags.tfd.sleep_interval == 5.0
+
+
+def test_file_only_values_survive(tmp_path):
+    f = tmp_path / "cfg.yaml"
+    f.write_text("version: v1\nflags:\n  failOnInitError: false\n")
+    cfg = new_config(config_file=str(f))
+    assert cfg.flags.fail_on_init_error is False
+
+
+def test_unknown_config_version_rejected(tmp_path):
+    f = tmp_path / "cfg.yaml"
+    f.write_text("version: v2\n")
+    with pytest.raises(ConfigError, match="unknown version"):
+        parse_config_file(str(f))
+
+
+def test_missing_version_defaults_to_v1(tmp_path):
+    f = tmp_path / "cfg.yaml"
+    f.write_text("flags:\n  tpuTopologyStrategy: single\n")
+    cfg = parse_config_file(str(f))
+    assert cfg.version == "v1"
+    assert cfg.flags.tpu_topology_strategy == "single"
+
+
+def test_invalid_strategy_rejected():
+    with pytest.raises(ConfigError, match="invalid tpu-topology-strategy"):
+        new_config(cli_values={"tpu-topology-strategy": "bogus"})
+
+
+@pytest.mark.parametrize(
+    "text,seconds",
+    [
+        ("60s", 60.0),
+        ("1m30s", 90.0),
+        ("100ms", 0.1),
+        ("2h", 7200.0),
+        ("0.5s", 0.5),
+        (5, 5.0),
+        ("10", 10.0),
+    ],
+)
+def test_parse_duration(text, seconds):
+    assert parse_duration(text) == pytest.approx(seconds)
+
+
+@pytest.mark.parametrize("bad", ["", "abc", "10parsecs", "s"])
+def test_parse_duration_rejects(bad):
+    with pytest.raises(ConfigError):
+        parse_duration(bad)
+
+
+def test_sharing_parsed_and_rename_gated(tmp_path):
+    f = tmp_path / "cfg.yaml"
+    f.write_text(
+        "version: v1\n"
+        "sharing:\n"
+        "  timeSlicing:\n"
+        "    resources:\n"
+        "    - name: google.com/tpu\n"
+        "      rename: google.com/tpu-shared\n"
+        "      replicas: 4\n"
+    )
+    cfg = new_config(config_file=str(f))
+    [r] = cfg.sharing.time_slicing.resources
+    assert r.name == "google.com/tpu"
+    assert r.replicas == 4
+
+    warnings = []
+    disable_resource_renaming(cfg, warnings.append)
+    assert cfg.sharing.time_slicing.resources[0].rename == ""
+    assert any("rename" in w for w in warnings)
+
+
+def test_rename_by_default_forces_default_shared_rename(tmp_path):
+    f = tmp_path / "cfg.yaml"
+    f.write_text(
+        "version: v1\n"
+        "sharing:\n"
+        "  timeSlicing:\n"
+        "    renameByDefault: true\n"
+        "    resources:\n"
+        "    - name: google.com/tpu\n"
+        "      rename: custom-name\n"
+        "      replicas: 2\n"
+    )
+    cfg = new_config(config_file=str(f))
+    disable_resource_renaming(cfg, lambda _: None)
+    assert cfg.sharing.time_slicing.resources[0].rename == "google.com/tpu.shared"
+
+
+def test_quoted_boolean_strings_parse_strictly(tmp_path):
+    # YAML-quoted "false" must not truthiness-convert to True.
+    f = tmp_path / "cfg.yaml"
+    f.write_text('version: v1\nflags:\n  tfd:\n    oneshot: "false"\n')
+    assert new_config(config_file=str(f)).flags.tfd.oneshot is False
+
+    bad = tmp_path / "bad.yaml"
+    bad.write_text('version: v1\nflags:\n  tfd:\n    oneshot: "maybe"\n')
+    with pytest.raises(ConfigError, match="invalid boolean"):
+        parse_config_file(str(bad))
+
+
+def test_config_to_dict_round_trip():
+    cfg = new_config(environ={"TFD_SLEEP_INTERVAL": "30s"})
+    d = cfg.to_dict()
+    assert d["flags"]["tfd"]["sleepInterval"] == 30.0
+    assert d["version"] == "v1"

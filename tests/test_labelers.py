@@ -1,0 +1,249 @@
+"""Tier-1 tests for the simple labelers: versions, slice capability,
+machine type, timestamp, chip/slice resource label families and sharing.
+
+Mirrors internal/lm/nvml_test.go (mig.capable truth table) and
+internal/lm/resource_test.go (resource label families incl. sharing)."""
+
+import pytest
+
+from gpu_feature_discovery_tpu.config import new_config
+from gpu_feature_discovery_tpu.config.spec import ReplicatedResource, Sharing, TimeSlicing
+from gpu_feature_discovery_tpu.lm.machine_type import new_machine_type_labeler
+from gpu_feature_discovery_tpu.lm.resource_labeler import (
+    new_chip_resource_labeler,
+    new_slice_resource_labeler,
+)
+from gpu_feature_discovery_tpu.lm.timestamp import new_timestamp_labeler
+from gpu_feature_discovery_tpu.lm.tpu import new_tpu_labeler
+from gpu_feature_discovery_tpu.lm.versions import (
+    new_slice_capability_labeler,
+    new_version_labeler,
+)
+from gpu_feature_discovery_tpu.resource.testing import (
+    MockChip,
+    MockManager,
+    new_single_host_manager,
+)
+
+
+def sharing_with(name="google.com/tpu", replicas=4, rename=""):
+    return Sharing(
+        time_slicing=TimeSlicing(
+            resources=[ReplicatedResource(name=name, rename=rename, replicas=replicas)]
+        )
+    )
+
+
+# ---------------------------------------------------------------------------
+# version labeler
+# ---------------------------------------------------------------------------
+
+def test_version_labeler_three_part():
+    labels = new_version_labeler(MockManager(driver_version="1.9.2"))
+    assert labels["google.com/tpu.driver.major"] == "1"
+    assert labels["google.com/tpu.driver.minor"] == "9"
+    assert labels["google.com/tpu.driver.rev"] == "2"
+    assert labels["google.com/tpu.runtime.major"] == "0"
+    assert labels["google.com/tpu.runtime.minor"] == "51"
+
+
+def test_version_labeler_two_part_has_empty_rev():
+    labels = new_version_labeler(MockManager(driver_version="2.14"))
+    assert labels["google.com/tpu.driver.rev"] == ""
+
+
+@pytest.mark.parametrize("bad", ["unknown", "1", "1.2.3.4"])
+def test_version_labeler_rejects_malformed(bad):
+    with pytest.raises(ValueError, match="does not match format"):
+        new_version_labeler(MockManager(driver_version=bad))
+
+
+# ---------------------------------------------------------------------------
+# slice capability truth table (nvml_test.go analog)
+# ---------------------------------------------------------------------------
+
+def test_slice_capable_empty_without_chips():
+    assert new_slice_capability_labeler(MockManager()).labels() == {}
+
+
+def test_slice_capable_true_when_any_chip_capable():
+    m = MockManager(
+        chips=[MockChip(slice_capable=False), MockChip(slice_capable=True)]
+    )
+    assert new_slice_capability_labeler(m).labels() == {
+        "google.com/tpu.slice.capable": "true"
+    }
+
+
+def test_slice_capable_false_when_none_capable():
+    m = MockManager(chips=[MockChip(slice_capable=False)])
+    assert new_slice_capability_labeler(m).labels() == {
+        "google.com/tpu.slice.capable": "false"
+    }
+
+
+# ---------------------------------------------------------------------------
+# machine type
+# ---------------------------------------------------------------------------
+
+def test_machine_type_reads_and_dashes(tmp_path):
+    f = tmp_path / "product_name"
+    f.write_text("Google Compute Engine\n")
+    assert new_machine_type_labeler(str(f)) == {
+        "google.com/tpu.machine": "Google-Compute-Engine"
+    }
+
+
+def test_machine_type_unknown_on_missing_file(tmp_path):
+    labels = new_machine_type_labeler(str(tmp_path / "nope"))
+    assert labels == {"google.com/tpu.machine": "unknown"}
+
+
+def test_machine_type_unknown_on_empty_path():
+    assert new_machine_type_labeler("") == {"google.com/tpu.machine": "unknown"}
+
+
+# ---------------------------------------------------------------------------
+# timestamp
+# ---------------------------------------------------------------------------
+
+def test_timestamp_label_is_unix_seconds():
+    cfg = new_config()
+    labels = new_timestamp_labeler(cfg).labels()
+    assert labels["google.com/tfd.timestamp"].isdigit()
+
+
+def test_timestamp_suppressed():
+    cfg = new_config(cli_values={"no-timestamp": True})
+    assert new_timestamp_labeler(cfg).labels() == {}
+
+
+# ---------------------------------------------------------------------------
+# chip resource labels (resource_test.go analog)
+# ---------------------------------------------------------------------------
+
+def test_chip_labels_base_family():
+    labels = new_chip_resource_labeler(Sharing(), MockChip(family="v4"), 4).labels()
+    assert labels == {
+        "google.com/tpu.product": "tpu-v4",
+        "google.com/tpu.count": "4",
+        "google.com/tpu.replicas": "1",
+        "google.com/tpu.memory": "32768",
+        "google.com/tpu.family": "v4",
+        "google.com/tpu.generation.major": "4",
+        "google.com/tpu.generation.minor": "0",
+        "google.com/tpu.tensorcores": "2",
+        "google.com/tpu.sparsecores": "4",
+    }
+
+
+def test_chip_labels_zero_count_is_empty():
+    assert new_chip_resource_labeler(Sharing(), MockChip(), 0).labels() == {}
+
+
+def test_chip_labels_sharing_replicas_and_shared_suffix():
+    labels = new_chip_resource_labeler(sharing_with(replicas=4), MockChip(), 4).labels()
+    assert labels["google.com/tpu.replicas"] == "4"
+    assert labels["google.com/tpu.product"] == "tpu-v4-SHARED"
+
+
+def test_chip_labels_renamed_sharing_keeps_product():
+    sharing = sharing_with(replicas=4, rename="google.com/tpu.shared")
+    labels = new_chip_resource_labeler(sharing, MockChip(), 4).labels()
+    assert labels["google.com/tpu.product"] == "tpu-v4"
+    assert labels["google.com/tpu.replicas"] == "4"
+
+
+def test_chip_labels_sharing_disabled_zero_replicas():
+    labels = new_chip_resource_labeler(None, MockChip(), 4).labels()
+    assert labels["google.com/tpu.replicas"] == "0"
+    assert "SHARED" not in labels["google.com/tpu.product"]
+
+
+def test_chip_labels_product_spaces_dashed():
+    labels = new_chip_resource_labeler(
+        Sharing(), MockChip(product="TPU v99 prototype"), 1
+    ).labels()
+    assert labels["google.com/tpu.product"] == "TPU-v99-prototype"
+
+
+def test_chip_labels_unknown_generation_family_undefined():
+    class WeirdChip(MockChip):
+        def get_generation(self):
+            return (9, 9)
+
+    labels = new_chip_resource_labeler(Sharing(), WeirdChip(), 1).labels()
+    assert labels["google.com/tpu.family"] == "undefined"
+    assert "google.com/tpu.tensorcores" not in labels
+
+
+def test_chip_labels_zero_generation_no_arch_labels():
+    class NoGenChip(MockChip):
+        def get_generation(self):
+            return (0, 0)
+
+    labels = new_chip_resource_labeler(Sharing(), NoGenChip(), 1).labels()
+    assert "google.com/tpu.family" not in labels
+    assert "google.com/tpu.generation.major" not in labels
+
+
+# ---------------------------------------------------------------------------
+# slice resource labels
+# ---------------------------------------------------------------------------
+
+def test_slice_labels_product_and_attributes():
+    chip = MockChip(family="v5p", slice_topologies=["2x2x1"])
+    [sl] = chip.get_slices()
+    labels = new_slice_resource_labeler("google.com/tpu", Sharing(), sl, 4).labels()
+    assert labels["google.com/tpu.product"] == "tpu-v5p-SLICE-2x2x1"
+    assert labels["google.com/tpu.count"] == "4"
+    assert labels["google.com/tpu.replicas"] == "1"
+    assert labels["google.com/tpu.memory"] == str(95 * 1024 * 4)
+    assert labels["google.com/tpu.chips"] == "4"
+    assert labels["google.com/tpu.topology.x"] == "2"
+    assert labels["google.com/tpu.topology.y"] == "2"
+    assert labels["google.com/tpu.topology.z"] == "1"
+    assert labels["google.com/tpu.hosts"] == "1"
+    assert labels["google.com/tpu.ici.links"] == "24"
+
+
+def test_slice_labels_custom_resource_name():
+    chip = MockChip(family="v5e", slice_topologies=["2x4"])
+    [sl] = chip.get_slices()
+    labels = new_slice_resource_labeler(
+        "google.com/tpu-2x4", Sharing(), sl, 2
+    ).labels()
+    assert labels["google.com/tpu-2x4.product"] == "tpu-v5e-SLICE-2x4"
+    assert labels["google.com/tpu-2x4.count"] == "2"
+    assert labels["google.com/tpu-2x4.chips"] == "8"
+
+
+# ---------------------------------------------------------------------------
+# device-backed labeler lifecycle
+# ---------------------------------------------------------------------------
+
+def test_tpu_labeler_empty_without_chips():
+    cfg = new_config()
+    m = MockManager()
+    assert new_tpu_labeler(m, cfg).labels() == {}
+    assert m.calls["init"] == 1
+    assert m.calls["shutdown"] == 1
+
+
+def test_tpu_labeler_shutdown_called_even_on_error():
+    cfg = new_config()
+    m = MockManager(chips=[MockChip()], driver_version="unknown")
+    with pytest.raises(ValueError):
+        new_tpu_labeler(m, cfg)
+    assert m.calls["shutdown"] == 1
+
+
+def test_tpu_labeler_full_pass(tmp_path):
+    f = tmp_path / "machine"
+    f.write_text("ct5p-hightpu-4t")
+    cfg = new_config(cli_values={"machine-type-file": str(f)})
+    labels = new_tpu_labeler(new_single_host_manager("v4-8"), cfg).labels()
+    assert labels["google.com/tpu.machine"] == "ct5p-hightpu-4t"
+    assert labels["google.com/tpu.count"] == "4"
+    assert labels["google.com/tpu.slice.capable"] == "true"
+    assert labels["google.com/tpu.driver.major"] == "1"

@@ -1,0 +1,115 @@
+"""Tier-1 tests for the resource abstraction: null manager, fallback
+decorator (internal/resource/fallback_test.go analog), mocks and fixture
+builders, slice grouping (internal/mig semantics)."""
+
+import pytest
+
+from gpu_feature_discovery_tpu.resource import (
+    FallbackToNullOnInitError,
+    NullManager,
+    ResourceError,
+)
+from gpu_feature_discovery_tpu.resource.testing import (
+    MockChip,
+    MockManager,
+    new_mixed_slice_manager,
+    new_single_host_manager,
+    new_uniform_slice_manager,
+)
+from gpu_feature_discovery_tpu.topology import SliceInfo
+
+
+def test_null_manager_has_no_chips_and_errors_on_versions():
+    m = NullManager()
+    m.init()
+    assert m.get_chips() == []
+    with pytest.raises(ResourceError):
+        m.get_driver_version()
+    with pytest.raises(ResourceError):
+        m.get_runtime_version()
+    m.shutdown()
+
+
+def test_fallback_swallows_init_error_and_switches_to_null():
+    inner = MockManager(
+        chips=[MockChip()], init_error=ResourceError("libtpu held busy")
+    )
+    m = FallbackToNullOnInitError(inner)
+    m.init()  # must not raise
+    assert m.get_chips() == []
+    with pytest.raises(ResourceError):
+        m.get_driver_version()
+
+
+def test_fallback_passes_through_on_success():
+    inner = MockManager(chips=[MockChip()])
+    m = FallbackToNullOnInitError(inner)
+    m.init()
+    assert len(m.get_chips()) == 1
+    assert m.get_driver_version() == "1.9.0"
+    assert m.get_runtime_version() == (0, 51)
+
+
+def test_full_chip_rejects_slice_only_methods():
+    chip = MockChip(family="v5p")
+    with pytest.raises(ResourceError):
+        chip.get_attributes()
+    with pytest.raises(ResourceError):
+        chip.get_parent_chip()
+
+
+def test_slice_device_shape():
+    chip = MockChip(family="v5p", slice_topologies=["2x2x1"])
+    [sl] = chip.get_slices()
+    assert sl.get_name() == "2x2x1"
+    assert sl.get_parent_chip() is chip
+    attrs = sl.get_attributes()
+    assert attrs["chips"] == 4
+    assert attrs["memory"] == 95 * 1024 * 4
+    assert attrs["tensorcores"] == 8
+    assert attrs["topology.x"] == 2
+    assert attrs["topology.y"] == 2
+    assert attrs["topology.z"] == 1
+    assert attrs["hosts"] == 1
+    with pytest.raises(ResourceError):
+        sl.get_slices()
+
+
+def test_single_host_builder_matches_accelerator_type():
+    m = new_single_host_manager("v4-8")
+    chips = m.get_chips()
+    assert len(chips) == 4
+    assert all(c.get_name() == "tpu-v4" for c in chips)
+    assert all(not c.is_slice_enabled() for c in chips)
+    assert all(c.is_slice_capable() for c in chips)
+
+
+def test_slice_info_grouping_memoizes_probes():
+    m = new_uniform_slice_manager("v4-8")
+    info = SliceInfo(m)
+    assert len(info.get_chips_with_slices_enabled()) == 4
+    assert info.get_chips_with_slices_disabled() == []
+    info.get_chips_map()
+    # Each chip probed exactly once despite repeated map access.
+    assert all(c.calls["is_slice_enabled"] == 1 for c in m.get_chips())
+
+
+def test_any_slice_enabled_chip_is_empty():
+    # vacuously true with no slice-enabled chips (mig.go:96-99 semantics)
+    assert SliceInfo(new_single_host_manager("v4-8")).any_slice_enabled_chip_is_empty()
+    # false when every enabled chip has slices
+    assert not SliceInfo(new_uniform_slice_manager("v4-8")).any_slice_enabled_chip_is_empty()
+    # true when one enabled chip exposes none
+    m = MockManager(
+        chips=[
+            MockChip(slice_topologies=["2x2x1"]),
+            MockChip(slice_enabled=True),
+        ]
+    )
+    assert SliceInfo(m).any_slice_enabled_chip_is_empty()
+
+
+def test_get_all_slices_spans_chips():
+    m = new_mixed_slice_manager("v5e")
+    slices = SliceInfo(m).get_all_slices()
+    assert sorted(s.get_name() for s in slices) == ["2x2", "2x2", "2x4", "2x4"]

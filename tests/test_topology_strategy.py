@@ -1,0 +1,218 @@
+"""Tier-1 tests for the slice/topology strategy engine — the analog of the
+reference's largest unit suite (internal/lm/mig-strategy_test.go:148-360
+case matrix): every none/single/mixed edge including sharing replicas and
+all three INVALID reasons."""
+
+import pytest
+
+from gpu_feature_discovery_tpu.config import new_config
+from gpu_feature_discovery_tpu.config.spec import ReplicatedResource
+from gpu_feature_discovery_tpu.lm.topology_strategy import new_resource_labeler
+from gpu_feature_discovery_tpu.resource.testing import (
+    MockChip,
+    MockManager,
+    new_mixed_slice_manager,
+    new_single_host_manager,
+    new_uniform_slice_manager,
+)
+
+
+def cfg_with_strategy(strategy, sharing_replicas=None, sharing_name="google.com/tpu"):
+    cfg = new_config(cli_values={"tpu-topology-strategy": strategy})
+    if sharing_replicas:
+        cfg.sharing.time_slicing.resources.append(
+            ReplicatedResource(name=sharing_name, replicas=sharing_replicas)
+        )
+    return cfg
+
+
+def labels_for(manager, cfg):
+    return new_resource_labeler(manager, cfg).labels()
+
+
+# ---------------------------------------------------------------------------
+# strategy = none
+# ---------------------------------------------------------------------------
+
+def test_none_no_chips_empty():
+    assert labels_for(MockManager(), cfg_with_strategy("none")) == {}
+
+
+def test_none_full_chip_labels_only():
+    labels = labels_for(new_single_host_manager("v4-8"), cfg_with_strategy("none"))
+    assert labels["google.com/tpu.count"] == "4"
+    assert labels["google.com/tpu.product"] == "tpu-v4"
+    assert "google.com/tpu.topology.strategy" not in labels
+    assert "google.com/tpu.chips" not in labels
+
+
+def test_none_with_sharing():
+    labels = labels_for(
+        new_single_host_manager("v4-8"), cfg_with_strategy("none", sharing_replicas=2)
+    )
+    assert labels["google.com/tpu.replicas"] == "2"
+    assert labels["google.com/tpu.product"] == "tpu-v4-SHARED"
+
+
+def test_none_slice_bound_chips_published_without_sharing():
+    # Slice-bound chips' base labels never carry sharing info
+    # (NewGPUResourceLabelerWithoutSharing, mig-strategy.go:155-163).
+    labels = labels_for(
+        new_uniform_slice_manager("v4-8"), cfg_with_strategy("none", sharing_replicas=2)
+    )
+    assert labels["google.com/tpu.replicas"] == "0"
+    assert labels["google.com/tpu.product"] == "tpu-v4"
+
+
+def test_none_plain_chip_overrides_slice_bound_same_model():
+    # A plain chip of the same model overrides the slice-bound entry and the
+    # count spans both groups (mig-strategy.go:136-176).
+    m = MockManager(
+        chips=[
+            MockChip(family="v4", slice_topologies=["2x2x1"]),
+            MockChip(family="v4"),
+        ]
+    )
+    labels = labels_for(m, cfg_with_strategy("none", sharing_replicas=2))
+    assert labels["google.com/tpu.count"] == "2"
+    assert labels["google.com/tpu.replicas"] == "2"  # sharing applies again
+    assert labels["google.com/tpu.product"] == "tpu-v4-SHARED"
+
+
+# ---------------------------------------------------------------------------
+# strategy = single
+# ---------------------------------------------------------------------------
+
+def test_single_no_slice_chips_behaves_like_none_plus_strategy_label():
+    labels = labels_for(new_single_host_manager("v4-8"), cfg_with_strategy("single"))
+    assert labels["google.com/tpu.topology.strategy"] == "single"
+    assert labels["google.com/tpu.product"] == "tpu-v4"
+    assert labels["google.com/tpu.count"] == "4"
+
+
+def test_single_valid_uniform_slice_overloads_tpu_resource():
+    labels = labels_for(new_uniform_slice_manager("v4-8"), cfg_with_strategy("single"))
+    assert labels["google.com/tpu.topology.strategy"] == "single"
+    assert labels["google.com/tpu.product"] == "tpu-v4-SLICE-2x2x1"
+    assert labels["google.com/tpu.count"] == "4"   # 4 chips × 1 slice each
+    assert labels["google.com/tpu.replicas"] == "1"
+    assert labels["google.com/tpu.chips"] == "4"
+    assert labels["google.com/tpu.memory"] == str(32768 * 4)
+    assert labels["google.com/tpu.topology.z"] == "1"
+
+
+def test_single_with_sharing_on_overloaded_resource():
+    labels = labels_for(
+        new_uniform_slice_manager("v4-8"),
+        cfg_with_strategy("single", sharing_replicas=3),
+    )
+    assert labels["google.com/tpu.replicas"] == "3"
+    assert labels["google.com/tpu.product"] == "tpu-v4-SLICE-2x2x1-SHARED"
+
+
+def test_single_invalid_empty_slice_bound_chip():
+    m = MockManager(
+        chips=[
+            MockChip(family="v4", slice_topologies=["2x2x1"]),
+            MockChip(family="v4", slice_enabled=True),  # bound but empty
+        ]
+    )
+    labels = labels_for(m, cfg_with_strategy("single"))
+    assert labels["google.com/tpu.product"] == "tpu-v4-SLICE-INVALID"
+    assert labels["google.com/tpu.count"] == "0"
+    assert labels["google.com/tpu.replicas"] == "0"
+    assert labels["google.com/tpu.memory"] == "0"
+
+
+def test_single_invalid_mixed_enable_disable():
+    m = MockManager(
+        chips=[
+            MockChip(family="v4", slice_topologies=["2x2x1"]),
+            MockChip(family="v4"),
+        ]
+    )
+    labels = labels_for(m, cfg_with_strategy("single"))
+    assert labels["google.com/tpu.product"] == "tpu-v4-SLICE-INVALID"
+    assert labels["google.com/tpu.count"] == "0"
+
+
+def test_single_invalid_multiple_topologies():
+    m = MockManager(
+        chips=[
+            MockChip(family="v4", slice_topologies=["2x2x1"]),
+            MockChip(family="v4", slice_topologies=["2x2x2"]),
+        ]
+    )
+    labels = labels_for(m, cfg_with_strategy("single"))
+    assert labels["google.com/tpu.product"] == "tpu-v4-SLICE-INVALID"
+
+
+def test_single_invalid_still_has_strategy_label():
+    m = MockManager(chips=[MockChip(family="v4", slice_enabled=True)])
+    labels = labels_for(m, cfg_with_strategy("single"))
+    assert labels["google.com/tpu.topology.strategy"] == "single"
+    assert labels["google.com/tpu.product"] == "tpu-v4-SLICE-INVALID"
+
+
+# ---------------------------------------------------------------------------
+# strategy = mixed
+# ---------------------------------------------------------------------------
+
+def test_mixed_per_topology_resources():
+    labels = labels_for(new_mixed_slice_manager("v5e"), cfg_with_strategy("mixed"))
+    assert labels["google.com/tpu.topology.strategy"] == "mixed"
+    # chips: 4 v5e chips; shapes 2x2 (x2 chips) and 2x4 (x2 chips)
+    assert labels["google.com/tpu-2x2.count"] == "2"
+    assert labels["google.com/tpu-2x2.product"] == "tpu-v5e-SLICE-2x2"
+    assert labels["google.com/tpu-2x2.chips"] == "4"
+    assert labels["google.com/tpu-2x4.count"] == "2"
+    assert labels["google.com/tpu-2x4.product"] == "tpu-v5e-SLICE-2x4"
+    assert labels["google.com/tpu-2x4.chips"] == "8"
+    # full-chip labels still present
+    assert labels["google.com/tpu.count"] == "4"
+
+
+def test_mixed_ignores_empty_slice_bound_chips():
+    m = MockManager(
+        chips=[
+            MockChip(family="v5e", slice_topologies=["2x2"]),
+            MockChip(family="v5e", slice_enabled=True),  # ignored under mixed
+        ]
+    )
+    labels = labels_for(m, cfg_with_strategy("mixed"))
+    assert labels["google.com/tpu-2x2.count"] == "1"
+    assert labels["google.com/tpu.product"] == "tpu-v5e"
+
+
+def test_mixed_sharing_targets_slice_resource():
+    labels = labels_for(
+        new_mixed_slice_manager("v5e", topologies=[["2x2"], ["2x2"]]),
+        cfg_with_strategy(
+            "mixed", sharing_replicas=2, sharing_name="google.com/tpu-2x2"
+        ),
+    )
+    assert labels["google.com/tpu-2x2.replicas"] == "2"
+    assert labels["google.com/tpu-2x2.product"] == "tpu-v5e-SLICE-2x2-SHARED"
+    # the full-chip resource is untouched by that sharing entry
+    assert labels["google.com/tpu.replicas"] == "0"
+
+
+def test_mixed_no_slices_at_all_just_strategy_label():
+    labels = labels_for(new_single_host_manager("v5e-8"), cfg_with_strategy("mixed"))
+    assert labels["google.com/tpu.topology.strategy"] == "mixed"
+    assert labels["google.com/tpu.count"] == "8"
+    assert not any(k.startswith("google.com/tpu-") for k in labels)
+
+
+# ---------------------------------------------------------------------------
+# multiple chip models
+# ---------------------------------------------------------------------------
+
+def test_multiple_models_warns_and_labels_both(caplog):
+    m = MockManager(chips=[MockChip(family="v4"), MockChip(family="v5p")])
+    with caplog.at_level("WARNING", logger="tfd.lm"):
+        labels = labels_for(m, cfg_with_strategy("none"))
+    assert any("Multiple chip models" in r.message for r in caplog.records)
+    # last-writer-wins across models: exactly one product survives
+    assert labels["google.com/tpu.product"] in ("tpu-v4", "tpu-v5p")
+    assert labels["google.com/tpu.count"] == "1"
