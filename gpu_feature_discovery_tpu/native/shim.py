@@ -1,0 +1,165 @@
+"""ctypes loader for the native C++ probe library + pure-Python fallbacks.
+
+The native layer mirrors the reference's cgo CUDA binding architecture
+(internal/cuda/api.go:24-56: dlopen ``libcuda.so.1`` with RTLD_LAZY |
+RTLD_GLOBAL, probe one symbol before first use, tolerate absence): our
+``libtfd_native.so`` (native/pjrt_shim.cc, native/pci_caps.cc) dlopens
+``libtpu.so`` lazily, probes the ``GetPjrtApi`` entry point, and reads the
+PJRT C API version straight off the returned struct header without creating
+a PJRT client — client creation would seize the TPU from the workload that
+owns it (SURVEY.md section 7 hard part #1).
+
+Everything here degrades cleanly: no built .so → filesystem-level libtpu
+probing; no libtpu → not-found results. The daemon must run on non-TPU
+nodes exactly like the reference binary runs without libcuda.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import glob
+import logging
+import os
+import sys
+from dataclasses import dataclass
+from typing import Optional
+
+log = logging.getLogger("tfd.native")
+
+NATIVE_LIB_NAME = "libtfd_native.so"
+
+# Search order for libtpu, mirroring the loader conventions of the TPU
+# stack: explicit flag/env first, then the pip-installed `libtpu` package,
+# then system paths.
+LIBTPU_ENV_VARS = ("TPU_LIBRARY_PATH", "PJRT_TPU_LIBRARY_PATH")
+LIBTPU_SYSTEM_PATHS = (
+    "/usr/lib/libtpu.so",
+    "/usr/local/lib/libtpu.so",
+    "/lib/libtpu.so",
+    "/usr/lib/x86_64-linux-gnu/libtpu.so",
+)
+
+
+@dataclass(frozen=True)
+class ProbeResult:
+    found: bool
+    source: str = ""       # how it was found ("env", "pip", "system", "flag")
+    path: str = ""
+    api_major: int = -1    # PJRT C API version when the native shim probed it
+    api_minor: int = -1
+
+
+def _candidate_paths(explicit: Optional[str]) -> list:
+    candidates = []
+    if explicit:
+        candidates.append(("flag", explicit))
+    for env in LIBTPU_ENV_VARS:
+        v = os.environ.get(env, "")
+        if v:
+            candidates.append(("env", v))
+    for site in sys.path:
+        if site and os.path.isdir(site):
+            hit = os.path.join(site, "libtpu", "libtpu.so")
+            if os.path.exists(hit):
+                candidates.append(("pip", hit))
+                break
+    for p in LIBTPU_SYSTEM_PATHS:
+        candidates.append(("system", p))
+    return candidates
+
+
+def probe_libtpu(explicit_path: Optional[str] = None) -> ProbeResult:
+    """Locate libtpu. Prefers the native shim's dlopen+symbol probe (the
+    cuda.Init Lookup("cuInit") analog); falls back to filesystem existence
+    when the native library is not built."""
+    shim = load_native()
+    for source, path in _candidate_paths(explicit_path):
+        if not os.path.exists(path):
+            continue
+        if shim is not None:
+            ok, major, minor = shim.probe(path)
+            if ok:
+                return ProbeResult(True, source, path, major, minor)
+            log.debug("libtpu at %s present but not loadable via native shim", path)
+            continue
+        return ProbeResult(True, source, path)
+    return ProbeResult(False)
+
+
+class NativeShim:
+    """Thin ctypes wrapper over libtfd_native.so's flat C ABI."""
+
+    def __init__(self, lib: ctypes.CDLL):
+        self._lib = lib
+        lib.tfd_probe_libtpu.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(ctypes.c_int),
+            ctypes.POINTER(ctypes.c_int),
+        ]
+        lib.tfd_probe_libtpu.restype = ctypes.c_int
+        lib.tfd_error_string.argtypes = [ctypes.c_int]
+        lib.tfd_error_string.restype = ctypes.c_char_p
+        lib.tfd_pci_vendor_capability.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        lib.tfd_pci_vendor_capability.restype = ctypes.c_int
+
+    def probe(self, libtpu_path: str):
+        """dlopen + GetPjrtApi probe; returns (ok, api_major, api_minor)."""
+        major = ctypes.c_int(-1)
+        minor = ctypes.c_int(-1)
+        rc = self._lib.tfd_probe_libtpu(
+            libtpu_path.encode(), ctypes.byref(major), ctypes.byref(minor)
+        )
+        return rc == 0, major.value, minor.value
+
+    def error_string(self, code: int) -> str:
+        return self._lib.tfd_error_string(code).decode()
+
+    def pci_vendor_capability(self, config: bytes) -> Optional[bytes]:
+        """C++ twin of PCIDevice.get_vendor_specific_capability."""
+        out = ctypes.create_string_buffer(256)
+        n = self._lib.tfd_pci_vendor_capability(config, len(config), out, len(out))
+        if n <= 0:
+            return None
+        return out.raw[:n]
+
+
+_native_cache: Optional[NativeShim] = None
+_native_probed = False
+
+
+def load_native() -> Optional[NativeShim]:
+    """Load libtfd_native.so from the package dir (built by ``make -C
+    gpu_feature_discovery_tpu/native``); None when absent or unloadable."""
+    global _native_cache, _native_probed
+    if _native_probed:
+        return _native_cache
+    _native_probed = True
+    for path in _native_lib_candidates():
+        try:
+            _native_cache = NativeShim(ctypes.CDLL(path))
+            log.debug("loaded native shim from %s", path)
+            return _native_cache
+        except (OSError, AttributeError) as e:
+            # AttributeError: a stale .so missing an expected symbol must
+            # degrade to the pure-Python fallback, not crash autodetect.
+            log.debug("native shim at %s not loadable: %s", path, e)
+    return None
+
+
+def _native_lib_candidates() -> list:
+    here = os.path.dirname(os.path.abspath(__file__))
+    return glob.glob(os.path.join(here, NATIVE_LIB_NAME)) + glob.glob(
+        os.path.join(here, "build", NATIVE_LIB_NAME)
+    )
+
+
+def reset_native_cache() -> None:
+    """Test hook: force re-probing after building the native library."""
+    global _native_cache, _native_probed
+    _native_cache = None
+    _native_probed = False

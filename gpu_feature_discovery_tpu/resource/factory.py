@@ -1,0 +1,120 @@
+"""Backend factory + platform autodetect.
+
+Reference: internal/resource/factory.go:27-73 — probe the platform, pick
+the manager, and wrap it with the fallback decorator unless
+--fail-on-init-error. The TPU probe chain (extended by the JAX/PJRT and
+native-shim backends) is:
+
+1. ``TFD_BACKEND`` env override — explicit backend selection; ``mock:<type>``
+   variants exist for integration tests on CPU-only machines (the reference
+   achieves the same with its mock-NVML container tests).
+2. libtpu present (native shim dlopen probe, or TPU chips on the PCI bus,
+   or a TPU VM metadata environment) → PJRT/JAX-backed manager.
+3. Otherwise → Null manager (non-TPU node: no labels).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Dict, Optional
+
+from gpu_feature_discovery_tpu.config.spec import Config
+from gpu_feature_discovery_tpu.resource.fallback import FallbackToNullOnInitError
+from gpu_feature_discovery_tpu.resource.null import NullManager
+from gpu_feature_discovery_tpu.resource.types import Manager
+
+log = logging.getLogger("tfd.resource")
+
+BACKEND_ENV = "TFD_BACKEND"
+
+
+def new_manager(config: Config) -> Manager:
+    """NewManager (factory.go:27-30)."""
+    return with_config(_get_manager(config), config)
+
+
+def with_config(manager: Manager, config: Config) -> Manager:
+    """WithConfig (factory.go:33-39)."""
+    if config.flags.fail_on_init_error:
+        return manager
+    return FallbackToNullOnInitError(manager)
+
+
+def _mock_backend(accel_type: str) -> Manager:
+    from gpu_feature_discovery_tpu.resource.testing import new_single_host_manager
+
+    return new_single_host_manager(accel_type)
+
+
+def _mock_slice_backend(accel_type: str) -> Manager:
+    from gpu_feature_discovery_tpu.resource.testing import new_uniform_slice_manager
+
+    return new_uniform_slice_manager(accel_type)
+
+
+def _get_manager(config: Config) -> Manager:
+    backend = os.environ.get(BACKEND_ENV, "auto").strip().lower()
+
+    if backend.startswith("mock:"):
+        accel = backend.split(":", 1)[1]
+        log.info("Using mock manager (%s)", accel)
+        return _mock_backend(accel)
+    if backend.startswith("mock-slice:"):
+        accel = backend.split(":", 1)[1]
+        log.info("Using mock uniform-slice manager (%s)", accel)
+        return _mock_slice_backend(accel)
+    if backend == "null":
+        log.info("Using null manager (forced)")
+        return NullManager()
+    if backend in ("jax", "pjrt"):
+        manager = _try_jax_manager(config)
+        if manager is None:
+            raise RuntimeError("TFD_BACKEND=jax requested but jax backend unavailable")
+        return manager
+
+    # auto detection
+    has_tpu, reason = _detect_tpu_platform(config)
+    log.info("Detected %sTPU platform: %s", "" if has_tpu else "non-", reason)
+    if has_tpu:
+        manager = _try_jax_manager(config)
+        if manager is not None:
+            log.info("Using PJRT (jax) manager")
+            return manager
+        log.warning("TPU detected but PJRT backend unavailable; using null manager")
+
+    log.warning("No valid resources detected; using empty manager.")
+    return NullManager()
+
+
+def _detect_tpu_platform(config: Config) -> tuple:
+    """hasNvml/isTegra probe analog (factory.go:54-57): native libtpu dlopen
+    probe, then TPU functions on the PCI bus, then a TPU VM environment."""
+    from gpu_feature_discovery_tpu.native.shim import probe_libtpu
+
+    probed = probe_libtpu(config.flags.libtpu_path or None)
+    if probed.found:
+        return True, f"libtpu loadable ({probed.source})"
+
+    try:
+        from gpu_feature_discovery_tpu.pci.pciutil import SysfsGooglePCI
+
+        if SysfsGooglePCI().devices():
+            return True, "Google PCI functions present on /sys/bus/pci"
+    except Exception:  # noqa: BLE001 - absence of sysfs is a non-TPU signal
+        pass
+
+    env = os.environ
+    if env.get("TPU_ACCELERATOR_TYPE") or env.get("TPU_WORKER_ID"):
+        return True, "TPU environment variables present"
+    return False, "no libtpu, no TPU PCI functions, no TPU environment"
+
+
+def _try_jax_manager(config: Config) -> Optional[Manager]:
+    try:
+        from gpu_feature_discovery_tpu.resource.jax_backend import JaxManager
+
+        return JaxManager(config)
+    except Exception as e:  # noqa: BLE001 - backend optional by design
+        log.warning("jax backend unavailable: %s", e)
+        return None

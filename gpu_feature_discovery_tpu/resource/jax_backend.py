@@ -1,0 +1,169 @@
+"""PJRT-backed device manager via JAX — the NVML-manager analog.
+
+Reference: internal/resource/nvml-lib.go:24-97 + nvml-device.go:26-88. On a
+TPU node the runtime stack is libtpu (the "driver") spoken through the PJRT
+C API; JAX is the canonical in-process PJRT client, so chip enumeration and
+attributes come from ``jax.devices("tpu")`` while version facts come from
+the libtpu distribution and the PJRT plugin.
+
+Lifecycle note (SURVEY.md section 7 hard part #1): creating a PJRT client
+grabs the TPU. Unlike NVML's cheap Init/Shutdown-per-cycle, this manager
+creates the client once on first init() and holds it for the process
+lifetime; shutdown() is a no-op by design. The daemon's labeling loop is
+therefore O(label math) per cycle rather than O(client creation) — this is
+how the <100ms p50 target is met (BASELINE.json).
+
+The per-generation ChipSpec tables back-fill attributes PJRT does not
+expose uniformly across v4/v5e/v5p ("riskiest unknown" (a), SURVEY.md
+section 7).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Optional, Tuple
+
+from gpu_feature_discovery_tpu.config.spec import Config
+from gpu_feature_discovery_tpu.models.chips import ChipSpec, spec_for
+from gpu_feature_discovery_tpu.resource.types import Chip, Manager, ResourceError
+
+log = logging.getLogger("tfd.resource")
+
+
+class JaxChip(Chip):
+    """One enumerated TPU chip (all TensorCores of one chip appear as one
+    PJRT device on the megacore generations; on v2/v3 each core is a PJRT
+    device — we merge per chip via (process_index, coords))."""
+
+    def __init__(self, device, spec: Optional[ChipSpec], memory_mb: int):
+        self._device = device
+        self._spec = spec
+        self._memory_mb = memory_mb
+
+    def is_slice_enabled(self) -> bool:
+        # PJRT exposes the chips the client owns; sub-slice partitioning is
+        # a provisioning-time concept surfaced through hostinfo/, not PJRT.
+        return False
+
+    def is_slice_capable(self) -> bool:
+        return self._spec.slice_capable if self._spec else False
+
+    def get_slices(self) -> List[Chip]:
+        return []
+
+    def get_attributes(self):
+        raise ResourceError("get_attributes only supported for slice partitions")
+
+    def get_name(self) -> str:
+        if self._spec:
+            return self._spec.product
+        # Unknown generation: normalize the PJRT device kind ("TPU v9" →
+        # "tpu-v9") so the product label stays well-formed.
+        return str(getattr(self._device, "device_kind", "tpu")).lower().replace(" ", "-")
+
+    def get_total_memory_mb(self) -> int:
+        return self._memory_mb
+
+    def get_parent_chip(self) -> Chip:
+        raise ResourceError("get_parent_chip only supported for slice partitions")
+
+    def get_generation(self) -> Tuple[int, int]:
+        if self._spec:
+            return (self._spec.generation, self._spec.variant_rank)
+        return (0, 0)
+
+
+class JaxManager(Manager):
+    def __init__(self, config: Config):
+        self._config = config
+        self._devices = None  # created once, held (see module docstring)
+
+    def init(self) -> None:
+        if self._devices is not None:
+            return
+        try:
+            import jax
+
+            # local_devices, not jax.devices(): labels are a per-NODE
+            # contract (like nvidia.com/gpu.count); on a multi-host slice
+            # jax.devices() would report slice-global chips.
+            self._devices = jax.local_devices(backend="tpu")
+        except Exception as e:  # noqa: BLE001 - backend init failures funnel
+            raise ResourceError(f"failed to initialize PJRT TPU client: {e}") from e
+        if not self._devices:
+            raise ResourceError("PJRT client reports no TPU devices")
+
+    def shutdown(self) -> None:
+        # Deliberate no-op: dropping the PJRT client mid-run would release
+        # and re-seize the TPU every cycle (nvml.Shutdown analog does not
+        # apply; see module docstring).
+        pass
+
+    def get_chips(self) -> List[Chip]:
+        if self._devices is None:
+            return []
+        chips: List[Chip] = []
+        seen = set()
+        for d in self._devices:
+            coords = tuple(getattr(d, "coords", ()) or ())
+            key = (getattr(d, "process_index", 0), coords or d.id)
+            if key in seen:
+                continue  # second TensorCore of the same chip (v2/v3)
+            seen.add(key)
+            spec = spec_for(str(getattr(d, "device_kind", "")))
+            chips.append(JaxChip(d, spec, _memory_mb(d, spec)))
+        return chips
+
+    def get_driver_version(self) -> str:
+        """libtpu distribution version — the driver-version analog."""
+        for dist in ("libtpu", "libtpu-nightly"):
+            try:
+                from importlib.metadata import version
+
+                return version(dist)
+            except Exception:  # noqa: BLE001
+                continue
+        try:
+            import jaxlib
+
+            return jaxlib.version.__version__
+        except Exception as e:  # noqa: BLE001
+            raise ResourceError(f"cannot determine libtpu version: {e}") from e
+
+    def get_runtime_version(self) -> Tuple[int, int]:
+        """PJRT C API version (major, minor) from the live backend, falling
+        back to the jaxlib (XLA runtime) version."""
+        try:
+            # jax.extend.backend is a submodule: it must be imported
+            # explicitly, `import jax` alone does not expose it.
+            import jax.extend.backend as jax_backend
+
+            backend = jax_backend.get_backend("tpu")
+            pv = str(getattr(backend, "platform_version", ""))
+            # e.g. "PJRT C API 0.51 (...)" — extract the first maj.min pair.
+            import re
+
+            m = re.search(r"(\d+)\.(\d+)", pv)
+            if m:
+                return (int(m.group(1)), int(m.group(2)))
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            import jaxlib
+
+            major, minor = jaxlib.version.__version__.split(".")[:2]
+            return (int(major), int(minor))
+        except Exception as e:  # noqa: BLE001
+            raise ResourceError(f"cannot determine PJRT runtime version: {e}") from e
+
+
+def _memory_mb(device, spec: Optional[ChipSpec]) -> int:
+    """Live HBM size when the runtime exposes it, else the spec table."""
+    try:
+        stats = device.memory_stats()
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if limit:
+            return int(limit) // (1024 * 1024)
+    except Exception:  # noqa: BLE001 - memory_stats unsupported on some kinds
+        pass
+    return spec.hbm_mb if spec else 0

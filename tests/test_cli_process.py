@@ -1,0 +1,141 @@
+"""Process-level CLI tests: the real binary surface driven the way the
+reference's integration harness drives the container
+(tests/integration-tests.py:19-33 — wait for the label file, regex-diff it,
+then observe daemon shutdown behavior)."""
+
+import os
+import re
+import signal
+import subprocess
+import sys
+import time
+from pathlib import Path
+
+REPO = Path(__file__).parent.parent
+
+
+def spawn(tmp_path, *args, backend="mock:v4-8", env_extra=None, **popen_kw):
+    # Scrub host-level TPU discovery signals: this sandbox may itself be a
+    # TPU host (ACCELERATOR_TYPE & co.), and the daemon would truthfully
+    # label it — goldens need a hermetic environment.
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not (
+            k.startswith(("TPU_", "TFD_"))
+            or k in ("ACCELERATOR_TYPE", "WORKER_ID", "AGENT_WORKER_NUMBER", "TOPOLOGY")
+        )
+    }
+    env["PYTHONPATH"] = str(REPO)
+    env["TFD_BACKEND"] = backend
+    env.update(env_extra or {})
+    return subprocess.Popen(
+        [sys.executable, "-m", "gpu_feature_discovery_tpu", *args],
+        env=env,
+        cwd=str(tmp_path),
+        stdout=subprocess.PIPE,
+        stderr=subprocess.PIPE,
+        **popen_kw,
+    )
+
+
+def wait_for_file(path, timeout=15.0):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if os.path.exists(path):
+            return True
+        time.sleep(0.05)
+    return False
+
+
+def test_cli_oneshot_writes_golden_parity_file(tmp_path):
+    out = tmp_path / "tfd"
+    proc = spawn(
+        tmp_path, "--oneshot", "--machine-type-file", "", "-o", str(out)
+    )
+    rc = proc.wait(timeout=60)
+    assert rc == 0, proc.stderr.read().decode()
+    golden = (REPO / "tests" / "expected-output.txt").read_text().splitlines()
+    lines = out.read_text().splitlines()
+    for line in lines:
+        assert any(re.fullmatch(g, line) for g in golden if g), f"unexpected: {line}"
+    assert len(lines) == len([g for g in golden if g])
+
+
+def test_cli_env_flag_aliases(tmp_path):
+    out = tmp_path / "tfd"
+    proc = spawn(
+        tmp_path,
+        "--machine-type-file", "",
+        "-o", str(out),
+        backend="mock-slice:v4-8",
+        env_extra={"TFD_ONESHOT": "true", "TPU_TOPOLOGY_STRATEGY": "single"},
+    )
+    assert proc.wait(timeout=60) == 0
+    content = out.read_text()
+    assert "google.com/tpu.topology.strategy=single" in content
+    assert "google.com/tpu.product=tpu-v4-SLICE-2x2x1" in content
+
+
+def test_cli_daemon_sigterm_removes_output(tmp_path):
+    out = tmp_path / "tfd"
+    proc = spawn(
+        tmp_path,
+        "--machine-type-file", "",
+        "-o", str(out),
+        "--sleep-interval", "60s",
+    )
+    try:
+        assert wait_for_file(out), proc.stderr.read().decode() if proc.poll() else "no file"
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0
+        assert not out.exists()
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_cli_sighup_reloads_and_keeps_running(tmp_path):
+    out = tmp_path / "tfd"
+    proc = spawn(
+        tmp_path,
+        "--machine-type-file", "",
+        "-o", str(out),
+        "--sleep-interval", "60s",
+    )
+    try:
+        assert wait_for_file(out)
+        first_stat = out.stat().st_mtime_ns
+        proc.send_signal(signal.SIGHUP)
+        # the reload loop must rewrite the file rather than exit
+        deadline = time.time() + 20
+        rewritten = False
+        while time.time() < deadline:
+            if proc.poll() is not None:
+                raise AssertionError(
+                    f"daemon exited on SIGHUP: {proc.stderr.read().decode()}"
+                )
+            if out.exists() and out.stat().st_mtime_ns != first_stat:
+                rewritten = True
+                break
+            time.sleep(0.05)
+        assert rewritten, "SIGHUP did not trigger a config reload + rewrite"
+    finally:
+        proc.send_signal(signal.SIGTERM)
+        try:
+            proc.wait(timeout=15)
+        except subprocess.TimeoutExpired:
+            proc.kill()
+
+
+def test_cli_bad_strategy_exits_nonzero(tmp_path):
+    proc = spawn(tmp_path, "--oneshot", "--tpu-topology-strategy", "bogus")
+    rc = proc.wait(timeout=60)
+    assert rc == 1
+    assert b"invalid tpu-topology-strategy" in proc.stderr.read()
+
+
+def test_cli_version_flag(tmp_path):
+    proc = spawn(tmp_path, "--version")
+    assert proc.wait(timeout=60) == 0
+    assert re.match(rb"\d+\.\d+\.\d+", proc.stdout.read().strip())

@@ -1,0 +1,209 @@
+"""Tier-2 binary-level tests: the real run() loop with mock backends,
+diffed against the golden regex files — the cmd/.../main_test.go analog
+(oneshot golden parity :91-135, TestRunSleep :184-271, no-timestamp,
+fail-on-init-error matrix :273-380, and mig_test.go's strategy goldens)."""
+
+import os
+import queue
+import re
+import signal
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from gpu_feature_discovery_tpu.cmd.main import run
+from gpu_feature_discovery_tpu.config import new_config
+from gpu_feature_discovery_tpu.hostinfo import StaticProvider, parse_tpu_env
+from gpu_feature_discovery_tpu.hostinfo.tpu_env import host_info_from_mapping
+from gpu_feature_discovery_tpu.lm.interconnect import InterconnectLabeler
+from gpu_feature_discovery_tpu.lm.labeler import Empty
+from gpu_feature_discovery_tpu.pci import MockGooglePCI
+from gpu_feature_discovery_tpu.resource import factory
+from gpu_feature_discovery_tpu.resource.testing import (
+    MockManager,
+    new_mixed_slice_manager,
+    new_single_host_manager,
+    new_uniform_slice_manager,
+)
+from gpu_feature_discovery_tpu.resource.types import ResourceError
+
+GOLDEN_DIR = Path(__file__).parent
+
+TPU_ENV = """\
+ACCELERATOR_TYPE: 'v5p-64'
+TPU_PROCESS_BOUNDS: '2,2,2'
+TPU_CHIPS_PER_PROCESS_BOUNDS: '2,2,1'
+TPU_TOPOLOGY_WRAP: 'true,true,true'
+WORKER_ID: '0'
+"""
+
+
+def check_result(output_path, golden_name):
+    """Bidirectional regex diff (main_test.go:403-435 + the stricter
+    integration-tests.py:19-33 behavior): every output line must match some
+    golden regex AND every golden regex must match some output line."""
+    golden = [
+        l for l in (GOLDEN_DIR / golden_name).read_text().splitlines() if l.strip()
+    ]
+    actual = [l for l in Path(output_path).read_text().splitlines() if l.strip()]
+
+    patterns = [re.compile(f"^{g}$") for g in golden]
+    unmatched_lines = [
+        line for line in actual if not any(p.match(line) for p in patterns)
+    ]
+    unmatched_patterns = [
+        g for g, p in zip(golden, patterns) if not any(p.match(line) for line in actual)
+    ]
+    assert not unmatched_lines, f"unexpected label lines: {unmatched_lines}"
+    assert not unmatched_patterns, f"labels missing for: {unmatched_patterns}"
+
+
+def cfg_for(tmp_path, strategy="none", oneshot=True, **cli):
+    machine = tmp_path / "machine-type"
+    machine.write_text("Google Compute Engine\n")
+    values = {
+        "tpu-topology-strategy": strategy,
+        "oneshot": oneshot,
+        "machine-type-file": str(machine),
+        "output-file": str(tmp_path / "tfd"),
+    }
+    values.update(cli)
+    return new_config(cli_values=values, environ={})
+
+
+def run_oneshot(manager, config, interconnect=None):
+    sigs = queue.Queue()
+    restart = run(manager, interconnect or Empty(), config, sigs)
+    assert restart is False
+    return config.flags.tfd.output_file
+
+
+# ---------------------------------------------------------------------------
+# golden-file parity (BASELINE.json configs 1-4)
+# ---------------------------------------------------------------------------
+
+def test_oneshot_base_golden(tmp_path):
+    out = run_oneshot(new_single_host_manager("v4-8"), cfg_for(tmp_path))
+    check_result(out, "expected-output.txt")
+
+
+def test_oneshot_topology_none_golden(tmp_path):
+    out = run_oneshot(new_single_host_manager("v5e-8"), cfg_for(tmp_path, "none"))
+    check_result(out, "expected-output-topology-none.txt")
+
+
+def test_oneshot_topology_single_golden(tmp_path):
+    out = run_oneshot(
+        new_uniform_slice_manager("v4-8"), cfg_for(tmp_path, "single")
+    )
+    check_result(out, "expected-output-topology-single.txt")
+
+
+def test_oneshot_topology_mixed_golden(tmp_path):
+    out = run_oneshot(new_mixed_slice_manager("v5e"), cfg_for(tmp_path, "mixed"))
+    check_result(out, "expected-output-topology-mixed.txt")
+
+
+def test_oneshot_interconnect_golden(tmp_path):
+    info = host_info_from_mapping(parse_tpu_env(TPU_ENV))
+    interconnect = InterconnectLabeler(
+        pci=MockGooglePCI(), provider=StaticProvider(info)
+    )
+    out = run_oneshot(
+        new_single_host_manager("v5p-8"), cfg_for(tmp_path), interconnect
+    )
+    check_result(out, "expected-output-interconnect.txt")
+
+
+# ---------------------------------------------------------------------------
+# loop / signal semantics
+# ---------------------------------------------------------------------------
+
+def test_run_sleep_rewrites_and_sigterm_cleans_up(tmp_path):
+    config = cfg_for(tmp_path, oneshot=False, **{"sleep-interval": "0.05s"})
+    out = config.flags.tfd.output_file
+    sigs = queue.Queue()
+    result = {}
+
+    def target():
+        result["restart"] = run(new_single_host_manager("v4-8"), Empty(), config, sigs)
+
+    t = threading.Thread(target=target)
+    t.start()
+    deadline = time.time() + 5
+    mtimes = set()
+    while time.time() < deadline and len(mtimes) < 2:
+        if os.path.exists(out):
+            mtimes.add(os.stat(out).st_mtime_ns)
+        time.sleep(0.01)
+    assert len(mtimes) >= 2, "label file was not rewritten on the sleep interval"
+
+    sigs.put(signal.SIGTERM)
+    t.join(timeout=5)
+    assert not t.is_alive()
+    assert result["restart"] is False
+    assert not os.path.exists(out), "daemon exit must remove the output file"
+    assert not os.path.exists(tmp_path / "tfd-tmp")
+
+
+def test_sighup_requests_restart_and_cleans_file(tmp_path):
+    config = cfg_for(tmp_path, oneshot=False, **{"sleep-interval": "10s"})
+    sigs = queue.Queue()
+    sigs.put(signal.SIGHUP)
+    restart = run(new_single_host_manager("v4-8"), Empty(), config, sigs)
+    assert restart is True
+    # restart also removes the file; the next run() pass rewrites it
+    assert not os.path.exists(config.flags.tfd.output_file)
+
+
+def test_oneshot_leaves_output_file(tmp_path):
+    out = run_oneshot(new_single_host_manager("v4-8"), cfg_for(tmp_path))
+    assert os.path.exists(out)
+
+
+def test_no_timestamp(tmp_path):
+    config = cfg_for(tmp_path, **{"no-timestamp": True})
+    out = run_oneshot(new_single_host_manager("v4-8"), config)
+    content = Path(out).read_text()
+    assert "tfd.timestamp" not in content
+    assert "google.com/tpu.count=4" in content
+
+
+def test_empty_manager_warns_but_writes(tmp_path, caplog):
+    config = cfg_for(tmp_path)
+    with caplog.at_level("WARNING", logger="tfd"):
+        out = run_oneshot(MockManager(), config)
+    assert any("no labels generated" in r.message for r in caplog.records)
+    # only the timestamp label survives
+    lines = Path(out).read_text().splitlines()
+    assert len(lines) == 1 and lines[0].startswith("google.com/tfd.timestamp=")
+
+
+# ---------------------------------------------------------------------------
+# fail-on-init-error matrix (main_test.go:273-380 analog)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("strategy", ["none", "single", "mixed"])
+@pytest.mark.parametrize("fail_on_init", [True, False])
+def test_fail_on_init_error_matrix(tmp_path, strategy, fail_on_init):
+    config = cfg_for(tmp_path, strategy, **{"fail-on-init-error": fail_on_init})
+    broken = MockManager(init_error=ResourceError("libtpu held busy"))
+    manager = factory.with_config(broken, config)
+
+    if fail_on_init:
+        with pytest.raises(ResourceError):
+            run_oneshot(manager, config)
+    else:
+        out = run_oneshot(manager, config)
+        lines = Path(out).read_text().splitlines()
+        assert len(lines) == 1 and lines[0].startswith("google.com/tfd.timestamp=")
+
+
+@pytest.mark.parametrize("strategy", ["none", "single", "mixed"])
+def test_healthy_manager_ignores_fail_flag(tmp_path, strategy):
+    config = cfg_for(tmp_path, strategy, **{"fail-on-init-error": False})
+    manager = factory.with_config(new_uniform_slice_manager("v4-8"), config)
+    out = run_oneshot(manager, config)
+    assert "google.com/tpu.count" in Path(out).read_text()

@@ -1,0 +1,172 @@
+"""Tier-1 tests for tpu-env parsing, HostInfo derivation, provider chain,
+PCI scanning/capability walking, and the interconnect labeler — the
+internal/vgpu test-suite analog (vgpu_test.go + pciutil_test.go)."""
+
+import pytest
+
+from gpu_feature_discovery_tpu.hostinfo import (
+    EnvMetadataProvider,
+    StaticProvider,
+    parse_tpu_env,
+)
+from gpu_feature_discovery_tpu.hostinfo.tpu_env import HostInfo, host_info_from_mapping
+from gpu_feature_discovery_tpu.lm.interconnect import InterconnectLabeler
+from gpu_feature_discovery_tpu.pci import MockGooglePCI, PCIDevice, SysfsGooglePCI
+from gpu_feature_discovery_tpu.pci.pciutil import (
+    PCIError,
+    build_config_space,
+    make_capability,
+)
+
+TPU_ENV_V5P_64 = """\
+ACCELERATOR_TYPE: 'v5p-64'
+CHIPS_PER_HOST_BOUNDS: '2,2,1'
+TPU_PROCESS_BOUNDS: '2,2,2'
+TPU_CHIPS_PER_PROCESS_BOUNDS: '2,2,1'
+TPU_TOPOLOGY_WRAP: 'true,false,true'
+WORKER_ID: '3'
+ZONE: 'us-east5-a'
+not a valid line
+"""
+
+
+# ---------------------------------------------------------------------------
+# tpu-env parsing
+# ---------------------------------------------------------------------------
+
+def test_parse_tpu_env_strips_quotes_and_skips_junk():
+    kv = parse_tpu_env(TPU_ENV_V5P_64)
+    assert kv["ACCELERATOR_TYPE"] == "v5p-64"
+    assert kv["WORKER_ID"] == "3"
+    assert "not a valid line" not in kv
+
+
+def test_host_info_from_tpu_env():
+    info = host_info_from_mapping(parse_tpu_env(TPU_ENV_V5P_64))
+    assert info.accelerator_type == "v5p-64"
+    assert info.worker_id == 3
+    assert info.worker_count == 8          # 2*2*2 process bounds
+    assert info.topology == "4x4x2"        # process bounds x chips/process
+    assert info.wrap == (True, False, True)
+    assert info.multi_host
+
+
+def test_host_info_from_gke_env_vars():
+    env = {
+        "TPU_ACCELERATOR_TYPE": "v5litepod-16",
+        "TPU_WORKER_ID": "1",
+        "TPU_WORKER_HOSTNAMES": "host-0,host-1,host-2,host-3",
+        "TPU_TOPOLOGY": "4x4",
+    }
+    info = host_info_from_mapping(env)
+    assert info.accelerator_type == "v5litepod-16"
+    assert info.worker_count == 4
+    assert info.topology == "4x4"
+    assert info.multi_host
+
+
+def test_host_info_falls_back_to_accelerator_type_tables():
+    info = HostInfo(accelerator_type="v4-16")
+    assert info.resolved_worker_count() == 2
+    assert info.resolved_topology() == "2x2x2"
+    assert info.multi_host
+
+
+def test_single_host_is_not_multihost():
+    info = HostInfo(accelerator_type="v4-8")
+    assert not info.multi_host
+
+
+def test_env_provider_none_when_no_tpu_vars():
+    assert EnvMetadataProvider({"PATH": "/bin"}).host_info() is None
+
+
+# ---------------------------------------------------------------------------
+# PCI scanning + capability walking
+# ---------------------------------------------------------------------------
+
+def test_capability_walk_finds_vendor_specific():
+    [with_cap, without_cap] = MockGooglePCI().devices()
+    cap = with_cap.get_vendor_specific_capability()
+    assert cap is not None
+    assert cap[0] == 0x09
+    assert b"TPUICI" in cap
+    assert without_cap.get_vendor_specific_capability() is None
+
+
+def test_capability_walk_requires_full_config_space():
+    dev = PCIDevice(path="", address="x", vendor="0x1ae0", device_class="0x0880",
+                    config=b"\x00" * 64)
+    with pytest.raises(PCIError, match="privileged"):
+        dev.get_vendor_specific_capability()
+
+
+def test_capability_walk_breaks_on_loop():
+    cfg = bytearray(build_config_space(capabilities=[make_capability(0x01, b"\x00")]))
+    cfg[0x41] = 0x40  # next pointer loops back to itself
+    dev = PCIDevice(path="", address="loop", vendor="0x1ae0",
+                    device_class="0x0880", config=bytes(cfg))
+    assert dev.get_vendor_specific_capability() is None
+
+
+def test_capability_walk_breaks_on_0xff():
+    cfg = bytearray(build_config_space(capabilities=[make_capability(0xFF, b"\x00")]))
+    dev = PCIDevice(path="", address="broken", vendor="0x1ae0",
+                    device_class="0x0880", config=bytes(cfg))
+    assert dev.get_vendor_specific_capability() is None
+
+
+def test_sysfs_scanner_filters_vendor(tmp_path):
+    for addr, vendor in [("0000:00:04.0", "0x1ae0"), ("0000:00:05.0", "0x8086")]:
+        d = tmp_path / addr
+        d.mkdir()
+        (d / "vendor").write_text(vendor + "\n")
+        (d / "class").write_text("0x088000\n")
+        (d / "config").write_bytes(build_config_space())
+    devices = SysfsGooglePCI(root=str(tmp_path)).devices()
+    assert [d.address for d in devices] == ["0000:00:04.0"]
+    assert devices[0].device_class == "0x0880"
+
+
+def test_sysfs_scanner_missing_root_raises():
+    with pytest.raises(PCIError, match="unable to read PCI bus devices"):
+        SysfsGooglePCI(root="/nonexistent/pci").devices()
+
+
+# ---------------------------------------------------------------------------
+# interconnect labeler
+# ---------------------------------------------------------------------------
+
+def test_interconnect_empty_with_no_sources():
+    assert InterconnectLabeler().labels() == {}
+
+
+def test_interconnect_pci_presence():
+    labels = InterconnectLabeler(pci=MockGooglePCI()).labels()
+    assert labels["google.com/tpu.pci.present"] == "true"
+    assert labels["google.com/tpu.pci.count"] == "2"
+
+
+def test_interconnect_multihost_labels():
+    info = host_info_from_mapping(parse_tpu_env(TPU_ENV_V5P_64))
+    info.raw["MACHINE_TYPE"] = "ct5p-hightpu-4t"
+    labels = InterconnectLabeler(provider=StaticProvider(info)).labels()
+    assert labels["google.com/tpu.slice.accelerator-type"] == "v5p-64"
+    assert labels["google.com/tpu.slice.topology"] == "4x4x2"
+    assert labels["google.com/tpu.multihost.present"] == "true"
+    assert labels["google.com/tpu.multihost.worker-id"] == "3"
+    assert labels["google.com/tpu.multihost.worker-count"] == "8"
+    assert labels["google.com/tpu.multihost.chips-per-host"] == "2x2x1"
+    assert labels["google.com/tpu.ici.wrap.x"] == "true"
+    assert labels["google.com/tpu.ici.wrap.y"] == "false"
+    assert labels["google.com/tpu.ici.wrap.z"] == "true"
+    assert labels["google.com/tpu.machine"] == "ct5p-hightpu-4t"
+
+
+def test_interconnect_single_host_minimal():
+    labels = InterconnectLabeler(
+        provider=StaticProvider(HostInfo(accelerator_type="v4-8"))
+    ).labels()
+    assert labels["google.com/tpu.multihost.present"] == "false"
+    assert labels["google.com/tpu.slice.topology"] == "2x2x1"
+    assert "google.com/tpu.multihost.worker-id" not in labels
