@@ -9,9 +9,15 @@ logic is exercised without chips. Env vars must be set before jax imports.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
-
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Force CPU even when the session env points JAX at real hardware (e.g.
+# JAX_PLATFORMS=axon tunneling to a TPU chip). jax may already be imported
+# by the image's sitecustomize, so the pin goes through jax.config; tiers
+# that don't need jax still run where jax isn't installed.
+try:
+    from gpu_feature_discovery_tpu.utils.jaxenv import pin_virtual_cpu_devices
+
+    pin_virtual_cpu_devices(8)
+except ImportError:  # pragma: no cover - jax-free environment
+    pass
