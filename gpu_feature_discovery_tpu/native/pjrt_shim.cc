@@ -1,0 +1,95 @@
+/* libtpu/PJRT probe: dlopen + GetPjrtApi version read, no client creation.
+ *
+ * The reference's native binding dlopens libcuda.so.1 lazily and probes
+ * cuInit before first use (internal/cuda/api.go:24-56). The TPU analog
+ * probes GetPjrtApi — the single well-known entry point every PJRT plugin
+ * (libtpu included) must export — and reads the API version straight off
+ * the returned struct header. Creating a PJRT client here would grab the
+ * TPU from the workload that owns it (SURVEY.md section 7 hard part #1),
+ * so the probe stops at the version struct.
+ */
+
+#include "tfd_native.h"
+
+#include <dlfcn.h>
+
+namespace {
+
+/* Minimal inline mirror of the PJRT C API header layout (the reference
+ * declares CUDA types inline the same way, cuda.go:26-101). The version
+ * fields live in a fixed-offset prefix that is ABI-stable by design:
+ * PJRT_Api begins {size_t struct_size; void* extension_start;
+ * PJRT_Api_Version pjrt_api_version;} and PJRT_Api_Version begins
+ * {size_t struct_size; void* extension_start; int major; int minor;}. */
+struct PjrtApiVersionPrefix {
+  size_t struct_size;
+  void* extension_start;
+  int major_version;
+  int minor_version;
+};
+
+struct PjrtApiPrefix {
+  size_t struct_size;
+  void* extension_start;
+  PjrtApiVersionPrefix version;
+};
+
+typedef const PjrtApiPrefix* (*GetPjrtApiFn)();
+
+}  // namespace
+
+extern "C" int tfd_probe_libtpu(const char* path, int* api_major,
+                                int* api_minor) {
+  if (path == nullptr || api_major == nullptr || api_minor == nullptr) {
+    return TFD_ERROR_INVALID_ARGUMENT;
+  }
+  *api_major = -1;
+  *api_minor = -1;
+
+  /* RTLD_LOCAL: a probe must not pollute the global symbol table the way
+   * the long-lived reference handle does (RTLD_GLOBAL, api.go:35) — the
+   * daemon's actual device work goes through PJRT in-process separately. */
+  void* handle = dlopen(path, RTLD_LAZY | RTLD_LOCAL);
+  if (handle == nullptr) {
+    return TFD_ERROR_LIB_NOT_FOUND;
+  }
+
+  GetPjrtApiFn get_api =
+      reinterpret_cast<GetPjrtApiFn>(dlsym(handle, "GetPjrtApi"));
+  if (get_api == nullptr) {
+    dlclose(handle);
+    return TFD_ERROR_SYMBOL_NOT_FOUND;
+  }
+
+  const PjrtApiPrefix* api = get_api();
+  if (api == nullptr) {
+    dlclose(handle);
+    return TFD_ERROR_NULL_API;
+  }
+
+  *api_major = api->version.major_version;
+  *api_minor = api->version.minor_version;
+  dlclose(handle);
+  return TFD_SUCCESS;
+}
+
+extern "C" const char* tfd_error_string(int code) {
+  switch (code) {
+    case TFD_SUCCESS:
+      return "TFD_SUCCESS";
+    case TFD_ERROR_INVALID_ARGUMENT:
+      return "TFD_ERROR_INVALID_ARGUMENT";
+    case TFD_ERROR_LIB_NOT_FOUND:
+      return "TFD_ERROR_LIB_NOT_FOUND";
+    case TFD_ERROR_SYMBOL_NOT_FOUND:
+      return "TFD_ERROR_SYMBOL_NOT_FOUND";
+    case TFD_ERROR_NULL_API:
+      return "TFD_ERROR_NULL_API";
+    case TFD_ERROR_CONFIG_TOO_SHORT:
+      return "TFD_ERROR_CONFIG_TOO_SHORT";
+    case TFD_ERROR_BUFFER_TOO_SMALL:
+      return "TFD_ERROR_BUFFER_TOO_SMALL";
+    default:
+      return "TFD_ERROR_UNKNOWN";
+  }
+}

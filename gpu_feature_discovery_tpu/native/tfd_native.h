@@ -1,0 +1,51 @@
+/* Flat C ABI of libtfd_native.so, consumed by native/shim.py via ctypes.
+ *
+ * TPU re-design of the reference's cgo CUDA binding (internal/cuda/
+ * cuda.go:22-110): the needed foreign types are declared inline here — no
+ * TPU SDK headers required to build — and the TPU library itself is only
+ * ever dlopen'd at runtime, so this .so builds and loads on machines with
+ * no libtpu at all (the -Wl,--unresolved-symbols trick is unnecessary
+ * because nothing links against libtpu).
+ */
+#ifndef TFD_NATIVE_H_
+#define TFD_NATIVE_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Result codes (CUresult/consts.go:19-86 analog). Keep in sync with
+ * tfd_error_string(). */
+typedef enum {
+  TFD_SUCCESS = 0,
+  TFD_ERROR_INVALID_ARGUMENT = 1,
+  TFD_ERROR_LIB_NOT_FOUND = 2,     /* dlopen failed */
+  TFD_ERROR_SYMBOL_NOT_FOUND = 3,  /* GetPjrtApi missing (not a PJRT lib) */
+  TFD_ERROR_NULL_API = 4,          /* GetPjrtApi returned NULL */
+  TFD_ERROR_CONFIG_TOO_SHORT = 5,  /* PCI config space < 256 bytes */
+  TFD_ERROR_BUFFER_TOO_SMALL = 6,  /* output buffer cannot hold the record */
+} tfd_result_t;
+
+/* dlopen(path) + GetPjrtApi() probe; writes the PJRT C API version into
+ * *api_major / *api_minor on success. Never creates a PJRT client — the
+ * probe must not seize the TPU from the workload that owns it. */
+int tfd_probe_libtpu(const char* path, int* api_major, int* api_minor);
+
+/* Human-readable name for a tfd_result_t (cuda/result.go analog). */
+const char* tfd_error_string(int code);
+
+/* Walk the PCI capability linked list of a 256-byte config space and copy
+ * the vendor-specific (id 0x09) record into out. Returns the record length
+ * (> 0), 0 when no vendor-specific capability exists, or a negative
+ * tfd_result_t on error. C++ twin of PCIDevice.get_vendor_specific_capability
+ * (pci/pciutil.py), itself a re-design of pciutil.go:115-151. */
+int tfd_pci_vendor_capability(const char* config, size_t config_len,
+                              char* out, size_t out_len);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TFD_NATIVE_H_ */

@@ -66,12 +66,17 @@ class PCIDevice:
                 break  # corrupt pointer past the config space
             cap_id = self.config[pos + PCI_CAPABILITY_LIST_ID]
             nxt = self.config[pos + PCI_CAPABILITY_LIST_NEXT]
-            length = self.config[pos + PCI_CAPABILITY_LENGTH]
             if pos in visited:  # chain looped
                 break
             if cap_id == 0xFF:  # chain broken
                 break
             if cap_id == PCI_CAPABILITY_VENDOR_SPECIFIC_ID:
+                # Byte 2 is a length field only for vendor-specific caps
+                # (for standard caps it is capability data), so it is read
+                # and validated only here.
+                length = self.config[pos + PCI_CAPABILITY_LENGTH]
+                if length < 3:  # record shorter than its own header: corrupt
+                    break
                 return self.config[pos : pos + length]
             visited.add(pos)
             pos = nxt

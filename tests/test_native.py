@@ -1,0 +1,161 @@
+"""Native C++ layer: build + exercise via the ctypes loader.
+
+The reference tests its binary format walker against golden config-space
+blobs (internal/vgpu/pciutil_test.go) and relies on the dlopen trick for
+the cgo binding; these tests compile the real .so, a *fake libtpu* that
+exports GetPjrtApi with a known version (the mock-NVML analog at the
+native level), and cross-check the C++ capability walker against the
+pure-Python one on the same synthesized blobs.
+"""
+
+import ctypes
+import os
+import shutil
+import subprocess
+import textwrap
+
+import pytest
+
+from gpu_feature_discovery_tpu.native import shim
+from gpu_feature_discovery_tpu.pci.pciutil import (
+    PCI_CAPABILITY_VENDOR_SPECIFIC_ID,
+    build_config_space,
+    default_mock_devices,
+    make_capability,
+)
+
+NATIVE_DIR = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "gpu_feature_discovery_tpu",
+    "native",
+)
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("make") is None,
+    reason="native toolchain unavailable",
+)
+
+
+@pytest.fixture(scope="module")
+def native():
+    subprocess.run(["make", "-C", NATIVE_DIR], check=True, capture_output=True)
+    shim.reset_native_cache()
+    lib = shim.load_native()
+    assert lib is not None, "built libtfd_native.so but loader did not find it"
+    yield lib
+    shim.reset_native_cache()
+
+
+@pytest.fixture(scope="module")
+def fake_libtpu(native, tmp_path_factory):
+    """A .so exporting GetPjrtApi with PJRT API version 0.42 — enough of
+    the real struct prefix for the probe, nothing else."""
+    d = tmp_path_factory.mktemp("fake-libtpu")
+    src = d / "fake_libtpu.c"
+    src.write_text(
+        textwrap.dedent(
+            """
+            #include <stddef.h>
+            struct Version { size_t sz; void* ext; int major; int minor; };
+            struct Api { size_t sz; void* ext; struct Version v; };
+            static struct Api api = {sizeof(struct Api), 0,
+                                     {sizeof(struct Version), 0, 0, 42}};
+            extern "C" const struct Api* GetPjrtApi(void) { return &api; }
+            """
+        )
+    )
+    out = d / "libtpu.so"
+    subprocess.run(
+        ["g++", "-shared", "-fPIC", "-o", str(out), str(src)],
+        check=True,
+        capture_output=True,
+    )
+    return str(out)
+
+
+def test_probe_fake_libtpu(native, fake_libtpu):
+    ok, major, minor = native.probe(fake_libtpu)
+    assert (ok, major, minor) == (True, 0, 42)
+
+
+def test_probe_missing_file(native):
+    ok, major, minor = native.probe("/nonexistent/libtpu.so")
+    assert not ok
+    assert (major, minor) == (-1, -1)
+
+
+def test_probe_so_without_getpjrtapi(native):
+    # libtfd_native.so itself is a valid .so with no GetPjrtApi export.
+    ok, _, _ = native.probe(os.path.join(NATIVE_DIR, shim.NATIVE_LIB_NAME))
+    assert not ok
+
+
+def test_error_strings(native):
+    assert native.error_string(0) == "TFD_SUCCESS"
+    assert native.error_string(2) == "TFD_ERROR_LIB_NOT_FOUND"
+    assert native.error_string(99) == "TFD_ERROR_UNKNOWN"
+
+
+def test_pci_walker_matches_python(native):
+    """C++ and Python walkers agree on every synthesized blob."""
+    for dev in default_mock_devices():
+        assert native.pci_vendor_capability(dev.config) == (
+            dev.get_vendor_specific_capability()
+        )
+
+
+def test_pci_walker_finds_second_capability(native):
+    cfg = build_config_space(
+        capabilities=[
+            make_capability(0x01, b"\x00\x00"),
+            make_capability(PCI_CAPABILITY_VENDOR_SPECIFIC_ID, b"HELLO"),
+        ]
+    )
+    cap = native.pci_vendor_capability(cfg)
+    assert cap is not None
+    assert cap[0] == PCI_CAPABILITY_VENDOR_SPECIFIC_ID
+    assert cap.endswith(b"HELLO")
+
+
+def test_pci_walker_corrupt_zero_length_cap(native):
+    """A capability record shorter than its own header is corrupt: both
+    walkers must agree on 'absent'."""
+    cfg = bytearray(
+        build_config_space(
+            capabilities=[make_capability(PCI_CAPABILITY_VENDOR_SPECIFIC_ID, b"X")]
+        )
+    )
+    cfg[0x42] = 0  # length byte < 3-byte header
+    from gpu_feature_discovery_tpu.pci.pciutil import PCIDevice
+
+    dev = PCIDevice(path="", address="0000:00:04.0", vendor="0x1ae0",
+                    device_class="0x0880", config=bytes(cfg))
+    assert dev.get_vendor_specific_capability() is None
+    assert native.pci_vendor_capability(bytes(cfg)) is None
+
+
+def test_pci_walker_short_config(native):
+    assert native.pci_vendor_capability(b"\x00" * 64) is None
+
+
+def test_pci_walker_looped_chain(native):
+    """A self-pointing capability must terminate, not spin."""
+    cfg = bytearray(build_config_space(capabilities=[make_capability(0x01, b"")]))
+    cfg[0x41] = 0x40  # next pointer loops back to itself
+    assert native.pci_vendor_capability(bytes(cfg)) is None
+
+
+def test_probe_libtpu_uses_env_path(native, fake_libtpu, monkeypatch):
+    monkeypatch.setenv("TPU_LIBRARY_PATH", fake_libtpu)
+    result = shim.probe_libtpu()
+    assert result.found
+    assert result.source == "env"
+    assert (result.api_major, result.api_minor) == (0, 42)
+
+
+def test_probe_libtpu_not_found(native, monkeypatch, tmp_path):
+    for env in shim.LIBTPU_ENV_VARS:
+        monkeypatch.delenv(env, raising=False)
+    monkeypatch.setattr(shim, "LIBTPU_SYSTEM_PATHS", ())
+    monkeypatch.setattr("sys.path", [str(tmp_path)])
+    assert not shim.probe_libtpu().found
