@@ -148,6 +148,15 @@ FLAG_DEFS: List[FlagDef] = [
         aliases=("output", "o"),
     ),
     FlagDef(
+        name="with-burnin",
+        env_vars=("TFD_WITH_BURNIN",),
+        parse=_parse_bool,
+        default=False,
+        help="run a short on-chip burn-in each cycle and emit tpu.health.* labels (TPU extension)",
+        setter=lambda c, v: setattr(_f(c).tfd, "with_burnin", v),
+        getter=lambda c: _f(c).tfd.with_burnin,
+    ),
+    FlagDef(
         name="machine-type-file",
         env_vars=("TFD_MACHINE_TYPE_FILE",),
         parse=str,

@@ -102,6 +102,7 @@ class TfdFlags:
     sleep_interval: Optional[float] = None  # seconds
     output_file: Optional[str] = None
     machine_type_file: Optional[str] = None
+    with_burnin: Optional[bool] = None  # TPU extension: on-chip health labels
 
 
 @dataclass
@@ -136,6 +137,7 @@ class Config:
                     "sleepInterval": self.flags.tfd.sleep_interval,
                     "outputFile": self.flags.tfd.output_file,
                     "machineTypeFile": self.flags.tfd.machine_type_file,
+                    "withBurnin": self.flags.tfd.with_burnin,
                 },
             },
             "sharing": {
@@ -202,6 +204,7 @@ def parse_config_file(path: str) -> Config:
         config.flags.tfd.sleep_interval = parse_duration(tfd["sleepInterval"])
     config.flags.tfd.output_file = _opt_str(tfd.get("outputFile"))
     config.flags.tfd.machine_type_file = _opt_str(tfd.get("machineTypeFile"))
+    config.flags.tfd.with_burnin = _opt_bool(tfd.get("withBurnin"))
 
     config.resources = raw.get("resources", {}) or {}
     config.sharing = Sharing.from_dict(raw.get("sharing", {}) or {})

@@ -1,0 +1,55 @@
+"""On-chip burn-in health labeler (TPU extension, gated by --with-burnin).
+
+No reference counterpart — GFD never computes on the GPU. On TPU, "the
+chip enumerates" and "the chip computes at speed" are different facts:
+a chip can appear via PJRT yet have degraded HBM or a wedged MXU. When
+enabled, each labeling cycle runs the short MXU burn-in on every local
+chip (ops/healthcheck.py measure_node_health) and publishes:
+
+    google.com/tpu.health.ok            = true|false   (all chips finite)
+    google.com/tpu.health.matmul-tflops = <int>        (worst chip's rate)
+
+Off by default because it occupies the chip for ~tens of ms and must never
+contend with a workload that owns the TPU (same reasoning that keeps the
+factory probe from creating a PJRT client, SURVEY.md section 7 hard part #1).
+"""
+
+from __future__ import annotations
+
+import logging
+
+from gpu_feature_discovery_tpu.config.spec import Config
+from gpu_feature_discovery_tpu.lm.labeler import Empty, Labeler
+from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.resource.types import Manager
+
+log = logging.getLogger("tfd.lm")
+
+HEALTH_OK = "google.com/tpu.health.ok"
+HEALTH_TFLOPS = "google.com/tpu.health.matmul-tflops"
+
+
+def new_health_labeler(manager: Manager, config: Config) -> Labeler:
+    """Empty unless --with-burnin and the node actually has chips."""
+    if not config.flags.tfd.with_burnin:
+        return Empty()
+    if not manager.get_chips():
+        return Empty()
+    try:
+        from gpu_feature_discovery_tpu.ops.healthcheck import measure_node_health
+    except ImportError as e:
+        # A missing/incompatible jax says nothing about chip health: skip
+        # the labels rather than mark a healthy node unhealthy.
+        log.warning("burn-in unavailable (no usable jax): %s", e)
+        return Empty()
+    try:
+        report = measure_node_health()
+    except Exception as e:  # noqa: BLE001 - degraded chip must not kill labeling
+        log.warning("burn-in failed: %s", e)
+        return Labels({HEALTH_OK: "false"})
+    return Labels(
+        {
+            HEALTH_OK: str(report["healthy"]).lower(),
+            HEALTH_TFLOPS: str(int(report["tflops"])),
+        }
+    )

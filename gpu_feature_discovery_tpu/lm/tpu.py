@@ -9,6 +9,7 @@ empty label set (the Null/fallback path), so non-TPU nodes publish nothing.
 from __future__ import annotations
 
 from gpu_feature_discovery_tpu.config.spec import Config
+from gpu_feature_discovery_tpu.lm.health import new_health_labeler
 from gpu_feature_discovery_tpu.lm.labeler import Empty, Labeler, Merge
 from gpu_feature_discovery_tpu.lm.machine_type import new_machine_type_labeler
 from gpu_feature_discovery_tpu.lm.topology_strategy import new_resource_labeler
@@ -36,9 +37,13 @@ def new_tpu_labeler(manager: Manager, config: Config) -> Labeler:
             slice_capability = new_slice_capability_labeler(manager)
         with timed("tpu.resources"):
             resources = new_resource_labeler(manager, config)
+        with timed("tpu.health"):
+            health = new_health_labeler(manager, config)
 
         # Flatten now: every probe happens inside init/shutdown.
-        return Merge(machine_type, versions, slice_capability, resources).labels()
+        return Merge(
+            machine_type, versions, slice_capability, resources, health
+        ).labels()
     finally:
         with timed("tpu.shutdown"):
             manager.shutdown()

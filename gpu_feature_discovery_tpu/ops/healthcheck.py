@@ -1,8 +1,9 @@
 """TPU health-check kernels: MXU burn-in, HBM probe, ICI sweep, train step.
 
 No counterpart in the reference (it labels hardware without computing on
-it); this is the TPU-native extension that backs the optional burn-in
-labeler and the multi-chip slice-validation path. Design notes:
+it); this is the TPU-native extension backing the health labeler
+(lm/health.py, gated by --with-burnin) and the multi-chip slice-validation
+path. Design notes:
 
 - The burn-in is a depth-chained bf16 matmul under ``lax.scan`` — one fused
   XLA computation whose FLOPs live on the MXU. Shapes are static and
@@ -92,9 +93,9 @@ def measure_chip_health(
 ) -> dict:
     """Run the burn-in on one chip and report health + achieved TFLOP/s.
 
-    Feeds the optional burn-in labeler: ``healthy`` is "every output
-    finite"; ``tflops`` is the best-of-``iters`` sustained matmul rate,
-    which on a healthy TPU should sit near the chip's bf16 peak.
+    ``healthy`` is "every output finite"; ``tflops`` is the
+    best-of-``iters`` sustained matmul rate, which on a healthy TPU should
+    sit near the chip's bf16 peak.
     """
     fn, (x, ws) = make_burnin_step(size=size, depth=depth)
     if device is not None:
@@ -111,6 +112,23 @@ def measure_chip_health(
         "healthy": healthy,
         "tflops": burnin_flops(size, depth) / best / 1e12,
         "seconds": best,
+    }
+
+
+def measure_node_health(
+    size: int = 512, depth: int = 8, iters: int = 4
+) -> dict:
+    """Burn in EVERY local device and aggregate: a node is healthy only if
+    all of its chips are, and the published rate is the worst chip's (the
+    slowest chip governs what a workload will see)."""
+    reports = [
+        measure_chip_health(size=size, depth=depth, iters=iters, device=d)
+        for d in jax.local_devices()
+    ]
+    return {
+        "healthy": all(r["healthy"] for r in reports),
+        "tflops": min(r["tflops"] for r in reports),
+        "chips": len(reports),
     }
 
 

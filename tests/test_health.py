@@ -1,0 +1,52 @@
+"""Burn-in health labeler: gating, label shape, failure tolerance."""
+
+from gpu_feature_discovery_tpu.config.flags import new_config
+from gpu_feature_discovery_tpu.lm.health import (
+    HEALTH_OK,
+    HEALTH_TFLOPS,
+    new_health_labeler,
+)
+from gpu_feature_discovery_tpu.resource.testing import (
+    MockChip,
+    MockManager,
+)
+
+
+def cfg(**cli):
+    return new_config(cli_values=cli, environ={}, config_file=None)
+
+
+def test_disabled_by_default():
+    manager = MockManager(chips=[MockChip()])
+    labels = new_health_labeler(manager, cfg()).labels()
+    assert labels == {}
+
+
+def test_empty_without_chips():
+    labels = new_health_labeler(MockManager(), cfg(**{"with-burnin": "true"})).labels()
+    assert labels == {}
+
+
+def test_enabled_emits_health_labels():
+    manager = MockManager(chips=[MockChip()])
+    labels = new_health_labeler(manager, cfg(**{"with-burnin": "true"})).labels()
+    assert labels[HEALTH_OK] == "true"
+    assert int(labels[HEALTH_TFLOPS]) >= 0
+
+
+def test_burnin_failure_labels_unhealthy(monkeypatch):
+    import gpu_feature_discovery_tpu.ops.healthcheck as hc
+
+    monkeypatch.setattr(
+        hc, "measure_node_health", lambda **kw: (_ for _ in ()).throw(RuntimeError("boom"))
+    )
+    manager = MockManager(chips=[MockChip()])
+    labels = new_health_labeler(manager, cfg(**{"with-burnin": "true"})).labels()
+    assert labels == {HEALTH_OK: "false"}
+
+
+def test_env_alias_enables():
+    manager = MockManager(chips=[MockChip()])
+    config = new_config(cli_values={}, environ={"TFD_WITH_BURNIN": "true"}, config_file=None)
+    labels = new_health_labeler(manager, config).labels()
+    assert HEALTH_OK in labels
