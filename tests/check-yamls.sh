@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# Verify the static manifests and chart pin the same image tag as the
+# release version (reference: tests/check-yamls.sh — tag drift between the
+# repo version and the YAMLs is a release-blocking error).
+set -euo pipefail
+
+here="$(cd "$(dirname "$0")" && pwd)"
+repo="$(dirname "$here")"
+
+version="$(PYTHONPATH="$repo" python -c \
+  'from gpu_feature_discovery_tpu.info.version import VERSION; print(VERSION)')"
+
+fail=0
+for y in "$repo"/deployments/static/*.yaml "$repo"/deployments/static/*.template; do
+  while IFS= read -r line; do
+    tag="${line##*:}"
+    if [ "$tag" != "$version" ]; then
+      echo "FAIL: $y pins image tag '$tag' but repo version is '$version'"
+      fail=1
+    fi
+  done < <(grep -E '^\s+- image:' "$y" | sed 's/[[:space:]]*$//')
+done
+
+chart="$repo/deployments/helm/tpu-feature-discovery/Chart.yaml"
+chart_app="$(grep '^appVersion:' "$chart" | tr -d '"' | awk '{print $2}')"
+if [ "$chart_app" != "$version" ]; then
+  echo "FAIL: Chart.yaml appVersion '$chart_app' != repo version '$version'"
+  fail=1
+fi
+
+app_version_labels="$(grep -rh 'app.kubernetes.io/version:' "$repo"/deployments/static/ | awk '{print $2}' | sort -u)"
+for v in $app_version_labels; do
+  if [ "$v" != "$version" ]; then
+    echo "FAIL: static manifest carries app.kubernetes.io/version '$v' != '$version'"
+    fail=1
+  fi
+done
+
+if [ "$fail" -eq 0 ]; then
+  echo "OK: all manifests pin image tag $version"
+fi
+exit "$fail"

@@ -1,0 +1,147 @@
+"""Deployment-artifact contract tests.
+
+The reference's check-yamls.sh only pins image tags; these go further and
+assert the YAML/flag-table contract so manifests cannot drift from the
+daemon's env surface (every TFD_* env the manifests set must be a real
+flag alias, the NFD handoff hostPath must match the default output dir,
+and the oneshot Job must keep the NODE_NAME substitution point).
+"""
+
+import glob
+import os
+import subprocess
+
+import yaml
+
+from gpu_feature_discovery_tpu.config.flags import (
+    DEFAULT_OUTPUT_FILE,
+    FLAG_DEFS,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+STATIC = os.path.join(REPO, "deployments", "static")
+HELM = os.path.join(REPO, "deployments", "helm", "tpu-feature-discovery")
+
+KNOWN_ENV = {e for fd in FLAG_DEFS for e in fd.env_vars}
+FEATURES_D = os.path.dirname(DEFAULT_OUTPUT_FILE)
+
+
+def static_daemonsets():
+    return sorted(glob.glob(os.path.join(STATIC, "*daemonset*.yaml")))
+
+
+def pod_spec(doc):
+    return doc["spec"]["template"]["spec"]
+
+
+def test_static_daemonsets_env_vars_are_real_flags():
+    for path in static_daemonsets():
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        for container in pod_spec(doc)["containers"]:
+            for env in container.get("env", []):
+                assert env["name"] in KNOWN_ENV, (
+                    f"{path}: env {env['name']} is not a TFD flag alias"
+                )
+
+
+def test_static_daemonsets_mount_features_d():
+    for path in static_daemonsets():
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        spec = pod_spec(doc)
+        host_paths = {
+            v["hostPath"]["path"] for v in spec["volumes"] if "hostPath" in v
+        }
+        assert FEATURES_D in host_paths, f"{path}: missing features.d hostPath"
+        for container in spec["containers"]:
+            mounts = {m["mountPath"] for m in container["volumeMounts"]}
+            assert FEATURES_D in mounts
+
+
+def test_static_daemonsets_tolerate_tpu_taint():
+    for path in static_daemonsets():
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        tols = pod_spec(doc).get("tolerations", [])
+        assert any(t.get("key") == "google.com/tpu" for t in tols), (
+            f"{path}: must tolerate the GKE TPU taint"
+        )
+
+
+def test_strategy_variants_differ_only_in_strategy():
+    def envs(path):
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        return {
+            e["name"]: e["value"]
+            for c in pod_spec(doc)["containers"]
+            for e in c.get("env", [])
+        }
+
+    base = envs(os.path.join(STATIC, "tpu-feature-discovery-daemonset.yaml"))
+    assert base["TFD_TPU_TOPOLOGY_STRATEGY"] == "none"
+    for strategy in ("single", "mixed"):
+        variant = envs(
+            os.path.join(
+                STATIC,
+                f"tpu-feature-discovery-daemonset-with-topology-{strategy}.yaml",
+            )
+        )
+        assert variant["TFD_TPU_TOPOLOGY_STRATEGY"] == strategy
+        variant["TFD_TPU_TOPOLOGY_STRATEGY"] = "none"
+        assert variant == base
+
+
+def test_job_template_keeps_node_name_substitution():
+    with open(os.path.join(STATIC, "tpu-feature-discovery-job.yaml.template")) as f:
+        doc = yaml.safe_load(f)
+    spec = doc["spec"]["template"]["spec"]
+    assert spec["nodeName"] == "NODE_NAME"
+    assert spec["restartPolicy"] == "Never"
+    args = spec["containers"][0]["args"]
+    assert "--oneshot" in args
+
+
+def test_helm_values_cover_the_flag_surface():
+    with open(os.path.join(HELM, "values.yaml")) as f:
+        values = yaml.safe_load(f)
+    for key in (
+        "failOnInitError",
+        "tpuTopologyStrategy",
+        "noTimestamp",
+        "sleepInterval",
+        "withBurnin",
+    ):
+        assert key in values, f"values.yaml missing {key}"
+    # The NFD master must be allowed to publish google.com/ labels.
+    assert "google.com" in values["nfd"]["master"]["extraLabelNs"]
+
+
+def test_helm_daemonset_template_sets_only_known_env():
+    # The template is mustache, not YAML; check the env-name strings.
+    with open(os.path.join(HELM, "templates", "daemonset.yml")) as f:
+        text = f.read()
+    import re
+
+    for name in re.findall(r"- name: (TFD_[A-Z_]+)", text):
+        assert name in KNOWN_ENV, f"daemonset.yml sets unknown env {name}"
+
+
+def test_nfd_example_grants_google_label_namespace():
+    with open(os.path.join(REPO, "tests", "nfd.yaml")) as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    master = next(
+        d for d in docs if d["kind"] == "Deployment" and "master" in d["metadata"]["name"]
+    )
+    args = master["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert any("--extra-label-ns=google.com" in a for a in args)
+
+
+def test_check_yamls_script_passes():
+    result = subprocess.run(
+        [os.path.join(REPO, "tests", "check-yamls.sh")],
+        capture_output=True,
+        text=True,
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
