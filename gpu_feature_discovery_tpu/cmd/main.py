@@ -138,9 +138,25 @@ def start(argv: Optional[list] = None) -> int:
 
 def new_interconnect_labeler(config: Config) -> Labeler:
     """vgpu.NewVGPULib(NewNvidiaPCILib()) analog (main.go:134): sysfs PCI
-    scanner + host metadata provider chain."""
-    del config  # reserved for future flags (e.g. disabling metadata)
-    return InterconnectLabeler(pci=_TolerantPCI(), provider=ChainedProvider())
+    scanner + host metadata provider chain. Escape hatches for hermetic
+    testing on real TPU VMs (where host facts would leak into golden
+    comparisons): TFD_NO_METADATA=1 skips the GCE metadata server;
+    TFD_HERMETIC=1 additionally blanks the env-var provider (needed because
+    site hooks can re-inject TPU_* into any child python process)."""
+    del config  # reserved for future flags
+    hermetic = _env_flag("TFD_HERMETIC")
+    use_mds = not hermetic and not _env_flag("TFD_NO_METADATA")
+    return InterconnectLabeler(
+        pci=_TolerantPCI(),
+        provider=ChainedProvider(
+            environ={} if hermetic else None, use_metadata_server=use_mds
+        ),
+    )
+
+
+def _env_flag(name: str) -> bool:
+    """Value-aware env toggle: "0"/"false"/"" are off, not just unset."""
+    return os.environ.get(name, "").strip().lower() not in ("", "0", "false", "f", "no", "off")
 
 
 class _TolerantPCI:

@@ -1,0 +1,144 @@
+#!/usr/bin/env python3
+"""Tier-4 E2E test against a real cluster (GKE TPU node pool).
+
+Reference behavior (tests/e2e-tests.py): deploy TFD + NFD from YAML, watch
+the Node until the timestamp label lands (180 s budget), then assert the
+node's labels equal the golden set plus whatever labels pre-existed,
+ignoring feature.node.kubernetes.io/*.
+
+Usage: python tests/e2e-tests.py TFD_YAML_PATH NFD_YAML_PATH [GOLDEN_PATH]
+Requires: kubernetes client + a kubeconfig pointing at the target cluster.
+"""
+
+import os
+import re
+import sys
+
+import yaml
+
+try:
+    from kubernetes import client, config, watch
+except ImportError:
+    print("The 'kubernetes' package is required for e2e tests", file=sys.stderr)
+    sys.exit(2)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TIMESTAMP_LABEL = "google.com/tfd.timestamp"
+WATCH_TIMEOUT_S = 180
+
+
+def get_expected_labels_regexs(path):
+    with open(path) as f:
+        return [re.compile(line.strip()) for line in f if line.strip()]
+
+
+def deploy_yaml_file(core_api, apps_api, rbac_api, batch_api, path):
+    with open(path) as f:
+        for body in yaml.safe_load_all(f):
+            if not body:
+                continue
+            kind = body["kind"]
+            ns = body.get("metadata", {}).get("namespace", "default")
+            if kind == "Namespace":
+                core_api.create_namespace(body)
+            elif kind == "ServiceAccount":
+                core_api.create_namespaced_service_account(ns, body)
+            elif kind == "Service":
+                core_api.create_namespaced_service(ns, body)
+            elif kind == "DaemonSet":
+                apps_api.create_namespaced_daemon_set(ns, body)
+            elif kind == "Deployment":
+                apps_api.create_namespaced_deployment(ns, body)
+            elif kind == "Job":
+                batch_api.create_namespaced_job(ns, body)
+            elif kind == "ClusterRole":
+                rbac_api.create_cluster_role(body)
+            elif kind == "ClusterRoleBinding":
+                rbac_api.create_cluster_role_binding(body)
+            else:
+                print(f"Unknown kind {kind}", file=sys.stderr)
+                sys.exit(1)
+
+
+def check_labels(expected_regexs, labels):
+    """Bidirectional diff, NFD's own labels excluded (reference :37-55)."""
+    expected = list(expected_regexs)
+    remaining = list(labels)
+    for label in list(remaining):
+        if label.startswith("feature.node.kubernetes.io/"):
+            remaining.remove(label)
+            continue
+        for regex in list(expected):
+            if regex.fullmatch(label):
+                expected.remove(regex)
+                remaining.remove(label)
+                break
+    for label in remaining:
+        print(f"Unexpected label on node: {label}", file=sys.stderr)
+    for regex in expected:
+        print(f"Missing label matching regex: {regex.pattern}", file=sys.stderr)
+    return not expected and not remaining
+
+
+def main():
+    if len(sys.argv) not in (3, 4):
+        print(f"Usage: {sys.argv[0]} TFD_YAML NFD_YAML [GOLDEN]", file=sys.stderr)
+        return 1
+    golden = sys.argv[3] if len(sys.argv) == 4 else os.path.join(
+        HERE, "expected-output.txt"
+    )
+
+    print("Running E2E tests for TFD")
+    config.load_kube_config()
+    core_api = client.CoreV1Api()
+    apps_api = client.AppsV1Api()
+    rbac_api = client.RbacAuthorizationV1Api()
+    batch_api = client.BatchV1Api()
+
+    nodes = core_api.list_node().items
+    if not nodes:
+        print("No nodes found", file=sys.stderr)
+        return 1
+
+    # Snapshot every node's pre-existing labels before deploying: the
+    # timestamp can land on any TPU node (a cluster usually also has
+    # non-TPU pools), and only that node's own prior labels are allowed
+    # to persist (reference :78-80, generalized to multi-node).
+    pre_labels = {
+        n.metadata.name: dict(n.metadata.labels or {}) for n in nodes
+    }
+
+    print("Deploying TFD and NFD")
+    deploy_yaml_file(core_api, apps_api, rbac_api, batch_api, sys.argv[1])
+    deploy_yaml_file(core_api, apps_api, rbac_api, batch_api, sys.argv[2])
+
+    print("Watching node updates")
+    labeled_node = None
+    w = watch.Watch()
+    # timeout_seconds is server-side: the stream ends cleanly at expiry
+    # instead of raising a client read timeout.
+    for event in w.stream(core_api.list_node, timeout_seconds=WATCH_TIMEOUT_S):
+        if event["type"] == "MODIFIED":
+            if TIMESTAMP_LABEL in (event["object"].metadata.labels or {}):
+                labeled_node = event["object"].metadata.name
+                print(f"Timestamp label found on {labeled_node}. Stop watching")
+                break
+    if labeled_node is None:
+        print("Timestamp label never appeared", file=sys.stderr)
+        return 1
+
+    print("Checking labels")
+    node = core_api.read_node(labeled_node)
+    regexs = get_expected_labels_regexs(golden)
+    for k, v in pre_labels.get(labeled_node, {}).items():
+        regexs.append(re.compile(re.escape(f"{k}={v}")))
+    labels = [f"{k}={v}" for k, v in (node.metadata.labels or {}).items()]
+    if not check_labels(regexs, labels):
+        print("E2E tests failed", file=sys.stderr)
+        return 1
+    print("E2E tests done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,150 @@
+#!/usr/bin/env python3
+"""Tier-3 integration test: run the real daemon end-to-end, diff its output
+file against a golden regex file bidirectionally (no missing labels, no
+unexpected labels).
+
+Reference behavior: tests/integration-tests.py — container runs privileged
+with a tmpdir bound at the NFD features.d path, the test waits for the
+label file, then every written line must match exactly one golden regex and
+every golden regex must be consumed. This version drives the daemon as a
+subprocess by default (runnable on any dev box / CI runner with no Docker
+or TPU: the mock backend stands in, exactly like the reference's
+mock-NVML container tests), and drives the container instead when
+--image is given.
+
+Usage:
+  python tests/integration-tests.py                       # subprocess, mock v4-8
+  python tests/integration-tests.py --backend mock:v5e-8
+  python tests/integration-tests.py --image IMG           # docker mode
+"""
+
+import argparse
+import os
+import re
+import subprocess
+import sys
+import tempfile
+import time
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+FEATURES_D = "/etc/kubernetes/node-feature-discovery/features.d"
+
+
+def load_golden_regexs(path):
+    with open(path) as f:
+        return [re.compile(line.strip()) for line in f if line.strip()]
+
+
+def check_labels(expected_regexs, labels):
+    """Bidirectional match (reference integration-tests.py:20-33): each
+    label consumes one regex; leftovers on either side fail."""
+    expected = list(expected_regexs)
+    remaining = list(labels)
+    for label in list(remaining):
+        for regex in list(expected):
+            if regex.fullmatch(label):
+                expected.remove(regex)
+                remaining.remove(label)
+                break
+    for label in remaining:
+        print(f"Unexpected label: {label}", file=sys.stderr)
+    for regex in expected:
+        print(f"Missing label matching regex: {regex.pattern}", file=sys.stderr)
+    return not expected and not remaining
+
+
+def wait_for_file(path, timeout_s, proc=None):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if os.path.exists(path):
+            return True
+        if proc is not None and proc.poll() is not None:
+            return os.path.exists(path)
+        time.sleep(0.2)
+    return False
+
+
+def run_subprocess_mode(args, out_dir):
+    # Hermetic: the mock backend must not mix with the host's real TPU
+    # facts (a dev box or CI runner may itself be a TPU VM whose TPU_* env
+    # and metadata server would leak extra labels into the golden diff).
+    env = {
+        k: v
+        for k, v in os.environ.items()
+        if not k.startswith(("TPU_", "TFD_"))
+    }
+    env["PYTHONPATH"] = REPO + os.pathsep + os.environ.get("PYTHONPATH", "")
+    env["TFD_BACKEND"] = args.backend
+    env["TFD_HERMETIC"] = "1"
+    out_file = os.path.join(out_dir, "tfd")
+    cmd = [
+        sys.executable, "-m", "gpu_feature_discovery_tpu",
+        "--oneshot", "true",
+        "--output-file", out_file,
+        "--tpu-topology-strategy", args.strategy,
+    ]
+    proc = subprocess.Popen(cmd, env=env)
+    ok = wait_for_file(out_file, args.timeout, proc)
+    try:
+        proc.wait(timeout=args.timeout)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait()
+        print("Daemon hung; killed", file=sys.stderr)
+        return None
+    if not ok:
+        print("Daemon never wrote the output file", file=sys.stderr)
+        return None
+    with open(out_file) as f:
+        return [line.strip() for line in f if line.strip()]
+
+
+def run_docker_mode(args, out_dir):
+    out_file = os.path.join(out_dir, "tfd")
+    cmd = [
+        "docker", "run", "--rm", "--privileged",
+        "-v", f"{out_dir}:{FEATURES_D}",
+        "-e", f"TFD_BACKEND={args.backend}",
+        "-e", "TFD_HERMETIC=1",  # same leak guard as subprocess mode
+        args.image,
+        "--oneshot", "true",
+        "--tpu-topology-strategy", args.strategy,
+    ]
+    subprocess.run(cmd, check=True, timeout=args.timeout)
+    if not os.path.exists(out_file):
+        print("Container never wrote the output file", file=sys.stderr)
+        return None
+    with open(out_file) as f:
+        return [line.strip() for line in f if line.strip()]
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--image", help="docker image (default: subprocess mode)")
+    parser.add_argument("--backend", default="mock:v4-8")
+    parser.add_argument("--strategy", default="none")
+    parser.add_argument(
+        "--golden", default=os.path.join(HERE, "expected-output.txt")
+    )
+    parser.add_argument("--timeout", type=float, default=120.0)
+    args = parser.parse_args()
+
+    print("Running integration tests for TFD")
+    regexs = load_golden_regexs(args.golden)
+    with tempfile.TemporaryDirectory() as out_dir:
+        if args.image:
+            labels = run_docker_mode(args, out_dir)
+        else:
+            labels = run_subprocess_mode(args, out_dir)
+    if labels is None:
+        return 1
+    if not check_labels(regexs, labels):
+        print("Integration tests failed", file=sys.stderr)
+        return 1
+    print("Integration tests done")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

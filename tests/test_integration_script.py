@@ -1,0 +1,30 @@
+"""Keep tier 3 runnable from the unit suite: the integration script (real
+daemon subprocess + golden regex diff) must pass for the base and
+strategy=single scenarios."""
+
+import os
+import subprocess
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+SCRIPT = os.path.join(HERE, "integration-tests.py")
+
+
+def run(*args):
+    return subprocess.run(
+        [sys.executable, SCRIPT, *args], capture_output=True, text=True, timeout=120
+    )
+
+
+def test_integration_none():
+    result = run()
+    assert result.returncode == 0, result.stdout + result.stderr
+
+
+def test_integration_single():
+    result = run(
+        "--backend", "mock-slice:v4-8",
+        "--strategy", "single",
+        "--golden", os.path.join(HERE, "expected-output-topology-single.txt"),
+    )
+    assert result.returncode == 0, result.stdout + result.stderr
