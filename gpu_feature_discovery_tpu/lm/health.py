@@ -27,6 +27,7 @@ log = logging.getLogger("tfd.lm")
 
 HEALTH_OK = "google.com/tpu.health.ok"
 HEALTH_TFLOPS = "google.com/tpu.health.matmul-tflops"
+HEALTH_HBM = "google.com/tpu.health.hbm-gbps"
 
 
 def new_health_labeler(manager: Manager, config: Config) -> Labeler:
@@ -47,9 +48,19 @@ def new_health_labeler(manager: Manager, config: Config) -> Labeler:
     except Exception as e:  # noqa: BLE001 - degraded chip must not kill labeling
         log.warning("burn-in failed: %s", e)
         return Labels({HEALTH_OK: "false"})
-    return Labels(
+    labels = Labels(
         {
             HEALTH_OK: str(report["healthy"]).lower(),
             HEALTH_TFLOPS: str(int(report["tflops"])),
         }
     )
+    hbm = report.get("hbm_gbps")
+    if hbm is not None:
+        if hbm >= 1.0:
+            labels[HEALTH_HBM] = str(int(hbm))
+        else:
+            # Sub-1 GiB/s is not a believable HBM reading on hardware that
+            # just passed the checksum — a tunneled/virtualized device is
+            # distorting timing; omit rather than publish a junk number.
+            log.warning("implausible HBM bandwidth %.3f GiB/s; omitting label", hbm)
+    return labels

@@ -120,14 +120,31 @@ def measure_node_health(
 ) -> dict:
     """Burn in EVERY local device and aggregate: a node is healthy only if
     all of its chips are, and the published rate is the worst chip's (the
-    slowest chip governs what a workload will see)."""
+    slowest chip governs what a workload will see).
+
+    On real TPUs the HBM streaming probe (ops/hbm.py) runs too; elsewhere
+    ``hbm_gbps`` is None — the interpreter would be slow and the number
+    meaningless as bandwidth.
+    """
+    devices = jax.local_devices()
     reports = [
         measure_chip_health(size=size, depth=depth, iters=iters, device=d)
-        for d in jax.local_devices()
+        for d in devices
     ]
+    hbm_gbps = None
+    if all(d.platform == "tpu" for d in devices):
+        from gpu_feature_discovery_tpu.ops.hbm import measure_hbm_bandwidth
+
+        hbm = [
+            measure_hbm_bandwidth(total_mib=64, iters=2, device=d)
+            for d in devices
+        ]
+        if all(r["checksum_ok"] for r in hbm):
+            hbm_gbps = min(r["gbps"] for r in hbm)
     return {
         "healthy": all(r["healthy"] for r in reports),
         "tflops": min(r["tflops"] for r in reports),
+        "hbm_gbps": hbm_gbps,
         "chips": len(reports),
     }
 
