@@ -1,0 +1,43 @@
+# Developer entry points (reference: Makefile check/test/coverage targets —
+# SURVEY.md section 2.4 build-system row — mapped to the Python/C++ stack).
+
+PYTHON ?= python
+IMAGE_NAME ?= ghcr.io/example/tpu-feature-discovery
+VERSION ?= 0.1.0
+
+.PHONY: all native test integration bench check-yamls lint clean docker-build
+
+all: native test
+
+native:
+	$(MAKE) -C gpu_feature_discovery_tpu/native
+
+test: native
+	$(PYTHON) -m pytest tests/ -q
+
+integration:
+	$(PYTHON) tests/integration-tests.py
+	$(PYTHON) tests/integration-tests.py --backend mock:v5e-8
+	$(PYTHON) tests/integration-tests.py \
+	    --backend mock-slice:v4-8 --strategy single \
+	    --golden tests/expected-output-topology-single.txt
+	$(PYTHON) tests/integration-tests.py \
+	    --backend mock-mixed:v5e:2x2,2x2 --strategy mixed \
+	    --golden tests/expected-output-topology-mixed.txt
+
+bench:
+	$(PYTHON) bench.py
+
+check-yamls:
+	tests/check-yamls.sh
+
+lint:
+	@command -v ruff >/dev/null && ruff check gpu_feature_discovery_tpu tests bench.py \
+	    || $(PYTHON) -m compileall -q gpu_feature_discovery_tpu tests bench.py
+
+clean:
+	$(MAKE) -C gpu_feature_discovery_tpu/native clean
+	find . -name __pycache__ -type d -prune -exec rm -rf {} +
+
+docker-build:
+	docker build -t $(IMAGE_NAME):$(VERSION) -f deployments/container/Dockerfile .

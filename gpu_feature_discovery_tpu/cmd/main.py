@@ -155,8 +155,19 @@ def new_interconnect_labeler(config: Config) -> Labeler:
 
 
 def _env_flag(name: str) -> bool:
-    """Value-aware env toggle: "0"/"false"/"" are off, not just unset."""
-    return os.environ.get(name, "").strip().lower() not in ("", "0", "false", "f", "no", "off")
+    """Value-aware env toggle with the same boolean grammar as every other
+    TFD flag (config.spec.parse_bool); unset/empty is off, an unparseable
+    value counts as on (presence implies intent) with a warning."""
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return False
+    try:
+        from gpu_feature_discovery_tpu.config.spec import parse_bool
+
+        return parse_bool(raw)
+    except ConfigError:
+        log.warning("%s=%r is not a boolean; treating as enabled", name, raw)
+        return True
 
 
 class _TolerantPCI:

@@ -53,6 +53,19 @@ def _mock_slice_backend(accel_type: str) -> Manager:
     return new_uniform_slice_manager(accel_type)
 
 
+def _mock_mixed_backend(spec: str) -> Manager:
+    """``mock-mixed:<family>[:<topo>,<topo>,...]`` — one chip per listed
+    slice topology (defaults to the builder's heterogeneous set)."""
+    from gpu_feature_discovery_tpu.resource.testing import new_mixed_slice_manager
+
+    family, _, topos = spec.partition(":")
+    if topos:
+        return new_mixed_slice_manager(
+            family, topologies=[[t] for t in topos.split(",") if t]
+        )
+    return new_mixed_slice_manager(family)
+
+
 def _get_manager(config: Config) -> Manager:
     backend = os.environ.get(BACKEND_ENV, "auto").strip().lower()
 
@@ -64,6 +77,10 @@ def _get_manager(config: Config) -> Manager:
         accel = backend.split(":", 1)[1]
         log.info("Using mock uniform-slice manager (%s)", accel)
         return _mock_slice_backend(accel)
+    if backend.startswith("mock-mixed:"):
+        family = backend.split(":", 1)[1]
+        log.info("Using mock mixed-slice manager (%s)", family)
+        return _mock_mixed_backend(family)
     if backend == "null":
         log.info("Using null manager (forced)")
         return NullManager()

@@ -1,0 +1,34 @@
+"""Shared golden-file matching for the integration and e2e scripts.
+
+One implementation of the reference's bidirectional diff (each label line
+consumes exactly one golden regex; leftovers on either side fail —
+integration-tests.py:20-33 / e2e-tests.py:37-55 in the reference)."""
+
+import re
+import sys
+
+
+def load_golden_regexs(path):
+    with open(path) as f:
+        return [re.compile(line.strip()) for line in f if line.strip()]
+
+
+def check_labels(expected_regexs, labels, ignore_prefixes=()):
+    """Bidirectional match; labels under ``ignore_prefixes`` are dropped
+    before matching (e2e ignores NFD's own feature.node.kubernetes.io/*)."""
+    expected = list(expected_regexs)
+    remaining = list(labels)
+    for label in list(remaining):
+        if ignore_prefixes and label.startswith(tuple(ignore_prefixes)):
+            remaining.remove(label)
+            continue
+        for regex in list(expected):
+            if regex.fullmatch(label):
+                expected.remove(regex)
+                remaining.remove(label)
+                break
+    for label in remaining:
+        print(f"Unexpected label: {label}", file=sys.stderr)
+    for regex in expected:
+        print(f"Missing label matching regex: {regex.pattern}", file=sys.stderr)
+    return not expected and not remaining

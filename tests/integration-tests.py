@@ -20,7 +20,7 @@ Usage:
 
 import argparse
 import os
-import re
+import signal
 import subprocess
 import sys
 import tempfile
@@ -30,28 +30,8 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 FEATURES_D = "/etc/kubernetes/node-feature-discovery/features.d"
 
-
-def load_golden_regexs(path):
-    with open(path) as f:
-        return [re.compile(line.strip()) for line in f if line.strip()]
-
-
-def check_labels(expected_regexs, labels):
-    """Bidirectional match (reference integration-tests.py:20-33): each
-    label consumes one regex; leftovers on either side fail."""
-    expected = list(expected_regexs)
-    remaining = list(labels)
-    for label in list(remaining):
-        for regex in list(expected):
-            if regex.fullmatch(label):
-                expected.remove(regex)
-                remaining.remove(label)
-                break
-    for label in remaining:
-        print(f"Unexpected label: {label}", file=sys.stderr)
-    for regex in expected:
-        print(f"Missing label matching regex: {regex.pattern}", file=sys.stderr)
-    return not expected and not remaining
+sys.path.insert(0, HERE)
+from golden_utils import check_labels, load_golden_regexs  # noqa: E402
 
 
 def wait_for_file(path, timeout_s, proc=None):
@@ -84,12 +64,14 @@ def run_subprocess_mode(args, out_dir):
         "--output-file", out_file,
         "--tpu-topology-strategy", args.strategy,
     ]
-    proc = subprocess.Popen(cmd, env=env)
+    # Own process group so a hang can be killed as a unit even if the
+    # daemon forked helpers.
+    proc = subprocess.Popen(cmd, env=env, start_new_session=True)
     ok = wait_for_file(out_file, args.timeout, proc)
     try:
         proc.wait(timeout=args.timeout)
     except subprocess.TimeoutExpired:
-        proc.kill()
+        os.killpg(os.getpgid(proc.pid), signal.SIGKILL)
         proc.wait()
         print("Daemon hung; killed", file=sys.stderr)
         return None

@@ -11,8 +11,14 @@ SCRIPT = os.path.join(HERE, "integration-tests.py")
 
 
 def run(*args):
+    # The script's own hang handling needs up to 2x its --timeout; keep the
+    # outer pytest timeout above that so the script can kill a hung daemon
+    # (and report it) before pytest kills the script.
     return subprocess.run(
-        [sys.executable, SCRIPT, *args], capture_output=True, text=True, timeout=120
+        [sys.executable, SCRIPT, "--timeout", "45", *args],
+        capture_output=True,
+        text=True,
+        timeout=300,
     )
 
 
