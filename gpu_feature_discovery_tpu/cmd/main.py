@@ -146,8 +146,17 @@ def new_interconnect_labeler(config: Config) -> Labeler:
     del config  # reserved for future flags
     hermetic = _env_flag("TFD_HERMETIC")
     use_mds = not hermetic and not _env_flag("TFD_NO_METADATA")
+    if _env_flag("TFD_MOCK_PCI"):
+        # Integration fixture: synthesized Google PCI functions (the
+        # reference gets real PCI devices from its GPU CI host; our
+        # CPU-only CI needs the mock to reach the pci.* label path).
+        from gpu_feature_discovery_tpu.pci.pciutil import MockGooglePCI
+
+        pci = MockGooglePCI()
+    else:
+        pci = _TolerantPCI()
     return InterconnectLabeler(
-        pci=_TolerantPCI(),
+        pci=pci,
         provider=ChainedProvider(
             environ={} if hermetic else None, use_metadata_server=use_mds
         ),

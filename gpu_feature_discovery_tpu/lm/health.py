@@ -28,6 +28,7 @@ log = logging.getLogger("tfd.lm")
 HEALTH_OK = "google.com/tpu.health.ok"
 HEALTH_TFLOPS = "google.com/tpu.health.matmul-tflops"
 HEALTH_HBM = "google.com/tpu.health.hbm-gbps"
+HEALTH_ICI = "google.com/tpu.health.ici.ok"
 
 
 def new_health_labeler(manager: Manager, config: Config) -> Labeler:
@@ -63,4 +64,6 @@ def new_health_labeler(manager: Manager, config: Config) -> Labeler:
             # just passed the checksum — a tunneled/virtualized device is
             # distorting timing; omit rather than publish a junk number.
             log.warning("implausible HBM bandwidth %.3f GiB/s; omitting label", hbm)
+    if report.get("ici_ok") is not None:
+        labels[HEALTH_ICI] = str(report["ici_ok"]).lower()
     return labels

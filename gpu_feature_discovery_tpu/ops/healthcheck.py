@@ -116,7 +116,10 @@ def measure_chip_health(
 
 
 def measure_node_health(
-    size: int = 512, depth: int = 8, iters: int = 4
+    size: int = 512,
+    depth: int = 8,
+    iters: int = 4,
+    ici: Optional[bool] = None,
 ) -> dict:
     """Burn in EVERY local device and aggregate: a node is healthy only if
     all of its chips are, and the published rate is the worst chip's (the
@@ -124,15 +127,17 @@ def measure_node_health(
 
     On real TPUs the HBM streaming probe (ops/hbm.py) runs too; elsewhere
     ``hbm_gbps`` is None — the interpreter would be slow and the number
-    meaningless as bandwidth.
+    meaningless as bandwidth. ``ici`` (auto: multi-chip TPU nodes) rings
+    the local chips with ppermute to verify every intra-host ICI link.
     """
     devices = jax.local_devices()
+    on_tpu = all(d.platform == "tpu" for d in devices)
     reports = [
         measure_chip_health(size=size, depth=depth, iters=iters, device=d)
         for d in devices
     ]
     hbm_gbps = None
-    if all(d.platform == "tpu" for d in devices):
+    if on_tpu:
         from gpu_feature_discovery_tpu.ops.hbm import measure_hbm_bandwidth
 
         hbm = [
@@ -141,10 +146,23 @@ def measure_node_health(
         ]
         if all(r["checksum_ok"] for r in hbm):
             hbm_gbps = min(r["gbps"] for r in hbm)
+    if ici is None:
+        ici = on_tpu and len(devices) > 1
+    elif ici and len(devices) < 2:
+        # An explicit request must fail loudly, not silently report
+        # "not measured" — a single device has no ring to sweep.
+        raise ValueError("ici sweep requested but only one local device")
+    ici_ok = None
+    if ici:
+        import numpy as np
+
+        sweep = ici_ring_sweep(Mesh(np.array(devices), ("ring",)))
+        ici_ok = sweep["links_ok"] and sweep["allreduce_ok"]
     return {
         "healthy": all(r["healthy"] for r in reports),
         "tflops": min(r["tflops"] for r in reports),
         "hbm_gbps": hbm_gbps,
+        "ici_ok": ici_ok,
         "chips": len(reports),
     }
 

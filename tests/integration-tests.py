@@ -47,16 +47,33 @@ def wait_for_file(path, timeout_s, proc=None):
 
 def run_subprocess_mode(args, out_dir):
     # Hermetic: the mock backend must not mix with the host's real TPU
-    # facts (a dev box or CI runner may itself be a TPU VM whose TPU_* env
+    # facts (a dev box or CI runner may itself be a TPU VM whose TPU env
     # and metadata server would leak extra labels into the golden diff).
+    # v2/v3 TPU VMs use unprefixed keys, so the scrub must cover those too
+    # (hostinfo/tpu_env.py host_info_from_mapping's alias list).
+    unprefixed = {
+        "ACCELERATOR_TYPE", "TOPOLOGY", "WORKER_ID", "WORKER_HOSTNAMES",
+        "HOST_BOUNDS", "CHIPS_PER_HOST_BOUNDS", "WRAP", "AGENT_WORKER_NUMBER",
+    }
     env = {
         k: v
         for k, v in os.environ.items()
-        if not k.startswith(("TPU_", "TFD_"))
+        if not k.startswith(("TPU_", "TFD_")) and k not in unprefixed
     }
     env["PYTHONPATH"] = REPO + os.pathsep + os.environ.get("PYTHONPATH", "")
     env["TFD_BACKEND"] = args.backend
-    env["TFD_HERMETIC"] = "1"
+    if args.hostenv:
+        # Simulated TPU VM facts (multi-host scenarios): fixture env vars
+        # replace the scrubbed host ones; metadata server stays off so only
+        # the fixture is visible.
+        env["TFD_NO_METADATA"] = "1"
+        env["TFD_MOCK_PCI"] = "1"
+        for pair in args.hostenv.split(";"):
+            key, _, value = pair.partition("=")
+            if key:
+                env[key.strip()] = value.strip()
+    else:
+        env["TFD_HERMETIC"] = "1"
     out_file = os.path.join(out_dir, "tfd")
     cmd = [
         sys.executable, "-m", "gpu_feature_discovery_tpu",
@@ -107,10 +124,17 @@ def main():
     parser.add_argument("--backend", default="mock:v4-8")
     parser.add_argument("--strategy", default="none")
     parser.add_argument(
+        "--hostenv",
+        help="semicolon-separated KEY=VALUE fixture env simulating a TPU VM "
+        "(enables the mock PCI scanner; subprocess mode only)",
+    )
+    parser.add_argument(
         "--golden", default=os.path.join(HERE, "expected-output.txt")
     )
     parser.add_argument("--timeout", type=float, default=120.0)
     args = parser.parse_args()
+    if args.image and args.hostenv:
+        parser.error("--hostenv requires subprocess mode (no --image)")
 
     print("Running integration tests for TFD")
     regexs = load_golden_regexs(args.golden)

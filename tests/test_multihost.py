@@ -1,0 +1,64 @@
+"""Multi-host consistency: every worker of a slice must emit identical
+slice-global labels from purely local metadata (SURVEY.md section 7
+"riskiest unknown (b)" — the daemonset stays coordination-free because no
+worker needs a peer to agree on what the slice looks like)."""
+
+import pytest
+
+from gpu_feature_discovery_tpu.hostinfo.provider import StaticProvider
+from gpu_feature_discovery_tpu.hostinfo.tpu_env import (
+    host_info_from_mapping,
+    parse_tpu_env,
+)
+from gpu_feature_discovery_tpu.lm.interconnect import (
+    WORKER_ID,
+    InterconnectLabeler,
+)
+
+V5P_64_ENV = """\
+ACCELERATOR_TYPE: 'v5p-64'
+TPU_PROCESS_BOUNDS: '2,2,2'
+TPU_CHIPS_PER_PROCESS_BOUNDS: '2,2,1'
+TPU_TOPOLOGY_WRAP: 'true,true,true'
+WORKER_ID: '{worker}'
+"""
+
+
+def worker_labels(worker: int):
+    info = host_info_from_mapping(parse_tpu_env(V5P_64_ENV.format(worker=worker)))
+    return dict(InterconnectLabeler(provider=StaticProvider(info)).labels())
+
+
+def test_all_workers_agree_on_slice_global_labels():
+    per_worker = [worker_labels(w) for w in range(8)]
+    globals_per_worker = [
+        {k: v for k, v in labels.items() if k != WORKER_ID}
+        for labels in per_worker
+    ]
+    assert all(g == globals_per_worker[0] for g in globals_per_worker[1:])
+
+
+def test_worker_ids_are_unique_and_local():
+    ids = [worker_labels(w)[WORKER_ID] for w in range(8)]
+    assert ids == [str(w) for w in range(8)]
+
+
+def test_slice_topology_derived_from_bounds():
+    labels = worker_labels(0)
+    # 2,2,2 process bounds x 2,2,1 chips per process = 4x4x2 chip grid.
+    assert labels["google.com/tpu.slice.topology"] == "4x4x2"
+    assert labels["google.com/tpu.multihost.worker-count"] == "8"
+    assert labels["google.com/tpu.multihost.chips-per-host"] == "2x2x1"
+
+
+@pytest.mark.parametrize("axis", ["x", "y", "z"])
+def test_wrap_labels_all_axes(axis):
+    labels = worker_labels(0)
+    assert labels[f"google.com/tpu.ici.wrap.{axis}"] == "true"
+
+
+def test_node_health_reports_ici_on_multichip_mesh():
+    from gpu_feature_discovery_tpu.ops.healthcheck import measure_node_health
+
+    report = measure_node_health(size=128, depth=2, iters=1, ici=True)
+    assert report["ici_ok"] is True
