@@ -89,8 +89,21 @@ def _get_manager(config: Config) -> Manager:
         if manager is None:
             raise RuntimeError("TFD_BACKEND=jax requested but jax backend unavailable")
         return manager
+    if backend in ("hostinfo", "metadata"):
+        # Eager availability check: a forced backend must fail loudly at
+        # factory time (matching TFD_BACKEND=jax), not be silently swapped
+        # for null by the fallback wrapper.
+        manager = _try_hostinfo_manager(config)
+        if manager is None:
+            raise RuntimeError(
+                "TFD_BACKEND=hostinfo requested but no TPU VM metadata available"
+            )
+        log.info("Using hostinfo (metadata) manager (forced)")
+        return manager
 
-    # auto detection
+    # Auto detection: PJRT first, metadata-derived inventory second, null
+    # last — the hasNVML -> isTegra -> null chain (factory.go:54-73) with
+    # TPU probes.
     has_tpu, reason = _detect_tpu_platform(config)
     log.info("Detected %sTPU platform: %s", "" if has_tpu else "non-", reason)
     if has_tpu:
@@ -98,7 +111,11 @@ def _get_manager(config: Config) -> Manager:
         if manager is not None:
             log.info("Using PJRT (jax) manager")
             return manager
-        log.warning("TPU detected but PJRT backend unavailable; using null manager")
+        manager = _try_hostinfo_manager(config)
+        if manager is not None:
+            log.info("Using hostinfo (metadata) manager; PJRT unavailable")
+            return manager
+        log.warning("TPU detected but no backend usable; using null manager")
 
     log.warning("No valid resources detected; using empty manager.")
     return NullManager()
@@ -134,4 +151,22 @@ def _try_jax_manager(config: Config) -> Optional[Manager]:
         return JaxManager(config)
     except Exception as e:  # noqa: BLE001 - backend optional by design
         log.warning("jax backend unavailable: %s", e)
+        return None
+
+
+def _try_hostinfo_manager(config: Config) -> Optional[Manager]:
+    """Metadata inventory is only a valid backend when the environment
+    actually names an accelerator type (the isTegra analog probe)."""
+    try:
+        from gpu_feature_discovery_tpu.hostinfo.provider import discover_host_info
+        from gpu_feature_discovery_tpu.resource.hostinfo_backend import (
+            HostinfoManager,
+        )
+
+        info = discover_host_info()
+        if info is None or not info.accelerator_type:
+            return None
+        return HostinfoManager(config, info=info)
+    except Exception as e:  # noqa: BLE001 - backend optional by design
+        log.warning("hostinfo backend unavailable: %s", e)
         return None

@@ -1,0 +1,123 @@
+"""Metadata-derived backend (the CUDA/Tegra fallback analog)."""
+
+import pytest
+
+from gpu_feature_discovery_tpu.config.flags import new_config
+from gpu_feature_discovery_tpu.hostinfo.tpu_env import (
+    HostInfo,
+    host_info_from_mapping,
+)
+from gpu_feature_discovery_tpu.resource.hostinfo_backend import (
+    UNKNOWN_DRIVER_VERSION,
+    HostinfoManager,
+    StaticChip,
+)
+from gpu_feature_discovery_tpu.resource.types import ResourceError
+
+
+def cfg(**cli):
+    return new_config(cli_values=cli, environ={}, config_file=None)
+
+
+def manager_for(env: dict) -> HostinfoManager:
+    return HostinfoManager(cfg(), info=host_info_from_mapping(env))
+
+
+def test_single_host_inventory_from_accelerator_type():
+    m = manager_for({"TPU_ACCELERATOR_TYPE": "v4-8"})
+    m.init()
+    chips = m.get_chips()
+    # v4-8 = 8 TensorCores = 4 chips, all on one host.
+    assert len(chips) == 4
+    assert chips[0].get_name() == "tpu-v4"
+    assert chips[0].get_total_memory_mb() == 32 * 1024
+    assert chips[0].get_generation() == (4, 0)
+
+
+def test_multi_host_share_from_bounds():
+    m = manager_for(
+        {
+            "TPU_ACCELERATOR_TYPE": "v5p-64",
+            "TPU_CHIPS_PER_PROCESS_BOUNDS": "2,2,1",
+        }
+    )
+    m.init()
+    # 32-chip slice, but this host holds only its 2x2x1 share.
+    assert len(m.get_chips()) == 4
+
+
+def test_slice_binding_exposes_topology():
+    m = manager_for(
+        {"TPU_ACCELERATOR_TYPE": "v5e-16", "TPU_TOPOLOGY": "4x4"}
+    )
+    m.init()
+    chip = m.get_chips()[0]
+    assert chip.is_slice_enabled()
+    (sl,) = chip.get_slices()
+    assert sl.get_name() == "4x4"
+    attrs = sl.get_attributes()
+    assert attrs["chips"] == 16
+    assert attrs["memory"] == 16 * 1024 * 16
+    assert sl.get_parent_chip() is chip
+
+
+def test_init_fails_without_metadata():
+    m = HostinfoManager(cfg(), info=HostInfo())
+    with pytest.raises(ResourceError):
+        m.init()
+
+
+def test_unknown_accelerator_type_yields_no_chips():
+    m = manager_for({"TPU_ACCELERATOR_TYPE": "v99-8"})
+    m.init()
+    assert m.get_chips() == []
+
+
+def test_degraded_versions_without_libtpu(monkeypatch):
+    import gpu_feature_discovery_tpu.resource.hostinfo_backend as hb
+    from gpu_feature_discovery_tpu.native.shim import ProbeResult
+
+    monkeypatch.setattr(
+        "gpu_feature_discovery_tpu.native.shim.probe_libtpu",
+        lambda explicit=None: ProbeResult(False),
+    )
+    m = hb.HostinfoManager(cfg(), info=host_info_from_mapping(
+        {"TPU_ACCELERATOR_TYPE": "v4-8"}
+    ))
+    m.init()
+    assert m.get_driver_version() == UNKNOWN_DRIVER_VERSION
+    assert m.get_runtime_version() == (0, 0)
+
+
+def test_static_chip_partition_method_errors():
+    from gpu_feature_discovery_tpu.models.chips import spec_for
+
+    chip = StaticChip(spec_for("v4"))
+    with pytest.raises(ResourceError):
+        chip.get_attributes()
+    with pytest.raises(ResourceError):
+        chip.get_parent_chip()
+
+
+def test_full_label_pass_over_hostinfo_backend(tmp_path):
+    """The labeler stack runs unmodified over the metadata backend —
+    the backend seam holds (SURVEY.md section 1 inter-layer rule)."""
+    from gpu_feature_discovery_tpu.lm.tpu import new_tpu_labeler
+
+    m = manager_for(
+        {"TPU_ACCELERATOR_TYPE": "v4-8", "TPU_TOPOLOGY": "2x2x1"}
+    )
+    config = cfg(**{"machine-type-file": str(tmp_path / "absent")})
+    labels = new_tpu_labeler(m, config).labels()
+    assert labels["google.com/tpu.count"] == "4"
+    assert labels["google.com/tpu.product"] == "tpu-v4"
+    assert labels["google.com/tpu.family"] == "v4"
+
+
+def test_malformed_topology_degrades_to_single_chip_partition():
+    m = manager_for(
+        {"TPU_ACCELERATOR_TYPE": "v4-8", "TPU_TOPOLOGY": "2x2x2x2"}
+    )
+    m.init()
+    (sl,) = m.get_chips()[0].get_slices()
+    assert sl.get_attributes()["chips"] == 1  # degraded, not crashed
