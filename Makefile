@@ -27,6 +27,8 @@ integration:
 	$(PYTHON) tests/integration-tests.py --backend mock:v5p-8 \
 	    --hostenv "TPU_ACCELERATOR_TYPE=v5p-64;TPU_PROCESS_BOUNDS=2,2,2;TPU_CHIPS_PER_PROCESS_BOUNDS=2,2,1;TPU_TOPOLOGY_WRAP=true,true,true;TPU_WORKER_ID=0;TPU_WORKER_HOSTNAMES=w0,w1,w2,w3,w4,w5,w6,w7" \
 	    --golden tests/expected-output-interconnect.txt
+	$(PYTHON) tests/integration-tests.py --config tests/config-shared.yaml \
+	    --golden tests/expected-output-shared.txt
 
 bench:
 	$(PYTHON) bench.py

@@ -81,6 +81,8 @@ def run_subprocess_mode(args, out_dir):
         "--output-file", out_file,
         "--tpu-topology-strategy", args.strategy,
     ]
+    if args.config:
+        cmd += ["--config-file", os.path.abspath(args.config)]
     # Own process group so a hang can be killed as a unit even if the
     # daemon forked helpers.
     proc = subprocess.Popen(cmd, env=env, start_new_session=True)
@@ -129,12 +131,17 @@ def main():
         "(enables the mock PCI scanner; subprocess mode only)",
     )
     parser.add_argument(
+        "--config", help="config file passed to the daemon via --config-file"
+    )
+    parser.add_argument(
         "--golden", default=os.path.join(HERE, "expected-output.txt")
     )
     parser.add_argument("--timeout", type=float, default=120.0)
     args = parser.parse_args()
     if args.image and args.hostenv:
         parser.error("--hostenv requires subprocess mode (no --image)")
+    if args.image and args.config:
+        parser.error("--config requires subprocess mode (no --image)")
 
     print("Running integration tests for TFD")
     regexs = load_golden_regexs(args.golden)

@@ -139,3 +139,43 @@ def test_cli_version_flag(tmp_path):
     proc = spawn(tmp_path, "--version")
     assert proc.wait(timeout=60) == 0
     assert re.match(rb"\d+\.\d+\.\d+", proc.stdout.read().strip())
+
+
+def test_cli_sighup_picks_up_config_file_changes(tmp_path):
+    """SIGHUP must re-read the config file, not just rerun with the old one
+    (start()'s outer reload loop, main.go:117-145)."""
+    out = tmp_path / "tfd"
+    cfg = tmp_path / "config.yaml"
+    cfg.write_text(
+        "version: v1\n"
+        "sharing:\n"
+        "  timeSlicing:\n"
+        "    resources:\n"
+        "      - name: google.com/tpu\n"
+        "        replicas: 4\n"
+    )
+    proc = spawn(
+        tmp_path,
+        "--machine-type-file", "",
+        "-o", str(out),
+        "--sleep-interval", "60s",
+        "--config-file", str(cfg),
+    )
+    try:
+        assert wait_for_file(out)
+        assert "google.com/tpu.replicas=4" in out.read_text()
+
+        cfg.write_text("version: v1\n")  # sharing removed
+        proc.send_signal(signal.SIGHUP)
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            assert proc.poll() is None, proc.stderr.read().decode()
+            if out.exists() and "google.com/tpu.replicas=1" in out.read_text():
+                break
+            time.sleep(0.1)
+        content = out.read_text()
+        assert "google.com/tpu.replicas=1" in content
+        assert "-SHARED" not in content
+    finally:
+        if proc.poll() is None:
+            proc.kill()
