@@ -16,8 +16,6 @@ test: native
 	$(PYTHON) -m pytest tests/ -q
 
 integration:
-	$(PYTHON) tests/integration-tests.py
-	$(PYTHON) tests/integration-tests.py --backend mock:v5e-8
 	$(PYTHON) tests/integration-tests.py \
 	    --backend mock-slice:v4-8 --strategy single \
 	    --golden tests/expected-output-topology-single.txt
@@ -29,6 +27,10 @@ integration:
 	    --golden tests/expected-output-interconnect.txt
 	$(PYTHON) tests/integration-tests.py --config tests/config-shared.yaml \
 	    --golden tests/expected-output-shared.txt
+	for t in v4-8 v5e-8 v5p-8; do \
+	    $(PYTHON) tests/integration-tests.py --backend mock:$$t \
+	        --golden tests/expected-output-$$t.txt || exit 1; \
+	done
 
 bench:
 	$(PYTHON) bench.py

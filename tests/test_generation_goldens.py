@@ -1,0 +1,23 @@
+"""Per-generation exact-value goldens (BASELINE.json: golden parity for
+v4 / v5e / v5p nodes). Unlike the generic expected-output.txt regexes,
+these pin the actual published numbers for each generation, so a spec-table
+regression (wrong HBM size, wrong core counts) fails loudly."""
+
+import pytest
+
+from gpu_feature_discovery_tpu.resource.testing import new_single_host_manager
+
+from test_daemon import cfg_for, check_result, run_oneshot
+
+
+@pytest.mark.parametrize(
+    "accel_type,golden",
+    [
+        ("v4-8", "expected-output-v4-8.txt"),
+        ("v5e-8", "expected-output-v5e-8.txt"),
+        ("v5p-8", "expected-output-v5p-8.txt"),
+    ],
+)
+def test_generation_golden(tmp_path, accel_type, golden):
+    out = run_oneshot(new_single_host_manager(accel_type), cfg_for(tmp_path))
+    check_result(out, golden)

@@ -46,31 +46,34 @@ def native():
     shim.reset_native_cache()
 
 
-@pytest.fixture(scope="module")
-def fake_libtpu(native, tmp_path_factory):
-    """A .so exporting GetPjrtApi with PJRT API version 0.42 — enough of
-    the real struct prefix for the probe, nothing else."""
-    d = tmp_path_factory.mktemp("fake-libtpu")
-    src = d / "fake_libtpu.c"
-    src.write_text(
-        textwrap.dedent(
-            """
-            #include <stddef.h>
-            struct Version { size_t sz; void* ext; int major; int minor; };
-            struct Api { size_t sz; void* ext; struct Version v; };
-            static struct Api api = {sizeof(struct Api), 0,
-                                     {sizeof(struct Version), 0, 0, 42}};
-            extern "C" const struct Api* GetPjrtApi(void) { return &api; }
-            """
-        )
-    )
-    out = d / "libtpu.so"
+def _compile_so(directory, code, name="libtpu.so"):
+    """Compile a snippet into a shared object (fake PJRT plugins)."""
+    src = directory / "plugin.cc"
+    src.write_text(textwrap.dedent(code))
+    out = directory / name
     subprocess.run(
         ["g++", "-shared", "-fPIC", "-o", str(out), str(src)],
         check=True,
         capture_output=True,
     )
     return str(out)
+
+
+@pytest.fixture(scope="module")
+def fake_libtpu(native, tmp_path_factory):
+    """A .so exporting GetPjrtApi with PJRT API version 0.42 — enough of
+    the real struct prefix for the probe, nothing else."""
+    return _compile_so(
+        tmp_path_factory.mktemp("fake-libtpu"),
+        """
+        #include <stddef.h>
+        struct Version { size_t sz; void* ext; int major; int minor; };
+        struct Api { size_t sz; void* ext; struct Version v; };
+        static struct Api api = {sizeof(struct Api), 0,
+                                 {sizeof(struct Version), 0, 0, 42}};
+        extern "C" const struct Api* GetPjrtApi(void) { return &api; }
+        """,
+    )
 
 
 def test_probe_fake_libtpu(native, fake_libtpu):
@@ -80,6 +83,17 @@ def test_probe_fake_libtpu(native, fake_libtpu):
 
 def test_probe_missing_file(native):
     ok, major, minor = native.probe("/nonexistent/libtpu.so")
+    assert not ok
+    assert (major, minor) == (-1, -1)
+
+
+def test_probe_null_api(native, tmp_path):
+    """A plugin whose GetPjrtApi returns NULL must probe as not-ok
+    (TFD_ERROR_NULL_API), not crash."""
+    so = _compile_so(
+        tmp_path, 'extern "C" const void* GetPjrtApi(void) { return 0; }\n'
+    )
+    ok, major, minor = native.probe(so)
     assert not ok
     assert (major, minor) == (-1, -1)
 
