@@ -1,0 +1,26 @@
+#!/bin/sh
+# Tier-4 CI runner (reference shape: tests/ci-run-e2e.sh — point the static
+# daemonset at the image under test, deploy TFD + NFD, watch the Node).
+# Requires a kubeconfig for a cluster with a TPU node pool, e.g.:
+#   gcloud container clusters create tfd-e2e --num-nodes=1
+#   gcloud container node-pools create tpu --cluster=tfd-e2e \
+#       --machine-type=ct5lp-hightpu-4t --num-nodes=1
+set -e
+
+cd "$(dirname "$0")"
+
+if [ "$#" -lt 2 ]; then
+  echo "Usage: $0 IMAGE_NAME VERSION [GOLDEN]" && exit 1
+fi
+
+IMAGE_NAME=$1
+VERSION=$2
+GOLDEN=${3:-expected-output.txt}
+TFD_YAML_FILE="../deployments/static/tpu-feature-discovery-daemonset.yaml"
+NFD_YAML_FILE="nfd.yaml"
+
+pip install -q kubernetes pyyaml
+
+sed -i -E "s|image: .*tpu-feature-discovery:.*|image: ${IMAGE_NAME}:${VERSION}|" "$TFD_YAML_FILE"
+
+python e2e-tests.py "$TFD_YAML_FILE" "$NFD_YAML_FILE" "$GOLDEN"

@@ -1,0 +1,24 @@
+#!/bin/sh
+# Tier-3 CI runner (reference shape: tests/ci-run-integration.sh — install
+# deps, run the golden matrix). Without IMAGE the matrix runs in subprocess
+# mode (no docker needed); with IMAGE every scenario that supports docker
+# mode drives the container instead.
+set -e
+
+cd "$(dirname "$0")/.."
+
+IMAGE=$1
+
+pip install -q "jax[cpu]" pyyaml 2>/dev/null || true
+
+if [ -n "$IMAGE" ]; then
+  python tests/integration-tests.py --image "$IMAGE" \
+      --golden tests/expected-output-v4-8.txt
+  python tests/integration-tests.py --image "$IMAGE" --backend mock:v5e-8 \
+      --golden tests/expected-output-v5e-8.txt
+  python tests/integration-tests.py --image "$IMAGE" \
+      --backend mock-slice:v4-8 --strategy single \
+      --golden tests/expected-output-topology-single.txt
+else
+  make integration
+fi
