@@ -9,10 +9,11 @@ reference publishes no numbers (SURVEY.md section 6), so vs_baseline is
 measured-p50 vs that target: > 1.0 means faster than required.
 
 Backend: the real PJRT/JAX TPU backend when a chip is reachable; otherwise
-the v5p multi-host mock fixture (BASELINE.json config #4 shape) so the
-benchmark is runnable anywhere. The backend actually used is reported in
-the JSON line (stdout is exactly one JSON object; diagnostics go to
-stderr).
+a mock of one v5p-256 pod worker (the BASELINE target scale: "p50 < 100ms
+across a v5p-256 pod" — each daemonset worker labels only its own node, so
+one worker's pass IS the per-node workload at pod scale). The backend
+actually used is reported in the JSON line (stdout is exactly one JSON
+object; diagnostics go to stderr).
 """
 
 from __future__ import annotations
@@ -53,12 +54,13 @@ def main() -> int:
 
     from gpu_feature_discovery_tpu.cmd.main import new_interconnect_labeler
     from gpu_feature_discovery_tpu.config.flags import new_config
+    from gpu_feature_discovery_tpu.hostinfo.provider import StaticProvider
+    from gpu_feature_discovery_tpu.hostinfo.tpu_env import host_info_from_mapping
+    from gpu_feature_discovery_tpu.lm.interconnect import InterconnectLabeler
     from gpu_feature_discovery_tpu.lm.labelers import new_labelers
     from gpu_feature_discovery_tpu.lm.labeler import Merge
     from gpu_feature_discovery_tpu.lm.timestamp import new_timestamp_labeler
-    from gpu_feature_discovery_tpu.resource.testing import (
-        new_uniform_slice_manager,
-    )
+    from gpu_feature_discovery_tpu.resource.testing import MockChip, MockManager
 
     out_dir = tempfile.mkdtemp(prefix="tfd-bench-")
     out_file = os.path.join(out_dir, "tfd")
@@ -69,12 +71,37 @@ def main() -> int:
     )
 
     manager = _real_tpu_manager(config)
-    backend = "pjrt-jax"
-    if manager is None:
-        # BASELINE.json config #4 shape: multi-host v5p-64 uniform slice.
-        manager = new_uniform_slice_manager("v5p-64")
-        backend = "mock-v5p-64"
-    interconnect = new_interconnect_labeler(config)
+    if manager is not None:
+        backend = "pjrt-jax"
+        interconnect = new_interconnect_labeler(config)
+    else:
+        # One worker of a v5p-256 pod: local chips bound into the pod-wide
+        # slice, multi-host facts from a static metadata fixture. Every
+        # shape fact derives from the one accelerator-type parse so the
+        # chip fixture and the host-info fixture cannot disagree.
+        from gpu_feature_discovery_tpu.models import parse_accelerator_type
+
+        at = parse_accelerator_type("v5p-256")
+        chips_per_host = at.spec.chips_per_host
+        manager = MockManager(
+            chips=[
+                MockChip(family=at.spec.family, slice_topologies=[at.topology_str])
+                for _ in range(chips_per_host)
+            ]
+        )
+        backend = f"mock-{at.name}-worker"
+        pod_fixture = host_info_from_mapping(
+            {
+                "TPU_ACCELERATOR_TYPE": at.name,
+                "TPU_TOPOLOGY": at.topology_str,
+                "TPU_TOPOLOGY_WRAP": "true,true,true",
+                "TPU_WORKER_ID": "0",
+                "TPU_WORKER_HOSTNAMES": ",".join(
+                    f"w{i}" for i in range(at.hosts)
+                ),
+            }
+        )
+        interconnect = InterconnectLabeler(provider=StaticProvider(pod_fixture))
     timestamp = new_timestamp_labeler(config)
 
     samples_ms = []
