@@ -1,0 +1,64 @@
+"""Factory auto-detection chain: PJRT -> hostinfo -> null
+(the hasNVML -> isTegra -> null order, factory.go:54-73)."""
+
+from gpu_feature_discovery_tpu.config.flags import new_config
+from gpu_feature_discovery_tpu.resource import factory
+from gpu_feature_discovery_tpu.resource.fallback import FallbackToNullOnInitError
+from gpu_feature_discovery_tpu.resource.hostinfo_backend import HostinfoManager
+from gpu_feature_discovery_tpu.resource.null import NullManager
+from gpu_feature_discovery_tpu.hostinfo.tpu_env import host_info_from_mapping
+
+
+def cfg(**cli):
+    return new_config(cli_values=cli, environ={}, config_file=None)
+
+
+def patch_detection(monkeypatch, has_tpu, jax_mgr, info):
+    monkeypatch.setattr(
+        factory, "_detect_tpu_platform", lambda config: (has_tpu, "patched")
+    )
+    monkeypatch.setattr(factory, "_try_jax_manager", lambda config: jax_mgr)
+    monkeypatch.setattr(
+        factory,
+        "_try_hostinfo_manager",
+        lambda config: HostinfoManager(config, info=info) if info else None,
+    )
+    monkeypatch.delenv(factory.BACKEND_ENV, raising=False)
+
+
+def test_prefers_jax_when_available(monkeypatch):
+    sentinel = object()
+    patch_detection(monkeypatch, True, sentinel, None)
+    assert factory._get_manager(cfg()) is sentinel
+
+
+def test_falls_back_to_hostinfo_without_jax(monkeypatch):
+    info = host_info_from_mapping({"TPU_ACCELERATOR_TYPE": "v4-8"})
+    patch_detection(monkeypatch, True, None, info)
+    assert isinstance(factory._get_manager(cfg()), HostinfoManager)
+
+
+def test_null_when_no_backend_usable(monkeypatch):
+    patch_detection(monkeypatch, True, None, None)
+    assert isinstance(factory._get_manager(cfg()), NullManager)
+
+
+def test_null_off_tpu_without_probing_backends(monkeypatch):
+    def boom(config):
+        raise AssertionError("backends must not be probed off-TPU")
+
+    monkeypatch.setattr(
+        factory, "_detect_tpu_platform", lambda config: (False, "patched")
+    )
+    monkeypatch.setattr(factory, "_try_jax_manager", boom)
+    monkeypatch.setattr(factory, "_try_hostinfo_manager", boom)
+    monkeypatch.delenv(factory.BACKEND_ENV, raising=False)
+    assert isinstance(factory._get_manager(cfg()), NullManager)
+
+
+def test_fallback_wrapper_applied_iff_not_fail_on_init(monkeypatch):
+    patch_detection(monkeypatch, True, None, None)
+    wrapped = factory.new_manager(cfg(**{"fail-on-init-error": "false"}))
+    assert isinstance(wrapped, FallbackToNullOnInitError)
+    bare = factory.new_manager(cfg(**{"fail-on-init-error": "true"}))
+    assert not isinstance(bare, FallbackToNullOnInitError)
