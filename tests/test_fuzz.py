@@ -1,0 +1,152 @@
+"""Property-based tests over the externally-facing parsers and the two
+capability walkers.
+
+The reference pins its binary-format walker with two captured blobs; these
+go further: random config spaces must never crash either walker, and the
+C++ twin must agree with the Python one bit-for-bit on every input — the
+strongest form of the cross-check contract (test_native.py runs the same
+check on curated blobs only).
+"""
+
+import shutil
+import string
+
+import pytest
+from hypothesis import given, settings
+from hypothesis import strategies as st
+
+from gpu_feature_discovery_tpu.config.flags import parse_duration
+from gpu_feature_discovery_tpu.config.spec import ConfigError
+from gpu_feature_discovery_tpu.hostinfo.tpu_env import (
+    host_info_from_mapping,
+    parse_tpu_env,
+)
+from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.pci.pciutil import PCIDevice
+
+
+# ---------------------------------------------------------------------------
+# tpu-env parser: externally provided metadata must never crash
+# ---------------------------------------------------------------------------
+
+@given(st.text(max_size=2000))
+@settings(max_examples=200)
+def test_parse_tpu_env_never_raises(text):
+    out = parse_tpu_env(text)
+    assert isinstance(out, dict)
+
+
+@given(
+    st.dictionaries(
+        st.text(alphabet=string.ascii_uppercase + "_", min_size=1, max_size=20),
+        st.text(
+            alphabet=string.ascii_letters + string.digits + ",x-.",
+            max_size=30,
+        ),
+        max_size=10,
+    )
+)
+@settings(max_examples=100)
+def test_host_info_from_arbitrary_mapping_never_raises(kv):
+    info = host_info_from_mapping(kv)
+    assert info.worker_id is None or info.worker_id >= 0
+
+
+@given(
+    st.dictionaries(
+        st.text(alphabet=string.ascii_uppercase + "_", min_size=1, max_size=16),
+        st.text(alphabet=string.ascii_letters + string.digits + "-_,x", max_size=20),
+        max_size=8,
+    )
+)
+@settings(max_examples=100)
+def test_parse_tpu_env_round_trips_wellformed_docs(kv):
+    doc = "".join(f"{k}: '{v}'\n" for k, v in kv.items())
+    assert parse_tpu_env(doc) == kv
+
+
+# ---------------------------------------------------------------------------
+# duration parser
+# ---------------------------------------------------------------------------
+
+@given(st.integers(min_value=0, max_value=10**6))
+def test_parse_duration_seconds(n):
+    assert parse_duration(f"{n}s") == float(n)
+    assert parse_duration(str(n)) == float(n)
+
+
+@given(st.text(alphabet=string.ascii_letters + "%$#@! ", min_size=1, max_size=10))
+@settings(max_examples=100)
+def test_parse_duration_garbage_raises_config_error(text):
+    try:
+        float(text)
+        return  # plain numbers are valid by design
+    except ValueError:
+        pass
+    try:
+        parse_duration(text)
+    except ConfigError:
+        return
+    # Anything parse_duration accepts must decompose into valid units.
+    assert any(u in text for u in ("ns", "us", "ms", "s", "m", "h"))
+
+
+# ---------------------------------------------------------------------------
+# capability walkers: no crash + C++/Python bit-for-bit parity
+# ---------------------------------------------------------------------------
+
+def _python_walk(config: bytes):
+    dev = PCIDevice(
+        path="", address="0000:00:04.0", vendor="0x1ae0",
+        device_class="0x0880", config=config,
+    )
+    return dev.get_vendor_specific_capability()
+
+
+@given(st.binary(min_size=256, max_size=256))
+@settings(max_examples=300)
+def test_python_walker_never_crashes(config):
+    result = _python_walk(config)
+    assert result is None or isinstance(result, bytes)
+
+
+@pytest.mark.skipif(shutil.which("g++") is None, reason="no native toolchain")
+@given(st.binary(min_size=256, max_size=256))
+@settings(max_examples=300, deadline=None)
+def test_walkers_agree_on_random_config_spaces(config):
+    from gpu_feature_discovery_tpu.native import shim
+
+    native = shim.load_native()
+    if native is None:
+        pytest.skip("native library not built")
+    assert native.pci_vendor_capability(config) == _python_walk(config)
+
+
+# ---------------------------------------------------------------------------
+# label file round trip
+# ---------------------------------------------------------------------------
+
+@given(
+    st.dictionaries(
+        st.text(
+            alphabet=string.ascii_letters + string.digits + "./-",
+            min_size=1,
+            max_size=40,
+        ).filter(lambda s: "=" not in s),
+        st.text(
+            alphabet=string.ascii_letters + string.digits + ".-_",
+            max_size=20,
+        ),
+        max_size=20,
+    )
+)
+@settings(max_examples=100)
+def test_labels_file_round_trip(tmp_path_factory, kv):
+    d = tmp_path_factory.mktemp("labels")
+    path = d / "tfd"
+    Labels(kv).write_to_file(str(path))
+    written = {}
+    for line in path.read_text().splitlines():
+        k, _, v = line.partition("=")
+        written[k] = v
+    assert written == {k: str(v) for k, v in kv.items()}
