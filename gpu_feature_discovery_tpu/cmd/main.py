@@ -165,18 +165,19 @@ def new_interconnect_labeler(config: Config) -> Labeler:
 
 def _env_flag(name: str) -> bool:
     """Value-aware env toggle with the same boolean grammar as every other
-    TFD flag (config.spec.parse_bool); unset/empty is off, an unparseable
-    value counts as on (presence implies intent) with a warning."""
+    TFD flag (config.spec.parse_bool); unset/empty is off. An unparseable
+    value is a hard ConfigError — a typo like TFD_HERMETIC=fals must not
+    silently flip behavior in either direction (strict parse-or-error, the
+    same contract every TFD_* boolean flag has)."""
     raw = os.environ.get(name, "").strip()
     if not raw:
         return False
-    try:
-        from gpu_feature_discovery_tpu.config.spec import parse_bool
+    from gpu_feature_discovery_tpu.config.spec import parse_bool
 
+    try:
         return parse_bool(raw)
-    except ConfigError:
-        log.warning("%s=%r is not a boolean; treating as enabled", name, raw)
-        return True
+    except ConfigError as e:
+        raise ConfigError(f"{name}={raw!r} is not a boolean: {e}") from e
 
 
 class _TolerantPCI:

@@ -31,6 +31,28 @@ HEALTH_HBM = "google.com/tpu.health.hbm-gbps"
 HEALTH_ICI = "google.com/tpu.health.ici.ok"
 
 
+def _acquire_tpu_devices():
+    """Local TPU devices, or None when the probe cannot ACQUIRE them.
+
+    Acquisition failure says nothing about chip health: jax may be absent,
+    the PJRT client may be un-creatable (the TPU is owned by another
+    container — the hostinfo-backend situation), or jax may have silently
+    fallen back to CPU. In all of those cases publishing any health label
+    would be a lie — a CPU-measured matmul rate is not TPU health, and a
+    merely-busy chip is not a failed one.
+    """
+    try:
+        import jax
+
+        devices = jax.local_devices()
+    except Exception as e:  # noqa: BLE001 - backend init failures funnel here
+        log.warning("burn-in skipped: cannot acquire devices: %s", e)
+        return None
+    if not devices or any(getattr(d, "platform", "") != "tpu" for d in devices):
+        return None
+    return devices
+
+
 def new_health_labeler(manager: Manager, config: Config) -> Labeler:
     """Empty unless --with-burnin and the node actually has chips."""
     if not config.flags.tfd.with_burnin:
@@ -44,10 +66,20 @@ def new_health_labeler(manager: Manager, config: Config) -> Labeler:
         # the labels rather than mark a healthy node unhealthy.
         log.warning("burn-in unavailable (no usable jax): %s", e)
         return Empty()
+    devices = _acquire_tpu_devices()
+    if devices is None:
+        log.warning(
+            "burn-in skipped: no local TPU devices acquirable (chip busy, "
+            "PJRT unusable, or CPU fallback); publishing no health labels"
+        )
+        return Empty()
     try:
-        report = measure_node_health()
+        report = measure_node_health(devices=devices)
     except Exception as e:  # noqa: BLE001 - degraded chip must not kill labeling
-        log.warning("burn-in failed: %s", e)
+        # Devices were ACQUIRED but the burn-in computation failed on them:
+        # that is a chip-execution failure, the one case health.ok=false is
+        # an honest signal (contrast _acquire_tpu_devices returning None).
+        log.warning("burn-in failed on acquired TPU devices: %s", e)
         return Labels({HEALTH_OK: "false"})
     labels = Labels(
         {

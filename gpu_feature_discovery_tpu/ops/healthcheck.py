@@ -85,11 +85,23 @@ def burnin_flops(size: int, depth: int) -> float:
     return 2.0 * depth * size**3
 
 
+@functools.lru_cache(maxsize=None)
+def _jitted_burnin(size: int, depth: int, dtype) -> Tuple[callable, jax.Array, jax.Array]:
+    """One jitted burn-in per (size, depth, dtype), cached for the process
+    lifetime (same rationale as hbm.py's _jitted_stream_sum): the daemon
+    calls this every labeling cycle for every device, and a fresh
+    ``jax.jit`` wrapper per call would re-trace and occupy the chip for
+    compile time each cycle."""
+    fn, (x, ws) = make_burnin_step(size=size, depth=depth, dtype=dtype)
+    return jax.jit(fn), x, ws
+
+
 def measure_chip_health(
     size: int = 512,
     depth: int = 8,
     iters: int = 4,
     device=None,
+    dtype=jnp.bfloat16,
 ) -> dict:
     """Run the burn-in on one chip and report health + achieved TFLOP/s.
 
@@ -97,10 +109,9 @@ def measure_chip_health(
     best-of-``iters`` sustained matmul rate, which on a healthy TPU should
     sit near the chip's bf16 peak.
     """
-    fn, (x, ws) = make_burnin_step(size=size, depth=depth)
+    step, x, ws = _jitted_burnin(size, depth, dtype)
     if device is not None:
         x, ws = jax.device_put(x, device), jax.device_put(ws, device)
-    step = jax.jit(fn)
     checksum, rms = jax.block_until_ready(step(x, ws))  # compile + warm
     best = float("inf")
     for _ in range(iters):
@@ -120,17 +131,23 @@ def measure_node_health(
     depth: int = 8,
     iters: int = 4,
     ici: Optional[bool] = None,
+    devices: Optional[list] = None,
 ) -> dict:
     """Burn in EVERY local device and aggregate: a node is healthy only if
     all of its chips are, and the published rate is the worst chip's (the
     slowest chip governs what a workload will see).
+
+    ``devices`` lets the caller pass an already-acquired device list (the
+    health labeler acquires first so it can tell "cannot acquire" apart
+    from "acquired but failing"); default is every local device.
 
     On real TPUs the HBM streaming probe (ops/hbm.py) runs too; elsewhere
     ``hbm_gbps`` is None — the interpreter would be slow and the number
     meaningless as bandwidth. ``ici`` (auto: multi-chip TPU nodes) rings
     the local chips with ppermute to verify every intra-host ICI link.
     """
-    devices = jax.local_devices()
+    if devices is None:
+        devices = jax.local_devices()
     on_tpu = all(d.platform == "tpu" for d in devices)
     reports = [
         measure_chip_health(size=size, depth=depth, iters=iters, device=d)

@@ -186,10 +186,12 @@ class HostinfoManager(Manager):
         return list(self._chips)
 
     def get_driver_version(self) -> str:
-        if self._probed and self._probed.found and self._probed.api_major >= 0:
-            # libtpu file present but client unusable: its PJRT API version
-            # is still a fact worth labeling.
-            return f"{self._probed.api_major}.{self._probed.api_minor}.0"
+        # Always the honest degradation (cuda-lib.go:68-70): without a
+        # usable client the libtpu DISTRIBUTION version is unknowable. The
+        # PJRT C API version the native probe can still read is a runtime
+        # fact, not a driver version — labeling it here would publish
+        # tpu.driver.major=0 and mislead every consumer keying on it; it is
+        # surfaced through get_runtime_version() instead.
         return UNKNOWN_DRIVER_VERSION
 
     def get_runtime_version(self) -> Tuple[int, int]:

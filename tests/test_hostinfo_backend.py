@@ -89,6 +89,38 @@ def test_degraded_versions_without_libtpu(monkeypatch):
     assert m.get_runtime_version() == (0, 0)
 
 
+def test_driver_version_never_derived_from_api_version(monkeypatch):
+    """VERDICT r1: a probed PJRT C API version (e.g. 0.67) must surface as
+    the RUNTIME version only — labeling it as the driver version would
+    publish tpu.driver.major=0 and feed garbage to label consumers."""
+    import gpu_feature_discovery_tpu.resource.hostinfo_backend as hb
+    from gpu_feature_discovery_tpu.native.shim import ProbeResult
+
+    monkeypatch.setattr(
+        "gpu_feature_discovery_tpu.native.shim.probe_libtpu",
+        lambda explicit=None: ProbeResult(
+            True, source="fake", api_major=0, api_minor=67
+        ),
+    )
+    m = hb.HostinfoManager(cfg(), info=host_info_from_mapping(
+        {"TPU_ACCELERATOR_TYPE": "v4-8"}
+    ))
+    m.init()
+    assert m.get_driver_version() == UNKNOWN_DRIVER_VERSION
+    assert m.get_runtime_version() == (0, 67)
+
+    from gpu_feature_discovery_tpu.lm.versions import (
+        DRIVER_MAJOR,
+        RUNTIME_MAJOR,
+        RUNTIME_MINOR,
+        new_version_labeler,
+    )
+
+    labels = new_version_labeler(m)
+    assert labels[DRIVER_MAJOR] == "unknown"
+    assert (labels[RUNTIME_MAJOR], labels[RUNTIME_MINOR]) == ("0", "67")
+
+
 def test_static_chip_partition_method_errors():
     from gpu_feature_discovery_tpu.models.chips import spec_for
 
