@@ -64,8 +64,16 @@ def main() -> int:
 
     out_dir = tempfile.mkdtemp(prefix="tfd-bench-")
     out_file = os.path.join(out_dir, "tfd")
+    # strategy=single is the flagship labeling path (slice-bound chips +
+    # overloaded google.com/tpu.* slice labels) and the slice binding is
+    # live on the PJRT backend, so the bench measures it — the heaviest
+    # per-cycle label workload, not the cheapest.
     config = new_config(
-        cli_values={"oneshot": "true", "output-file": out_file},
+        cli_values={
+            "oneshot": "true",
+            "output-file": out_file,
+            "tpu-topology-strategy": "single",
+        },
         environ={},
         config_file=None,
     )

@@ -23,6 +23,7 @@ from gpu_feature_discovery_tpu.config.flags import (
     CONFIG_FILE_ENV_VARS,
     FLAG_DEFS,
     disable_resource_renaming,
+    env_flag as _env_flag,
     new_config,
 )
 from gpu_feature_discovery_tpu.config.spec import Config, ConfigError
@@ -142,10 +143,13 @@ def new_interconnect_labeler(config: Config) -> Labeler:
     testing on real TPU VMs (where host facts would leak into golden
     comparisons): TFD_NO_METADATA=1 skips the GCE metadata server;
     TFD_HERMETIC=1 additionally blanks the env-var provider (needed because
-    site hooks can re-inject TPU_* into any child python process)."""
+    site hooks can re-inject TPU_* into any child python process). The
+    gating semantics live in hostinfo.provider.gated_provider_args so the
+    PJRT slice binding and this labeler can never disagree."""
     del config  # reserved for future flags
-    hermetic = _env_flag("TFD_HERMETIC")
-    use_mds = not hermetic and not _env_flag("TFD_NO_METADATA")
+    from gpu_feature_discovery_tpu.hostinfo.provider import gated_provider_args
+
+    environ, use_mds = gated_provider_args()
     if _env_flag("TFD_MOCK_PCI"):
         # Integration fixture: synthesized Google PCI functions (the
         # reference gets real PCI devices from its GPU CI host; our
@@ -157,27 +161,8 @@ def new_interconnect_labeler(config: Config) -> Labeler:
         pci = _TolerantPCI()
     return InterconnectLabeler(
         pci=pci,
-        provider=ChainedProvider(
-            environ={} if hermetic else None, use_metadata_server=use_mds
-        ),
+        provider=ChainedProvider(environ, use_metadata_server=use_mds),
     )
-
-
-def _env_flag(name: str) -> bool:
-    """Value-aware env toggle with the same boolean grammar as every other
-    TFD flag (config.spec.parse_bool); unset/empty is off. An unparseable
-    value is a hard ConfigError — a typo like TFD_HERMETIC=fals must not
-    silently flip behavior in either direction (strict parse-or-error, the
-    same contract every TFD_* boolean flag has)."""
-    raw = os.environ.get(name, "").strip()
-    if not raw:
-        return False
-    from gpu_feature_discovery_tpu.config.spec import parse_bool
-
-    try:
-        return parse_bool(raw)
-    except ConfigError as e:
-        raise ConfigError(f"{name}={raw!r} is not a boolean: {e}") from e
 
 
 class _TolerantPCI:

@@ -39,6 +39,23 @@ _DURATION_UNITS = {
 }
 
 
+def env_flag(name: str) -> bool:
+    """Value-aware env toggle with the same boolean grammar as every other
+    TFD flag (config.spec.parse_bool); unset/empty is off. An unparseable
+    value is a hard ConfigError — a typo like TFD_HERMETIC=fals must not
+    silently flip behavior in either direction (strict parse-or-error, the
+    same contract every TFD_* boolean flag has)."""
+    import os
+
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return False
+    try:
+        return _parse_bool(raw)
+    except ConfigError as e:
+        raise ConfigError(f"{name}={raw!r} is not a boolean: {e}") from e
+
+
 def parse_duration(value: Any) -> float:
     """Parse a Go-style duration ("60s", "1m30s", "100ms") or a bare number
     of seconds into float seconds (cli.DurationFlag analog)."""

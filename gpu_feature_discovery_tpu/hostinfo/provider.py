@@ -135,3 +135,22 @@ def discover_host_info(
     use_metadata_server: bool = True,
 ) -> Optional[HostInfo]:
     return ChainedProvider(environ, use_metadata_server).host_info()
+
+
+def gated_provider_args() -> tuple:
+    """(environ, use_metadata_server) honoring the TFD_HERMETIC /
+    TFD_NO_METADATA escape hatches — the ONE place the gating semantics
+    live. Every in-daemon metadata consumer (interconnect labeler, PJRT
+    slice binding) builds its provider from this so a hermetic golden run
+    sees no host facts from ANY path. Raises ConfigError on typo'd values
+    (env_flag's strict contract)."""
+    from gpu_feature_discovery_tpu.config.flags import env_flag
+
+    hermetic = env_flag("TFD_HERMETIC")
+    use_mds = not hermetic and not env_flag("TFD_NO_METADATA")
+    return ({} if hermetic else None), use_mds
+
+
+def discover_host_info_gated() -> Optional[HostInfo]:
+    environ, use_mds = gated_provider_args()
+    return discover_host_info(environ, use_metadata_server=use_mds)

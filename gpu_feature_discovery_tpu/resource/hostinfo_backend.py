@@ -28,8 +28,8 @@ from gpu_feature_discovery_tpu.hostinfo.tpu_env import (
     _parse_bounds as parse_bounds,
 )
 from gpu_feature_discovery_tpu.models import parse_accelerator_type
-from gpu_feature_discovery_tpu.models.accelerator_types import parse_topology
-from gpu_feature_discovery_tpu.models.chips import ChipSpec, hosts_for
+from gpu_feature_discovery_tpu.models.chips import ChipSpec
+from gpu_feature_discovery_tpu.resource.slice_partition import SlicePartition
 from gpu_feature_discovery_tpu.resource.types import Chip, Manager, ResourceError
 
 log = logging.getLogger("tfd.resource")
@@ -37,61 +37,11 @@ log = logging.getLogger("tfd.resource")
 UNKNOWN_DRIVER_VERSION = "unknown.unknown.unknown"  # cuda-lib.go:68-70 analog
 
 
-class StaticSlice(Chip):
+class StaticSlice(SlicePartition):
     """Slice partition synthesized from the slice topology string (the
-    nvml-mig-device analog, facts from the spec tables instead of NVML)."""
-
-    def __init__(self, topology: str, parent: "StaticChip", spec: ChipSpec):
-        self._topology = topology
-        self._parent = parent
-        self._spec = spec
-
-    def _dims(self) -> Tuple[int, ...]:
-        # Metadata is externally provided: a malformed or >3-dim topology
-        # string degrades to a 1-chip partition rather than crashing the
-        # labeling pass.
-        dims = parse_topology(self._topology)
-        if not dims or len(dims) > 3:
-            return (1, 1, 1)
-        return tuple(dims) + (1,) * (3 - len(dims))
-
-    def is_slice_enabled(self) -> bool:
-        raise ResourceError("is_slice_enabled not supported for slice partitions")
-
-    def is_slice_capable(self) -> bool:
-        raise ResourceError("is_slice_capable not supported for slice partitions")
-
-    def get_slices(self) -> List[Chip]:
-        raise ResourceError("get_slices not supported for slice partitions")
-
-    def get_attributes(self) -> Dict[str, object]:
-        x, y, z = self._dims()
-        chips = x * y * z
-        spec = self._spec
-        return {
-            "memory": spec.hbm_mb * chips,
-            "tensorcores": spec.tensorcores * chips,
-            "sparsecores": spec.sparsecores * chips,
-            "chips": chips,
-            "topology.x": x,
-            "topology.y": y,
-            "topology.z": z,
-            "hosts": hosts_for(spec, chips),
-            "ici.links": spec.ici_links_per_chip * chips,
-        }
-
-    def get_name(self) -> str:
-        return self._topology
-
-    def get_total_memory_mb(self) -> int:
-        x, y, z = self._dims()
-        return self._spec.hbm_mb * x * y * z
-
-    def get_parent_chip(self) -> Chip:
-        return self._parent
-
-    def get_generation(self) -> Tuple[int, int]:
-        return (self._spec.generation, self._spec.variant_rank)
+    nvml-mig-device analog, facts from the spec tables instead of NVML).
+    All behavior lives in the shared SlicePartition — the PJRT backend
+    binds the same partition type to live chips."""
 
 
 class StaticChip(Chip):

@@ -1,0 +1,89 @@
+"""Slice-partition device shared by the PJRT and hostinfo backends.
+
+The nvml-mig-device analog (internal/resource/nvml-mig-device.go:35-105):
+a sub-grid of the chip fabric a chip is bound into, named by its topology
+string ("2x2x1"). On TPU, slice membership is a provisioning-time fact —
+the accelerator type / TPU_TOPOLOGY metadata, or the live device-coordinate
+bounding box — so partition ATTRIBUTES derive from the generation spec
+tables scaled by the topology dims, with a live per-chip HBM override when
+the parent backend measured one (the PJRT path).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+from gpu_feature_discovery_tpu.models.accelerator_types import parse_topology
+from gpu_feature_discovery_tpu.models.chips import ChipSpec, hosts_for
+from gpu_feature_discovery_tpu.resource.types import Chip, ResourceError
+
+
+class SlicePartition(Chip):
+    """One slice partition attached to a parent chip.
+
+    Mirrors nvmlMigDevice's asymmetry: attribute/parent methods work, the
+    full-chip methods raise (nvml-mig-device.go vs nvml-device.go).
+    """
+
+    def __init__(
+        self,
+        topology: str,
+        parent: Chip,
+        spec: ChipSpec,
+        per_chip_memory_mb: Optional[int] = None,
+    ):
+        self._topology = topology
+        self._parent = parent
+        self._spec = spec
+        # Live HBM reading from the parent backend when available (PJRT
+        # memory_stats); the spec table otherwise.
+        self._chip_mb = per_chip_memory_mb or spec.hbm_mb
+
+    def _dims(self) -> Tuple[int, ...]:
+        # Topology may be externally provided metadata: a malformed or
+        # >3-dim string degrades to a 1-chip partition rather than crashing
+        # the labeling pass.
+        dims = parse_topology(self._topology)
+        if not dims or len(dims) > 3:
+            return (1, 1, 1)
+        return tuple(dims) + (1,) * (3 - len(dims))
+
+    def is_slice_enabled(self) -> bool:
+        raise ResourceError("is_slice_enabled not supported for slice partitions")
+
+    def is_slice_capable(self) -> bool:
+        raise ResourceError("is_slice_capable not supported for slice partitions")
+
+    def get_slices(self) -> List[Chip]:
+        raise ResourceError("get_slices not supported for slice partitions")
+
+    def get_attributes(self) -> Dict[str, object]:
+        """The 9-attribute family (nvml-mig-device.go:35-53 analog, TPU
+        vocabulary: chips/topology/hosts/ici.links for slices/engines)."""
+        x, y, z = self._dims()
+        chips = x * y * z
+        spec = self._spec
+        return {
+            "memory": self._chip_mb * chips,
+            "tensorcores": spec.tensorcores * chips,
+            "sparsecores": spec.sparsecores * chips,
+            "chips": chips,
+            "topology.x": x,
+            "topology.y": y,
+            "topology.z": z,
+            "hosts": hosts_for(spec, chips),
+            "ici.links": spec.ici_links_per_chip * chips,
+        }
+
+    def get_name(self) -> str:
+        return self._topology
+
+    def get_total_memory_mb(self) -> int:
+        x, y, z = self._dims()
+        return self._chip_mb * x * y * z
+
+    def get_parent_chip(self) -> Chip:
+        return self._parent
+
+    def get_generation(self) -> Tuple[int, int]:
+        return (self._spec.generation, self._spec.variant_rank)

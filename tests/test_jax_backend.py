@@ -1,0 +1,189 @@
+"""Slice-aware PJRT/JAX backend — hardware-free via a fake enumeration.
+
+The reference's primary backend fully implements its partitioning story
+(internal/resource/nvml-device.go:40-56 IsMigEnabled/GetMigDevices on the
+live NVML handle); these tests pin the TPU analog: live-enumerated chips
+bound into their provisioned slice from metadata or from the global PJRT
+device-coordinate bounding box, so strategy=single/mixed fires on real
+TPU nodes, not only on mocks.
+"""
+
+import pytest
+
+import gpu_feature_discovery_tpu.resource.jax_backend as jb
+from gpu_feature_discovery_tpu.config.flags import new_config
+from gpu_feature_discovery_tpu.resource.jax_backend import (
+    JaxManager,
+    _topology_from_coords,
+)
+
+
+class FakeDev:
+    """Duck-typed PJRT device (jaxlib Device attributes we consume)."""
+
+    def __init__(self, id, coords, kind="TPU v5p", process_index=0, mem=None):
+        self.id = id
+        self.coords = coords
+        self.device_kind = kind
+        self.process_index = process_index
+        self._mem = mem
+
+    def memory_stats(self):
+        if self._mem is None:
+            raise RuntimeError("memory_stats unsupported")
+        return {"bytes_limit": self._mem}
+
+
+def cfg(**cli):
+    return new_config(cli_values=cli, environ={}, config_file=None)
+
+
+def grid(nx, ny, nz, kind="TPU v5p", local=None):
+    devs = []
+    i = 0
+    for x in range(nx):
+        for y in range(ny):
+            for z in range(nz):
+                devs.append(FakeDev(i, [x, y, z], kind=kind))
+                i += 1
+    return devs
+
+
+def manager_with(local, all_devs, monkeypatch, metadata_info=None):
+    monkeypatch.setattr(jb, "_enumerate_tpu_devices", lambda: (local, all_devs))
+    monkeypatch.setattr(
+        "gpu_feature_discovery_tpu.hostinfo.provider.discover_host_info_gated",
+        lambda: metadata_info,
+    )
+    m = JaxManager(cfg())
+    m.init()
+    return m
+
+
+# ---------------------------------------------------------------------------
+# Coordinate bounding box
+# ---------------------------------------------------------------------------
+
+def test_topology_from_dense_3d_box():
+    assert _topology_from_coords(grid(2, 2, 1)) == "2x2x1"
+    assert _topology_from_coords(grid(2, 2, 2)) == "2x2x2"
+
+
+def test_topology_trims_singleton_z_for_2d_generations():
+    # v5e coords are 3-vectors with z always 0; its topology vocabulary is 2D.
+    assert _topology_from_coords(grid(2, 2, 1), ndims=2) == "2x2"
+    assert _topology_from_coords(grid(1, 1, 1), ndims=2) == "1x1"
+
+
+def test_topology_rejects_sparse_and_malformed():
+    sparse = [FakeDev(0, [0, 0, 0]), FakeDev(1, [2, 0, 0])]  # hole at x=1
+    assert _topology_from_coords(sparse) == ""
+    assert _topology_from_coords([FakeDev(0, None)]) == ""
+    ragged = [FakeDev(0, [0, 0]), FakeDev(1, [1, 0, 0])]
+    assert _topology_from_coords(ragged) == ""
+    assert _topology_from_coords([]) == ""
+
+
+# ---------------------------------------------------------------------------
+# Slice binding on the live backend
+# ---------------------------------------------------------------------------
+
+def test_chips_bound_into_slice_from_live_coords(monkeypatch):
+    devs = grid(2, 2, 1)
+    m = manager_with(devs, devs, monkeypatch)
+    chips = m.get_chips()
+    assert len(chips) == 4
+    for chip in chips:
+        assert chip.is_slice_enabled()
+        (sl,) = chip.get_slices()
+        assert sl.get_name() == "2x2x1"
+        assert sl.get_parent_chip() is chip
+        attrs = sl.get_attributes()
+        assert attrs["chips"] == 4
+        assert (attrs["topology.x"], attrs["topology.y"], attrs["topology.z"]) == (2, 2, 1)
+
+
+def test_metadata_topology_beats_coords(monkeypatch):
+    """Provisioning truth wins over the live bounding box (a multi-host
+    slice's local coords only span the host's corner of the grid)."""
+    from gpu_feature_discovery_tpu.hostinfo.tpu_env import host_info_from_mapping
+
+    local = grid(2, 2, 1)
+    info = host_info_from_mapping(
+        {"TPU_ACCELERATOR_TYPE": "v5p-64", "TPU_TOPOLOGY": "2x4x4"}
+    )
+    m = manager_with(local, local, monkeypatch, metadata_info=info)
+    (sl,) = m.get_chips()[0].get_slices()
+    assert sl.get_name() == "2x4x4"
+    assert sl.get_attributes()["chips"] == 32
+
+
+def test_unresolvable_topology_leaves_chips_unbound(monkeypatch):
+    devs = [FakeDev(0, None), FakeDev(1, None)]  # no coords, no metadata
+    m = manager_with(devs, devs, monkeypatch)
+    for chip in m.get_chips():
+        assert not chip.is_slice_enabled()
+        assert chip.get_slices() == []
+
+
+def test_slice_memory_uses_live_hbm_reading(monkeypatch):
+    gib = 1024 * 1024 * 1024
+    devs = [FakeDev(i, [i % 2, i // 2, 0], kind="TPU v5 lite", mem=15 * gib)
+            for i in range(4)]
+    m = manager_with(devs, devs, monkeypatch)
+    (sl,) = m.get_chips()[0].get_slices()
+    # 4-chip slice at the measured 15 GiB/chip, not the 16 GiB spec number.
+    assert sl.get_attributes()["memory"] == 15 * 1024 * 4
+    assert sl.get_name() == "2x2"  # 2D vocabulary for v5e
+
+
+def test_v2_style_core_dedupe_binds_once_per_chip(monkeypatch):
+    # Two PJRT devices sharing chip coords (v2/v3 cores) → one chip.
+    devs = [
+        FakeDev(0, [0, 0, 0], kind="TPU v2"),
+        FakeDev(1, [0, 0, 0], kind="TPU v2"),
+    ]
+    m = manager_with(devs, devs, monkeypatch)
+    chips = m.get_chips()
+    assert len(chips) == 1
+
+
+# ---------------------------------------------------------------------------
+# The flagship path: strategy=single over the live backend
+# ---------------------------------------------------------------------------
+
+def test_strategy_single_fires_on_live_backend(monkeypatch):
+    from gpu_feature_discovery_tpu.lm.topology_strategy import new_resource_labeler
+
+    devs = grid(2, 2, 1)
+    m = manager_with(devs, devs, monkeypatch)
+    config = cfg(**{"tpu-topology-strategy": "single"})
+    labels = new_resource_labeler(m, config).labels()
+    assert labels["google.com/tpu.topology.strategy"] == "single"
+    assert labels["google.com/tpu.product"] == "tpu-v5p-SLICE-2x2x1"
+    assert labels["google.com/tpu.chips"] == "4"
+    assert labels["google.com/tpu.topology.x"] == "2"
+    assert labels["google.com/tpu.count"] == "4"  # 4 slice devices on node
+
+
+def test_strategy_mixed_fires_on_live_backend(monkeypatch):
+    from gpu_feature_discovery_tpu.lm.topology_strategy import new_resource_labeler
+
+    devs = grid(2, 1, 1)
+    m = manager_with(devs, devs, monkeypatch)
+    config = cfg(**{"tpu-topology-strategy": "mixed"})
+    labels = new_resource_labeler(m, config).labels()
+    assert labels["google.com/tpu-2x1x1.product"] == "tpu-v5p-SLICE-2x1x1"
+    assert labels["google.com/tpu-2x1x1.chips"] == "2"
+
+
+def test_init_failure_raises_resource_error(monkeypatch):
+    from gpu_feature_discovery_tpu.resource.types import ResourceError
+
+    def boom():
+        raise RuntimeError("no TPU")
+
+    monkeypatch.setattr(jb, "_enumerate_tpu_devices", boom)
+    m = JaxManager(cfg())
+    with pytest.raises(ResourceError):
+        m.init()

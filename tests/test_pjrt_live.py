@@ -1,0 +1,110 @@
+"""Live-hardware golden: strategy=single over the REAL PJRT backend.
+
+VERDICT r1 item 1's done-criterion: ``TFD_BACKEND=jax`` with
+``--tpu-topology-strategy=single`` must emit the overloaded
+``google.com/tpu.*`` slice labels on a real TPU node, pinned by
+``expected-output-topology-single-pjrt.txt``. The daemon runs as a
+SUBPROCESS: the in-process conftest pins jax to a virtual CPU mesh, but a
+child process inherits the session's real JAX platform, so this test
+reaches actual hardware when present and skips cleanly everywhere else
+(the reference's integration tier has the same needs-real-hardware gate).
+"""
+
+import os
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from test_daemon import check_result
+
+REPO_ROOT = Path(__file__).resolve().parent.parent
+
+_tpu_state = None
+
+
+def _hermetic_env():
+    env = dict(os.environ)
+    env["TFD_HERMETIC"] = "1"
+    # APPEND to PYTHONPATH, never replace: some environments register
+    # their TPU PJRT plugin through an existing PYTHONPATH entry, and
+    # clobbering it silently downgrades child processes to CPU.
+    existing = env.get("PYTHONPATH", "")
+    env["PYTHONPATH"] = (
+        f"{REPO_ROOT}{os.pathsep}{existing}" if existing else str(REPO_ROOT)
+    )
+    return env
+
+
+def tpu_available() -> bool:
+    """One subprocess probe per session: does a child process see TPUs?"""
+    global _tpu_state
+    if _tpu_state is None:
+        try:
+            r = subprocess.run(
+                [
+                    sys.executable,
+                    "-c",
+                    "import jax; ds = jax.local_devices(); "
+                    "print(bool(ds) and all(d.platform == 'tpu' for d in ds))",
+                ],
+                capture_output=True,
+                text=True,
+                timeout=180,
+                env=_hermetic_env(),
+            )
+            _tpu_state = r.returncode == 0 and r.stdout.strip().endswith("True")
+        except (subprocess.TimeoutExpired, OSError):
+            _tpu_state = False
+    return _tpu_state
+
+
+needs_tpu = pytest.mark.skipif(
+    "not __import__('test_pjrt_live').tpu_available()",
+    reason="no real TPU reachable from a subprocess",
+)
+
+
+def run_daemon(tmp_path, *args):
+    out = tmp_path / "tfd"
+    env = _hermetic_env()
+    env["TFD_BACKEND"] = "jax"
+    r = subprocess.run(
+        [sys.executable, "-m", "gpu_feature_discovery_tpu", "--oneshot",
+         "--output-file", str(out), *args],
+        capture_output=True,
+        text=True,
+        timeout=300,
+        env=env,
+        cwd=REPO_ROOT,
+    )
+    assert r.returncode == 0, f"daemon failed: {r.stderr[-2000:]}"
+    return out
+
+
+@needs_tpu
+def test_pjrt_strategy_single_golden(tmp_path):
+    out = run_daemon(tmp_path, "--tpu-topology-strategy", "single")
+    check_result(out, "expected-output-topology-single-pjrt.txt")
+
+
+@needs_tpu
+def test_pjrt_slice_labels_present_and_consistent(tmp_path):
+    """Beyond format parity: the slice labels must be internally consistent
+    (chips == topology.x*y*z, the product embeds the same topology)."""
+    out = run_daemon(tmp_path, "--tpu-topology-strategy", "single")
+    labels = dict(
+        line.split("=", 1) for line in out.read_text().splitlines() if line
+    )
+    import math
+
+    x = int(labels["google.com/tpu.topology.x"])
+    y = int(labels["google.com/tpu.topology.y"])
+    z = int(labels["google.com/tpu.topology.z"])
+    assert int(labels["google.com/tpu.chips"]) == x * y * z
+    # The product suffix is the slice topology and must agree with the
+    # attribute family (tpu-v5e-SLICE-2x2 → 2*2 chips).
+    slice_topo = labels["google.com/tpu.product"].rsplit("SLICE-", 1)[-1]
+    dims = [int(d) for d in slice_topo.split("x")]
+    assert math.prod(dims) == int(labels["google.com/tpu.chips"])
