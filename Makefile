@@ -5,7 +5,9 @@ PYTHON ?= python
 IMAGE_NAME ?= ghcr.io/example/tpu-feature-discovery
 VERSION ?= 0.1.0
 
-.PHONY: all native test integration bench check-yamls lint clean docker-build
+COV_MIN ?= 75
+
+.PHONY: all native test coverage integration bench check-yamls lint clean docker-build
 
 all: native test
 
@@ -14,6 +16,13 @@ native:
 
 test: native
 	$(PYTHON) -m pytest tests/ -q
+
+# Coverage gate (reference Makefile:109-111: go test -coverprofile with
+# mocks excluded — the exclusions live in pyproject [tool.coverage.run]).
+coverage: native
+	$(PYTHON) -m pytest tests/ -q \
+	    --cov=gpu_feature_discovery_tpu --cov-report=term-missing \
+	    --cov-fail-under=$(COV_MIN)
 
 integration:
 	$(PYTHON) tests/integration-tests.py \

@@ -128,6 +128,14 @@ def start(argv: Optional[list] = None) -> int:
             manager = factory.new_manager(config)
             interconnect = new_interconnect_labeler(config)
 
+            # A reload may change --with-burnin/--burnin-interval: drop the
+            # cached health labels so the new config starts with a fresh
+            # probe instead of republishing measurements taken under the
+            # old one.
+            from gpu_feature_discovery_tpu.lm.health import reset_burnin_schedule
+
+            reset_burnin_schedule()
+
             log.info("Start running")
             restart = run(manager, interconnect, config, sigs)
         except Exception as e:  # noqa: BLE001 - match reference error-to-exit

@@ -21,6 +21,7 @@ from gpu_feature_discovery_tpu.config.spec import (
     TOPOLOGY_STRATEGY_NONE,
     parse_bool as _parse_bool,
     parse_config_file,
+    parse_positive_int as _parse_positive_int,
 )
 
 DEFAULT_OUTPUT_FILE = "/etc/kubernetes/node-feature-discovery/features.d/tfd"
@@ -172,6 +173,16 @@ FLAG_DEFS: List[FlagDef] = [
         help="run a short on-chip burn-in each cycle and emit tpu.health.* labels (TPU extension)",
         setter=lambda c, v: setattr(_f(c).tfd, "with_burnin", v),
         getter=lambda c: _f(c).tfd.with_burnin,
+    ),
+    FlagDef(
+        name="burnin-interval",
+        env_vars=("TFD_BURNIN_INTERVAL",),
+        parse=_parse_positive_int,
+        default=10,
+        help="with --with-burnin, probe every Nth labeling cycle and reuse "
+        "cached health labels in between (1 = every cycle)",
+        setter=lambda c, v: setattr(_f(c).tfd, "burnin_interval", v),
+        getter=lambda c: _f(c).tfd.burnin_interval,
     ),
     FlagDef(
         name="machine-type-file",

@@ -103,6 +103,7 @@ class TfdFlags:
     output_file: Optional[str] = None
     machine_type_file: Optional[str] = None
     with_burnin: Optional[bool] = None  # TPU extension: on-chip health labels
+    burnin_interval: Optional[int] = None  # probe every Nth cycle (cache between)
 
 
 @dataclass
@@ -138,6 +139,7 @@ class Config:
                     "outputFile": self.flags.tfd.output_file,
                     "machineTypeFile": self.flags.tfd.machine_type_file,
                     "withBurnin": self.flags.tfd.with_burnin,
+                    "burninInterval": self.flags.tfd.burnin_interval,
                 },
             },
             "sharing": {
@@ -168,6 +170,17 @@ def parse_bool(value: Any) -> bool:
     if s in ("0", "f", "false", "no", "n", "off"):
         return False
     raise ConfigError(f"invalid boolean: {value!r}")
+
+
+def parse_positive_int(value: Any) -> int:
+    """Strict positive-integer parsing (shared by CLI/env/file inputs)."""
+    try:
+        n = int(str(value).strip())
+    except ValueError as e:
+        raise ConfigError(f"invalid integer: {value!r}") from e
+    if n < 1:
+        raise ConfigError(f"value must be >= 1: {value!r}")
+    return n
 
 
 def parse_config_file(path: str) -> Config:
@@ -205,6 +218,8 @@ def parse_config_file(path: str) -> Config:
     config.flags.tfd.output_file = _opt_str(tfd.get("outputFile"))
     config.flags.tfd.machine_type_file = _opt_str(tfd.get("machineTypeFile"))
     config.flags.tfd.with_burnin = _opt_bool(tfd.get("withBurnin"))
+    if tfd.get("burninInterval") is not None:
+        config.flags.tfd.burnin_interval = parse_positive_int(tfd["burninInterval"])
 
     config.resources = raw.get("resources", {}) or {}
     config.sharing = Sharing.from_dict(raw.get("sharing", {}) or {})

@@ -179,3 +179,60 @@ def test_cli_sighup_picks_up_config_file_changes(tmp_path):
     finally:
         if proc.poll() is None:
             proc.kill()
+
+
+def test_cli_signal_storm_survives_and_cleans_up(tmp_path):
+    """The -race analog for the queue-backed signal watcher (VERDICT r1):
+    a storm of SIGHUPs delivered during active label cycles must never
+    crash, wedge, or drop the reload semantics; a final SIGTERM must still
+    exit cleanly and remove the output file."""
+    out = tmp_path / "tfd"
+    proc = spawn(
+        tmp_path,
+        "--machine-type-file", "",
+        "-o", str(out),
+        "--sleep-interval", "100ms",  # cycles constantly, signals land mid-cycle
+    )
+    try:
+        assert wait_for_file(out), (
+            proc.stderr.read().decode() if proc.poll() is not None else "no file"
+        )
+        for _ in range(30):
+            proc.send_signal(signal.SIGHUP)
+            time.sleep(0.02)
+            assert proc.poll() is None, (
+                f"daemon died mid-storm: {proc.stderr.read().decode()}"
+            )
+        # Still alive and still labeling after the storm.
+        time.sleep(0.5)
+        assert proc.poll() is None
+        assert out.exists()
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0, proc.stderr.read().decode()
+        assert not out.exists()
+        stderr = proc.stderr.read().decode()
+        assert "Traceback" not in stderr, stderr
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+
+
+def test_cli_interleaved_hup_term_race(tmp_path):
+    """SIGHUP immediately followed by SIGTERM: the daemon may process the
+    reload first, but the TERM must win — exit 0, output file removed."""
+    out = tmp_path / "tfd"
+    proc = spawn(
+        tmp_path,
+        "--machine-type-file", "",
+        "-o", str(out),
+        "--sleep-interval", "100ms",
+    )
+    try:
+        assert wait_for_file(out)
+        proc.send_signal(signal.SIGHUP)
+        proc.send_signal(signal.SIGTERM)
+        assert proc.wait(timeout=30) == 0, proc.stderr.read().decode()
+        assert not out.exists()
+    finally:
+        if proc.poll() is None:
+            proc.kill()

