@@ -129,6 +129,17 @@ FLAG_DEFS: List[FlagDef] = [
         getter=lambda c: _f(c).libtpu_path,
     ),
     FlagDef(
+        name="native-enumeration",
+        env_vars=("TFD_NATIVE_ENUMERATION",),
+        parse=_parse_bool,
+        default=False,
+        help="allow the native (PJRT C API) enumeration fallback when JAX "
+        "is unusable; creates and destroys a PJRT client, which briefly "
+        "seizes the TPU — never enable on nodes running workloads",
+        setter=lambda c, v: setattr(_f(c), "native_enumeration", v),
+        getter=lambda c: _f(c).native_enumeration,
+    ),
+    FlagDef(
         name="oneshot",
         env_vars=("TFD_ONESHOT",),
         parse=_parse_bool,

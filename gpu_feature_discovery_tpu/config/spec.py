@@ -113,6 +113,7 @@ class Flags:
     tpu_topology_strategy: Optional[str] = None
     fail_on_init_error: Optional[bool] = None
     libtpu_path: Optional[str] = None  # nvidiaDriverRoot analog
+    native_enumeration: Optional[bool] = None  # opt-in: PJRT C-API enumeration
     tfd: TfdFlags = field(default_factory=TfdFlags)
 
 
@@ -132,6 +133,7 @@ class Config:
                 "tpuTopologyStrategy": self.flags.tpu_topology_strategy,
                 "failOnInitError": self.flags.fail_on_init_error,
                 "libtpuPath": self.flags.libtpu_path,
+                "nativeEnumeration": self.flags.native_enumeration,
                 "tfd": {
                     "oneshot": self.flags.tfd.oneshot,
                     "noTimestamp": self.flags.tfd.no_timestamp,
@@ -206,6 +208,7 @@ def parse_config_file(path: str) -> Config:
     config.flags.tpu_topology_strategy = _opt_str(flags.get("tpuTopologyStrategy"))
     config.flags.fail_on_init_error = _opt_bool(flags.get("failOnInitError"))
     config.flags.libtpu_path = _opt_str(flags.get("libtpuPath"))
+    config.flags.native_enumeration = _opt_bool(flags.get("nativeEnumeration"))
 
     tfd = flags.get("tfd", {}) or {}
     config.flags.tfd.oneshot = _opt_bool(tfd.get("oneshot"))

@@ -36,6 +36,144 @@ struct PjrtApiPrefix {
 
 typedef const PjrtApiPrefix* (*GetPjrtApiFn)();
 
+/* Function-table prefix of PJRT_Api, through the entry points enumeration
+ * needs. The PJRT C API is append-only with struct_size versioning, so
+ * these offsets are stable for every plugin new enough to pass the
+ * struct_size check in tfd_enumerate (the same contract the reference
+ * leans on when it binds exactly 7 CUDA entry points by name,
+ * cuda.go:103-109 — here the "names" are fixed table slots). */
+struct PjrtApiTable {
+  size_t struct_size;
+  void* extension_start;
+  PjrtApiVersionPrefix version;
+  void* error_destroy;
+  void* error_message;
+  void* error_getcode;
+  void* plugin_initialize;
+  void* plugin_attributes;
+  void* event_destroy;
+  void* event_isready;
+  void* event_error;
+  void* event_await;
+  void* event_onready;
+  void* client_create;
+  void* client_destroy;
+  void* client_platform_name;
+  void* client_process_index;
+  void* client_platform_version;
+  void* client_devices;
+  void* client_addressable_devices;
+  void* client_lookup_device;
+  void* client_lookup_addressable_device;
+  void* client_addressable_memories;
+  void* client_compile;
+  void* client_default_device_assignment;
+  void* client_buffer_from_host_buffer;
+  void* device_description_id;
+  void* device_description_process_index;
+  void* device_description_attributes;
+  void* device_description_kind;
+  void* device_description_debug_string;
+  void* device_description_to_string;
+  void* device_get_description;
+};
+
+/* Argument structs, inline-declared like the reference's CUDA types
+ * (cuda.go:26-101). Every PJRT call takes {struct_size, extension_start,
+ * ...} and returns a PJRT_Error* (NULL = success). */
+struct ErrorDestroyArgs { size_t struct_size; void* ext; void* error; };
+struct PluginInitializeArgs { size_t struct_size; void* ext; };
+struct ClientCreateArgs {
+  size_t struct_size;
+  void* ext;
+  const void* create_options;
+  size_t num_options;
+  void* kv_get_callback;
+  void* kv_get_user_arg;
+  void* kv_put_callback;
+  void* kv_put_user_arg;
+  void* client;  /* out */
+  /* Appended by PJRT 0.57+ (non-blocking KV try-get); current plugins
+   * validate struct_size against the full 11-field layout. */
+  void* kv_try_get_callback;
+  void* kv_try_get_user_arg;
+};
+struct ClientDestroyArgs { size_t struct_size; void* ext; void* client; };
+struct ClientPlatformNameArgs {
+  size_t struct_size;
+  void* ext;
+  void* client;
+  const char* platform_name;  /* out */
+  size_t platform_name_size;  /* out */
+};
+struct ClientAddressableDevicesArgs {
+  size_t struct_size;
+  void* ext;
+  void* client;
+  void* const* addressable_devices;  /* out */
+  size_t num_addressable_devices;    /* out */
+};
+struct DeviceGetDescriptionArgs {
+  size_t struct_size;
+  void* ext;
+  void* device;
+  void* device_description;  /* out */
+};
+struct DeviceDescriptionIdArgs {
+  size_t struct_size;
+  void* ext;
+  void* device_description;
+  int id;  /* out */
+};
+struct DeviceDescriptionProcessIndexArgs {
+  size_t struct_size;
+  void* ext;
+  void* device_description;
+  int process_index;  /* out */
+};
+struct DeviceDescriptionKindArgs {
+  size_t struct_size;
+  void* ext;
+  void* device_description;
+  const char* device_kind;  /* out */
+  size_t device_kind_size;  /* out */
+};
+
+struct ErrorMessageArgs {
+  size_t struct_size;
+  void* ext;
+  void* error;
+  const char* message;  /* out */
+  size_t message_size;  /* out */
+};
+
+typedef void* (*PjrtErrorFn)(void*);  /* generic PJRT_Error* f(Args*) */
+
+/* Call a PJRT entry point; on failure, copy the error message into err_msg
+ * (when provided) and destroy the error object. Returns true on success. */
+bool pjrt_call(const PjrtApiTable* api, void* fn_slot, void* args,
+               char* err_msg = nullptr, size_t err_msg_len = 0) {
+  if (fn_slot == nullptr) return false;
+  void* err = reinterpret_cast<PjrtErrorFn>(fn_slot)(args);
+  if (err == nullptr) return true;
+  if (err_msg != nullptr && err_msg_len > 0 && api->error_message != nullptr) {
+    ErrorMessageArgs msg_args = {sizeof(ErrorMessageArgs), nullptr, err,
+                                 nullptr, 0};
+    reinterpret_cast<PjrtErrorFn>(api->error_message)(&msg_args);
+    size_t n = msg_args.message_size;
+    if (n >= err_msg_len) n = err_msg_len - 1;
+    if (msg_args.message != nullptr) {
+      for (size_t i = 0; i < n; ++i) err_msg[i] = msg_args.message[i];
+      err_msg[n] = '\0';
+    }
+  }
+  if (api->error_destroy != nullptr) {
+    ErrorDestroyArgs destroy_args = {sizeof(ErrorDestroyArgs), nullptr, err};
+    reinterpret_cast<PjrtErrorFn>(api->error_destroy)(&destroy_args);
+  }
+  return false;
+}
+
 }  // namespace
 
 extern "C" int tfd_probe_libtpu(const char* path, int* api_major,
@@ -73,6 +211,138 @@ extern "C" int tfd_probe_libtpu(const char* path, int* api_major,
   return TFD_SUCCESS;
 }
 
+extern "C" int tfd_enumerate(const char* path, tfd_device_info_t* out,
+                             size_t max_devices, size_t* n_devices,
+                             char* platform, size_t platform_len,
+                             char* err_msg, size_t err_msg_len) {
+  if (err_msg != nullptr && err_msg_len > 0) err_msg[0] = '\0';
+  if (path == nullptr || out == nullptr || n_devices == nullptr ||
+      platform == nullptr || platform_len == 0) {
+    return TFD_ERROR_INVALID_ARGUMENT;
+  }
+  *n_devices = 0;
+  platform[0] = '\0';
+
+  void* handle = dlopen(path, RTLD_LAZY | RTLD_LOCAL);
+  if (handle == nullptr) {
+    return TFD_ERROR_LIB_NOT_FOUND;
+  }
+
+  GetPjrtApiFn get_api =
+      reinterpret_cast<GetPjrtApiFn>(dlsym(handle, "GetPjrtApi"));
+  if (get_api == nullptr) {
+    dlclose(handle);
+    return TFD_ERROR_SYMBOL_NOT_FOUND;
+  }
+  const PjrtApiTable* api =
+      reinterpret_cast<const PjrtApiTable*>(get_api());
+  if (api == nullptr) {
+    dlclose(handle);
+    return TFD_ERROR_NULL_API;
+  }
+  /* The plugin's table must at least reach the last slot we dereference.
+   * struct_size is the PJRT versioning contract, so an old plugin is
+   * detected here instead of via a wild pointer. */
+  if (api->struct_size < sizeof(PjrtApiTable)) {
+    dlclose(handle);
+    return TFD_ERROR_API_TOO_OLD;
+  }
+
+  /* Plugins require Plugin_Initialize before first use; tolerate a missing
+   * slot (pre-initialize-era plugins) but not a failing call. */
+  if (api->plugin_initialize != nullptr) {
+    PluginInitializeArgs init_args = {sizeof(PluginInitializeArgs), nullptr};
+    if (!pjrt_call(api, api->plugin_initialize, &init_args, err_msg,
+                   err_msg_len)) {
+      /* No dlclose past this point (see comment at the success path):
+       * Plugin_Initialize may already have spawned threads. */
+      return TFD_ERROR_PLUGIN_INIT;
+    }
+  }
+
+  ClientCreateArgs create_args = {sizeof(ClientCreateArgs), nullptr,
+                                  nullptr,  0,       nullptr, nullptr,
+                                  nullptr,  nullptr, nullptr, nullptr,
+                                  nullptr};
+  if (!pjrt_call(api, api->client_create, &create_args, err_msg,
+                 err_msg_len) ||
+      create_args.client == nullptr) {
+    return TFD_ERROR_CLIENT_CREATE;
+  }
+  void* client = create_args.client;
+  int rc = TFD_SUCCESS;
+
+  ClientPlatformNameArgs name_args = {sizeof(ClientPlatformNameArgs), nullptr,
+                                      client, nullptr, 0};
+  if (pjrt_call(api, api->client_platform_name, &name_args) &&
+      name_args.platform_name != nullptr) {
+    size_t n = name_args.platform_name_size;
+    if (n >= platform_len) n = platform_len - 1;
+    for (size_t i = 0; i < n; ++i) platform[i] = name_args.platform_name[i];
+    platform[n] = '\0';
+  } else {
+    rc = TFD_ERROR_ENUMERATE;
+  }
+
+  ClientAddressableDevicesArgs dev_args = {
+      sizeof(ClientAddressableDevicesArgs), nullptr, client, nullptr, 0};
+  if (rc == TFD_SUCCESS &&
+      pjrt_call(api, api->client_addressable_devices, &dev_args)) {
+    *n_devices = dev_args.num_addressable_devices;
+    size_t to_copy = dev_args.num_addressable_devices;
+    if (to_copy > max_devices) {
+      to_copy = max_devices;
+      rc = TFD_ERROR_BUFFER_TOO_SMALL;
+    }
+    for (size_t i = 0; i < to_copy; ++i) {
+      DeviceGetDescriptionArgs desc_args = {sizeof(DeviceGetDescriptionArgs),
+                                            nullptr,
+                                            dev_args.addressable_devices[i],
+                                            nullptr};
+      if (!pjrt_call(api, api->device_get_description, &desc_args) ||
+          desc_args.device_description == nullptr) {
+        rc = TFD_ERROR_ENUMERATE;
+        break;
+      }
+      void* desc = desc_args.device_description;
+
+      DeviceDescriptionIdArgs id_args = {sizeof(DeviceDescriptionIdArgs),
+                                         nullptr, desc, -1};
+      DeviceDescriptionProcessIndexArgs pi_args = {
+          sizeof(DeviceDescriptionProcessIndexArgs), nullptr, desc, -1};
+      DeviceDescriptionKindArgs kind_args = {
+          sizeof(DeviceDescriptionKindArgs), nullptr, desc, nullptr, 0};
+      if (!pjrt_call(api, api->device_description_id, &id_args) ||
+          !pjrt_call(api, api->device_description_process_index, &pi_args) ||
+          !pjrt_call(api, api->device_description_kind, &kind_args) ||
+          kind_args.device_kind == nullptr) {
+        rc = TFD_ERROR_ENUMERATE;
+        break;
+      }
+      out[i].id = id_args.id;
+      out[i].process_index = pi_args.process_index;
+      size_t kn = kind_args.device_kind_size;
+      if (kn >= sizeof(out[i].kind)) kn = sizeof(out[i].kind) - 1;
+      for (size_t k = 0; k < kn; ++k) out[i].kind[k] = kind_args.device_kind[k];
+      out[i].kind[kn] = '\0';
+    }
+  } else if (rc == TFD_SUCCESS) {
+    rc = TFD_ERROR_ENUMERATE;
+  }
+
+  /* Always release the TPU before returning — holding it past this call
+   * would defeat the opt-in contract in the header. The dlopen HANDLE is
+   * deliberately leaked: Plugin_Initialize/Client_Create may spawn
+   * background threads and process-global state that Client_Destroy does
+   * not tear down, so unmapping the .so could leave live threads on
+   * unmapped code (XLA itself never dlcloses PJRT plugins). The probe
+   * path's dlclose is safe because it never initializes the plugin. */
+  ClientDestroyArgs destroy_args = {sizeof(ClientDestroyArgs), nullptr,
+                                    client};
+  pjrt_call(api, api->client_destroy, &destroy_args);
+  return rc;
+}
+
 extern "C" const char* tfd_error_string(int code) {
   switch (code) {
     case TFD_SUCCESS:
@@ -89,6 +359,14 @@ extern "C" const char* tfd_error_string(int code) {
       return "TFD_ERROR_CONFIG_TOO_SHORT";
     case TFD_ERROR_BUFFER_TOO_SMALL:
       return "TFD_ERROR_BUFFER_TOO_SMALL";
+    case TFD_ERROR_API_TOO_OLD:
+      return "TFD_ERROR_API_TOO_OLD";
+    case TFD_ERROR_CLIENT_CREATE:
+      return "TFD_ERROR_CLIENT_CREATE";
+    case TFD_ERROR_ENUMERATE:
+      return "TFD_ERROR_ENUMERATE";
+    case TFD_ERROR_PLUGIN_INIT:
+      return "TFD_ERROR_PLUGIN_INIT";
     default:
       return "TFD_ERROR_UNKNOWN";
   }

@@ -49,6 +49,23 @@ class ProbeResult:
     api_minor: int = -1
 
 
+@dataclass(frozen=True)
+class EnumeratedDevice:
+    """One device from the native enumeration path (tfd_device_info_t)."""
+
+    id: int
+    process_index: int
+    kind: str
+
+
+class _CDeviceInfo(ctypes.Structure):
+    _fields_ = [
+        ("id", ctypes.c_int),
+        ("process_index", ctypes.c_int),
+        ("kind", ctypes.c_char * 64),
+    ]
+
+
 def _candidate_paths(explicit: Optional[str]) -> list:
     candidates = []
     if explicit:
@@ -106,6 +123,17 @@ class NativeShim:
             ctypes.c_size_t,
         ]
         lib.tfd_pci_vendor_capability.restype = ctypes.c_int
+        lib.tfd_enumerate.argtypes = [
+            ctypes.c_char_p,
+            ctypes.POINTER(_CDeviceInfo),
+            ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_size_t),
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+            ctypes.c_char_p,
+            ctypes.c_size_t,
+        ]
+        lib.tfd_enumerate.restype = ctypes.c_int
 
     def probe(self, libtpu_path: str):
         """dlopen + GetPjrtApi probe; returns (ok, api_major, api_minor)."""
@@ -118,6 +146,55 @@ class NativeShim:
 
     def error_string(self, code: int) -> str:
         return self._lib.tfd_error_string(code).decode()
+
+    def enumerate(self, libtpu_path: str, max_devices: int = 256):
+        """Full device enumeration through the PJRT C API — client create →
+        list → destroy, no ML runtime in-process. SEIZES THE TPU for the
+        call; callers gate it behind --native-enumeration.
+
+        Returns (platform, [EnumeratedDevice, ...]) or None on failure.
+        """
+        out = (_CDeviceInfo * max_devices)()
+        n = ctypes.c_size_t(0)
+        platform = ctypes.create_string_buffer(64)
+        err = ctypes.create_string_buffer(512)
+        rc = self._lib.tfd_enumerate(
+            libtpu_path.encode(),
+            out,
+            max_devices,
+            ctypes.byref(n),
+            platform,
+            len(platform),
+            err,
+            len(err),
+        )
+        TFD_ERROR_BUFFER_TOO_SMALL = 6
+        if rc == TFD_ERROR_BUFFER_TOO_SMALL:
+            # The C layer filled max_devices valid records and reported the
+            # true count — a truncated inventory still beats none.
+            log.warning(
+                "native enumeration of %s truncated: %d devices, kept %d",
+                libtpu_path,
+                n.value,
+                max_devices,
+            )
+        elif rc != 0:
+            log.warning(
+                "native enumeration of %s failed: %s%s",
+                libtpu_path,
+                self.error_string(rc),
+                f" ({err.value.decode(errors='replace')})" if err.value else "",
+            )
+            return None
+        devices = [
+            EnumeratedDevice(
+                id=out[i].id,
+                process_index=out[i].process_index,
+                kind=out[i].kind.decode(errors="replace"),
+            )
+            for i in range(min(n.value, max_devices))
+        ]
+        return platform.value.decode(errors="replace"), devices
 
     def pci_vendor_capability(self, config: bytes) -> Optional[bytes]:
         """C++ twin of PCIDevice.get_vendor_specific_capability."""

@@ -26,7 +26,19 @@ typedef enum {
   TFD_ERROR_NULL_API = 4,          /* GetPjrtApi returned NULL */
   TFD_ERROR_CONFIG_TOO_SHORT = 5,  /* PCI config space < 256 bytes */
   TFD_ERROR_BUFFER_TOO_SMALL = 6,  /* output buffer cannot hold the record */
+  TFD_ERROR_API_TOO_OLD = 7,       /* PJRT table lacks the entry points */
+  TFD_ERROR_CLIENT_CREATE = 8,     /* PJRT_Client_Create failed */
+  TFD_ERROR_ENUMERATE = 9,         /* a device query failed post-create */
+  TFD_ERROR_PLUGIN_INIT = 10,      /* PJRT_Plugin_Initialize failed */
 } tfd_result_t;
+
+/* One enumerated device (the cuDeviceGet/cuDeviceGetName record analog,
+ * internal/cuda/api.go:58-118). */
+typedef struct {
+  int id;                 /* PJRT global device id */
+  int process_index;      /* owning process (host) within the slice */
+  char kind[64];          /* device kind, e.g. "TPU v5 lite" */
+} tfd_device_info_t;
 
 /* dlopen(path) + GetPjrtApi() probe; writes the PJRT C API version into
  * *api_major / *api_minor on success. Never creates a PJRT client — the
@@ -35,6 +47,28 @@ int tfd_probe_libtpu(const char* path, int* api_major, int* api_minor);
 
 /* Human-readable name for a tfd_result_t (cuda/result.go analog). */
 const char* tfd_error_string(int code);
+
+/* Full enumeration WITHOUT any ML runtime in-process: dlopen(path),
+ * GetPjrtApi, PJRT_Plugin_Initialize, PJRT_Client_Create, list the
+ * client's addressable devices (id / process index / kind) and the
+ * platform name, then destroy the client (the dlopen handle is leaked
+ * once the plugin initialized — plugins spawn threads that outlive the
+ * client, so unmapping would be unsafe). Mirrors the reference's
+ * 7-entry-point CUDA enumeration (internal/cuda/cuda.go:103-109,
+ * api.go:58-118).
+ *
+ * CREATING THE CLIENT SEIZES THE TPU for the call's duration — callers
+ * must gate this behind explicit opt-in (--native-enumeration) so it
+ * never contends with a workload that owns the chip. The probe path
+ * (tfd_probe_libtpu) stays client-free for exactly that reason.
+ *
+ * Writes at most max_devices records and the true count into *n_devices
+ * (TFD_ERROR_BUFFER_TOO_SMALL when truncated); platform receives the
+ * NUL-terminated platform name ("tpu"); err_msg (optional, may be NULL)
+ * receives the PJRT error message when initialization/creation fails. */
+int tfd_enumerate(const char* path, tfd_device_info_t* out,
+                  size_t max_devices, size_t* n_devices, char* platform,
+                  size_t platform_len, char* err_msg, size_t err_msg_len);
 
 /* Walk the PCI capability linked list of a 256-byte config space and copy
  * the vendor-specific (id 0x09) record into out. Returns the record length

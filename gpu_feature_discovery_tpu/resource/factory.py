@@ -9,7 +9,9 @@ native-shim backends) is:
    variants exist for integration tests on CPU-only machines (the reference
    achieves the same with its mock-NVML container tests).
 2. libtpu present (native shim dlopen probe, or TPU chips on the PCI bus,
-   or a TPU VM metadata environment) → PJRT/JAX-backed manager.
+   or a TPU VM metadata environment) → PJRT/JAX-backed manager, then the
+   native C-API enumeration (opt-in via --native-enumeration), then the
+   metadata inventory.
 3. Otherwise → Null manager (non-TPU node: no labels).
 """
 
@@ -89,6 +91,16 @@ def _get_manager(config: Config) -> Manager:
         if manager is None:
             raise RuntimeError("TFD_BACKEND=jax requested but jax backend unavailable")
         return manager
+    if backend == "native":
+        # Forced selection bypasses the opt-in flag: naming the backend IS
+        # the opt-in (the operator typed it knowing it seizes the chip).
+        manager = _try_native_manager(config, forced=True)
+        if manager is None:
+            raise RuntimeError(
+                "TFD_BACKEND=native requested but native enumeration unavailable"
+            )
+        log.info("Using native (PJRT C API) manager (forced)")
+        return manager
     if backend in ("hostinfo", "metadata"):
         # Eager availability check: a forced backend must fail loudly at
         # factory time (matching TFD_BACKEND=jax), not be silently swapped
@@ -110,6 +122,10 @@ def _get_manager(config: Config) -> Manager:
         manager = _try_jax_manager(config)
         if manager is not None:
             log.info("Using PJRT (jax) manager")
+            return manager
+        manager = _try_native_manager(config)
+        if manager is not None:
+            log.info("Using native (PJRT C API) manager; jax unavailable")
             return manager
         manager = _try_hostinfo_manager(config)
         if manager is not None:
@@ -151,6 +167,27 @@ def _try_jax_manager(config: Config) -> Optional[Manager]:
         return JaxManager(config)
     except Exception as e:  # noqa: BLE001 - backend optional by design
         log.warning("jax backend unavailable: %s", e)
+        return None
+
+
+def _try_native_manager(config: Config, forced: bool = False) -> Optional[Manager]:
+    """Native PJRT C-API enumeration — OPT-IN (--native-enumeration), since
+    creating a client briefly seizes the TPU; a forced TFD_BACKEND=native
+    counts as opt-in. Availability (libtpu + built .so) is checked eagerly
+    so the auto chain can fall through to hostinfo."""
+    if not forced and not config.flags.native_enumeration:
+        return None
+    try:
+        from gpu_feature_discovery_tpu.native.shim import load_native, probe_libtpu
+        from gpu_feature_discovery_tpu.resource.native_backend import NativeManager
+
+        if load_native() is None:
+            return None
+        if not probe_libtpu(config.flags.libtpu_path or None).found:
+            return None
+        return NativeManager(config)
+    except Exception as e:  # noqa: BLE001 - backend optional by design
+        log.warning("native backend unavailable: %s", e)
         return None
 
 

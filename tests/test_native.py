@@ -173,3 +173,117 @@ def test_probe_libtpu_not_found(native, monkeypatch, tmp_path):
     monkeypatch.setattr(shim, "LIBTPU_SYSTEM_PATHS", ())
     monkeypatch.setattr("sys.path", [str(tmp_path)])
     assert not shim.probe_libtpu().found
+
+
+@pytest.fixture(scope="module")
+def fake_pjrt_full(native, tmp_path_factory):
+    """A fake PJRT plugin implementing the full enumeration surface:
+    plugin-init, client create/destroy, platform name, 2 addressable
+    devices with id/process-index/kind — the mock-NVML analog for
+    tfd_enumerate (reference: internal/cuda mocked via moq at the Go
+    layer; here the mock IS a real .so speaking the C ABI)."""
+    return _compile_so(
+        tmp_path_factory.mktemp("fake-pjrt-full"),
+        """
+        #include <stddef.h>
+        #include <string.h>
+
+        struct Version { size_t sz; void* ext; int major; int minor; };
+
+        // Args mirrors (prefix-compatible with pjrt_shim.cc's inline decls).
+        struct PluginInitArgs { size_t sz; void* ext; };
+        struct CreateArgs { size_t sz; void* ext; const void* opts;
+                            size_t nopts; void* kvg; void* kvga; void* kvp;
+                            void* kvpa; void* client; void* kvt; void* kvta; };
+        struct DestroyArgs { size_t sz; void* ext; void* client; };
+        struct NameArgs { size_t sz; void* ext; void* client;
+                          const char* name; size_t name_sz; };
+        struct DevsArgs { size_t sz; void* ext; void* client;
+                          void* const* devs; size_t ndevs; };
+        struct DescArgs { size_t sz; void* ext; void* dev; void* desc; };
+        struct IdArgs { size_t sz; void* ext; void* desc; int id; };
+        struct PiArgs { size_t sz; void* ext; void* desc; int pi; };
+        struct KindArgs { size_t sz; void* ext; void* desc;
+                          const char* kind; size_t kind_sz; };
+
+        static int fake_client, dev_a, dev_b;
+        static void* devs[2] = {&dev_a, &dev_b};
+
+        extern "C" {
+        static void* plugin_init(void* a) { (void)a; return 0; }
+        static void* create(void* a) {
+          ((struct CreateArgs*)a)->client = &fake_client; return 0; }
+        static void* destroy(void* a) { (void)a; return 0; }
+        static void* name(void* a) {
+          struct NameArgs* n = (struct NameArgs*)a;
+          n->name = "tpu"; n->name_sz = 3; return 0; }
+        static void* devices(void* a) {
+          struct DevsArgs* d = (struct DevsArgs*)a;
+          d->devs = devs; d->ndevs = 2; return 0; }
+        static void* get_desc(void* a) {
+          struct DescArgs* d = (struct DescArgs*)a;
+          d->desc = d->dev; return 0; }
+        static void* desc_id(void* a) {
+          struct IdArgs* i = (struct IdArgs*)a;
+          i->id = (i->desc == &dev_a) ? 0 : 1; return 0; }
+        static void* desc_pi(void* a) {
+          ((struct PiArgs*)a)->pi = 0; return 0; }
+        static void* desc_kind(void* a) {
+          struct KindArgs* k = (struct KindArgs*)a;
+          k->kind = "TPU v4"; k->kind_sz = 6; return 0; }
+
+        struct Api {
+          size_t sz; void* ext; struct Version v;
+          void* err_destroy; void* err_message; void* err_getcode;
+          void* plugin_initialize; void* plugin_attributes;
+          void* ev_destroy; void* ev_isready; void* ev_error;
+          void* ev_await; void* ev_onready;
+          void* client_create; void* client_destroy; void* client_name;
+          void* client_pi; void* client_pv; void* client_devices;
+          void* client_addressable_devices; void* client_lookup;
+          void* client_lookup_addr; void* client_addr_mems;
+          void* client_compile; void* client_dda; void* client_bfhb;
+          void* dd_id; void* dd_pi; void* dd_attrs; void* dd_kind;
+          void* dd_debug; void* dd_tostring; void* dev_get_description;
+        };
+        static struct Api api;
+        const struct Api* GetPjrtApi(void) {
+          memset(&api, 0, sizeof(api));
+          api.sz = sizeof(api); api.v.sz = sizeof(struct Version);
+          api.v.major = 0; api.v.minor = 77;
+          api.plugin_initialize = (void*)plugin_init;
+          api.client_create = (void*)create;
+          api.client_destroy = (void*)destroy;
+          api.client_name = (void*)name;
+          api.client_addressable_devices = (void*)devices;
+          api.dd_id = (void*)desc_id;
+          api.dd_pi = (void*)desc_pi;
+          api.dd_kind = (void*)desc_kind;
+          api.dev_get_description = (void*)get_desc;
+          return &api;
+        }
+        }
+        """,
+        name="libfakepjrt.so",
+    )
+
+
+def test_enumerate_fake_plugin(native, fake_pjrt_full):
+    result = native.enumerate(fake_pjrt_full)
+    assert result is not None
+    platform, devices = result
+    assert platform == "tpu"
+    assert [(d.id, d.process_index, d.kind) for d in devices] == [
+        (0, 0, "TPU v4"),
+        (1, 0, "TPU v4"),
+    ]
+
+
+def test_enumerate_probe_only_plugin_fails_cleanly(native, fake_libtpu):
+    """The version-only fake (struct_size stops at the version prefix) must
+    be rejected as API-too-old, not dereferenced past its end."""
+    assert native.enumerate(fake_libtpu) is None
+
+
+def test_enumerate_missing_lib(native):
+    assert native.enumerate("/nonexistent/libtpu.so") is None

@@ -1,0 +1,118 @@
+"""NativeManager: device inventory through the C++ PJRT enumeration path.
+
+Exercised against the same fake PJRT plugin test_native.py compiles — the
+reference tests its CUDA fallback through mocks at the Go layer; here the
+mock is a real .so speaking the C ABI, so ctypes marshalling, the C++
+call sequence, and the Python backend are all under test at once.
+"""
+
+import shutil
+
+import pytest
+
+from gpu_feature_discovery_tpu.config.flags import new_config
+from gpu_feature_discovery_tpu.resource.types import ResourceError
+
+from test_native import _compile_so, fake_pjrt_full, native  # noqa: F401
+
+pytestmark = pytest.mark.skipif(
+    shutil.which("g++") is None or shutil.which("make") is None,
+    reason="native toolchain unavailable",
+)
+
+
+def cfg(**cli):
+    return new_config(cli_values=cli, environ={}, config_file=None)
+
+
+@pytest.fixture()
+def fake_env(fake_pjrt_full, monkeypatch):  # noqa: F811
+    monkeypatch.setenv("TPU_LIBRARY_PATH", fake_pjrt_full)
+    monkeypatch.setenv("TFD_HERMETIC", "1")  # no metadata slice binding
+    yield fake_pjrt_full
+
+
+def test_native_manager_enumerates_fake_plugin(native, fake_env):  # noqa: F811
+    from gpu_feature_discovery_tpu.resource.native_backend import NativeManager
+
+    m = NativeManager(cfg())
+    m.init()
+    chips = m.get_chips()
+    assert len(chips) == 2  # the fake exports two "TPU v4" devices
+    assert chips[0].get_name() == "tpu-v4"
+    assert chips[0].get_total_memory_mb() == 32 * 1024
+    assert m.get_runtime_version() == (0, 77)
+    assert m.get_driver_version() == "unknown.unknown.unknown"
+
+
+def test_native_manager_binds_slices_from_metadata(native, fake_pjrt_full, monkeypatch):  # noqa: F811
+    from gpu_feature_discovery_tpu.resource.native_backend import NativeManager
+
+    monkeypatch.setenv("TPU_LIBRARY_PATH", fake_pjrt_full)
+    monkeypatch.delenv("TFD_HERMETIC", raising=False)
+    monkeypatch.setenv("TFD_NO_METADATA", "1")
+    monkeypatch.setenv("TPU_ACCELERATOR_TYPE", "v4-8")
+    monkeypatch.setenv("TPU_TOPOLOGY", "2x2x1")
+    m = NativeManager(cfg())
+    m.init()
+    chip = m.get_chips()[0]
+    assert chip.is_slice_enabled()
+    (sl,) = chip.get_slices()
+    assert sl.get_name() == "2x2x1"
+
+
+def test_native_manager_fails_without_libtpu(native, monkeypatch):  # noqa: F811
+    from gpu_feature_discovery_tpu.native import shim
+    from gpu_feature_discovery_tpu.resource.native_backend import NativeManager
+
+    for env in shim.LIBTPU_ENV_VARS:
+        monkeypatch.delenv(env, raising=False)
+    monkeypatch.setattr(shim, "LIBTPU_SYSTEM_PATHS", ())
+    monkeypatch.setattr("sys.path", [])
+    with pytest.raises(ResourceError):
+        NativeManager(cfg()).init()
+
+
+def test_factory_auto_skips_native_without_opt_in(native, fake_env, monkeypatch):  # noqa: F811
+    """Auto chain must NOT reach the chip-seizing native path unless the
+    operator opted in; with the flag it is preferred over hostinfo."""
+    from gpu_feature_discovery_tpu.resource import factory
+    from gpu_feature_discovery_tpu.resource.native_backend import NativeManager
+
+    monkeypatch.setenv("TFD_BACKEND", "auto")
+    # jax must be unavailable for the chain to consider native.
+    monkeypatch.setattr(factory, "_try_jax_manager", lambda config: None)
+
+    manager = factory._get_manager(cfg())
+    assert not isinstance(manager, NativeManager)
+
+    manager = factory._get_manager(cfg(**{"native-enumeration": "true"}))
+    assert isinstance(manager, NativeManager)
+
+
+def test_factory_forced_native_backend(native, fake_env, monkeypatch):  # noqa: F811
+    """TFD_BACKEND=native counts as opt-in by itself."""
+    from gpu_feature_discovery_tpu.resource import factory
+    from gpu_feature_discovery_tpu.resource.native_backend import NativeManager
+
+    monkeypatch.setenv("TFD_BACKEND", "native")
+    manager = factory._get_manager(cfg())
+    assert isinstance(manager, NativeManager)
+    manager.init()
+    assert len(manager.get_chips()) == 2
+
+
+def test_full_label_pass_over_native_backend(native, fake_env, tmp_path):  # noqa: F811
+    """The labeler stack runs unmodified over the native backend — the
+    backend seam holds (SURVEY.md section 1 inter-layer rule)."""
+    from gpu_feature_discovery_tpu.lm.tpu import new_tpu_labeler
+    from gpu_feature_discovery_tpu.resource.native_backend import NativeManager
+
+    m = NativeManager(cfg())
+    config = cfg(**{"machine-type-file": str(tmp_path / "absent")})
+    labels = new_tpu_labeler(m, config).labels()
+    assert labels["google.com/tpu.count"] == "2"
+    assert labels["google.com/tpu.product"] == "tpu-v4"
+    assert labels["google.com/tpu.runtime.major"] == "0"
+    assert labels["google.com/tpu.runtime.minor"] == "77"
+    assert labels["google.com/tpu.driver.major"] == "unknown"
