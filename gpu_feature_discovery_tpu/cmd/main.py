@@ -125,6 +125,20 @@ def start(argv: Optional[list] = None) -> int:
         )
 
         try:
+            # Retry the metadata server each config epoch: the shared
+            # provider's unreachable-cache spares every consumer in the
+            # epoch a timeout, but a boot-time race (daemonset up before
+            # metadata is routable) must be recoverable by SIGHUP, not
+            # only by pod restart. Reset BEFORE building the manager and
+            # the interconnect labeler — they capture the shared provider
+            # at construction, and a post-construction reset would hand
+            # the new epoch the previous epoch's unreachable verdict.
+            from gpu_feature_discovery_tpu.hostinfo.provider import (
+                reset_metadata_provider_cache,
+            )
+
+            reset_metadata_provider_cache()
+
             manager = factory.new_manager(config)
             interconnect = new_interconnect_labeler(config)
 

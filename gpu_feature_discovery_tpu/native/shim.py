@@ -28,6 +28,23 @@ log = logging.getLogger("tfd.native")
 
 NATIVE_LIB_NAME = "libtfd_native.so"
 
+# tfd_result_t, mirrored ONCE from native/tfd_native.h (the cuda/consts.go
+# CUresult-mirror analog). test_native.py pins each value against the C
+# layer's tfd_error_string so a renumbered enum fails loudly instead of
+# silently flipping the truncation-tolerant path into a hard failure
+# (ADVICE r2).
+TFD_SUCCESS = 0
+TFD_ERROR_INVALID_ARGUMENT = 1
+TFD_ERROR_LIB_NOT_FOUND = 2
+TFD_ERROR_SYMBOL_NOT_FOUND = 3
+TFD_ERROR_NULL_API = 4
+TFD_ERROR_CONFIG_TOO_SHORT = 5
+TFD_ERROR_BUFFER_TOO_SMALL = 6
+TFD_ERROR_API_TOO_OLD = 7
+TFD_ERROR_CLIENT_CREATE = 8
+TFD_ERROR_ENUMERATE = 9
+TFD_ERROR_PLUGIN_INIT = 10
+
 # Search order for libtpu, mirroring the loader conventions of the TPU
 # stack: explicit flag/env first, then the pip-installed `libtpu` package,
 # then system paths.
@@ -168,7 +185,6 @@ class NativeShim:
             err,
             len(err),
         )
-        TFD_ERROR_BUFFER_TOO_SMALL = 6
         if rc == TFD_ERROR_BUFFER_TOO_SMALL:
             # The C layer filled max_devices valid records and reported the
             # true count — a truncated inventory still beats none.
@@ -178,7 +194,7 @@ class NativeShim:
                 n.value,
                 max_devices,
             )
-        elif rc != 0:
+        elif rc != TFD_SUCCESS:
             log.warning(
                 "native enumeration of %s failed: %s%s",
                 libtpu_path,

@@ -119,7 +119,14 @@ def _get_manager(config: Config) -> Manager:
     has_tpu, reason = _detect_tpu_platform(config)
     log.info("Detected %sTPU platform: %s", "" if has_tpu else "non-", reason)
     if has_tpu:
-        manager = _try_jax_manager(config)
+        # Eager verification is itself gated on the degradation contract:
+        # --fail-on-init-error=true means "init failures exit 1 loudly", so
+        # the jax manager must stay lazy and crash in run() — eagerly
+        # catching its init error here would silently select a degraded
+        # backend the operator asked not to get silently.
+        manager = _try_jax_manager(
+            config, eager=not config.flags.fail_on_init_error
+        )
         if manager is not None:
             log.info("Using PJRT (jax) manager")
             return manager
@@ -160,11 +167,33 @@ def _detect_tpu_platform(config: Config) -> tuple:
     return False, "no libtpu, no TPU PCI functions, no TPU environment"
 
 
-def _try_jax_manager(config: Config) -> Optional[Manager]:
+def _try_jax_manager(config: Config, eager: bool = False) -> Optional[Manager]:
+    """JaxManager, or None when jax is unusable.
+
+    ``eager`` (the auto chain) verifies usability by running init() NOW —
+    construction alone cannot fail (jax imports lazily inside init), so
+    without this the chain would never fall through to native/hostinfo: a
+    broken/absent jax would only surface at init() where the fallback
+    wrapper swaps in Null (no labels) instead of a degraded backend
+    (ADVICE r2 medium). init() is idempotent and the PJRT client is held
+    for the process lifetime anyway, so the eager call costs nothing
+    extra on a healthy node. Forced TFD_BACKEND=jax keeps lazy init so
+    the --fail-on-init-error contract decides how init failures surface.
+    """
+    from gpu_feature_discovery_tpu.config.spec import ConfigError
+
     try:
         from gpu_feature_discovery_tpu.resource.jax_backend import JaxManager
 
-        return JaxManager(config)
+        manager = JaxManager(config)
+        if eager:
+            manager.init()
+        return manager
+    except ConfigError:
+        # init() re-raises a typo'd TFD_HERMETIC/TFD_NO_METADATA as a hard
+        # config error; falling through to another backend would silently
+        # ignore the flag the operator mistyped.
+        raise
     except Exception as e:  # noqa: BLE001 - backend optional by design
         log.warning("jax backend unavailable: %s", e)
         return None

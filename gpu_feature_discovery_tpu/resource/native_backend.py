@@ -88,6 +88,8 @@ class NativeManager(Manager):
     def _slice_topology(self) -> str:
         """Provisioning metadata topology (hermetic-aware), as in the JAX
         backend's source 1; the C enumeration carries no coordinates."""
+        from gpu_feature_discovery_tpu.config.spec import ConfigError
+
         try:
             from gpu_feature_discovery_tpu.hostinfo.provider import (
                 discover_host_info_gated,
@@ -96,6 +98,11 @@ class NativeManager(Manager):
             info = discover_host_info_gated()
             if info is not None:
                 return info.resolved_topology()
+        except ConfigError:
+            # A typo'd TFD_HERMETIC/TFD_NO_METADATA is a hard config error —
+            # same contract as JaxManager._resolve_slice_topology (ADVICE r2:
+            # the two backends must agree on the strict env_flag grammar).
+            raise
         except Exception as e:  # noqa: BLE001 - metadata optional by design
             log.debug("no host metadata for slice topology: %s", e)
         return ""

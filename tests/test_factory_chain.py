@@ -17,7 +17,9 @@ def patch_detection(monkeypatch, has_tpu, jax_mgr, info):
     monkeypatch.setattr(
         factory, "_detect_tpu_platform", lambda config: (has_tpu, "patched")
     )
-    monkeypatch.setattr(factory, "_try_jax_manager", lambda config: jax_mgr)
+    monkeypatch.setattr(
+        factory, "_try_jax_manager", lambda config, eager=False: jax_mgr
+    )
     monkeypatch.setattr(
         factory,
         "_try_hostinfo_manager",
@@ -44,7 +46,7 @@ def test_null_when_no_backend_usable(monkeypatch):
 
 
 def test_null_off_tpu_without_probing_backends(monkeypatch):
-    def boom(config):
+    def boom(config, eager=False):
         raise AssertionError("backends must not be probed off-TPU")
 
     monkeypatch.setattr(
@@ -54,6 +56,38 @@ def test_null_off_tpu_without_probing_backends(monkeypatch):
     monkeypatch.setattr(factory, "_try_hostinfo_manager", boom)
     monkeypatch.delenv(factory.BACKEND_ENV, raising=False)
     assert isinstance(factory._get_manager(cfg()), NullManager)
+
+
+def test_auto_chain_falls_through_on_jax_init_failure(monkeypatch):
+    """ADVICE r2 (medium): JaxManager construction can't fail (jax imports
+    lazily in init), so the auto chain must verify usability eagerly and
+    fall through to a degraded backend — not let the fallback wrapper
+    swap in Null (no labels) later. Eager verification only applies under
+    --fail-on-init-error=false: that flag IS the degradation opt-in, and
+    with it true the jax manager stays lazy so init failures exit loudly
+    in run()."""
+    from gpu_feature_discovery_tpu.resource import jax_backend
+
+    def broken_enumeration():
+        raise RuntimeError("jax wedged")
+
+    monkeypatch.setattr(jax_backend, "_enumerate_tpu_devices", broken_enumeration)
+    monkeypatch.setattr(
+        factory, "_detect_tpu_platform", lambda config: (True, "patched")
+    )
+    info = host_info_from_mapping({"TPU_ACCELERATOR_TYPE": "v4-8"})
+    monkeypatch.setattr(
+        factory,
+        "_try_hostinfo_manager",
+        lambda config: HostinfoManager(config, info=info),
+    )
+    monkeypatch.delenv(factory.BACKEND_ENV, raising=False)
+    degraded = factory._get_manager(cfg(**{"fail-on-init-error": "false"}))
+    assert isinstance(degraded, HostinfoManager)
+    # Loud mode: jax is still selected (lazy); its init error surfaces in
+    # run() and exits 1 instead of silently degrading.
+    loud = factory._get_manager(cfg(**{"fail-on-init-error": "true"}))
+    assert isinstance(loud, jax_backend.JaxManager)
 
 
 def test_fallback_wrapper_applied_iff_not_fail_on_init(monkeypatch):

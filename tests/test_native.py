@@ -110,6 +110,21 @@ def test_error_strings(native):
     assert native.error_string(99) == "TFD_ERROR_UNKNOWN"
 
 
+def test_result_enum_mirror_matches_c_layer(native):
+    """Every tfd_result_t constant mirrored in shim.py must round-trip
+    through the C layer's tfd_error_string to its own name — renumbering
+    either side without the other fails here instead of silently changing
+    rc-handling behavior (ADVICE r2: shim.py duplicated the enum inline)."""
+    mirrored = {
+        name: value
+        for name, value in vars(shim).items()
+        if name == "TFD_SUCCESS" or name.startswith("TFD_ERROR_")
+    }
+    assert len(mirrored) == 11  # full tfd_native.h enum, nothing dropped
+    for name, value in mirrored.items():
+        assert native.error_string(value) == name
+
+
 def test_pci_walker_matches_python(native):
     """C++ and Python walkers agree on every synthesized blob."""
     for dev in default_mock_devices():

@@ -80,13 +80,23 @@ def test_factory_auto_skips_native_without_opt_in(native, fake_env, monkeypatch)
     from gpu_feature_discovery_tpu.resource.native_backend import NativeManager
 
     monkeypatch.setenv("TFD_BACKEND", "auto")
-    # jax must be unavailable for the chain to consider native.
-    monkeypatch.setattr(factory, "_try_jax_manager", lambda config: None)
+    # jax must be unavailable for the chain to consider native. Break it
+    # the way production would see it (init-time enumeration failure) so
+    # the eager-verification path in _try_jax_manager is what's exercised,
+    # not a monkeypatched stand-in (ADVICE r2 medium).
+    from gpu_feature_discovery_tpu.resource import jax_backend
 
-    manager = factory._get_manager(cfg())
+    def broken_enumeration():
+        raise RuntimeError("jax wedged")
+
+    monkeypatch.setattr(jax_backend, "_enumerate_tpu_devices", broken_enumeration)
+
+    manager = factory._get_manager(cfg(**{"fail-on-init-error": "false"}))
     assert not isinstance(manager, NativeManager)
 
-    manager = factory._get_manager(cfg(**{"native-enumeration": "true"}))
+    manager = factory._get_manager(
+        cfg(**{"native-enumeration": "true", "fail-on-init-error": "false"})
+    )
     assert isinstance(manager, NativeManager)
 
 
