@@ -129,6 +129,11 @@ def new_health_labeler(manager: Manager, config: Config) -> Labeler:
         # the next cycles retry the acquisition instead of republishing.
         # The failure streak resets too — burn-in failures separated by an
         # unacquirable gap are not "consecutive" evidence of a wedged chip.
+        # Deliberate consequence: if acquirability flaps, every reacquired
+        # cycle re-probes (the cache can never survive the gap). A fresh
+        # probe per reacquisition is the honest reading of a device that
+        # keeps coming and going; the interval throttle only governs
+        # steadily-acquirable chips.
         sched.cached = None
         sched.consecutive_failures = 0
         return Empty()

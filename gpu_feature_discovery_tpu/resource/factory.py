@@ -55,6 +55,16 @@ def _mock_slice_backend(accel_type: str) -> Manager:
     return new_uniform_slice_manager(accel_type)
 
 
+def _mock_worker_backend(accel_type: str) -> Manager:
+    """``mock-worker:<accel_type>`` — one worker of a multi-host slice
+    (only this host's chips, bound to the full slice topology)."""
+    from gpu_feature_discovery_tpu.resource.testing import (
+        new_multihost_worker_manager,
+    )
+
+    return new_multihost_worker_manager(accel_type)
+
+
 def _mock_mixed_backend(spec: str) -> Manager:
     """``mock-mixed:<family>[:<topo>,<topo>,...]`` — one chip per listed
     slice topology (defaults to the builder's heterogeneous set)."""
@@ -79,6 +89,10 @@ def _get_manager(config: Config) -> Manager:
         accel = backend.split(":", 1)[1]
         log.info("Using mock uniform-slice manager (%s)", accel)
         return _mock_slice_backend(accel)
+    if backend.startswith("mock-worker:"):
+        accel = backend.split(":", 1)[1]
+        log.info("Using mock multi-host worker manager (%s)", accel)
+        return _mock_worker_backend(accel)
     if backend.startswith("mock-mixed:"):
         family = backend.split(":", 1)[1]
         log.info("Using mock mixed-slice manager (%s)", family)

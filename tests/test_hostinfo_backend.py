@@ -56,8 +56,9 @@ def test_slice_binding_exposes_topology():
     (sl,) = chip.get_slices()
     assert sl.get_name() == "4x4"
     attrs = sl.get_attributes()
-    assert attrs["chips"] == 16
-    assert attrs["memory"] == 16 * 1024 * 16
+    assert attrs["slice.chips"] == 16
+    assert attrs["memory"] == 16 * 1024  # per chip
+    assert attrs["slice.memory"] == 16 * 1024 * 16
     assert sl.get_parent_chip() is chip
 
 
@@ -152,4 +153,4 @@ def test_malformed_topology_degrades_to_single_chip_partition():
     )
     m.init()
     (sl,) = m.get_chips()[0].get_slices()
-    assert sl.get_attributes()["chips"] == 1  # degraded, not crashed
+    assert sl.get_attributes()["slice.chips"] == 1  # degraded, not crashed

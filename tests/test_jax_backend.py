@@ -99,7 +99,7 @@ def test_chips_bound_into_slice_from_live_coords(monkeypatch):
         assert sl.get_name() == "2x2x1"
         assert sl.get_parent_chip() is chip
         attrs = sl.get_attributes()
-        assert attrs["chips"] == 4
+        assert attrs["slice.chips"] == 4
         assert (attrs["topology.x"], attrs["topology.y"], attrs["topology.z"]) == (2, 2, 1)
 
 
@@ -115,7 +115,7 @@ def test_metadata_topology_beats_coords(monkeypatch):
     m = manager_with(local, local, monkeypatch, metadata_info=info)
     (sl,) = m.get_chips()[0].get_slices()
     assert sl.get_name() == "2x4x4"
-    assert sl.get_attributes()["chips"] == 32
+    assert sl.get_attributes()["slice.chips"] == 32
 
 
 def test_unresolvable_topology_leaves_chips_unbound(monkeypatch):
@@ -132,8 +132,9 @@ def test_slice_memory_uses_live_hbm_reading(monkeypatch):
             for i in range(4)]
     m = manager_with(devs, devs, monkeypatch)
     (sl,) = m.get_chips()[0].get_slices()
-    # 4-chip slice at the measured 15 GiB/chip, not the 16 GiB spec number.
-    assert sl.get_attributes()["memory"] == 15 * 1024 * 4
+    # Measured 15 GiB/chip, not the 16 GiB spec number; slice total scales.
+    assert sl.get_attributes()["memory"] == 15 * 1024
+    assert sl.get_attributes()["slice.memory"] == 15 * 1024 * 4
     assert sl.get_name() == "2x2"  # 2D vocabulary for v5e
 
 
@@ -161,7 +162,7 @@ def test_strategy_single_fires_on_live_backend(monkeypatch):
     labels = new_resource_labeler(m, config).labels()
     assert labels["google.com/tpu.topology.strategy"] == "single"
     assert labels["google.com/tpu.product"] == "tpu-v5p-SLICE-2x2x1"
-    assert labels["google.com/tpu.chips"] == "4"
+    assert labels["google.com/tpu.slice.chips"] == "4"
     assert labels["google.com/tpu.topology.x"] == "2"
     assert labels["google.com/tpu.count"] == "4"  # 4 slice devices on node
 
@@ -174,7 +175,7 @@ def test_strategy_mixed_fires_on_live_backend(monkeypatch):
     config = cfg(**{"tpu-topology-strategy": "mixed"})
     labels = new_resource_labeler(m, config).labels()
     assert labels["google.com/tpu-2x1x1.product"] == "tpu-v5p-SLICE-2x1x1"
-    assert labels["google.com/tpu-2x1x1.chips"] == "2"
+    assert labels["google.com/tpu-2x1x1.slice.chips"] == "2"
 
 
 def test_init_failure_raises_resource_error(monkeypatch):

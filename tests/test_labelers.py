@@ -198,13 +198,14 @@ def test_slice_labels_product_and_attributes():
     assert labels["google.com/tpu.product"] == "tpu-v5p-SLICE-2x2x1"
     assert labels["google.com/tpu.count"] == "4"
     assert labels["google.com/tpu.replicas"] == "1"
-    assert labels["google.com/tpu.memory"] == str(95 * 1024 * 4)
-    assert labels["google.com/tpu.chips"] == "4"
+    assert labels["google.com/tpu.memory"] == str(95 * 1024)  # per chip
+    assert labels["google.com/tpu.slice.memory"] == str(95 * 1024 * 4)
+    assert labels["google.com/tpu.slice.chips"] == "4"
     assert labels["google.com/tpu.topology.x"] == "2"
     assert labels["google.com/tpu.topology.y"] == "2"
     assert labels["google.com/tpu.topology.z"] == "1"
-    assert labels["google.com/tpu.hosts"] == "1"
-    assert labels["google.com/tpu.ici.links"] == "24"
+    assert labels["google.com/tpu.slice.hosts"] == "1"
+    assert labels["google.com/tpu.ici.links"] == "6"  # per chip
 
 
 def test_slice_labels_custom_resource_name():
@@ -215,7 +216,7 @@ def test_slice_labels_custom_resource_name():
     ).labels()
     assert labels["google.com/tpu-2x4.product"] == "tpu-v5e-SLICE-2x4"
     assert labels["google.com/tpu-2x4.count"] == "2"
-    assert labels["google.com/tpu-2x4.chips"] == "8"
+    assert labels["google.com/tpu-2x4.slice.chips"] == "8"
 
 
 # ---------------------------------------------------------------------------

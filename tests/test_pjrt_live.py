@@ -102,9 +102,9 @@ def test_pjrt_slice_labels_present_and_consistent(tmp_path):
     x = int(labels["google.com/tpu.topology.x"])
     y = int(labels["google.com/tpu.topology.y"])
     z = int(labels["google.com/tpu.topology.z"])
-    assert int(labels["google.com/tpu.chips"]) == x * y * z
+    assert int(labels["google.com/tpu.slice.chips"]) == x * y * z
     # The product suffix is the slice topology and must agree with the
     # attribute family (tpu-v5e-SLICE-2x2 → 2*2 chips).
     slice_topo = labels["google.com/tpu.product"].rsplit("SLICE-", 1)[-1]
     dims = [int(d) for d in slice_topo.split("x")]
-    assert math.prod(dims) == int(labels["google.com/tpu.chips"])
+    assert math.prod(dims) == int(labels["google.com/tpu.slice.chips"])

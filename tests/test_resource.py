@@ -64,13 +64,14 @@ def test_slice_device_shape():
     assert sl.get_name() == "2x2x1"
     assert sl.get_parent_chip() is chip
     attrs = sl.get_attributes()
-    assert attrs["chips"] == 4
-    assert attrs["memory"] == 95 * 1024 * 4
-    assert attrs["tensorcores"] == 8
+    assert attrs["slice.chips"] == 4
+    assert attrs["memory"] == 95 * 1024  # per chip; slice total under slice.memory
+    assert attrs["slice.memory"] == 95 * 1024 * 4
+    assert attrs["tensorcores"] == 2
     assert attrs["topology.x"] == 2
     assert attrs["topology.y"] == 2
     assert attrs["topology.z"] == 1
-    assert attrs["hosts"] == 1
+    assert attrs["slice.hosts"] == 1
     with pytest.raises(ResourceError):
         sl.get_slices()
 
