@@ -147,6 +147,61 @@ struct ErrorMessageArgs {
   size_t message_size;  /* out */
 };
 
+/* PJRT_NamedValue: the typed attribute record DeviceDescription_Attributes
+ * returns (the cuDeviceGetAttribute analog — CUDA enumerates attributes by
+ * integer id, PJRT by name). Declared inline like everything else here. */
+enum {
+  kPjrtNamedValueString = 0,
+  kPjrtNamedValueInt64 = 1,
+  kPjrtNamedValueInt64List = 2,
+  kPjrtNamedValueFloat = 3,
+  kPjrtNamedValueBool = 4,
+};
+struct PjrtNamedValue {
+  size_t struct_size;
+  void* ext;
+  const char* name;
+  size_t name_size;
+  int type; /* PJRT_NamedValue_Type */
+  union {
+    const char* string_value;
+    long long int64_value;
+    const long long* int64_array_value;
+    float float_value;
+    bool bool_value;
+  } v;
+  size_t value_size; /* list length for kInt64List */
+};
+struct DeviceDescriptionAttributesArgs {
+  size_t struct_size;
+  void* ext;
+  void* device_description;
+  size_t num_attributes;             /* out */
+  const PjrtNamedValue* attributes;  /* out */
+};
+
+bool attr_name_is(const PjrtNamedValue& a, const char* want) {
+  if (a.name == nullptr) return false;
+  size_t wlen = 0;
+  while (want[wlen] != '\0') ++wlen;
+  if (a.name_size != wlen) return false;
+  for (size_t i = 0; i < wlen; ++i) {
+    if (a.name[i] != want[i]) return false;
+  }
+  return true;
+}
+
+/* Exact-name allowlist for the HBM-capacity attribute. A substring match
+ * on "memory"/"hbm" would latch onto the first non-capacity attribute a
+ * future plugin exposes (memory_bandwidth, hbm_utilization, ...) and
+ * publish a wildly wrong size — capacity must be opted in by name. */
+bool attr_is_memory_capacity(const PjrtNamedValue& a) {
+  return attr_name_is(a, "memory_space_size") ||
+         attr_name_is(a, "memory_bytes") || attr_name_is(a, "memory_size") ||
+         attr_name_is(a, "hbm_bytes") || attr_name_is(a, "hbm_size_bytes") ||
+         attr_name_is(a, "hbm_size");
+}
+
 typedef void* (*PjrtErrorFn)(void*);  /* generic PJRT_Error* f(Args*) */
 
 /* Call a PJRT entry point; on failure, copy the error message into err_msg
@@ -175,6 +230,8 @@ bool pjrt_call(const PjrtApiTable* api, void* fn_slot, void* args,
 }
 
 }  // namespace
+
+extern "C" int tfd_abi_version(void) { return TFD_NATIVE_ABI_VERSION; }
 
 extern "C" int tfd_probe_libtpu(const char* path, int* api_major,
                                 int* api_minor) {
@@ -325,6 +382,42 @@ extern "C" int tfd_enumerate(const char* path, tfd_device_info_t* out,
       if (kn >= sizeof(out[i].kind)) kn = sizeof(out[i].kind) - 1;
       for (size_t k = 0; k < kn; ++k) out[i].kind[k] = kind_args.device_kind[k];
       out[i].kind[kn] = '\0';
+
+      /* Real device attributes (cuDeviceGetAttribute/cuDeviceTotalMem
+       * analog, cuda-device.go:70-98). Best-effort by design: attribute
+       * coverage varies across plugin generations, so a missing slot or a
+       * failing call leaves the sentinels — the Python layer falls back to
+       * its spec tables exactly as it did before this path existed. */
+      out[i].coords_len = 0;
+      out[i].coords[0] = out[i].coords[1] = out[i].coords[2] = -1;
+      out[i].core_on_chip = -1;
+      out[i].memory_raw = -1;
+      DeviceDescriptionAttributesArgs attr_args = {
+          sizeof(DeviceDescriptionAttributesArgs), nullptr, desc, 0, nullptr};
+      if (api->device_description_attributes != nullptr &&
+          pjrt_call(api, api->device_description_attributes, &attr_args) &&
+          attr_args.attributes != nullptr) {
+        for (size_t a = 0; a < attr_args.num_attributes; ++a) {
+          const PjrtNamedValue& nv = attr_args.attributes[a];
+          if (nv.type == kPjrtNamedValueInt64List &&
+              attr_name_is(nv, "coords") && nv.v.int64_array_value != nullptr &&
+              nv.value_size >= 1 && nv.value_size <= 3) {
+            /* >3-D coords are NOT clamped: truncating would alias distinct
+             * chips and merge them in the dedup pass — leave the sentinel
+             * and let the spec-table fallback handle the unknown shape. */
+            for (size_t c = 0; c < nv.value_size; ++c) {
+              out[i].coords[c] = nv.v.int64_array_value[c];
+            }
+            out[i].coords_len = static_cast<int>(nv.value_size);
+          } else if (nv.type == kPjrtNamedValueInt64 &&
+                     attr_name_is(nv, "core_on_chip")) {
+            out[i].core_on_chip = nv.v.int64_value;
+          } else if (nv.type == kPjrtNamedValueInt64 &&
+                     out[i].memory_raw < 0 && attr_is_memory_capacity(nv)) {
+            out[i].memory_raw = nv.v.int64_value;
+          }
+        }
+      }
     }
   } else if (rc == TFD_SUCCESS) {
     rc = TFD_ERROR_ENUMERATE;

@@ -68,11 +68,31 @@ class ProbeResult:
 
 @dataclass(frozen=True)
 class EnumeratedDevice:
-    """One device from the native enumeration path (tfd_device_info_t)."""
+    """One device from the native enumeration path (tfd_device_info_t).
+
+    ``coords``/``core_on_chip``/``memory_mb`` are attribute-backed facts
+    from PJRT_DeviceDescription_Attributes (the cuDeviceGetAttribute /
+    cuDeviceTotalMem analog, cuda-device.go:70-98); None when the plugin
+    does not expose the attribute — callers fall back to spec tables."""
 
     id: int
     process_index: int
     kind: str
+    coords: Optional[tuple] = None
+    core_on_chip: Optional[int] = None
+    memory_mb: Optional[int] = None
+
+
+def _memory_mb_from_raw(raw: int) -> Optional[int]:
+    """The memory attribute's unit is not standardized across plugins.
+    Real HBM sizes are 8-128 GiB: expressed in bytes that is >= 2^33,
+    expressed in MiB it is < 2^18, so one threshold (64 MiB) separates the
+    two encodings for every plausible chip."""
+    if raw < 0:
+        return None
+    if raw > 64 * 1024 * 1024:
+        return raw // (1024 * 1024)
+    return raw
 
 
 class _CDeviceInfo(ctypes.Structure):
@@ -80,6 +100,10 @@ class _CDeviceInfo(ctypes.Structure):
         ("id", ctypes.c_int),
         ("process_index", ctypes.c_int),
         ("kind", ctypes.c_char * 64),
+        ("coords", ctypes.c_longlong * 3),
+        ("coords_len", ctypes.c_int),
+        ("core_on_chip", ctypes.c_longlong),
+        ("memory_raw", ctypes.c_longlong),
     ]
 
 
@@ -120,11 +144,26 @@ def probe_libtpu(explicit_path: Optional[str] = None) -> ProbeResult:
     return ProbeResult(False)
 
 
+# Must equal TFD_NATIVE_ABI_VERSION in tfd_native.h. A stale prebuilt .so
+# with a different struct layout would otherwise parse device records at
+# the wrong stride — silently corrupting every record after the first.
+NATIVE_ABI_VERSION = 2
+
+
 class NativeShim:
     """Thin ctypes wrapper over libtfd_native.so's flat C ABI."""
 
     def __init__(self, lib: ctypes.CDLL):
         self._lib = lib
+        lib.tfd_abi_version.restype = ctypes.c_int
+        got = lib.tfd_abi_version()
+        if got != NATIVE_ABI_VERSION:
+            # Raises the type load_native() treats as "not loadable", so a
+            # stale library degrades cleanly to the pure-Python fallbacks.
+            raise OSError(
+                f"libtfd_native.so ABI {got} != expected {NATIVE_ABI_VERSION};"
+                " rebuild with make -C gpu_feature_discovery_tpu/native"
+            )
         lib.tfd_probe_libtpu.argtypes = [
             ctypes.c_char_p,
             ctypes.POINTER(ctypes.c_int),
@@ -207,6 +246,15 @@ class NativeShim:
                 id=out[i].id,
                 process_index=out[i].process_index,
                 kind=out[i].kind.decode(errors="replace"),
+                coords=(
+                    tuple(out[i].coords[: out[i].coords_len])
+                    if out[i].coords_len > 0
+                    else None
+                ),
+                core_on_chip=(
+                    out[i].core_on_chip if out[i].core_on_chip >= 0 else None
+                ),
+                memory_mb=_memory_mb_from_raw(out[i].memory_raw),
             )
             for i in range(min(n.value, max_devices))
         ]

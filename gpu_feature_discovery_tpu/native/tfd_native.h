@@ -32,13 +32,31 @@ typedef enum {
   TFD_ERROR_PLUGIN_INIT = 10,      /* PJRT_Plugin_Initialize failed */
 } tfd_result_t;
 
-/* One enumerated device (the cuDeviceGet/cuDeviceGetName record analog,
- * internal/cuda/api.go:58-118). */
+/* One enumerated device (the cuDeviceGet/cuDeviceGetName +
+ * cuDeviceGetAttribute/cuDeviceTotalMem record analog,
+ * internal/cuda/api.go:58-118, cuda-device.go:70-98). The attribute
+ * fields come from PJRT_DeviceDescription_Attributes and are sentinel'd
+ * when the plugin does not expose them — attribute coverage varies by
+ * generation (SURVEY.md "riskiest unknowns" (a)). */
 typedef struct {
   int id;                 /* PJRT global device id */
   int process_index;      /* owning process (host) within the slice */
   char kind[64];          /* device kind, e.g. "TPU v5 lite" */
+  long long coords[3];    /* "coords" attribute (ICI grid position) */
+  int coords_len;         /* 0 when the plugin exposes no coords */
+  long long core_on_chip; /* "core_on_chip" attribute; -1 when absent */
+  long long memory_raw;   /* first int64 attribute whose name contains
+                             "memory" or "hbm", verbatim (bytes vs MiB is
+                             decided Python-side); -1 when absent */
 } tfd_device_info_t;
+
+/* ABI version of THIS header's structs. Bump whenever tfd_device_info_t
+ * (or any other ctypes-crossed layout) changes; shim.py refuses to load a
+ * .so whose tfd_abi_version() disagrees, so a stale prebuilt library
+ * degrades to the pure-Python fallback instead of parsing device records
+ * with the wrong stride. */
+#define TFD_NATIVE_ABI_VERSION 2
+int tfd_abi_version(void);
 
 /* dlopen(path) + GetPjrtApi() probe; writes the PJRT C API version into
  * *api_major / *api_minor on success. Never creates a PJRT client — the

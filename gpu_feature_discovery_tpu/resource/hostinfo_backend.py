@@ -45,12 +45,23 @@ class StaticSlice(SlicePartition):
 
 
 class StaticChip(Chip):
-    """One chip known only through the spec tables (cuda-device analog)."""
+    """One chip known only through the spec tables (cuda-device analog).
 
-    def __init__(self, spec: ChipSpec, slice_topology: str = ""):
+    ``memory_mb`` overrides the spec table when the caller measured the
+    real value (the native backend's attribute-backed enumeration)."""
+
+    def __init__(
+        self,
+        spec: ChipSpec,
+        slice_topology: str = "",
+        memory_mb: Optional[int] = None,
+    ):
         self._spec = spec
+        self._memory_mb = memory_mb if memory_mb else spec.hbm_mb
         self._slices = (
-            [StaticSlice(slice_topology, self, spec)] if slice_topology else []
+            [StaticSlice(slice_topology, self, spec, per_chip_memory_mb=memory_mb)]
+            if slice_topology
+            else []
         )
 
     def is_slice_enabled(self) -> bool:
@@ -69,7 +80,7 @@ class StaticChip(Chip):
         return self._spec.product
 
     def get_total_memory_mb(self) -> int:
-        return self._spec.hbm_mb
+        return self._memory_mb
 
     def get_parent_chip(self) -> Chip:
         raise ResourceError("get_parent_chip only supported for slice partitions")

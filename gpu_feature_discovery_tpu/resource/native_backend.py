@@ -15,9 +15,14 @@ running a workload must fall through to the metadata backend instead
 (SURVEY.md section 7 hard part #1).
 
 Inventory is live hardware (unlike HostinfoManager's metadata guesses);
-attributes come from the generation spec tables keyed by the enumerated
-device kind, and slice binding reuses the metadata topology the same way
-the JAX backend does.
+attributes come from PJRT_DeviceDescription_Attributes when the plugin
+exposes them — coords (ICI grid position, also used to dedup the two
+TensorCores of one v2/v3 chip and to derive slice topology), core_on_chip,
+and the HBM size (the cuDeviceGetAttribute/cuDeviceTotalMem parity,
+cuda-device.go:70-98) — with the generation spec tables as fallback for
+whatever the plugin leaves out. Slice binding prefers the metadata
+topology exactly like the JAX backend, then the local coordinate bounding
+box.
 """
 
 from __future__ import annotations
@@ -87,7 +92,8 @@ class NativeManager(Manager):
 
     def _slice_topology(self) -> str:
         """Provisioning metadata topology (hermetic-aware), as in the JAX
-        backend's source 1; the C enumeration carries no coordinates."""
+        backend's source 1. When this resolves nothing, get_chips falls
+        back to the enumerated coords (_topology_from_local_coords)."""
         from gpu_feature_discovery_tpu.config.spec import ConfigError
 
         try:
@@ -113,8 +119,11 @@ class NativeManager(Manager):
         if self._enumerated is None:
             return []
         _, devices = self._enumerated
-        topology = self._slice_topology()
+        topology = self._slice_topology() or self._topology_from_local_coords(
+            devices
+        )
         chips: List[Chip] = []
+        seen = set()
         for dev in devices:
             spec = spec_for(dev.kind)
             if spec is None:
@@ -123,9 +132,42 @@ class NativeManager(Manager):
                     dev.kind,
                 )
                 continue
-            chips.append(StaticChip(spec, slice_topology=topology))
+            if dev.coords is not None:
+                # v2/v3 expose each TensorCore as its own PJRT device;
+                # both cores of a chip share coords (same dedup the JAX
+                # backend does, jax_backend.py get_chips).
+                key = (dev.process_index, dev.coords)
+                if key in seen:
+                    continue
+                seen.add(key)
+            chips.append(
+                StaticChip(
+                    spec, slice_topology=topology, memory_mb=dev.memory_mb
+                )
+            )
         self._chips = chips
         return list(chips)
+
+    @staticmethod
+    def _topology_from_local_coords(devices: list) -> str:
+        """Bounding box of the enumerated coords — the JAX backend's live
+        source 2, with one honesty caveat: the C enumeration sees only
+        ADDRESSABLE devices, so the box is this host's corner of the grid,
+        not the whole slice. It is consulted only when metadata resolved
+        nothing, and multi-host TPU VMs always carry tpu-env metadata (the
+        runtime needs it to rendezvous) — so in the reachable case, a
+        metadata-less single host, the local box IS the slice."""
+        from gpu_feature_discovery_tpu.resource.jax_backend import (
+            _topology_from_coords,
+        )
+
+        with_coords = [d for d in devices if d.coords is not None]
+        if len(with_coords) != len(devices) or not devices:
+            return ""
+        spec = spec_for(devices[0].kind)
+        return _topology_from_coords(
+            with_coords, ndims=spec.ici_dims if spec else None
+        )
 
     def get_driver_version(self) -> str:
         # Honest degradation, same as HostinfoManager: the enumeration

@@ -292,6 +292,147 @@ def test_enumerate_fake_plugin(native, fake_pjrt_full):
         (0, 0, "TPU v4"),
         (1, 0, "TPU v4"),
     ]
+    # The attribute-less fake leaves the attribute facts unset: callers
+    # fall back to spec tables, the pre-attributes behavior.
+    assert all(
+        (d.coords, d.core_on_chip, d.memory_mb) == (None, None, None)
+        for d in devices
+    )
+
+
+@pytest.fixture(scope="module")
+def fake_pjrt_attrs(native, tmp_path_factory):
+    """A fake plugin that ALSO implements DeviceDescription_Attributes:
+    four "TPU v3" TensorCore devices — two per chip, chips at coords
+    (0,0,0) and (1,0,0) — each exposing coords (int64 list), core_on_chip
+    (int64), and memory_bytes (int64, 16 GiB). Exercises the attribute
+    parity with cuda-device.go:70-98."""
+    return _compile_so(
+        tmp_path_factory.mktemp("fake-pjrt-attrs"),
+        """
+        #include <stddef.h>
+        #include <string.h>
+
+        struct Version { size_t sz; void* ext; int major; int minor; };
+        struct PluginInitArgs { size_t sz; void* ext; };
+        struct CreateArgs { size_t sz; void* ext; const void* opts;
+                            size_t nopts; void* kvg; void* kvga; void* kvp;
+                            void* kvpa; void* client; void* kvt; void* kvta; };
+        struct DestroyArgs { size_t sz; void* ext; void* client; };
+        struct NameArgs { size_t sz; void* ext; void* client;
+                          const char* name; size_t name_sz; };
+        struct DevsArgs { size_t sz; void* ext; void* client;
+                          void* const* devs; size_t ndevs; };
+        struct DescArgs { size_t sz; void* ext; void* dev; void* desc; };
+        struct IdArgs { size_t sz; void* ext; void* desc; int id; };
+        struct PiArgs { size_t sz; void* ext; void* desc; int pi; };
+        struct KindArgs { size_t sz; void* ext; void* desc;
+                          const char* kind; size_t kind_sz; };
+        struct NamedValue { size_t sz; void* ext; const char* name;
+                            size_t name_sz; int type;
+                            union { const char* s; long long i;
+                                    const long long* arr; float f;
+                                    bool b; } v;
+                            size_t value_sz; };
+        struct AttrsArgs { size_t sz; void* ext; void* desc; size_t num;
+                           const struct NamedValue* attrs; };
+
+        static int fake_client;
+        static int dev[4];
+        static void* devs[4] = {&dev[0], &dev[1], &dev[2], &dev[3]};
+        static long long coords_a[3] = {0, 0, 0};
+        static long long coords_b[3] = {1, 0, 0};
+        static struct NamedValue attr_out[4][3];
+
+        static int which(void* d) {
+          for (int i = 0; i < 4; ++i) if (d == &dev[i]) return i;
+          return 0;
+        }
+
+        extern "C" {
+        static void* plugin_init(void* a) { (void)a; return 0; }
+        static void* create(void* a) {
+          ((struct CreateArgs*)a)->client = &fake_client; return 0; }
+        static void* destroy(void* a) { (void)a; return 0; }
+        static void* name(void* a) {
+          struct NameArgs* n = (struct NameArgs*)a;
+          n->name = "tpu"; n->name_sz = 3; return 0; }
+        static void* devices(void* a) {
+          struct DevsArgs* d = (struct DevsArgs*)a;
+          d->devs = devs; d->ndevs = 4; return 0; }
+        static void* get_desc(void* a) {
+          struct DescArgs* d = (struct DescArgs*)a;
+          d->desc = d->dev; return 0; }
+        static void* desc_id(void* a) {
+          struct IdArgs* i = (struct IdArgs*)a;
+          i->id = which(i->desc); return 0; }
+        static void* desc_pi(void* a) {
+          ((struct PiArgs*)a)->pi = 0; return 0; }
+        static void* desc_kind(void* a) {
+          struct KindArgs* k = (struct KindArgs*)a;
+          k->kind = "TPU v3"; k->kind_sz = 6; return 0; }
+        static void* desc_attrs(void* a) {
+          struct AttrsArgs* at = (struct AttrsArgs*)a;
+          int idx = which(at->desc);
+          struct NamedValue* o = attr_out[idx];
+          memset(o, 0, sizeof(attr_out[idx]));
+          o[0].name = "coords"; o[0].name_sz = 6; o[0].type = 2;
+          o[0].v.arr = (idx < 2) ? coords_a : coords_b; o[0].value_sz = 3;
+          o[1].name = "core_on_chip"; o[1].name_sz = 12; o[1].type = 1;
+          o[1].v.i = idx % 2;
+          o[2].name = "memory_bytes"; o[2].name_sz = 12; o[2].type = 1;
+          o[2].v.i = 17179869184LL;  /* 16 GiB */
+          at->num = 3; at->attrs = o;
+          return 0; }
+
+        struct Api {
+          size_t sz; void* ext; struct Version v;
+          void* err_destroy; void* err_message; void* err_getcode;
+          void* plugin_initialize; void* plugin_attributes;
+          void* ev_destroy; void* ev_isready; void* ev_error;
+          void* ev_await; void* ev_onready;
+          void* client_create; void* client_destroy; void* client_name;
+          void* client_pi; void* client_pv; void* client_devices;
+          void* client_addressable_devices; void* client_lookup;
+          void* client_lookup_addr; void* client_addr_mems;
+          void* client_compile; void* client_dda; void* client_bfhb;
+          void* dd_id; void* dd_pi; void* dd_attrs; void* dd_kind;
+          void* dd_debug; void* dd_tostring; void* dev_get_description;
+        };
+        static struct Api api;
+        const struct Api* GetPjrtApi(void) {
+          memset(&api, 0, sizeof(api));
+          api.sz = sizeof(api); api.v.sz = sizeof(struct Version);
+          api.v.major = 0; api.v.minor = 77;
+          api.plugin_initialize = (void*)plugin_init;
+          api.client_create = (void*)create;
+          api.client_destroy = (void*)destroy;
+          api.client_name = (void*)name;
+          api.client_addressable_devices = (void*)devices;
+          api.dd_id = (void*)desc_id;
+          api.dd_pi = (void*)desc_pi;
+          api.dd_attrs = (void*)desc_attrs;
+          api.dd_kind = (void*)desc_kind;
+          api.dev_get_description = (void*)get_desc;
+          return &api;
+        }
+        }
+        """,
+        name="libfakepjrt-attrs.so",
+    )
+
+
+def test_enumerate_reads_device_attributes(native, fake_pjrt_attrs):
+    """coords / core_on_chip / memory flow from the plugin's NamedValue
+    records through the C parser and ctypes marshalling."""
+    platform, devices = native.enumerate(fake_pjrt_attrs)
+    assert platform == "tpu"
+    assert len(devices) == 4
+    assert [d.coords for d in devices] == [
+        (0, 0, 0), (0, 0, 0), (1, 0, 0), (1, 0, 0)
+    ]
+    assert [d.core_on_chip for d in devices] == [0, 1, 0, 1]
+    assert all(d.memory_mb == 16 * 1024 for d in devices)  # bytes -> MiB
 
 
 def test_enumerate_probe_only_plugin_fails_cleanly(native, fake_libtpu):
