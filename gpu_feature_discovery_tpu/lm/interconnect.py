@@ -15,12 +15,19 @@ from typing import Optional
 
 from gpu_feature_discovery_tpu.hostinfo.tpu_env import HostInfo
 from gpu_feature_discovery_tpu.lm.labels import Labels
-from gpu_feature_discovery_tpu.pci.pciutil import GooglePCI
+from gpu_feature_discovery_tpu.pci.pciutil import (
+    GooglePCI,
+    PCIError,
+    decode_vendor_capability,
+)
 
 log = logging.getLogger("tfd.lm")
 
 PCI_PRESENT = "google.com/tpu.pci.present"
 PCI_COUNT = "google.com/tpu.pci.count"
+HOST_INTERFACE = "google.com/tpu.pci.host-interface"
+HOST_DRIVER_VERSION = "google.com/tpu.pci.host-driver-version"
+HOST_DRIVER_BRANCH = "google.com/tpu.pci.host-driver-branch"
 ACCEL_TYPE = "google.com/tpu.slice.accelerator-type"
 SLICE_TOPOLOGY = "google.com/tpu.slice.topology"
 MULTIHOST_PRESENT = "google.com/tpu.multihost.present"
@@ -47,6 +54,7 @@ class InterconnectLabeler:
             if devices:
                 labels[PCI_PRESENT] = "true"
                 labels[PCI_COUNT] = str(len(devices))
+                labels.update(_host_interface_labels(devices))
 
         info: Optional[HostInfo] = (
             self._provider.host_info() if self._provider is not None else None
@@ -54,6 +62,34 @@ class InterconnectLabeler:
         if info is not None:
             labels.update(_host_info_labels(info))
         return labels
+
+
+def _host_interface_labels(devices) -> Labels:
+    """Labels from the first decodable vendor-specific capability record
+    (vgpu.host-driver-version/-branch analog, vgpu.go:108-153 feeding
+    lm/vgpu.go:41-52). Most TPU functions carry no record — host-driver
+    facts normally come from the metadata server — so absence is silent;
+    a short config read (unprivileged container) warns and skips that
+    device, matching the labeler's warn-don't-fail posture."""
+    labels = Labels()
+    for dev in devices:
+        try:
+            cap = dev.get_vendor_specific_capability()
+        except PCIError as e:
+            log.warning("skipping PCI capability read for %s: %s", dev.address, e)
+            continue
+        if cap is None:
+            continue
+        info = decode_vendor_capability(cap)
+        if info is None:
+            continue
+        labels[HOST_INTERFACE] = info.signature
+        if info.driver_version:
+            labels[HOST_DRIVER_VERSION] = info.driver_version
+        if info.driver_branch:
+            labels[HOST_DRIVER_BRANCH] = info.driver_branch
+        break
+    return labels
 
 
 def _host_info_labels(info: HostInfo) -> Labels:

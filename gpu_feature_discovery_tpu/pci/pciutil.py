@@ -83,6 +83,60 @@ class PCIDevice:
         return None
 
 
+@dataclass(frozen=True)
+class HostInterfaceInfo:
+    """Decoded vendor-specific capability record (Device.GetInfo analog,
+    vgpu.go:108-153). The reference walks sub-records to record-id 0 and
+    reads fixed 10-byte host-driver version + branch fields; the TPU
+    record is self-describing instead: a NUL-terminated ASCII signature
+    naming the host interface (e.g. ``TPUICI``), a one-byte record id
+    (0 = host-driver info, mirroring the reference's record id 0), then
+    NUL-terminated strings — driver version, then optional branch."""
+
+    signature: str
+    driver_version: str = ""
+    driver_branch: str = ""
+
+
+def decode_vendor_capability(cap: bytes) -> Optional[HostInterfaceInfo]:
+    """Decode the record returned by get_vendor_specific_capability, or
+    None when it is absent/malformed. Malformed records are a normal
+    hardware condition (a future device revision, a truncated read), so
+    this never raises — warn-don't-fail lives with the caller."""
+    if not cap or len(cap) < 4 or cap[0] != PCI_CAPABILITY_VENDOR_SPECIFIC_ID:
+        return None
+    body = cap[3 : cap[PCI_CAPABILITY_LENGTH]]
+    sig_end = body.find(0)
+    if sig_end <= 0:
+        return None
+    try:
+        signature = body[:sig_end].decode("ascii")
+    except UnicodeDecodeError:
+        return None
+    if not signature.isprintable():
+        return None
+    rest = body[sig_end + 1 :]
+    if not rest or rest[0] != 0:  # unknown record id: signature-only
+        return HostInterfaceInfo(signature=signature)
+    fields = rest[1:].split(b"\x00")
+    strings = []
+    for raw in fields:
+        if not raw:
+            continue
+        try:
+            s = raw.decode("ascii")
+        except UnicodeDecodeError:
+            break  # garbage after the good strings: keep what parsed
+        if not s.isprintable():
+            break
+        strings.append(s)
+    return HostInterfaceInfo(
+        signature=signature,
+        driver_version=strings[0] if strings else "",
+        driver_branch=strings[1] if len(strings) > 1 else "",
+    )
+
+
 class GooglePCI(Protocol):
     """Scanner interface (NvidiaPCI, pciutil.go:28-30)."""
 
@@ -188,7 +242,8 @@ def default_mock_devices() -> List[PCIDevice]:
         capabilities=[
             make_capability(0x01, b"\x00\x00"),  # power management
             make_capability(
-                PCI_CAPABILITY_VENDOR_SPECIFIC_ID, b"TPUICI\x00\x001.9.0\x00"
+                PCI_CAPABILITY_VENDOR_SPECIFIC_ID,
+                b"TPUICI\x00\x001.9.0\x00prod\x00",
             ),
         ]
     )

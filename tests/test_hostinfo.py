@@ -152,6 +152,70 @@ def test_capability_walk_breaks_on_0xff():
     assert dev.get_vendor_specific_capability() is None
 
 
+def test_decode_vendor_capability_full_record():
+    from gpu_feature_discovery_tpu.pci.pciutil import decode_vendor_capability
+
+    [with_cap, _] = MockGooglePCI().devices()
+    info = decode_vendor_capability(with_cap.get_vendor_specific_capability())
+    assert info is not None
+    assert info.signature == "TPUICI"
+    assert info.driver_version == "1.9.0"
+    assert info.driver_branch == "prod"
+
+
+def test_decode_vendor_capability_degrades_gracefully():
+    from gpu_feature_discovery_tpu.pci.pciutil import decode_vendor_capability
+
+    # None / too short / wrong capability id.
+    assert decode_vendor_capability(b"") is None
+    assert decode_vendor_capability(b"\x09\x00\x03") is None
+    assert decode_vendor_capability(
+        make_capability(0x01, b"TPUICI\x00\x001.9.0\x00")
+    ) is None
+    # Empty or non-terminated signature.
+    assert decode_vendor_capability(make_capability(0x09, b"\x00rest")) is None
+    assert decode_vendor_capability(make_capability(0x09, b"TPUICI")) is None
+    # Non-ASCII signature.
+    assert decode_vendor_capability(make_capability(0x09, b"\xff\xfe\x00")) is None
+    # Signature only (no record body): record with empty fields.
+    info = decode_vendor_capability(make_capability(0x09, b"TPUICI\x00"))
+    assert info is not None and info.signature == "TPUICI"
+    assert info.driver_version == "" and info.driver_branch == ""
+    # Unknown record id: signature is still trusted, strings are not.
+    info = decode_vendor_capability(make_capability(0x09, b"TPUICI\x00\x07junk"))
+    assert info is not None and info.signature == "TPUICI"
+    assert info.driver_version == ""
+    # Garbage after a good version string: keep what parsed.
+    info = decode_vendor_capability(
+        make_capability(0x09, b"TPUICI\x00\x001.9.0\x00\xff\xff\x00")
+    )
+    assert info is not None and info.driver_version == "1.9.0"
+    assert info.driver_branch == ""
+
+
+def test_interconnect_host_interface_labels():
+    labels = InterconnectLabeler(pci=MockGooglePCI()).labels()
+    assert labels["google.com/tpu.pci.host-interface"] == "TPUICI"
+    assert labels["google.com/tpu.pci.host-driver-version"] == "1.9.0"
+    assert labels["google.com/tpu.pci.host-driver-branch"] == "prod"
+
+
+def test_interconnect_tolerates_short_config_space():
+    # Unprivileged containers see a 64-byte config space; the capability
+    # read raises PCIError, and the labeler must keep the presence labels
+    # rather than fail the cycle (warn-don't-fail).
+    class ShortConfigPCI:
+        def devices(self):
+            return [
+                PCIDevice(path="", address="0000:00:04.0", vendor="0x1ae0",
+                          device_class="0x0880", config=b"\x00" * 64)
+            ]
+
+    labels = InterconnectLabeler(pci=ShortConfigPCI()).labels()
+    assert labels["google.com/tpu.pci.present"] == "true"
+    assert "google.com/tpu.pci.host-interface" not in labels
+
+
 def test_sysfs_scanner_filters_vendor(tmp_path):
     for addr, vendor in [("0000:00:04.0", "0x1ae0"), ("0000:00:05.0", "0x8086")]:
         d = tmp_path / addr
