@@ -140,6 +140,18 @@ FLAG_DEFS: List[FlagDef] = [
         getter=lambda c: _f(c).native_enumeration,
     ),
     FlagDef(
+        name="pjrt-create-options",
+        env_vars=("TFD_PJRT_CREATE_OPTIONS",),
+        parse=str,
+        default="",
+        help='";"-separated key=value NamedValues passed to '
+        "PJRT_Client_Create by the native-enumeration backend (some PJRT "
+        "plugins require named options; value types are inferred, or "
+        "forced with a s:/i:/f:/b: key prefix)",
+        setter=lambda c, v: setattr(_f(c), "pjrt_create_options", v),
+        getter=lambda c: _f(c).pjrt_create_options,
+    ),
+    FlagDef(
         name="oneshot",
         env_vars=("TFD_ONESHOT",),
         parse=_parse_bool,

@@ -114,6 +114,10 @@ class Flags:
     fail_on_init_error: Optional[bool] = None
     libtpu_path: Optional[str] = None  # nvidiaDriverRoot analog
     native_enumeration: Optional[bool] = None  # opt-in: PJRT C-API enumeration
+    # ";"-separated key=value NamedValues for PJRT_Client_Create (some
+    # plugins require named options to create a client; tfd_native.h has
+    # the grammar). Only consulted by the native-enumeration backend.
+    pjrt_create_options: Optional[str] = None
     tfd: TfdFlags = field(default_factory=TfdFlags)
 
 
@@ -134,6 +138,7 @@ class Config:
                 "failOnInitError": self.flags.fail_on_init_error,
                 "libtpuPath": self.flags.libtpu_path,
                 "nativeEnumeration": self.flags.native_enumeration,
+                "pjrtCreateOptions": self.flags.pjrt_create_options,
                 "tfd": {
                     "oneshot": self.flags.tfd.oneshot,
                     "noTimestamp": self.flags.tfd.no_timestamp,
@@ -209,6 +214,7 @@ def parse_config_file(path: str) -> Config:
     config.flags.fail_on_init_error = _opt_bool(flags.get("failOnInitError"))
     config.flags.libtpu_path = _opt_str(flags.get("libtpuPath"))
     config.flags.native_enumeration = _opt_bool(flags.get("nativeEnumeration"))
+    config.flags.pjrt_create_options = _opt_str(flags.get("pjrtCreateOptions"))
 
     tfd = flags.get("tfd", {}) or {}
     config.flags.tfd.oneshot = _opt_bool(tfd.get("oneshot"))

@@ -202,6 +202,135 @@ bool attr_is_memory_capacity(const PjrtNamedValue& a) {
          attr_name_is(a, "hbm_size");
 }
 
+/* Client-create options ("key=value;..." -> PJRT_NamedValue[]). Some
+ * plugins refuse PJRT_Client_Create without specific named options — the
+ * C API makes options part of the create contract, so an enumeration
+ * path that cannot pass them simply cannot open such plugins. Parsing
+ * lives here (not Python) so the NamedValue memory management stays next
+ * to the call that consumes it. */
+struct CreateOptions {
+  char buf[2048];            /* mutable copy; names/strings point into it */
+  PjrtNamedValue vals[32];
+  size_t count = 0;
+};
+
+bool text_is_int64(const char* s) {
+  if (*s == '-') ++s;
+  if (*s == '\0') return false;
+  for (; *s != '\0'; ++s) {
+    if (*s < '0' || *s > '9') return false;
+  }
+  return true;
+}
+
+/* Returns TFD_SUCCESS or TFD_ERROR_INVALID_ARGUMENT (malformed segment,
+ * too many options, or spec longer than the buffer). */
+int parse_create_options(const char* spec, CreateOptions* o, char* err_msg,
+                         size_t err_msg_len) {
+  auto fail = [&](const char* what) {
+    if (err_msg != nullptr && err_msg_len > 0) {
+      size_t i = 0;
+      for (; what[i] != '\0' && i < err_msg_len - 1; ++i) err_msg[i] = what[i];
+      err_msg[i] = '\0';
+    }
+    return TFD_ERROR_INVALID_ARGUMENT;
+  };
+  size_t len = 0;
+  while (spec[len] != '\0') ++len;
+  if (len >= sizeof(o->buf)) return fail("create options too long");
+  for (size_t i = 0; i <= len; ++i) o->buf[i] = spec[i];
+
+  char* p = o->buf;
+  char* end = o->buf + len;
+  while (p < end) {
+    char* seg_end = p;
+    while (seg_end < end && *seg_end != ';') ++seg_end;
+    *seg_end = '\0';
+    if (*p != '\0') { /* empty segments (trailing ';') are tolerated */
+      if (o->count >= sizeof(o->vals) / sizeof(o->vals[0])) {
+        return fail("too many create options");
+      }
+      char forced = '\0';
+      if ((p[0] == 's' || p[0] == 'i' || p[0] == 'f' || p[0] == 'b') &&
+          p[1] == ':') {
+        forced = p[0];
+        p += 2;
+      }
+      char* eq = p;
+      while (*eq != '\0' && *eq != '=') ++eq;
+      if (*eq != '=' || eq == p) {
+        return fail("create option is not key=value");
+      }
+      *eq = '\0';
+      char* value = eq + 1;
+      PjrtNamedValue& nv = o->vals[o->count++];
+      nv.struct_size = sizeof(PjrtNamedValue);
+      nv.ext = nullptr;
+      nv.name = p;
+      nv.name_size = static_cast<size_t>(eq - p);
+      nv.value_size = 1;
+      bool is_true = false, is_false = false;
+      {
+        const char* t = "true";
+        const char* f = "false";
+        size_t ti = 0, fi = 0;
+        while (t[ti] != '\0' && value[ti] == t[ti]) ++ti;
+        is_true = t[ti] == '\0' && value[ti] == '\0';
+        while (f[fi] != '\0' && value[fi] == f[fi]) ++fi;
+        is_false = f[fi] == '\0' && value[fi] == '\0';
+      }
+      if (forced == 'b' || (forced == '\0' && (is_true || is_false))) {
+        if (!is_true && !is_false) return fail("b: value must be true|false");
+        nv.type = kPjrtNamedValueBool;
+        nv.v.bool_value = is_true;
+      } else if (forced == 'i' ||
+                 (forced == '\0' && text_is_int64(value))) {
+        if (!text_is_int64(value)) return fail("i: value is not an integer");
+        bool neg = value[0] == '-';
+        long long acc = 0;
+        for (const char* d = value + (neg ? 1 : 0); *d != '\0'; ++d) {
+          if (__builtin_mul_overflow(acc, 10, &acc) ||
+              __builtin_add_overflow(acc, *d - '0', &acc)) {
+            return fail("integer value out of int64 range");
+          }
+        }
+        nv.type = kPjrtNamedValueInt64;
+        /* -acc cannot overflow: acc <= LLONG_MAX, so -acc >= -LLONG_MAX >
+         * LLONG_MIN (LLONG_MIN itself is rejected one digit early). */
+        nv.v.int64_value = neg ? -acc : acc;
+      } else if (forced == 'f') {
+        /* Minimal decimal parser (no strtof: keep this file libc-light
+         * and locale-independent). Accepts [-]digits[.digits]. */
+        const char* d = value;
+        bool neg = *d == '-';
+        if (neg) ++d;
+        if (*d == '\0') return fail("f: value is not a number");
+        float acc = 0.0f;
+        for (; *d >= '0' && *d <= '9'; ++d) acc = acc * 10.0f + (*d - '0');
+        if (*d == '.') {
+          ++d;
+          float scale = 0.1f;
+          for (; *d >= '0' && *d <= '9'; ++d) {
+            acc += (*d - '0') * scale;
+            scale *= 0.1f;
+          }
+        }
+        if (*d != '\0') return fail("f: value is not a number");
+        nv.type = kPjrtNamedValueFloat;
+        nv.v.float_value = neg ? -acc : acc;
+      } else {
+        nv.type = kPjrtNamedValueString;
+        nv.v.string_value = value;
+        size_t vlen = 0;
+        while (value[vlen] != '\0') ++vlen;
+        nv.value_size = vlen;
+      }
+    }
+    p = seg_end + 1;
+  }
+  return TFD_SUCCESS;
+}
+
 typedef void* (*PjrtErrorFn)(void*);  /* generic PJRT_Error* f(Args*) */
 
 /* Call a PJRT entry point; on failure, copy the error message into err_msg
@@ -268,10 +397,11 @@ extern "C" int tfd_probe_libtpu(const char* path, int* api_major,
   return TFD_SUCCESS;
 }
 
-extern "C" int tfd_enumerate(const char* path, tfd_device_info_t* out,
-                             size_t max_devices, size_t* n_devices,
-                             char* platform, size_t platform_len,
-                             char* err_msg, size_t err_msg_len) {
+extern "C" int tfd_enumerate(const char* path, const char* create_options,
+                             tfd_device_info_t* out, size_t max_devices,
+                             size_t* n_devices, char* platform,
+                             size_t platform_len, char* err_msg,
+                             size_t err_msg_len) {
   if (err_msg != nullptr && err_msg_len > 0) err_msg[0] = '\0';
   if (path == nullptr || out == nullptr || n_devices == nullptr ||
       platform == nullptr || platform_len == 0) {
@@ -279,6 +409,15 @@ extern "C" int tfd_enumerate(const char* path, tfd_device_info_t* out,
   }
   *n_devices = 0;
   platform[0] = '\0';
+
+  /* Stack-local: ctypes releases the GIL around this call, so a static
+   * buffer would race two concurrent enumerations (~3.5 KB is fine). */
+  CreateOptions opts;
+  opts.count = 0;
+  if (create_options != nullptr && create_options[0] != '\0') {
+    int rc = parse_create_options(create_options, &opts, err_msg, err_msg_len);
+    if (rc != TFD_SUCCESS) return rc;
+  }
 
   void* handle = dlopen(path, RTLD_LAZY | RTLD_LOCAL);
   if (handle == nullptr) {
@@ -318,7 +457,8 @@ extern "C" int tfd_enumerate(const char* path, tfd_device_info_t* out,
   }
 
   ClientCreateArgs create_args = {sizeof(ClientCreateArgs), nullptr,
-                                  nullptr,  0,       nullptr, nullptr,
+                                  opts.count > 0 ? opts.vals : nullptr,
+                                  opts.count, nullptr, nullptr,
                                   nullptr,  nullptr, nullptr, nullptr,
                                   nullptr};
   if (!pjrt_call(api, api->client_create, &create_args, err_msg,

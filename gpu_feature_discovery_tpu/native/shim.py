@@ -147,7 +147,7 @@ def probe_libtpu(explicit_path: Optional[str] = None) -> ProbeResult:
 # Must equal TFD_NATIVE_ABI_VERSION in tfd_native.h. A stale prebuilt .so
 # with a different struct layout would otherwise parse device records at
 # the wrong stride — silently corrupting every record after the first.
-NATIVE_ABI_VERSION = 2
+NATIVE_ABI_VERSION = 3
 
 
 class NativeShim:
@@ -181,6 +181,7 @@ class NativeShim:
         lib.tfd_pci_vendor_capability.restype = ctypes.c_int
         lib.tfd_enumerate.argtypes = [
             ctypes.c_char_p,
+            ctypes.c_char_p,
             ctypes.POINTER(_CDeviceInfo),
             ctypes.c_size_t,
             ctypes.POINTER(ctypes.c_size_t),
@@ -203,10 +204,19 @@ class NativeShim:
     def error_string(self, code: int) -> str:
         return self._lib.tfd_error_string(code).decode()
 
-    def enumerate(self, libtpu_path: str, max_devices: int = 256):
+    def enumerate(
+        self,
+        libtpu_path: str,
+        max_devices: int = 256,
+        create_options: Optional[str] = None,
+    ):
         """Full device enumeration through the PJRT C API — client create →
         list → destroy, no ML runtime in-process. SEIZES THE TPU for the
         call; callers gate it behind --native-enumeration.
+
+        ``create_options`` parameterizes PJRT_Client_Create with typed
+        NamedValues (";"-separated key=value; see tfd_native.h for the
+        grammar) — some plugins require named options to create a client.
 
         Returns (platform, [EnumeratedDevice, ...]) or None on failure.
         """
@@ -216,6 +226,7 @@ class NativeShim:
         err = ctypes.create_string_buffer(512)
         rc = self._lib.tfd_enumerate(
             libtpu_path.encode(),
+            create_options.encode() if create_options else None,
             out,
             max_devices,
             ctypes.byref(n),

@@ -51,11 +51,11 @@ typedef struct {
 } tfd_device_info_t;
 
 /* ABI version of THIS header's structs. Bump whenever tfd_device_info_t
- * (or any other ctypes-crossed layout) changes; shim.py refuses to load a
- * .so whose tfd_abi_version() disagrees, so a stale prebuilt library
- * degrades to the pure-Python fallback instead of parsing device records
- * with the wrong stride. */
-#define TFD_NATIVE_ABI_VERSION 2
+ * (or any other ctypes-crossed layout or signature) changes; shim.py
+ * refuses to load a .so whose tfd_abi_version() disagrees, so a stale
+ * prebuilt library degrades to the pure-Python fallback instead of
+ * parsing device records with the wrong stride. */
+#define TFD_NATIVE_ABI_VERSION 3
 int tfd_abi_version(void);
 
 /* dlopen(path) + GetPjrtApi() probe; writes the PJRT C API version into
@@ -80,13 +80,22 @@ const char* tfd_error_string(int code);
  * never contends with a workload that owns the chip. The probe path
  * (tfd_probe_libtpu) stays client-free for exactly that reason.
  *
+ * create_options (optional, may be NULL/empty) parameterizes
+ * PJRT_Client_Create with typed PJRT_NamedValue records — some plugins
+ * REQUIRE named options to create a client at all (the PJRT C API makes
+ * them part of the create contract). Grammar: ";"-separated `key=value`
+ * pairs. Value type is inferred (`true`/`false` -> Bool, integer text ->
+ * Int64, else String) and can be forced with a `s:`/`i:`/`f:`/`b:` key
+ * prefix, e.g. "topology=v5e:2x2;rank=4294967295;s:build=true".
+ *
  * Writes at most max_devices records and the true count into *n_devices
  * (TFD_ERROR_BUFFER_TOO_SMALL when truncated); platform receives the
  * NUL-terminated platform name ("tpu"); err_msg (optional, may be NULL)
  * receives the PJRT error message when initialization/creation fails. */
-int tfd_enumerate(const char* path, tfd_device_info_t* out,
-                  size_t max_devices, size_t* n_devices, char* platform,
-                  size_t platform_len, char* err_msg, size_t err_msg_len);
+int tfd_enumerate(const char* path, const char* create_options,
+                  tfd_device_info_t* out, size_t max_devices,
+                  size_t* n_devices, char* platform, size_t platform_len,
+                  char* err_msg, size_t err_msg_len);
 
 /* Walk the PCI capability linked list of a 256-byte config space and copy
  * the vendor-specific (id 0x09) record into out. Returns the record length

@@ -118,11 +118,13 @@ def decode_vendor_capability(cap: bytes) -> Optional[HostInterfaceInfo]:
     rest = body[sig_end + 1 :]
     if not rest or rest[0] != 0:  # unknown record id: signature-only
         return HostInterfaceInfo(signature=signature)
+    # The fields are POSITIONAL (version, then branch — the reference's
+    # record is two fixed 10-byte slots, vgpu.go:108-153): an empty first
+    # field means "no version", it must not promote the branch into the
+    # version slot.
     fields = rest[1:].split(b"\x00")
     strings = []
-    for raw in fields:
-        if not raw:
-            continue
+    for raw in fields[:2]:
         try:
             s = raw.decode("ascii")
         except UnicodeDecodeError:

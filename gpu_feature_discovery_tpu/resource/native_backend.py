@@ -64,7 +64,10 @@ class NativeManager(Manager):
             raise ResourceError(
                 "native enumeration: libtfd_native.so not built/loadable"
             )
-        result = shim.enumerate(self._probed.path)
+        result = shim.enumerate(
+            self._probed.path,
+            create_options=self._config.flags.pjrt_create_options or None,
+        )
         if result is None:
             raise ResourceError(
                 f"native enumeration of {self._probed.path} failed"

@@ -191,6 +191,14 @@ def test_decode_vendor_capability_degrades_gracefully():
     )
     assert info is not None and info.driver_version == "1.9.0"
     assert info.driver_branch == ""
+    # Fields are positional: an EMPTY version slot must not promote the
+    # branch into the version label.
+    info = decode_vendor_capability(
+        make_capability(0x09, b"TPUICI\x00\x00\x00prod\x00")
+    )
+    assert info is not None
+    assert info.driver_version == ""
+    assert info.driver_branch == "prod"
 
 
 def test_interconnect_host_interface_labels():

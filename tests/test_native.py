@@ -435,6 +435,185 @@ def test_enumerate_reads_device_attributes(native, fake_pjrt_attrs):
     assert all(d.memory_mb == 16 * 1024 for d in devices)  # bytes -> MiB
 
 
+@pytest.fixture(scope="module")
+def fake_pjrt_requires_opts(native, tmp_path_factory):
+    """A fake plugin whose PJRT_Client_Create REJECTS clients unless the
+    caller passed the exact typed NamedValue options it requires — the
+    PJRT C API makes create options part of the contract, and real
+    plugins (pool-scheduled TPU terminals among them) do exactly this.
+    Exercises every value encoding: inferred string/int64/bool, negative
+    int64, forced f: float, and forced s: (keeping "true" a string)."""
+    return _compile_so(
+        tmp_path_factory.mktemp("fake-pjrt-opts"),
+        """
+        #include <stddef.h>
+        #include <string.h>
+
+        struct Version { size_t sz; void* ext; int major; int minor; };
+        struct PluginInitArgs { size_t sz; void* ext; };
+        struct CreateArgs { size_t sz; void* ext; const void* opts;
+                            size_t nopts; void* kvg; void* kvga; void* kvp;
+                            void* kvpa; void* client; void* kvt; void* kvta; };
+        struct DestroyArgs { size_t sz; void* ext; void* client; };
+        struct NameArgs { size_t sz; void* ext; void* client;
+                          const char* name; size_t name_sz; };
+        struct DevsArgs { size_t sz; void* ext; void* client;
+                          void* const* devs; size_t ndevs; };
+        struct DescArgs { size_t sz; void* ext; void* dev; void* desc; };
+        struct IdArgs { size_t sz; void* ext; void* desc; int id; };
+        struct PiArgs { size_t sz; void* ext; void* desc; int pi; };
+        struct KindArgs { size_t sz; void* ext; void* desc;
+                          const char* kind; size_t kind_sz; };
+        struct NamedValue { size_t sz; void* ext; const char* name;
+                            size_t name_sz; int type;
+                            union { const char* s; long long i;
+                                    const long long* arr; float f;
+                                    bool b; } v;
+                            size_t value_sz; };
+        struct ErrMsgArgs { size_t sz; void* ext; void* error;
+                            const char* message; size_t message_sz; };
+        struct ErrDestroyArgs { size_t sz; void* ext; void* error; };
+
+        static int fake_client, dev_a, err_obj;
+        static void* devs[1] = {&dev_a};
+        static const char* err_text = "missing required create options";
+
+        static int str_is(const struct NamedValue* nv, const char* want) {
+          size_t n = strlen(want);
+          return nv->type == 0 && nv->value_sz == n &&
+                 nv->v.s != 0 && memcmp(nv->v.s, want, n) == 0;
+        }
+        static int name_is(const struct NamedValue* nv, const char* want) {
+          size_t n = strlen(want);
+          return nv->name_sz == n && memcmp(nv->name, want, n) == 0;
+        }
+
+        extern "C" {
+        static void* plugin_init(void* a) { (void)a; return 0; }
+        static void* err_message(void* a) {
+          struct ErrMsgArgs* m = (struct ErrMsgArgs*)a;
+          m->message = err_text; m->message_sz = strlen(err_text);
+          return 0; }
+        static void* err_destroy(void* a) { (void)a; return 0; }
+        static void* create(void* a) {
+          struct CreateArgs* c = (struct CreateArgs*)a;
+          const struct NamedValue* o = (const struct NamedValue*)c->opts;
+          int ok = 0;
+          /* require: session_id="tfd" (string), rank=4294967295 (int64),
+             priority=-1 (int64), local_only=false (bool),
+             scale=1.5 (float, forced f:), build="true" (string via s:). */
+          int seen = 0;
+          for (size_t i = 0; i < c->nopts; ++i) {
+            const struct NamedValue* nv = &o[i];
+            if (nv->sz != sizeof(struct NamedValue)) { seen = -1000; break; }
+            if (name_is(nv, "session_id") && str_is(nv, "tfd")) seen |= 1;
+            if (name_is(nv, "rank") && nv->type == 1 &&
+                nv->v.i == 4294967295LL) seen |= 2;
+            if (name_is(nv, "priority") && nv->type == 1 &&
+                nv->v.i == -1) seen |= 4;
+            if (name_is(nv, "local_only") && nv->type == 4 &&
+                nv->v.b == false) seen |= 8;
+            if (name_is(nv, "scale") && nv->type == 3 &&
+                nv->v.f > 1.49f && nv->v.f < 1.51f) seen |= 16;
+            if (name_is(nv, "build") && str_is(nv, "true")) seen |= 32;
+          }
+          ok = (seen == 63);
+          if (!ok) return &err_obj;
+          c->client = &fake_client;
+          return 0; }
+        static void* destroy(void* a) { (void)a; return 0; }
+        static void* name(void* a) {
+          struct NameArgs* n = (struct NameArgs*)a;
+          n->name = "tpu"; n->name_sz = 3; return 0; }
+        static void* devices(void* a) {
+          struct DevsArgs* d = (struct DevsArgs*)a;
+          d->devs = devs; d->ndevs = 1; return 0; }
+        static void* get_desc(void* a) {
+          struct DescArgs* d = (struct DescArgs*)a;
+          d->desc = d->dev; return 0; }
+        static void* desc_id(void* a) {
+          ((struct IdArgs*)a)->id = 0; return 0; }
+        static void* desc_pi(void* a) {
+          ((struct PiArgs*)a)->pi = 0; return 0; }
+        static void* desc_kind(void* a) {
+          struct KindArgs* k = (struct KindArgs*)a;
+          k->kind = "TPU v4"; k->kind_sz = 6; return 0; }
+
+        struct Api {
+          size_t sz; void* ext; struct Version v;
+          void* err_destroy; void* err_message; void* err_getcode;
+          void* plugin_initialize; void* plugin_attributes;
+          void* ev_destroy; void* ev_isready; void* ev_error;
+          void* ev_await; void* ev_onready;
+          void* client_create; void* client_destroy; void* client_name;
+          void* client_pi; void* client_pv; void* client_devices;
+          void* client_addressable_devices; void* client_lookup;
+          void* client_lookup_addr; void* client_addr_mems;
+          void* client_compile; void* client_dda; void* client_bfhb;
+          void* dd_id; void* dd_pi; void* dd_attrs; void* dd_kind;
+          void* dd_debug; void* dd_tostring; void* dev_get_description;
+        };
+        static struct Api api;
+        const struct Api* GetPjrtApi(void) {
+          memset(&api, 0, sizeof(api));
+          api.sz = sizeof(api); api.v.sz = sizeof(struct Version);
+          api.v.major = 0; api.v.minor = 77;
+          api.err_destroy = (void*)err_destroy;
+          api.err_message = (void*)err_message;
+          api.plugin_initialize = (void*)plugin_init;
+          api.client_create = (void*)create;
+          api.client_destroy = (void*)destroy;
+          api.client_name = (void*)name;
+          api.client_addressable_devices = (void*)devices;
+          api.dd_id = (void*)desc_id;
+          api.dd_pi = (void*)desc_pi;
+          api.dd_kind = (void*)desc_kind;
+          api.dev_get_description = (void*)get_desc;
+          return &api;
+        }
+        }
+        """,
+        name="libfakepjrt-opts.so",
+    )
+
+
+REQUIRED_OPTS = (
+    "session_id=tfd;rank=4294967295;priority=-1;local_only=false;"
+    "f:scale=1.5;s:build=true"
+)
+
+
+def test_enumerate_plugin_requiring_options_fails_without(native,
+                                                          fake_pjrt_requires_opts):
+    assert native.enumerate(fake_pjrt_requires_opts) is None
+
+
+def test_enumerate_passes_typed_create_options(native, fake_pjrt_requires_opts):
+    """Every encoding survives the trip: inferred string/int64/bool,
+    negative int64, forced float, forced keep-as-string."""
+    result = native.enumerate(
+        fake_pjrt_requires_opts, create_options=REQUIRED_OPTS
+    )
+    assert result is not None
+    platform, devices = result
+    assert platform == "tpu"
+    assert [(d.id, d.kind) for d in devices] == [(0, "TPU v4")]
+
+
+def test_enumerate_tolerates_trailing_semicolon(native, fake_pjrt_requires_opts):
+    assert native.enumerate(
+        fake_pjrt_requires_opts, create_options=REQUIRED_OPTS + ";"
+    ) is not None
+
+
+def test_enumerate_malformed_create_options(native, fake_pjrt_requires_opts):
+    for bad in ("notkeyvalue", "=v", "i:rank=abc", "b:x=maybe", "f:s=1.2.3",
+                "rank=9223372036854775808",      # int64 overflow
+                "i:rank=99999999999999999999"):  # forced-int overflow
+        assert native.enumerate(fake_pjrt_requires_opts,
+                                create_options=bad) is None
+
+
 def test_enumerate_probe_only_plugin_fails_cleanly(native, fake_libtpu):
     """The version-only fake (struct_size stops at the version prefix) must
     be rejected as API-too-old, not dereferenced past its end."""

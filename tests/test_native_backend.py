@@ -14,9 +14,11 @@ from gpu_feature_discovery_tpu.config.flags import new_config
 from gpu_feature_discovery_tpu.resource.types import ResourceError
 
 from test_native import (  # noqa: F401
+    REQUIRED_OPTS,
     _compile_so,
     fake_pjrt_attrs,
     fake_pjrt_full,
+    fake_pjrt_requires_opts,
     native,
 )
 
@@ -91,6 +93,21 @@ def test_native_manager_attribute_backed_chips(native, fake_pjrt_attrs, monkeypa
     assert sl.get_name() == "2x1"
     assert sl.get_attributes()["slice.chips"] == 2
     assert sl.get_attributes()["memory"] == 16 * 1024
+
+
+def test_native_manager_passes_create_options(native, fake_pjrt_requires_opts,  # noqa: F811
+                                              monkeypatch):
+    """--pjrt-create-options reaches PJRT_Client_Create: a plugin that
+    refuses optionless clients enumerates once the flag is set."""
+    from gpu_feature_discovery_tpu.resource.native_backend import NativeManager
+
+    monkeypatch.setenv("TPU_LIBRARY_PATH", fake_pjrt_requires_opts)
+    monkeypatch.setenv("TFD_HERMETIC", "1")
+    with pytest.raises(ResourceError):
+        NativeManager(cfg()).init()
+    m = NativeManager(cfg(**{"pjrt-create-options": REQUIRED_OPTS}))
+    m.init()
+    assert [c.get_name() for c in m.get_chips()] == ["tpu-v4"]
 
 
 def test_native_manager_fails_without_libtpu(native, monkeypatch):  # noqa: F811
