@@ -1,0 +1,57 @@
+#!/usr/bin/env python3
+"""Point the static TFD DaemonSet at the image under test for the kind e2e.
+
+The hermetic cluster has no TPU, so the container runs the mock backend —
+the reference does the same at this tier (mock NVML inside the container,
+Dockerfile.ubi8 test stage) — while everything around it is real: image,
+DaemonSet RBAC/scheduling, the features.d hostPath handoff, NFD, and the
+Node label watch.
+
+Usage: ci-prepare-e2e-manifest.py IMAGE OUT_PATH [BACKEND]
+"""
+
+import os
+import sys
+
+import yaml
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+STATIC = os.path.join(
+    os.path.dirname(HERE),
+    "deployments/static/tpu-feature-discovery-daemonset.yaml",
+)
+
+
+def prepare(image, backend="mock:v4-8", manifest_path=STATIC):
+    with open(manifest_path) as f:
+        ds = yaml.safe_load(f)
+    (container,) = ds["spec"]["template"]["spec"]["containers"]
+    container["image"] = image
+    # kind-loaded images exist only in the node's containerd store; any
+    # pull attempt would fail, so never pull.
+    container["imagePullPolicy"] = "Never"
+    container.setdefault("env", []).extend(
+        [
+            {"name": "TFD_BACKEND", "value": backend},
+            # The runner itself must not leak host TPU/metadata facts into
+            # the golden diff (same guard as integration-tests.py).
+            {"name": "TFD_HERMETIC", "value": "1"},
+        ]
+    )
+    return ds
+
+
+def main():
+    if len(sys.argv) not in (3, 4):
+        print(f"Usage: {sys.argv[0]} IMAGE OUT_PATH [BACKEND]", file=sys.stderr)
+        return 1
+    backend = sys.argv[3] if len(sys.argv) == 4 else "mock:v4-8"
+    ds = prepare(sys.argv[1], backend)
+    with open(sys.argv[2], "w") as f:
+        yaml.safe_dump(ds, f, sort_keys=False)
+    print(f"Wrote {sys.argv[2]} (image={sys.argv[1]}, backend={backend})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

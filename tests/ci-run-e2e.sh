@@ -19,7 +19,8 @@ GOLDEN=${3:-expected-output.txt}
 TFD_YAML_FILE="../deployments/static/tpu-feature-discovery-daemonset.yaml"
 NFD_YAML_FILE="nfd.yaml"
 
-pip install -q kubernetes pyyaml
+# Stdlib k8s client (tests/k8s_stdlib.py); only yaml is needed.
+pip install -q pyyaml
 
 sed -i -E "s|image: .*tpu-feature-discovery:.*|image: ${IMAGE_NAME}:${VERSION}|" "$TFD_YAML_FILE"
 

@@ -1,13 +1,20 @@
 #!/usr/bin/env python3
-"""Tier-4 E2E test against a real cluster (GKE TPU node pool).
+"""Tier-4 E2E test against a real cluster (kind in CI, GKE TPU pool live).
 
 Reference behavior (tests/e2e-tests.py): deploy TFD + NFD from YAML, watch
 the Node until the timestamp label lands (180 s budget), then assert the
 node's labels equal the golden set plus whatever labels pre-existed,
 ignoring feature.node.kubernetes.io/*.
 
+Runs on the standard library alone (tests/k8s_stdlib.py replaces the
+`kubernetes` pip package the reference uses) so the identical script
+executes hermetically against the fake API server in
+tests/test_e2e_script.py, against kind in CI, and against a real GKE TPU
+node pool.
+
 Usage: python tests/e2e-tests.py TFD_YAML_PATH NFD_YAML_PATH [GOLDEN_PATH]
-Requires: kubernetes client + a kubeconfig pointing at the target cluster.
+Env: KUBECONFIG selects the cluster; TFD_E2E_WATCH_TIMEOUT_S overrides
+the 180 s watch budget (tests use a short one).
 """
 
 import os
@@ -16,47 +23,21 @@ import sys
 
 import yaml
 
-try:
-    from kubernetes import client, config, watch
-except ImportError:
-    print("The 'kubernetes' package is required for e2e tests", file=sys.stderr)
-    sys.exit(2)
-
 HERE = os.path.dirname(os.path.abspath(__file__))
 TIMESTAMP_LABEL = "google.com/tfd.timestamp"
-WATCH_TIMEOUT_S = 180
+WATCH_TIMEOUT_S = float(os.environ.get("TFD_E2E_WATCH_TIMEOUT_S", "180"))
 
 sys.path.insert(0, HERE)
 from golden_utils import check_labels as _check_labels  # noqa: E402
 from golden_utils import load_golden_regexs  # noqa: E402
+from k8s_stdlib import KubeClient, create_object  # noqa: E402
 
 
-def deploy_yaml_file(core_api, apps_api, rbac_api, batch_api, path):
+def deploy_yaml_file(client, path):
     with open(path) as f:
         for body in yaml.safe_load_all(f):
-            if not body:
-                continue
-            kind = body["kind"]
-            ns = body.get("metadata", {}).get("namespace", "default")
-            if kind == "Namespace":
-                core_api.create_namespace(body)
-            elif kind == "ServiceAccount":
-                core_api.create_namespaced_service_account(ns, body)
-            elif kind == "Service":
-                core_api.create_namespaced_service(ns, body)
-            elif kind == "DaemonSet":
-                apps_api.create_namespaced_daemon_set(ns, body)
-            elif kind == "Deployment":
-                apps_api.create_namespaced_deployment(ns, body)
-            elif kind == "Job":
-                batch_api.create_namespaced_job(ns, body)
-            elif kind == "ClusterRole":
-                rbac_api.create_cluster_role(body)
-            elif kind == "ClusterRoleBinding":
-                rbac_api.create_cluster_role_binding(body)
-            else:
-                print(f"Unknown kind {kind}", file=sys.stderr)
-                sys.exit(1)
+            if body:
+                create_object(client, body)
 
 
 def check_labels(expected_regexs, labels):
@@ -75,13 +56,9 @@ def main():
     )
 
     print("Running E2E tests for TFD")
-    config.load_kube_config()
-    core_api = client.CoreV1Api()
-    apps_api = client.AppsV1Api()
-    rbac_api = client.RbacAuthorizationV1Api()
-    batch_api = client.BatchV1Api()
+    client = KubeClient.from_kubeconfig()
 
-    nodes = core_api.list_node().items
+    nodes = client.get("/api/v1/nodes").get("items", [])
     if not nodes:
         print("No nodes found", file=sys.stderr)
         return 1
@@ -91,22 +68,26 @@ def main():
     # non-TPU pools), and only that node's own prior labels are allowed
     # to persist (reference :78-80, generalized to multi-node).
     pre_labels = {
-        n.metadata.name: dict(n.metadata.labels or {}) for n in nodes
+        n["metadata"]["name"]: dict(n["metadata"].get("labels") or {})
+        for n in nodes
     }
 
-    print("Deploying TFD and NFD")
-    deploy_yaml_file(core_api, apps_api, rbac_api, batch_api, sys.argv[1])
-    deploy_yaml_file(core_api, apps_api, rbac_api, batch_api, sys.argv[2])
+    print("Deploying NFD and TFD")
+    # NFD first: its manifest creates the node-feature-discovery namespace
+    # the TFD DaemonSet deploys into — the reverse order 404s on a fresh
+    # cluster.
+    deploy_yaml_file(client, sys.argv[2])
+    deploy_yaml_file(client, sys.argv[1])
 
     print("Watching node updates")
     labeled_node = None
-    w = watch.Watch()
-    # timeout_seconds is server-side: the stream ends cleanly at expiry
+    # timeoutSeconds is server-side: the stream ends cleanly at expiry
     # instead of raising a client read timeout.
-    for event in w.stream(core_api.list_node, timeout_seconds=WATCH_TIMEOUT_S):
-        if event["type"] == "MODIFIED":
-            if TIMESTAMP_LABEL in (event["object"].metadata.labels or {}):
-                labeled_node = event["object"].metadata.name
+    for event in client.watch("/api/v1/nodes", timeout_s=WATCH_TIMEOUT_S):
+        if event.get("type") == "MODIFIED":
+            labels = event["object"]["metadata"].get("labels") or {}
+            if TIMESTAMP_LABEL in labels:
+                labeled_node = event["object"]["metadata"]["name"]
                 print(f"Timestamp label found on {labeled_node}. Stop watching")
                 break
     if labeled_node is None:
@@ -114,7 +95,7 @@ def main():
         return 1
 
     print("Checking labels")
-    node = core_api.read_node(labeled_node)
+    node = client.get(f"/api/v1/nodes/{labeled_node}")
     regexs = load_golden_regexs(golden)
     for k, v in pre_labels.get(labeled_node, {}).items():
         # Our own namespace is governed by the goldens; allowlisting stale
@@ -123,7 +104,9 @@ def main():
         if k.startswith("google.com/"):
             continue
         regexs.append(re.compile(re.escape(f"{k}={v}")))
-    labels = [f"{k}={v}" for k, v in (node.metadata.labels or {}).items()]
+    labels = [
+        f"{k}={v}" for k, v in (node["metadata"].get("labels") or {}).items()
+    ]
     if not check_labels(regexs, labels):
         print("E2E tests failed", file=sys.stderr)
         return 1

