@@ -1,0 +1,136 @@
+#!/usr/bin/env python3
+"""Contract check for `helm template` output (VERDICT r2 missing #3).
+
+The raw-YAML contract tests (test_deployments.py) cover the static
+manifests and the chart *sources*; this checks what helm actually
+RENDERS: every TFD_* env var the daemonset carries must be a real flag
+alias, the features.d hostPath must match the daemon's default output
+directory, the container must be privileged (full PCI config-space
+reads), and with nfd.deploy=true the bundled NFD subchart must render a
+worker wired to the same features.d handoff plus a master allowed to
+publish the google.com namespace.
+
+Usage: helm template tfd deployments/helm/tpu-feature-discovery | \
+           python tests/helm-contract.py [--no-nfd] [RENDERED.yaml]
+"""
+
+import argparse
+import os
+import sys
+
+import yaml
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.dirname(HERE))
+
+FEATURES_D = "/etc/kubernetes/node-feature-discovery/features.d"
+
+
+def tfd_env_aliases():
+    from gpu_feature_discovery_tpu.config.flags import FLAG_DEFS
+
+    return {env for fd in FLAG_DEFS for env in fd.env_vars}
+
+
+def load_docs(stream):
+    return [d for d in yaml.safe_load_all(stream) if d]
+
+
+def find(docs, kind, name_contains):
+    return [
+        d
+        for d in docs
+        if d.get("kind") == kind
+        and name_contains in d.get("metadata", {}).get("name", "")
+    ]
+
+
+def check_tfd_daemonset(docs):
+    daemonsets = find(docs, "DaemonSet", "tpu-feature-discovery")
+    assert len(daemonsets) == 1, (
+        f"expected exactly one TFD DaemonSet, got {len(daemonsets)}"
+    )
+    spec = daemonsets[0]["spec"]["template"]["spec"]
+    (container,) = spec["containers"]
+
+    aliases = tfd_env_aliases()
+    for env in container.get("env", []):
+        assert env["name"] in aliases, (
+            f"rendered env var {env['name']} is not a TFD flag alias"
+        )
+
+    mounts = {m["name"]: m["mountPath"] for m in container["volumeMounts"]}
+    assert mounts.get("output-dir") == FEATURES_D
+    volumes = {v["name"]: v for v in spec["volumes"]}
+    assert volumes["output-dir"]["hostPath"]["path"] == FEATURES_D
+
+    from gpu_feature_discovery_tpu.config.flags import DEFAULT_OUTPUT_FILE
+
+    assert os.path.dirname(DEFAULT_OUTPUT_FILE) == FEATURES_D, (
+        "daemon default output dir drifted from the chart hostPath"
+    )
+    assert container["securityContext"].get("privileged") is True
+    return daemonsets[0]
+
+
+def check_nfd(docs, expected):
+    workers = find(docs, "DaemonSet", "-worker")
+    masters = find(docs, "Deployment", "-master")
+    if not expected:
+        assert not workers and not masters, (
+            "nfd.deploy=false must render no NFD workloads"
+        )
+        return
+    assert len(workers) == 1 and len(masters) == 1, (
+        f"expected 1 NFD worker + 1 master, got {len(workers)}/{len(masters)}"
+    )
+    wspec = workers[0]["spec"]["template"]["spec"]
+    (wctr,) = wspec["containers"]
+    wmounts = {m["name"]: m["mountPath"] for m in wctr["volumeMounts"]}
+    assert wmounts.get("features-d") == FEATURES_D, (
+        "NFD worker does not read the TFD handoff dir"
+    )
+    (mctr,) = masters[0]["spec"]["template"]["spec"]["containers"]
+    assert any(
+        "--extra-label-ns=google.com" in a for a in mctr.get("args", [])
+    ), "nfd-master cannot publish the google.com label namespace"
+    # These manifests wire worker->master gRPC and ship no NodeFeature
+    # CRD; v0.14+ NFD images default to the CRD API, so gRPC must be
+    # re-enabled on BOTH binaries or no label ever lands.
+    for name, ctr in (("worker", wctr), ("master", mctr)):
+        assert "-enable-nodefeature-api=false" in ctr.get("args", []), (
+            f"nfd-{name} would default to the NodeFeature CRD API "
+            "(no CRD is installed): pass -enable-nodefeature-api=false"
+        )
+    # The worker must dial the rendered master service by name.
+    services = find(docs, "Service", "-master")
+    assert len(services) == 1
+    svc_name = services[0]["metadata"]["name"]
+    assert any(
+        a.startswith("--server=") and svc_name in a for a in wctr["args"]
+    ), "nfd-worker does not dial the rendered master service"
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("rendered", nargs="?", help="file (default: stdin)")
+    parser.add_argument(
+        "--no-nfd",
+        action="store_true",
+        help="assert the NFD subchart did NOT render (nfd.deploy=false)",
+    )
+    args = parser.parse_args()
+    if args.rendered:
+        with open(args.rendered) as f:
+            docs = load_docs(f)
+    else:
+        docs = load_docs(sys.stdin)
+    check_tfd_daemonset(docs)
+    check_nfd(docs, expected=not args.no_nfd)
+    print(f"helm contract OK ({len(docs)} rendered objects, "
+          f"nfd={'absent' if args.no_nfd else 'present'})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())
