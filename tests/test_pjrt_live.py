@@ -84,6 +84,61 @@ def run_daemon(tmp_path, *args):
 
 
 @needs_tpu
+@pytest.mark.skipif(
+    not os.environ.get("TFD_LIVE_NATIVE_PLUGIN"),
+    reason="set TFD_LIVE_NATIVE_PLUGIN (path to the PJRT plugin .so; "
+    "optionally TFD_LIVE_NATIVE_OPTS) to opt in — native enumeration "
+    "creates a PJRT client, which seizes the chip",
+)
+def test_native_backend_matches_jax_on_real_chip(tmp_path):
+    """VERDICT r2 next #4 done-criterion: on the real chip,
+    TFD_BACKEND=native publishes the same chip facts as the JAX backend.
+    Version labels differ BY DESIGN (native reports the PJRT C API
+    version as the runtime and an honest unknown driver; jax reports
+    libtpu/jaxlib versions), so only those families are excluded."""
+    out_jax = run_daemon(tmp_path, "--no-timestamp")
+    env = _hermetic_env()
+    env["TFD_BACKEND"] = "native"
+    args = [
+        sys.executable, "-m", "gpu_feature_discovery_tpu", "--oneshot",
+        "--no-timestamp", "--output-file", str(tmp_path / "native"),
+        "--libtpu-path", os.environ["TFD_LIVE_NATIVE_PLUGIN"],
+    ]
+    opts = os.environ.get("TFD_LIVE_NATIVE_OPTS", "")
+    if opts:
+        args += ["--pjrt-create-options", opts]
+    r = subprocess.run(args, capture_output=True, text=True, timeout=300,
+                       env=env, cwd=REPO_ROOT)
+    assert r.returncode == 0, f"native daemon failed: {r.stderr[-2000:]}"
+
+    def load(path):
+        return {
+            k: v
+            for k, v in (
+                line.split("=", 1)
+                for line in path.read_text().splitlines()
+                if line
+            )
+            if not k.startswith(
+                ("google.com/tpu.driver.", "google.com/tpu.runtime.")
+            )
+        }
+
+    jax_labels, native_labels = load(out_jax), load(tmp_path / "native")
+    # Memory is sourced differently by design too: jax publishes the
+    # allocator's usable limit (device.memory_stats bytes_limit), native
+    # the HBM capacity attribute (or the spec table). Same chip, but the
+    # two numbers may differ by the runtime's reservation — compare with
+    # tolerance instead of exactly.
+    mem_keys = {k for k in jax_labels | native_labels if "memory" in k}
+    for k in mem_keys:
+        assert k in jax_labels and k in native_labels, f"{k} on one side only"
+        a, b = int(jax_labels.pop(k)), int(native_labels.pop(k))
+        assert abs(a - b) <= 0.05 * max(a, b), f"{k}: jax={a} native={b}"
+    assert jax_labels == native_labels
+
+
+@needs_tpu
 def test_pjrt_strategy_single_golden(tmp_path):
     out = run_daemon(tmp_path, "--tpu-topology-strategy", "single")
     check_result(out, "expected-output-topology-single-pjrt.txt")
