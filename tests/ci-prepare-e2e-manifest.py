@@ -7,9 +7,10 @@ Dockerfile.ubi8 test stage) — while everything around it is real: image,
 DaemonSet RBAC/scheduling, the features.d hostPath handoff, NFD, and the
 Node label watch.
 
-Usage: ci-prepare-e2e-manifest.py IMAGE OUT_PATH [BACKEND]
+Usage: ci-prepare-e2e-manifest.py IMAGE OUT_PATH [--backend B] [--manifest M]
 """
 
+import argparse
 import os
 import sys
 
@@ -42,14 +43,24 @@ def prepare(image, backend="mock:v4-8", manifest_path=STATIC):
 
 
 def main():
-    if len(sys.argv) not in (3, 4):
-        print(f"Usage: {sys.argv[0]} IMAGE OUT_PATH [BACKEND]", file=sys.stderr)
-        return 1
-    backend = sys.argv[3] if len(sys.argv) == 4 else "mock:v4-8"
-    ds = prepare(sys.argv[1], backend)
-    with open(sys.argv[2], "w") as f:
+    parser = argparse.ArgumentParser()
+    parser.add_argument("image")
+    parser.add_argument("out_path")
+    parser.add_argument("--backend", default="mock:v4-8")
+    parser.add_argument(
+        "--manifest",
+        default=STATIC,
+        help="static DaemonSet to patch (e.g. the -with-topology-single "
+        "variant for the strategy scenario)",
+    )
+    args = parser.parse_args()
+    ds = prepare(args.image, args.backend, args.manifest)
+    with open(args.out_path, "w") as f:
         yaml.safe_dump(ds, f, sort_keys=False)
-    print(f"Wrote {sys.argv[2]} (image={sys.argv[1]}, backend={backend})")
+    print(
+        f"Wrote {args.out_path} (image={args.image}, backend={args.backend}, "
+        f"manifest={os.path.basename(args.manifest)})"
+    )
     return 0
 
 

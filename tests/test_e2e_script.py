@@ -26,6 +26,7 @@ import subprocess
 import sys
 import threading
 
+import pytest
 import yaml
 
 HERE = os.path.dirname(os.path.abspath(__file__))
@@ -174,14 +175,14 @@ def write_kubeconfig(tmp_path, server_url):
     return str(path)
 
 
-def run_tfd_daemon_oneshot(features_file):
+def run_tfd_daemon_oneshot(features_file, strategy="none", backend="mock:v4-8"):
     """The real daemon, mock backend — the same payload the DaemonSet's
     container produces into the features.d hostPath."""
     env = dict(os.environ)
     env.update(
         {
             "TFD_HERMETIC": "1",
-            "TFD_BACKEND": "mock:v4-8",
+            "TFD_BACKEND": backend,
             "PYTHONPATH": REPO_ROOT
             + os.pathsep
             + env.get("PYTHONPATH", ""),
@@ -195,6 +196,8 @@ def run_tfd_daemon_oneshot(features_file):
             "--oneshot",
             "--output-file",
             str(features_file),
+            "--tpu-topology-strategy",
+            strategy,
         ],
         check=True,
         capture_output=True,
@@ -203,7 +206,13 @@ def run_tfd_daemon_oneshot(features_file):
     )
 
 
-def run_e2e(tmp_path, kubeconfig, watch_timeout="10"):
+def run_e2e(
+    tmp_path,
+    kubeconfig,
+    watch_timeout="10",
+    manifest="deployments/static/tpu-feature-discovery-daemonset.yaml",
+    golden="expected-output.txt",
+):
     env = dict(os.environ)
     env["KUBECONFIG"] = kubeconfig
     env["TFD_E2E_WATCH_TIMEOUT_S"] = watch_timeout
@@ -211,12 +220,9 @@ def run_e2e(tmp_path, kubeconfig, watch_timeout="10"):
         [
             sys.executable,
             os.path.join(HERE, "e2e-tests.py"),
-            os.path.join(
-                REPO_ROOT,
-                "deployments/static/tpu-feature-discovery-daemonset.yaml",
-            ),
+            os.path.join(REPO_ROOT, manifest),
             os.path.join(HERE, "nfd.yaml"),
-            os.path.join(HERE, "expected-output.txt"),
+            os.path.join(HERE, golden),
         ],
         capture_output=True,
         text=True,
@@ -225,14 +231,43 @@ def run_e2e(tmp_path, kubeconfig, watch_timeout="10"):
     )
 
 
-def test_e2e_script_against_fake_cluster(tmp_path):
+@pytest.mark.parametrize(
+    "backend,strategy,manifest,golden",
+    [
+        (
+            "mock:v4-8",
+            "none",
+            "deployments/static/tpu-feature-discovery-daemonset.yaml",
+            "expected-output.txt",
+        ),
+        # The strategy scenario the kind CI matrix also runs: the single
+        # overload's slice label family (slice-enabled mock) propagates
+        # through the same deploy-watch-assert contract.
+        (
+            "mock-slice:v4-8",
+            "single",
+            "deployments/static/"
+            "tpu-feature-discovery-daemonset-with-topology-single.yaml",
+            "expected-output-topology-single.txt",
+        ),
+    ],
+    ids=["base", "topology-single"],
+)
+def test_e2e_script_against_fake_cluster(
+    tmp_path, backend, strategy, manifest, golden
+):
     features_file = tmp_path / "features.d" / "tfd"
     features_file.parent.mkdir()
-    run_tfd_daemon_oneshot(features_file)
+    run_tfd_daemon_oneshot(features_file, strategy=strategy, backend=backend)
 
     api = FakeKubeApi(str(features_file))
     try:
-        result = run_e2e(tmp_path, write_kubeconfig(tmp_path, api.url))
+        result = run_e2e(
+            tmp_path,
+            write_kubeconfig(tmp_path, api.url),
+            manifest=manifest,
+            golden=golden,
+        )
         assert result.returncode == 0, (
             f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
         )

@@ -66,10 +66,10 @@ needs_tpu = pytest.mark.skipif(
 )
 
 
-def run_daemon(tmp_path, *args):
-    out = tmp_path / "tfd"
+def run_daemon(tmp_path, *args, backend="jax", out_name="tfd"):
+    out = tmp_path / out_name
     env = _hermetic_env()
-    env["TFD_BACKEND"] = "jax"
+    env["TFD_BACKEND"] = backend
     r = subprocess.run(
         [sys.executable, "-m", "gpu_feature_discovery_tpu", "--oneshot",
          "--output-file", str(out), *args],
@@ -97,19 +97,15 @@ def test_native_backend_matches_jax_on_real_chip(tmp_path):
     version as the runtime and an honest unknown driver; jax reports
     libtpu/jaxlib versions), so only those families are excluded."""
     out_jax = run_daemon(tmp_path, "--no-timestamp")
-    env = _hermetic_env()
-    env["TFD_BACKEND"] = "native"
     args = [
-        sys.executable, "-m", "gpu_feature_discovery_tpu", "--oneshot",
-        "--no-timestamp", "--output-file", str(tmp_path / "native"),
+        "--no-timestamp",
         "--libtpu-path", os.environ["TFD_LIVE_NATIVE_PLUGIN"],
     ]
     opts = os.environ.get("TFD_LIVE_NATIVE_OPTS", "")
     if opts:
         args += ["--pjrt-create-options", opts]
-    r = subprocess.run(args, capture_output=True, text=True, timeout=300,
-                       env=env, cwd=REPO_ROOT)
-    assert r.returncode == 0, f"native daemon failed: {r.stderr[-2000:]}"
+    out_native = run_daemon(tmp_path, *args, backend="native",
+                            out_name="native")
 
     def load(path):
         return {
@@ -124,7 +120,7 @@ def test_native_backend_matches_jax_on_real_chip(tmp_path):
             )
         }
 
-    jax_labels, native_labels = load(out_jax), load(tmp_path / "native")
+    jax_labels, native_labels = load(out_jax), load(out_native)
     # Memory is sourced differently by design too: jax publishes the
     # allocator's usable limit (device.memory_stats bytes_limit), native
     # the HBM capacity attribute (or the spec table). Same chip, but the
