@@ -7,7 +7,7 @@ VERSION ?= 0.1.0
 
 COV_MIN ?= 75
 
-.PHONY: all native test coverage integration bench check-yamls lint clean docker-build
+.PHONY: all native test coverage integration bench check-yamls lint helm-check clean docker-build
 
 all: native test
 
@@ -46,6 +46,18 @@ bench:
 
 check-yamls:
 	tests/check-yamls.sh
+
+# Lint + render + contract-check the helm chart (needs the helm binary;
+# the same checks run in the CI helm job).
+helm-check:
+	helm lint deployments/helm/tpu-feature-discovery \
+	    --namespace node-feature-discovery
+	helm template tfd deployments/helm/tpu-feature-discovery \
+	    --namespace node-feature-discovery \
+	    | $(PYTHON) tests/helm-contract.py
+	helm template tfd deployments/helm/tpu-feature-discovery \
+	    --namespace node-feature-discovery --set nfd.deploy=false \
+	    | $(PYTHON) tests/helm-contract.py --no-nfd
 
 lint:
 	@command -v ruff >/dev/null && ruff check gpu_feature_discovery_tpu tests bench.py \

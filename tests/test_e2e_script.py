@@ -22,6 +22,7 @@ runs the same script against a real cluster.
 import http.server
 import json
 import os
+import shutil
 import subprocess
 import sys
 import threading
@@ -33,18 +34,22 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 REPO_ROOT = os.path.dirname(HERE)
 NODE_NAME = "fake-node-1"
 
+sys.path.insert(0, HERE)
+from k8s_stdlib import KubeClient  # noqa: E402
+
 
 class FakeKubeApi:
     """Just enough kube-apiserver for e2e-tests.py: create objects, list
     and read nodes, and a watch stream that emits MODIFIED once the 'NFD'
     side applied the features file to the node."""
 
-    def __init__(self, features_file, conflict_kinds=()):
+    def __init__(self, features_file, conflict_kinds=(), require_token=None):
         self.features_file = features_file
         self.node_labels = {"kubernetes.io/hostname": NODE_NAME}
         self.created = []  # (path, kind, name)
         self.namespaces = {"default", "kube-system"}
         self.conflict_kinds = set(conflict_kinds)  # respond 409 for these
+        self.require_token = require_token  # 401 unless this Bearer token
         self.tfd_deployed = threading.Event()
         self.lock = threading.Lock()
 
@@ -53,6 +58,15 @@ class FakeKubeApi:
         class Handler(http.server.BaseHTTPRequestHandler):
             def log_message(self, *args):  # keep pytest output clean
                 pass
+
+            def parse_request(self):
+                ok = super().parse_request()
+                if ok and state.require_token:
+                    got = self.headers.get("Authorization", "")
+                    if got != f"Bearer {state.require_token}":
+                        self._json({"reason": "Unauthorized"}, code=401)
+                        return False
+                return ok
 
             def _json(self, obj, code=200):
                 body = json.dumps(obj).encode()
@@ -360,6 +374,154 @@ def test_e2e_script_tolerates_preexisting_infra(tmp_path):
         assert result.returncode == 0, (
             f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
         )
+    finally:
+        api.shutdown()
+
+
+needs_openssl = pytest.mark.skipif(
+    shutil.which("openssl") is None, reason="openssl unavailable"
+)
+
+
+def _openssl_selfsigned(tmp_path, stem, cn, san=None):
+    cmd = [
+        "openssl", "req", "-x509", "-newkey", "rsa:2048", "-nodes",
+        "-keyout", str(tmp_path / f"{stem}.key"),
+        "-out", str(tmp_path / f"{stem}.crt"),
+        "-days", "1", "-subj", f"/CN={cn}",
+    ]
+    if san:
+        cmd += ["-addext", f"subjectAltName={san}"]
+    subprocess.run(cmd, check=True, capture_output=True, timeout=60)
+    return tmp_path / f"{stem}.crt", tmp_path / f"{stem}.key"
+
+
+@needs_openssl
+def test_k8s_stdlib_tls_client_certs(tmp_path):
+    """The auth path kind kubeconfigs actually use: https server verified
+    against certificate-authority-data, client authenticated by
+    client-certificate-data/client-key-data (all inline base64 PEM, the
+    _materialize temp-file path). The fake API serves one TLS request."""
+    import base64
+    import ssl
+
+    server_crt, server_key = _openssl_selfsigned(
+        tmp_path, "server", "127.0.0.1", san="IP:127.0.0.1"
+    )
+    client_crt, client_key = _openssl_selfsigned(tmp_path, "client", "e2e-client")
+
+    api = FakeKubeApi(str(tmp_path / "unused"))
+    ctx = ssl.SSLContext(ssl.PROTOCOL_TLS_SERVER)
+    ctx.load_cert_chain(str(server_crt), str(server_key))
+    # mTLS: require and verify the client certificate.
+    ctx.verify_mode = ssl.CERT_REQUIRED
+    ctx.load_verify_locations(str(client_crt))
+    api.server.socket = ctx.wrap_socket(api.server.socket, server_side=True)
+    try:
+        host, port = api.server.server_address
+        b64 = lambda p: base64.b64encode(p.read_bytes()).decode()  # noqa: E731
+        kubeconfig = tmp_path / "kubeconfig"
+        kubeconfig.write_text(
+            yaml.safe_dump(
+                {
+                    "apiVersion": "v1",
+                    "kind": "Config",
+                    "current-context": "tls",
+                    "contexts": [
+                        {
+                            "name": "tls",
+                            "context": {"cluster": "tls", "user": "tls"},
+                        }
+                    ],
+                    "clusters": [
+                        {
+                            "name": "tls",
+                            "cluster": {
+                                "server": f"https://127.0.0.1:{port}",
+                                "certificate-authority-data": b64(server_crt),
+                            },
+                        }
+                    ],
+                    "users": [
+                        {
+                            "name": "tls",
+                            "user": {
+                                "client-certificate-data": b64(client_crt),
+                                "client-key-data": b64(client_key),
+                            },
+                        }
+                    ],
+                }
+            )
+        )
+        client = KubeClient.from_kubeconfig(str(kubeconfig))
+        nodes = client.get("/api/v1/nodes")["items"]
+        assert [n["metadata"]["name"] for n in nodes] == [NODE_NAME]
+    finally:
+        api.shutdown()
+
+
+def _token_kubeconfig(tmp_path, server_url, user):
+    path = tmp_path / "kubeconfig-token"
+    path.write_text(
+        yaml.safe_dump(
+            {
+                "apiVersion": "v1",
+                "kind": "Config",
+                "current-context": "tok",
+                "contexts": [
+                    {"name": "tok", "context": {"cluster": "tok", "user": "tok"}}
+                ],
+                "clusters": [
+                    {"name": "tok", "cluster": {"server": server_url}}
+                ],
+                "users": [{"name": "tok", "user": user}],
+            }
+        )
+    )
+    return str(path)
+
+
+def test_k8s_stdlib_bearer_token_auth(tmp_path):
+    """Static token auth (the simplest GKE/service-account path): the fake
+    401s without the right Authorization header."""
+    import urllib.error
+
+    api = FakeKubeApi(str(tmp_path / "unused"), require_token="sekrit")
+    try:
+        good = KubeClient.from_kubeconfig(
+            _token_kubeconfig(tmp_path, api.url, {"token": "sekrit"})
+        )
+        assert good.get("/api/v1/nodes")["items"]
+        bad = KubeClient.from_kubeconfig(
+            _token_kubeconfig(tmp_path, api.url, {"token": "wrong"})
+        )
+        with pytest.raises(urllib.error.HTTPError):
+            bad.get("/api/v1/nodes")
+    finally:
+        api.shutdown()
+
+
+def test_k8s_stdlib_exec_auth_plugin(tmp_path):
+    """client.authentication.k8s.io exec plugin (how GKE kubeconfigs mint
+    tokens): the client must run the command and use status.token."""
+    api = FakeKubeApi(str(tmp_path / "unused"), require_token="exec-minted")
+    cred = json.dumps({"status": {"token": "exec-minted"}})
+    try:
+        client = KubeClient.from_kubeconfig(
+            _token_kubeconfig(
+                tmp_path,
+                api.url,
+                {
+                    "exec": {
+                        "command": "sh",
+                        "args": ["-c", f"echo '{cred}'"],
+                        "env": [{"name": "UNUSED", "value": "1"}],
+                    }
+                },
+            )
+        )
+        assert client.get("/api/v1/nodes")["items"]
     finally:
         api.shutdown()
 

@@ -8,7 +8,6 @@ native level), and cross-check the C++ capability walker against the
 pure-Python one on the same synthesized blobs.
 """
 
-import ctypes
 import os
 import shutil
 import subprocess
@@ -612,6 +611,20 @@ def test_enumerate_malformed_create_options(native, fake_pjrt_requires_opts):
                 "i:rank=99999999999999999999"):  # forced-int overflow
         assert native.enumerate(fake_pjrt_requires_opts,
                                 create_options=bad) is None
+
+
+def test_enumerate_create_options_boundaries(native, fake_pjrt_requires_opts):
+    """Parser limits and lenient corners, observable because the plugin
+    ignores options it does not require: empty values and '=' inside a
+    value are legal; over-limit counts and over-long specs fail cleanly."""
+    ok = lambda extra: native.enumerate(  # noqa: E731
+        fake_pjrt_requires_opts, create_options=REQUIRED_OPTS + extra
+    )
+    assert ok(";empty=") is not None            # empty string value
+    assert ok(";kv=a=b;x=1") is not None        # '=' inside a value
+    assert ok(";" + ";".join(f"k{i}=1" for i in range(26))) is not None  # 32 total
+    assert ok(";" + ";".join(f"k{i}=1" for i in range(27))) is None  # 33: too many
+    assert ok(";pad=" + "x" * 2048) is None     # spec exceeds the 2 KiB buffer
 
 
 def test_enumerate_probe_only_plugin_fails_cleanly(native, fake_libtpu):
