@@ -1,0 +1,110 @@
+"""Docs drift guards: the configuration reference must track the real
+flag surface, and the label reference must name every label family the
+labelers can emit. Documentation that silently rots is worse than none —
+the reference keeps its README flag table honest by hand; these tests do
+it mechanically."""
+
+import os
+import re
+
+from gpu_feature_discovery_tpu.config.flags import (
+    CONFIG_FILE_ENV_VARS,
+    FLAG_DEFS,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DOCS = os.path.join(os.path.dirname(HERE), "docs")
+
+
+def read(name):
+    with open(os.path.join(DOCS, name)) as f:
+        return f.read()
+
+
+def test_configuration_doc_covers_every_flag():
+    doc = read("configuration.md")
+    for fd in FLAG_DEFS:
+        assert f"`--{fd.name}`" in doc, f"flag --{fd.name} undocumented"
+        for env in fd.env_vars:
+            assert f"`{env}`" in doc, f"env alias {env} undocumented"
+        # The default must appear on the flag's table row (number/string/
+        # bool rendering is prose, so just require the row mentions it).
+        row = next(
+            line for line in doc.splitlines() if f"`--{fd.name}`" in line
+        )
+        if isinstance(fd.default, bool):
+            assert f"`{str(fd.default).lower()}`" in row, (
+                f"--{fd.name} default not documented"
+            )
+    for env in CONFIG_FILE_ENV_VARS:
+        assert f"`{env}`" in doc
+
+
+def test_configuration_doc_names_no_phantom_flags():
+    """Every `--flag` the doc mentions must exist (catches docs outliving
+    a removed/renamed flag)."""
+    doc = read("configuration.md")
+    known = {fd.name for fd in FLAG_DEFS} | {
+        "config-file", "version", "output", "mig-strategy"
+    }  # --mig-strategy appears only as the reference analog; -o is an alias
+    for m in re.finditer(r"`--([a-z][a-z0-9-]*)`", doc):
+        assert m.group(1) in known, f"doc names unknown flag --{m.group(1)}"
+
+
+def test_configuration_doc_config_file_keys_parse():
+    """The YAML example in the doc must round-trip through the real
+    config-file parser — a renamed camelCase key fails here."""
+    import yaml
+
+    from gpu_feature_discovery_tpu.config import spec
+
+    doc = read("configuration.md")
+    (block,) = re.findall(r"```yaml\n(.*?)```", doc, flags=re.S)
+    parsed = yaml.safe_load(block)
+    assert parsed["version"] == "v1"
+
+    import tempfile
+
+    with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as f:
+        f.write(block)
+        path = f.name
+    try:
+        config = spec.parse_config_file(path)
+    finally:
+        os.unlink(path)
+    assert config.flags.tpu_topology_strategy == "single"
+    assert config.flags.fail_on_init_error is False
+    assert config.flags.tfd.sleep_interval == 60.0
+    assert config.flags.tfd.burnin_interval == 10
+    assert config.sharing.time_slicing.resources[0].replicas == 4
+
+
+def test_labels_doc_covers_emitted_label_families():
+    """Every label key family the labelers can emit must appear in
+    docs/labels.md (checked by key, values are prose)."""
+    doc = read("labels.md")
+    families = [
+        "tpu.product", "tpu.count", "tpu.replicas", "tpu.memory",
+        "tpu.family", "tpu.generation.major", "tpu.generation.minor",
+        "tpu.tensorcores", "tpu.sparsecores", "tpu.slice.capable",
+        "tpu.driver.major", "tpu.runtime.major", "tpu.machine",
+        "tfd.timestamp", "tpu.topology.strategy", "tpu.slice.chips",
+        "tpu.slice.hosts", "tpu.slice.memory", "tpu.ici.links",
+        "tpu.health.ok", "tpu.health.matmul-tflops", "tpu.health.hbm-gbps",
+        "tpu.health.probe-ms", "tpu.multihost.worker-id",
+        "tpu.pci.host-interface", "tpu.pci.host-driver-version",
+    ]
+    # The doc collapses sibling keys into one row (`tpu.generation.
+    # major/minor`, `tpu.slice.chips/hosts/memory`): expand every
+    # backticked slash-run into its member keys before matching.
+    documented = set()
+    for token in re.findall(r"`google\.com/([a-z0-9./_-]+)`", doc):
+        parts = token.split("/")
+        documented.add(parts[0])
+        base = parts[0].rsplit(".", 1)[0]
+        for sibling in parts[1:]:
+            documented.add(f"{base}.{sibling}")
+    for fam in families:
+        assert any(d == fam or d.startswith(fam + ".") for d in documented), (
+            f"label family {fam} undocumented in labels.md"
+        )
