@@ -1,0 +1,25 @@
+"""tpu-feature-discovery: TPU-native node feature discovery for Kubernetes.
+
+A from-scratch re-design of NVIDIA's GPU Feature Discovery (reference:
+``Telemaco019/gpu-feature-discovery``) for Cloud TPU nodes: probes local TPU
+hardware through a native libtpu/PJRT shim, the TPU VM metadata environment,
+and PCI sysfs, and atomically publishes ``google.com/tpu.*`` labels to the
+Node Feature Discovery "local" feature source.
+
+Layer map (outer to inner, mirroring SURVEY.md section 1):
+
+- ``cmd``       : CLI + daemon loop               (ref cmd/gpu-feature-discovery/)
+- ``config``    : versioned config / flag system  (ref vendored api/config/v1)
+- ``lm``        : label-generation engine         (ref internal/lm/)
+- ``resource``  : Manager/Chip device abstraction (ref internal/resource/)
+- ``topology``  : slice grouping + validation     (ref internal/mig/)
+- ``pci``       : sysfs PCI probing               (ref internal/vgpu/)
+- ``native``    : C++ libtpu/PJRT dlopen shim     (ref internal/cuda/ cgo binding)
+- ``models``    : TPU generation spec tables      (ref getArchFamily tables)
+- ``parallel``  : on-device ICI topology probes (JAX collectives over a Mesh)
+- ``ops``       : on-device MXU/HBM health microbenchmarks
+"""
+
+from gpu_feature_discovery_tpu.info.version import VERSION as __version__  # noqa: F401
+
+LABEL_DOMAIN = "google.com"

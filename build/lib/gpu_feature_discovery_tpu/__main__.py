@@ -1,0 +1,3 @@
+from gpu_feature_discovery_tpu.cmd.main import main
+
+main()

@@ -1,0 +1,263 @@
+"""CLI entry point + daemon loop.
+
+Reference: cmd/gpu-feature-discovery/main.go. Same surface: the flag set
+(main.go:33-82, TFD_* env aliases), the config-reload outer loop re-entered
+on SIGHUP (main.go:117-145), and run()'s generate → atomic write → sleep
+cycle with signal-driven shutdown that deletes the output file unless in
+oneshot mode (main.go:148-232).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+import queue
+import signal
+import sys
+import time
+from typing import Optional, Tuple
+
+from gpu_feature_discovery_tpu.config.flags import (
+    CONFIG_FILE_ENV_VARS,
+    FLAG_DEFS,
+    disable_resource_renaming,
+    env_flag as _env_flag,
+    new_config,
+)
+from gpu_feature_discovery_tpu.config.spec import Config, ConfigError
+from gpu_feature_discovery_tpu.hostinfo.provider import ChainedProvider
+from gpu_feature_discovery_tpu.info.version import get_version_string
+from gpu_feature_discovery_tpu.lm.interconnect import InterconnectLabeler
+from gpu_feature_discovery_tpu.lm.labeler import Labeler, Merge
+from gpu_feature_discovery_tpu.lm.labelers import new_labelers
+from gpu_feature_discovery_tpu.lm.labels import remove_output_file
+from gpu_feature_discovery_tpu.lm.timestamp import new_timestamp_labeler
+from gpu_feature_discovery_tpu.pci.pciutil import SysfsGooglePCI
+from gpu_feature_discovery_tpu.resource import factory
+from gpu_feature_discovery_tpu.resource.types import Manager
+from gpu_feature_discovery_tpu.utils import logging as tfd_logging
+from gpu_feature_discovery_tpu.utils.timing import timed
+
+log = logging.getLogger("tfd")
+
+WATCHED_SIGNALS = (signal.SIGHUP, signal.SIGINT, signal.SIGTERM, signal.SIGQUIT)
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpu-feature-discovery",
+        description="generate NFD labels for Google TPU devices",
+    )
+    parser.add_argument("--version", action="version", version=get_version_string())
+    for fd in FLAG_DEFS:
+        names = [f"--{fd.name}"] + [
+            (f"--{a}" if len(a) > 1 else f"-{a}") for a in fd.aliases
+        ]
+        # All flags take a value (booleans accept true/false) so that unset
+        # flags are distinguishable — the c.IsSet() analog.
+        if fd.parse is str:
+            parser.add_argument(*names, dest=fd.name, default=None, help=fd.help)
+        else:
+            parser.add_argument(
+                *names,
+                dest=fd.name,
+                default=None,
+                nargs="?",
+                const="true",  # bare --oneshot means true
+                help=fd.help,
+            )
+    parser.add_argument(
+        "--config-file",
+        dest="config-file",
+        default=None,
+        help="path to a config file as an alternative to command line options",
+    )
+    parser.add_argument(
+        "--debug", action="store_true", help="enable debug logging (TFD extension)"
+    )
+    return parser
+
+
+def new_os_watcher() -> "queue.Queue[int]":
+    """Buffered signal channel (cmd/gpu-feature-discovery/watchers.go:26-31)."""
+    sigs: "queue.Queue[int]" = queue.Queue()
+    for s in WATCHED_SIGNALS:
+        signal.signal(s, lambda signum, _frame: sigs.put(signum))
+    return sigs
+
+
+def load_config(cli_values: dict, config_file: Optional[str]) -> Config:
+    """loadConfig (main.go:96-107): build + validate, then zero the
+    feature-gated sections."""
+    config = new_config(
+        cli_values=cli_values, environ=dict(os.environ), config_file=config_file
+    )
+    disable_resource_renaming(config, log.warning)
+    return config
+
+
+def start(argv: Optional[list] = None) -> int:
+    """start() (main.go:109-146): OS watcher + config-reload loop."""
+    parser = build_arg_parser()
+    ns = vars(parser.parse_args(argv))
+    tfd_logging.setup(debug=ns.pop("debug", False))
+
+    cli_values = {k: v for k, v in ns.items() if v is not None and k != "config-file"}
+    config_file = ns.get("config-file") or next(
+        (os.environ[e] for e in CONFIG_FILE_ENV_VARS if os.environ.get(e)), None
+    )
+
+    log.info("Starting OS watcher.")
+    sigs = new_os_watcher()
+
+    while True:
+        log.info("Loading configuration.")
+        try:
+            config = load_config(cli_values, config_file)
+        except ConfigError as e:
+            log.error("unable to load config: %s", e)
+            return 1
+
+        log.info(
+            "\nRunning with config:\n%s", json.dumps(config.to_dict(), indent=2)
+        )
+
+        try:
+            # Retry the metadata server each config epoch: the shared
+            # provider's unreachable-cache spares every consumer in the
+            # epoch a timeout, but a boot-time race (daemonset up before
+            # metadata is routable) must be recoverable by SIGHUP, not
+            # only by pod restart. Reset BEFORE building the manager and
+            # the interconnect labeler — they capture the shared provider
+            # at construction, and a post-construction reset would hand
+            # the new epoch the previous epoch's unreachable verdict.
+            from gpu_feature_discovery_tpu.hostinfo.provider import (
+                reset_metadata_provider_cache,
+            )
+
+            reset_metadata_provider_cache()
+
+            manager = factory.new_manager(config)
+            interconnect = new_interconnect_labeler(config)
+
+            # A reload may change --with-burnin/--burnin-interval: drop the
+            # cached health labels so the new config starts with a fresh
+            # probe instead of republishing measurements taken under the
+            # old one.
+            from gpu_feature_discovery_tpu.lm.health import reset_burnin_schedule
+
+            reset_burnin_schedule()
+
+            log.info("Start running")
+            restart = run(manager, interconnect, config, sigs)
+        except Exception as e:  # noqa: BLE001 - match reference error-to-exit
+            log.error("Error: %s", e)
+            return 1
+        if not restart:
+            return 0
+
+
+def new_interconnect_labeler(config: Config) -> Labeler:
+    """vgpu.NewVGPULib(NewNvidiaPCILib()) analog (main.go:134): sysfs PCI
+    scanner + host metadata provider chain. Escape hatches for hermetic
+    testing on real TPU VMs (where host facts would leak into golden
+    comparisons): TFD_NO_METADATA=1 skips the GCE metadata server;
+    TFD_HERMETIC=1 additionally blanks the env-var provider (needed because
+    site hooks can re-inject TPU_* into any child python process). The
+    gating semantics live in hostinfo.provider.gated_provider_args so the
+    PJRT slice binding and this labeler can never disagree."""
+    del config  # reserved for future flags
+    from gpu_feature_discovery_tpu.hostinfo.provider import gated_provider_args
+
+    environ, use_mds = gated_provider_args()
+    if _env_flag("TFD_MOCK_PCI"):
+        # Integration fixture: synthesized Google PCI functions (the
+        # reference gets real PCI devices from its GPU CI host; our
+        # CPU-only CI needs the mock to reach the pci.* label path).
+        from gpu_feature_discovery_tpu.pci.pciutil import MockGooglePCI
+
+        pci = MockGooglePCI()
+    else:
+        pci = _TolerantPCI()
+    return InterconnectLabeler(
+        pci=pci,
+        provider=ChainedProvider(environ, use_metadata_server=use_mds),
+    )
+
+
+class _TolerantPCI:
+    """Sysfs scan that degrades to 'no devices' off-cluster (the reference
+    propagates sysfs errors because it always runs privileged on Linux; we
+    also run in dev environments without /sys/bus/pci)."""
+
+    def __init__(self):
+        self._scanner = SysfsGooglePCI()
+
+    def devices(self):
+        try:
+            return self._scanner.devices()
+        except Exception as e:  # noqa: BLE001
+            log.debug("PCI scan unavailable: %s", e)
+            return []
+
+
+def run(
+    manager: Manager,
+    interconnect: Labeler,
+    config: Config,
+    sigs: "queue.Queue[int]",
+) -> bool:
+    """run() (main.go:148-210). Returns True to request a config reload
+    (SIGHUP), False for clean exit."""
+    output_file = config.flags.tfd.output_file
+    oneshot = config.flags.tfd.oneshot
+    try:
+        timestamp_labeler = new_timestamp_labeler(config)
+        while True:
+            with timed("labelgen.total"):
+                loop_labelers = new_labelers(manager, interconnect, config)
+                labels = Merge(timestamp_labeler, loop_labelers).labels()
+
+            if len(labels) <= 1:
+                log.warning("no labels generated from any source")
+
+            log.info("Writing labels to output file %s", output_file or "<stdout>")
+            labels.write_to_file(output_file)
+
+            if oneshot:
+                return False
+
+            log.info("Sleeping for %ss", config.flags.tfd.sleep_interval)
+            deadline = time.monotonic() + config.flags.tfd.sleep_interval
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break  # rerun
+                try:
+                    signum = sigs.get(timeout=remaining)
+                except queue.Empty:
+                    break  # rerun
+                if signum == signal.SIGHUP:
+                    log.info("Received SIGHUP, restarting.")
+                    return True
+                log.info("Received signal %s, shutting down.", signum)
+                return False
+    finally:
+        # Deferred cleanup (main.go:149-156): a daemon exit removes the
+        # label file so stale labels don't outlive the pod; oneshot leaves
+        # the file for NFD.
+        if not oneshot and output_file:
+            try:
+                remove_output_file(output_file)
+            except OSError as e:
+                log.warning("Error removing output file: %s", e)
+
+
+def main() -> None:
+    sys.exit(start())
+
+
+if __name__ == "__main__":
+    main()

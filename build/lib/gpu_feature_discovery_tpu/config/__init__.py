@@ -1,0 +1,30 @@
+from gpu_feature_discovery_tpu.config.spec import (
+    Config,
+    Flags,
+    TfdFlags,
+    Sharing,
+    TimeSlicing,
+    ReplicatedResource,
+    TOPOLOGY_STRATEGY_NONE,
+    TOPOLOGY_STRATEGY_SINGLE,
+    TOPOLOGY_STRATEGY_MIXED,
+    VERSION as CONFIG_VERSION,
+)
+from gpu_feature_discovery_tpu.config.flags import FLAG_DEFS, FlagDef, new_config, parse_duration
+
+__all__ = [
+    "Config",
+    "Flags",
+    "TfdFlags",
+    "Sharing",
+    "TimeSlicing",
+    "ReplicatedResource",
+    "TOPOLOGY_STRATEGY_NONE",
+    "TOPOLOGY_STRATEGY_SINGLE",
+    "TOPOLOGY_STRATEGY_MIXED",
+    "CONFIG_VERSION",
+    "FLAG_DEFS",
+    "FlagDef",
+    "new_config",
+    "parse_duration",
+]

@@ -1,0 +1,285 @@
+"""Flag table + CLI > env > file > default precedence.
+
+Reference: cmd/gpu-feature-discovery/main.go:33-82 (urfave/cli flag
+definitions with GFD_*/legacy env aliases) and the vendored
+updateFromCLIFlag semantics (flags.go:29-40): a CLI value overrides the
+config file only when explicitly set on the command line or via an
+environment alias; otherwise a config-file value survives, and defaults
+fill whatever is still unset.
+"""
+
+from __future__ import annotations
+
+import re
+from dataclasses import dataclass
+from typing import Any, Callable, Dict, List, Optional, Sequence
+
+from gpu_feature_discovery_tpu.config.spec import (
+    Config,
+    ConfigError,
+    TOPOLOGY_STRATEGIES,
+    TOPOLOGY_STRATEGY_NONE,
+    parse_bool as _parse_bool,
+    parse_config_file,
+    parse_positive_int as _parse_positive_int,
+)
+
+DEFAULT_OUTPUT_FILE = "/etc/kubernetes/node-feature-discovery/features.d/tfd"
+DEFAULT_MACHINE_TYPE_FILE = "/sys/class/dmi/id/product_name"
+DEFAULT_SLEEP_INTERVAL = 60.0
+
+_DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
+_DURATION_UNITS = {
+    "ns": 1e-9,
+    "us": 1e-6,
+    "µs": 1e-6,
+    "ms": 1e-3,
+    "s": 1.0,
+    "m": 60.0,
+    "h": 3600.0,
+}
+
+
+def env_flag(name: str) -> bool:
+    """Value-aware env toggle with the same boolean grammar as every other
+    TFD flag (config.spec.parse_bool); unset/empty is off. An unparseable
+    value is a hard ConfigError — a typo like TFD_HERMETIC=fals must not
+    silently flip behavior in either direction (strict parse-or-error, the
+    same contract every TFD_* boolean flag has)."""
+    import os
+
+    raw = os.environ.get(name, "").strip()
+    if not raw:
+        return False
+    try:
+        return _parse_bool(raw)
+    except ConfigError as e:
+        raise ConfigError(f"{name}={raw!r} is not a boolean: {e}") from e
+
+
+def parse_duration(value: Any) -> float:
+    """Parse a Go-style duration ("60s", "1m30s", "100ms") or a bare number
+    of seconds into float seconds (cli.DurationFlag analog)."""
+    if isinstance(value, (int, float)):
+        return float(value)
+    s = str(value).strip()
+    if not s:
+        raise ConfigError("empty duration")
+    try:
+        return float(s)
+    except ValueError:
+        pass
+    pos = 0
+    total = 0.0
+    for m in _DURATION_RE.finditer(s):
+        if m.start() != pos:
+            raise ConfigError(f"invalid duration: {value!r}")
+        total += float(m.group(1)) * _DURATION_UNITS[m.group(2)]
+        pos = m.end()
+    if pos != len(s) or pos == 0:
+        raise ConfigError(f"invalid duration: {value!r}")
+    return total
+
+
+@dataclass(frozen=True)
+class FlagDef:
+    """One CLI flag: name, env aliases, type, default, and where it lands in
+    the Config (mirror of the urfave/cli flag list, main.go:33-82)."""
+
+    name: str                      # CLI name, e.g. "tpu-topology-strategy"
+    env_vars: Sequence[str]        # checked in order
+    parse: Callable[[Any], Any]
+    default: Any
+    help: str
+    setter: Callable[[Config, Any], None]
+    getter: Callable[[Config], Any]
+    aliases: Sequence[str] = ()
+
+
+def _f(cfg: Config):  # noqa: D401 - tiny accessor helpers
+    return cfg.flags
+
+
+FLAG_DEFS: List[FlagDef] = [
+    FlagDef(
+        name="tpu-topology-strategy",
+        env_vars=("TFD_TPU_TOPOLOGY_STRATEGY", "TPU_TOPOLOGY_STRATEGY"),
+        parse=str,
+        default=TOPOLOGY_STRATEGY_NONE,
+        help="the desired strategy for exposing TPU slice topology: [none | single | mixed]",
+        setter=lambda c, v: setattr(_f(c), "tpu_topology_strategy", v),
+        getter=lambda c: _f(c).tpu_topology_strategy,
+    ),
+    FlagDef(
+        name="fail-on-init-error",
+        env_vars=("TFD_FAIL_ON_INIT_ERROR", "FAIL_ON_INIT_ERROR"),
+        parse=_parse_bool,
+        default=True,
+        help="fail if an error is encountered during initialization, otherwise label with no devices",
+        setter=lambda c, v: setattr(_f(c), "fail_on_init_error", v),
+        getter=lambda c: _f(c).fail_on_init_error,
+    ),
+    FlagDef(
+        name="libtpu-path",
+        env_vars=("TFD_LIBTPU_PATH", "TPU_LIBRARY_PATH"),
+        parse=str,
+        default="",
+        help="explicit path to libtpu.so (empty = search default locations)",
+        setter=lambda c, v: setattr(_f(c), "libtpu_path", v),
+        getter=lambda c: _f(c).libtpu_path,
+    ),
+    FlagDef(
+        name="native-enumeration",
+        env_vars=("TFD_NATIVE_ENUMERATION",),
+        parse=_parse_bool,
+        default=False,
+        help="allow the native (PJRT C API) enumeration fallback when JAX "
+        "is unusable; creates and destroys a PJRT client, which briefly "
+        "seizes the TPU — never enable on nodes running workloads",
+        setter=lambda c, v: setattr(_f(c), "native_enumeration", v),
+        getter=lambda c: _f(c).native_enumeration,
+    ),
+    FlagDef(
+        name="pjrt-create-options",
+        env_vars=("TFD_PJRT_CREATE_OPTIONS",),
+        parse=str,
+        default="",
+        help='";"-separated key=value NamedValues passed to '
+        "PJRT_Client_Create by the native-enumeration backend (some PJRT "
+        "plugins require named options; value types are inferred, or "
+        "forced with a s:/i:/f:/b: key prefix)",
+        setter=lambda c, v: setattr(_f(c), "pjrt_create_options", v),
+        getter=lambda c: _f(c).pjrt_create_options,
+    ),
+    FlagDef(
+        name="oneshot",
+        env_vars=("TFD_ONESHOT",),
+        parse=_parse_bool,
+        default=False,
+        help="label once and exit",
+        setter=lambda c, v: setattr(_f(c).tfd, "oneshot", v),
+        getter=lambda c: _f(c).tfd.oneshot,
+    ),
+    FlagDef(
+        name="no-timestamp",
+        env_vars=("TFD_NO_TIMESTAMP",),
+        parse=_parse_bool,
+        default=False,
+        help="do not add the timestamp to the labels",
+        setter=lambda c, v: setattr(_f(c).tfd, "no_timestamp", v),
+        getter=lambda c: _f(c).tfd.no_timestamp,
+    ),
+    FlagDef(
+        name="sleep-interval",
+        env_vars=("TFD_SLEEP_INTERVAL",),
+        parse=parse_duration,
+        default=DEFAULT_SLEEP_INTERVAL,
+        help="time to sleep between labeling (Go duration, e.g. 60s)",
+        setter=lambda c, v: setattr(_f(c).tfd, "sleep_interval", v),
+        getter=lambda c: _f(c).tfd.sleep_interval,
+    ),
+    FlagDef(
+        name="output-file",
+        env_vars=("TFD_OUTPUT_FILE",),
+        parse=str,
+        default=DEFAULT_OUTPUT_FILE,
+        help="path to the NFD feature file to write",
+        setter=lambda c, v: setattr(_f(c).tfd, "output_file", v),
+        getter=lambda c: _f(c).tfd.output_file,
+        aliases=("output", "o"),
+    ),
+    FlagDef(
+        name="with-burnin",
+        env_vars=("TFD_WITH_BURNIN",),
+        parse=_parse_bool,
+        default=False,
+        help="run a short on-chip burn-in each cycle and emit tpu.health.* labels (TPU extension)",
+        setter=lambda c, v: setattr(_f(c).tfd, "with_burnin", v),
+        getter=lambda c: _f(c).tfd.with_burnin,
+    ),
+    FlagDef(
+        name="burnin-interval",
+        env_vars=("TFD_BURNIN_INTERVAL",),
+        parse=_parse_positive_int,
+        default=10,
+        help="with --with-burnin, probe every Nth labeling cycle and reuse "
+        "cached health labels in between (1 = every cycle)",
+        setter=lambda c, v: setattr(_f(c).tfd, "burnin_interval", v),
+        getter=lambda c: _f(c).tfd.burnin_interval,
+    ),
+    FlagDef(
+        name="machine-type-file",
+        env_vars=("TFD_MACHINE_TYPE_FILE",),
+        parse=str,
+        default=DEFAULT_MACHINE_TYPE_FILE,
+        help="path to a file containing the DMI (SMBIOS) machine type of the node",
+        setter=lambda c, v: setattr(_f(c).tfd, "machine_type_file", v),
+        getter=lambda c: _f(c).tfd.machine_type_file,
+    ),
+]
+
+# --config-file itself (env TFD_CONFIG_FILE / CONFIG_FILE) is handled by the
+# caller before new_config, matching the reference's Destination-bound flag.
+CONFIG_FILE_ENV_VARS = ("TFD_CONFIG_FILE", "CONFIG_FILE")
+
+
+def new_config(
+    cli_values: Optional[Dict[str, Any]] = None,
+    environ: Optional[Dict[str, str]] = None,
+    config_file: Optional[str] = None,
+) -> Config:
+    """Build the final Config with (1) CLI > (2) env > (3) file > (4) default
+    precedence (config.go:40-57 + flags.go:29-40).
+
+    ``cli_values`` holds only flags the user explicitly passed (the argparse
+    front-end filters out unset ones — the c.IsSet() analog). Values arrive
+    pre-parsed or as raw strings; both are accepted.
+    """
+    cli_values = cli_values or {}
+    environ = environ if environ is not None else {}
+
+    config = parse_config_file(config_file) if config_file else Config()
+
+    for fd in FLAG_DEFS:
+        if fd.name in cli_values:
+            fd.setter(config, fd.parse(cli_values[fd.name]))
+            continue
+        env_val = next(
+            (environ[e] for e in fd.env_vars if environ.get(e) not in (None, "")),
+            None,
+        )
+        if env_val is not None:
+            fd.setter(config, fd.parse(env_val))
+        elif fd.getter(config) is None:
+            fd.setter(config, fd.default)
+
+    strategy = config.flags.tpu_topology_strategy
+    if strategy not in TOPOLOGY_STRATEGIES:
+        raise ConfigError(
+            f"invalid tpu-topology-strategy: {strategy!r} (want one of {TOPOLOGY_STRATEGIES})"
+        )
+    return config
+
+
+def disable_resource_renaming(config: Config, log: Callable[[str], None]) -> None:
+    """Feature-gate resource renaming/device selection, exactly like
+    disableResourceRenamingInConfig (main.go:236-270): warn and zero the
+    unsupported fields so downstream code never sees them."""
+    if config.resources:
+        log("Customizing the 'resources' field is not yet supported in the config. Ignoring...")
+        config.resources = {}
+
+    rename_by_default = config.sharing.time_slicing.rename_by_default
+    sets_non_default_rename = False
+    for r in config.sharing.time_slicing.resources:
+        if not rename_by_default and r.rename:
+            sets_non_default_rename = True
+            r.rename = ""
+        if rename_by_default and r.rename != r.default_shared_rename():
+            sets_non_default_rename = True
+            r.rename = r.default_shared_rename()
+    if sets_non_default_rename:
+        log(
+            "Setting the 'rename' field in sharing.timeSlicing.resources is not yet "
+            "supported in the config. Ignoring..."
+        )

@@ -1,0 +1,243 @@
+"""Versioned configuration spec.
+
+Mirrors the vendored device-plugin config API the reference builds on
+(vendor/github.com/NVIDIA/k8s-device-plugin/api/config/v1/config.go:33-57,
+flags.go:44-121, replicas.go:28-60): a versioned YAML/JSON document
+``{version, flags, resources, sharing}`` where every flag is optional and
+population order is (1) CLI, (2) environment, (3) config file, (4) default.
+
+TPU vocabulary swaps: ``migStrategy`` → ``tpuTopologyStrategy`` (slice
+strategies), resource names live under ``google.com/``.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+import yaml
+
+VERSION = "v1"
+
+# Slice/topology strategies — the MIG-strategy analog (BASELINE.json:
+# `single` = uniform pod slice, `mixed` = heterogeneous multi-slice).
+# Reference constants: internal/lm/mig-strategy.go:29-33.
+TOPOLOGY_STRATEGY_NONE = "none"
+TOPOLOGY_STRATEGY_SINGLE = "single"
+TOPOLOGY_STRATEGY_MIXED = "mixed"
+TOPOLOGY_STRATEGIES = (
+    TOPOLOGY_STRATEGY_NONE,
+    TOPOLOGY_STRATEGY_SINGLE,
+    TOPOLOGY_STRATEGY_MIXED,
+)
+
+FULL_TPU_RESOURCE_NAME = "google.com/tpu"
+
+
+@dataclass
+class ReplicatedResource:
+    """One time-sliced resource (replicas.go:37-43). ``devices`` selection is
+    feature-gated off just like the reference (main.go:236-270), so only
+    name/rename/replicas are honored."""
+
+    name: str = ""
+    rename: str = ""
+    replicas: int = 0
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "ReplicatedResource":
+        return ReplicatedResource(
+            name=str(d.get("name", "")),
+            rename=str(d.get("rename", "")),
+            replicas=int(d.get("replicas", 0)),
+        )
+
+    def default_shared_rename(self) -> str:
+        """resource-name.shared rename default (replicas.go DefaultSharedRename)."""
+        return self.name + ".shared"
+
+
+@dataclass
+class TimeSlicing:
+    """Sharing settings (replicas.go:29-34)."""
+
+    rename_by_default: bool = False
+    fail_requests_greater_than_one: bool = False
+    resources: List[ReplicatedResource] = field(default_factory=list)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "TimeSlicing":
+        return TimeSlicing(
+            rename_by_default=parse_bool(d.get("renameByDefault", False)),
+            fail_requests_greater_than_one=parse_bool(d.get("failRequestsGreaterThanOne", False)),
+            resources=[ReplicatedResource.from_dict(r) for r in d.get("resources", []) or []],
+        )
+
+
+@dataclass
+class Sharing:
+    time_slicing: TimeSlicing = field(default_factory=TimeSlicing)
+
+    @staticmethod
+    def from_dict(d: Dict[str, Any]) -> "Sharing":
+        return Sharing(time_slicing=TimeSlicing.from_dict(d.get("timeSlicing", {}) or {}))
+
+    def replication_info(self, resource_name: str) -> Optional[ReplicatedResource]:
+        """Find the replication entry for a resource name
+        (cf. lm/resource.go:213-226 replicationInfo)."""
+        for r in self.time_slicing.resources:
+            if r.name == resource_name:
+                return r
+        return None
+
+
+@dataclass
+class TfdFlags:
+    """Daemon-specific flags (GFDCommandLineFlags, flags.go:66-73).
+    ``None`` means "not set anywhere yet" so config-file values can land
+    without being clobbered by defaults (flags.go:29-40 semantics)."""
+
+    oneshot: Optional[bool] = None
+    no_timestamp: Optional[bool] = None
+    sleep_interval: Optional[float] = None  # seconds
+    output_file: Optional[str] = None
+    machine_type_file: Optional[str] = None
+    with_burnin: Optional[bool] = None  # TPU extension: on-chip health labels
+    burnin_interval: Optional[int] = None  # probe every Nth cycle (cache between)
+
+
+@dataclass
+class Flags:
+    """Common + daemon flags (CommandLineFlags, flags.go:50-59)."""
+
+    tpu_topology_strategy: Optional[str] = None
+    fail_on_init_error: Optional[bool] = None
+    libtpu_path: Optional[str] = None  # nvidiaDriverRoot analog
+    native_enumeration: Optional[bool] = None  # opt-in: PJRT C-API enumeration
+    # ";"-separated key=value NamedValues for PJRT_Client_Create (some
+    # plugins require named options to create a client; tfd_native.h has
+    # the grammar). Only consulted by the native-enumeration backend.
+    pjrt_create_options: Optional[str] = None
+    tfd: TfdFlags = field(default_factory=TfdFlags)
+
+
+@dataclass
+class Config:
+    version: str = VERSION
+    flags: Flags = field(default_factory=Flags)
+    resources: Dict[str, Any] = field(default_factory=dict)
+    sharing: Sharing = field(default_factory=Sharing)
+
+    def to_dict(self) -> Dict[str, Any]:
+        """JSON-dumpable view, used by the startup config dump
+        (cf. main.go:127-131)."""
+        return {
+            "version": self.version,
+            "flags": {
+                "tpuTopologyStrategy": self.flags.tpu_topology_strategy,
+                "failOnInitError": self.flags.fail_on_init_error,
+                "libtpuPath": self.flags.libtpu_path,
+                "nativeEnumeration": self.flags.native_enumeration,
+                "pjrtCreateOptions": self.flags.pjrt_create_options,
+                "tfd": {
+                    "oneshot": self.flags.tfd.oneshot,
+                    "noTimestamp": self.flags.tfd.no_timestamp,
+                    "sleepInterval": self.flags.tfd.sleep_interval,
+                    "outputFile": self.flags.tfd.output_file,
+                    "machineTypeFile": self.flags.tfd.machine_type_file,
+                    "withBurnin": self.flags.tfd.with_burnin,
+                    "burninInterval": self.flags.tfd.burnin_interval,
+                },
+            },
+            "sharing": {
+                "timeSlicing": {
+                    "renameByDefault": self.sharing.time_slicing.rename_by_default,
+                    "failRequestsGreaterThanOne": self.sharing.time_slicing.fail_requests_greater_than_one,
+                    "resources": [
+                        {"name": r.name, "rename": r.rename, "replicas": r.replicas}
+                        for r in self.sharing.time_slicing.resources
+                    ],
+                },
+            },
+        }
+
+
+class ConfigError(Exception):
+    pass
+
+
+def parse_bool(value: Any) -> bool:
+    """Strict boolean parsing shared by CLI/env/file inputs; quoted YAML
+    strings like "false" must not truthiness-convert to True."""
+    if isinstance(value, bool):
+        return value
+    s = str(value).strip().lower()
+    if s in ("1", "t", "true", "yes", "y", "on"):
+        return True
+    if s in ("0", "f", "false", "no", "n", "off"):
+        return False
+    raise ConfigError(f"invalid boolean: {value!r}")
+
+
+def parse_positive_int(value: Any) -> int:
+    """Strict positive-integer parsing (shared by CLI/env/file inputs)."""
+    try:
+        n = int(str(value).strip())
+    except ValueError as e:
+        raise ConfigError(f"invalid integer: {value!r}") from e
+    if n < 1:
+        raise ConfigError(f"value must be >= 1: {value!r}")
+    return n
+
+
+def parse_config_file(path: str) -> Config:
+    """Parse a YAML/JSON config file with version checking
+    (config.go:60-99)."""
+    try:
+        with open(path) as f:
+            raw = yaml.safe_load(f) or {}
+    except OSError as e:
+        raise ConfigError(f"error opening config file: {e}") from e
+    except yaml.YAMLError as e:
+        raise ConfigError(f"unmarshal error: {e}") from e
+
+    if not isinstance(raw, dict):
+        raise ConfigError(f"config file must contain a mapping, got {type(raw).__name__}")
+
+    version = raw.get("version") or VERSION
+    if version != VERSION:
+        raise ConfigError(f"unknown version: {version}")
+
+    config = Config(version=version)
+    flags = raw.get("flags", {}) or {}
+    config.flags.tpu_topology_strategy = _opt_str(flags.get("tpuTopologyStrategy"))
+    config.flags.fail_on_init_error = _opt_bool(flags.get("failOnInitError"))
+    config.flags.libtpu_path = _opt_str(flags.get("libtpuPath"))
+    config.flags.native_enumeration = _opt_bool(flags.get("nativeEnumeration"))
+    config.flags.pjrt_create_options = _opt_str(flags.get("pjrtCreateOptions"))
+
+    tfd = flags.get("tfd", {}) or {}
+    config.flags.tfd.oneshot = _opt_bool(tfd.get("oneshot"))
+    config.flags.tfd.no_timestamp = _opt_bool(tfd.get("noTimestamp"))
+    if tfd.get("sleepInterval") is not None:
+        # Deferred import to avoid a cycle (flags imports spec).
+        from gpu_feature_discovery_tpu.config.flags import parse_duration
+
+        config.flags.tfd.sleep_interval = parse_duration(tfd["sleepInterval"])
+    config.flags.tfd.output_file = _opt_str(tfd.get("outputFile"))
+    config.flags.tfd.machine_type_file = _opt_str(tfd.get("machineTypeFile"))
+    config.flags.tfd.with_burnin = _opt_bool(tfd.get("withBurnin"))
+    if tfd.get("burninInterval") is not None:
+        config.flags.tfd.burnin_interval = parse_positive_int(tfd["burninInterval"])
+
+    config.resources = raw.get("resources", {}) or {}
+    config.sharing = Sharing.from_dict(raw.get("sharing", {}) or {})
+    return config
+
+
+def _opt_str(v: Any) -> Optional[str]:
+    return None if v is None else str(v)
+
+
+def _opt_bool(v: Any) -> Optional[bool]:
+    return None if v is None else parse_bool(v)

@@ -1,0 +1,18 @@
+from gpu_feature_discovery_tpu.hostinfo.tpu_env import HostInfo, parse_tpu_env
+from gpu_feature_discovery_tpu.hostinfo.provider import (
+    ChainedProvider,
+    EnvMetadataProvider,
+    GceMetadataProvider,
+    StaticProvider,
+    discover_host_info,
+)
+
+__all__ = [
+    "HostInfo",
+    "parse_tpu_env",
+    "ChainedProvider",
+    "EnvMetadataProvider",
+    "GceMetadataProvider",
+    "StaticProvider",
+    "discover_host_info",
+]

@@ -1,0 +1,141 @@
+"""TPU VM environment parsing — the config-space record-walking analog.
+
+Where the reference decodes vGPU host-driver version/branch records out of
+PCI vendor-specific capability bytes (internal/vgpu/vgpu.go:108-153), a TPU
+VM's host-side facts arrive through the GCE metadata attribute ``tpu-env``:
+a YAML-ish document of ``KEY: 'value'`` lines such as::
+
+    ACCELERATOR_TYPE: 'v5p-64'
+    TPU_PROCESS_BOUNDS: '2,2,2'
+    TPU_CHIPS_PER_PROCESS_BOUNDS: '2,2,1'
+    WORKER_ID: '3'
+    TPU_TOPOLOGY_WRAP: 'true,true,true'
+
+On GKE, equivalent facts are injected as pod/node environment variables
+(TPU_ACCELERATOR_TYPE, TPU_TOPOLOGY, TPU_WORKER_ID, TPU_WORKER_HOSTNAMES).
+This module normalizes both into one HostInfo.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional, Tuple
+
+from gpu_feature_discovery_tpu.models import parse_accelerator_type
+from gpu_feature_discovery_tpu.models.accelerator_types import parse_topology
+
+_LINE_RE = re.compile(r"^\s*([A-Za-z0-9_.-]+)\s*:\s*(.*?)\s*$")
+
+
+@dataclass
+class HostInfo:
+    """Slice-global facts derivable from purely local metadata — the
+    coordination-free property SURVEY.md section 7 requires (each daemonset
+    worker labels its own node without talking to peers)."""
+
+    accelerator_type: str = ""
+    topology: str = ""                       # chip grid of the WHOLE slice
+    worker_id: Optional[int] = None
+    worker_count: Optional[int] = None
+    worker_hostnames: List[str] = field(default_factory=list)
+    chips_per_host_bounds: str = ""          # e.g. "2,2,1"
+    wrap: Tuple[bool, ...] = ()              # ICI torus wraparound per axis
+    raw: Dict[str, str] = field(default_factory=dict)
+
+    @property
+    def multi_host(self) -> bool:
+        if self.worker_count is not None:
+            return self.worker_count > 1
+        at = parse_accelerator_type(self.accelerator_type)
+        return bool(at and at.multi_host)
+
+    def resolved_worker_count(self) -> Optional[int]:
+        if self.worker_count is not None:
+            return self.worker_count
+        if self.worker_hostnames:
+            return len(self.worker_hostnames)
+        at = parse_accelerator_type(self.accelerator_type)
+        return at.hosts if at else None
+
+    def resolved_topology(self) -> str:
+        if self.topology:
+            return self.topology
+        at = parse_accelerator_type(self.accelerator_type)
+        return at.topology_str if at else ""
+
+
+def parse_tpu_env(text: str) -> Dict[str, str]:
+    """Parse ``KEY: 'value'`` lines; quotes stripped, malformed lines
+    skipped (defensive: this is externally-provided metadata)."""
+    out: Dict[str, str] = {}
+    for line in text.splitlines():
+        m = _LINE_RE.match(line)
+        if not m:
+            continue
+        key, value = m.group(1), m.group(2)
+        if len(value) >= 2 and value[0] == value[-1] and value[0] in "'\"":
+            value = value[1:-1]
+        out[key] = value
+    return out
+
+
+def host_info_from_mapping(kv: Dict[str, str]) -> HostInfo:
+    """Build HostInfo from a tpu-env mapping or an os.environ-style dict;
+    recognizes both TPU VM metadata keys and GKE env-var names."""
+    def get(*names: str) -> str:
+        for n in names:
+            v = kv.get(n)
+            if v:
+                return v.strip()
+        return ""
+
+    info = HostInfo(raw={k: v for k, v in kv.items() if k.isupper()})
+    info.accelerator_type = get("ACCELERATOR_TYPE", "TPU_ACCELERATOR_TYPE").lower()
+    info.topology = get("TPU_TOPOLOGY", "TOPOLOGY").lower()
+    info.chips_per_host_bounds = get(
+        "TPU_CHIPS_PER_PROCESS_BOUNDS", "TPU_CHIPS_PER_HOST_BOUNDS",
+        "CHIPS_PER_HOST_BOUNDS",  # v2/v3 TPU VMs use the unprefixed key
+    )
+
+    worker_id = get("WORKER_ID", "TPU_WORKER_ID", "AGENT_WORKER_NUMBER")
+    if worker_id.isdigit():
+        info.worker_id = int(worker_id)
+
+    hostnames = get("TPU_WORKER_HOSTNAMES", "WORKER_HOSTNAMES")
+    if hostnames:
+        info.worker_hostnames = [h.strip() for h in hostnames.split(",") if h.strip()]
+        info.worker_count = len(info.worker_hostnames)
+
+    process_bounds = get("TPU_PROCESS_BOUNDS", "TPU_HOST_BOUNDS", "HOST_BOUNDS")
+    if info.worker_count is None and process_bounds:
+        dims = _parse_bounds(process_bounds)
+        if dims:
+            info.worker_count = math.prod(dims)
+
+    wrap = get("TPU_TOPOLOGY_WRAP", "WRAP")
+    if wrap:
+        info.wrap = tuple(w.strip().lower() == "true" for w in wrap.split(","))
+
+    # Derive the slice topology when only process/chip bounds are present
+    # (process_bounds × chips_per_process per axis = chip grid).
+    if not info.topology and process_bounds and info.chips_per_host_bounds:
+        pb = _parse_bounds(process_bounds)
+        cb = _parse_bounds(info.chips_per_host_bounds)
+        if pb and cb and len(pb) == len(cb):
+            info.topology = "x".join(str(p * c) for p, c in zip(pb, cb))
+
+    return info
+
+
+def _parse_bounds(bounds: str) -> Optional[Tuple[int, ...]]:
+    """"2,2,1" → (2,2,1); also accepts "2x2x1"."""
+    sep = "," if "," in bounds else "x"
+    try:
+        dims = tuple(int(p) for p in bounds.split(sep))
+    except ValueError:
+        return None
+    if not dims or any(d <= 0 for d in dims):
+        return None
+    return dims

@@ -1,0 +1,3 @@
+from gpu_feature_discovery_tpu.info.version import VERSION, get_version_string
+
+__all__ = ["VERSION", "get_version_string"]

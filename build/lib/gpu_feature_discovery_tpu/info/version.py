@@ -1,0 +1,17 @@
+"""Build/version metadata.
+
+Reference: internal/info/version.go:22-43 (ldflags-injected version + gitCommit;
+here populated at build time via TFD_VERSION/TFD_GIT_COMMIT env or defaults).
+"""
+
+import os
+
+VERSION = os.environ.get("TFD_VERSION", "0.1.0")
+GIT_COMMIT = os.environ.get("TFD_GIT_COMMIT", "")
+
+
+def get_version_string() -> str:
+    """Format the version string like reference GetVersionString()."""
+    if GIT_COMMIT:
+        return f"{VERSION}-{GIT_COMMIT}"
+    return VERSION

@@ -1,0 +1,4 @@
+from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.lm.labeler import Labeler, Merge, Empty
+
+__all__ = ["Labels", "Labeler", "Merge", "Empty"]

@@ -1,0 +1,122 @@
+"""Host-interconnect labeler — the vGPU labeler analog.
+
+Reference: internal/lm/vgpu.go:32-55 probes lazily inside Labels() and
+publishes nothing when no vGPU devices exist. Here the "host side" facts of
+a TPU node are its multi-host slice membership (worker index/count, slice
+topology, ICI wraparound — the ICI/DCN discovery row of SURVEY.md section
+5) plus PCI-level TPU presence, all derived from purely local sources so
+the daemonset stays coordination-free.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional
+
+from gpu_feature_discovery_tpu.hostinfo.tpu_env import HostInfo
+from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.pci.pciutil import (
+    GooglePCI,
+    PCIError,
+    decode_vendor_capability,
+)
+
+log = logging.getLogger("tfd.lm")
+
+PCI_PRESENT = "google.com/tpu.pci.present"
+PCI_COUNT = "google.com/tpu.pci.count"
+HOST_INTERFACE = "google.com/tpu.pci.host-interface"
+HOST_DRIVER_VERSION = "google.com/tpu.pci.host-driver-version"
+HOST_DRIVER_BRANCH = "google.com/tpu.pci.host-driver-branch"
+ACCEL_TYPE = "google.com/tpu.slice.accelerator-type"
+SLICE_TOPOLOGY = "google.com/tpu.slice.topology"
+MULTIHOST_PRESENT = "google.com/tpu.multihost.present"
+WORKER_ID = "google.com/tpu.multihost.worker-id"
+WORKER_COUNT = "google.com/tpu.multihost.worker-count"
+CHIPS_PER_HOST = "google.com/tpu.multihost.chips-per-host"
+WRAP_PREFIX = "google.com/tpu.ici.wrap"
+MACHINE = "google.com/tpu.machine"
+
+
+class InterconnectLabeler:
+    """Lazy labeler over a PCI scanner + HostInfo provider; either may be
+    None (vgpuLabeler struct analog)."""
+
+    def __init__(self, pci: Optional[GooglePCI] = None, provider=None):
+        self._pci = pci
+        self._provider = provider
+
+    def labels(self) -> Labels:
+        labels = Labels()
+
+        if self._pci is not None:
+            devices = self._pci.devices()
+            if devices:
+                labels[PCI_PRESENT] = "true"
+                labels[PCI_COUNT] = str(len(devices))
+                labels.update(_host_interface_labels(devices))
+
+        info: Optional[HostInfo] = (
+            self._provider.host_info() if self._provider is not None else None
+        )
+        if info is not None:
+            labels.update(_host_info_labels(info))
+        return labels
+
+
+def _host_interface_labels(devices) -> Labels:
+    """Labels from the first decodable vendor-specific capability record
+    (vgpu.host-driver-version/-branch analog, vgpu.go:108-153 feeding
+    lm/vgpu.go:41-52). Most TPU functions carry no record — host-driver
+    facts normally come from the metadata server — so absence is silent;
+    a short config read (unprivileged container) warns and skips that
+    device, matching the labeler's warn-don't-fail posture."""
+    labels = Labels()
+    for dev in devices:
+        try:
+            cap = dev.get_vendor_specific_capability()
+        except PCIError as e:
+            log.warning("skipping PCI capability read for %s: %s", dev.address, e)
+            continue
+        if cap is None:
+            continue
+        info = decode_vendor_capability(cap)
+        if info is None:
+            continue
+        labels[HOST_INTERFACE] = info.signature
+        if info.driver_version:
+            labels[HOST_DRIVER_VERSION] = info.driver_version
+        if info.driver_branch:
+            labels[HOST_DRIVER_BRANCH] = info.driver_branch
+        break
+    return labels
+
+
+def _host_info_labels(info: HostInfo) -> Labels:
+    labels = Labels()
+    if info.accelerator_type:
+        labels[ACCEL_TYPE] = info.accelerator_type
+    topology = info.resolved_topology()
+    if topology:
+        labels[SLICE_TOPOLOGY] = topology
+
+    multi = info.multi_host
+    labels[MULTIHOST_PRESENT] = str(multi).lower()
+    if multi:
+        if info.worker_id is not None:
+            labels[WORKER_ID] = str(info.worker_id)
+        count = info.resolved_worker_count()
+        if count is not None:
+            labels[WORKER_COUNT] = str(count)
+        if info.chips_per_host_bounds:
+            labels[CHIPS_PER_HOST] = info.chips_per_host_bounds.replace(",", "x")
+
+    for axis, wrapped in zip("xyz", info.wrap):
+        labels[f"{WRAP_PREFIX}.{axis}"] = str(wrapped).lower()
+
+    # The precise GCE machine type beats the DMI product name when known
+    # (merge order: interconnect runs after the device labeler).
+    machine = info.raw.get("MACHINE_TYPE", "")
+    if machine:
+        labels[MACHINE] = machine
+    return labels

@@ -1,0 +1,45 @@
+"""Labeler composition primitives.
+
+Reference: internal/lm/labeler.go:28-30 (interface), list.go:22-46 (Merge with
+last-writer-wins ordering), empty.go:20-24. Ordering is the override
+mechanism: labels produced later in a merged list overwrite earlier ones.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Protocol, runtime_checkable
+
+from gpu_feature_discovery_tpu.lm.labels import Labels
+
+
+@runtime_checkable
+class Labeler(Protocol):
+    """Anything that can produce a label map (labeler.go:28-30)."""
+
+    def labels(self) -> Labels: ...
+
+
+class Empty:
+    """A labeler producing no labels (empty.go:20-24)."""
+
+    def labels(self) -> Labels:
+        return Labels()
+
+
+class _List:
+    """A list of labelers that is itself a Labeler (list.go:22-31).
+    Later labels win (list.go:33-46)."""
+
+    def __init__(self, labelers: Iterable[Labeler]):
+        self._labelers = list(labelers)
+
+    def labels(self) -> Labels:
+        merged = Labels()
+        for labeler in self._labelers:
+            merged.update(labeler.labels())
+        return merged
+
+
+def Merge(*labelers: Labeler) -> Labeler:
+    """Compose labelers into one; later labelers override earlier keys."""
+    return _List(labelers)

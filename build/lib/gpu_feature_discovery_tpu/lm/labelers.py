@@ -1,0 +1,25 @@
+"""Labeler composition root.
+
+Reference: internal/lm/labeler.go:33-45 (NewLabelers = Merge(NVML labeler,
+vGPU labeler)). Ours merges the device-backed TPU labeler with the
+host-interconnect labeler (the vGPU analog: multi-host slice metadata from
+the TPU VM environment — SURVEY.md section 5 "distributed communication
+backend" row). The timestamp labeler is merged in by the daemon loop, as in
+run() (main.go:158-168).
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+from gpu_feature_discovery_tpu.config.spec import Config
+from gpu_feature_discovery_tpu.lm.labeler import Empty, Labeler, Merge
+from gpu_feature_discovery_tpu.lm.tpu import new_tpu_labeler
+from gpu_feature_discovery_tpu.resource.types import Manager
+
+
+def new_labelers(
+    manager: Manager, interconnect: Optional[Labeler], config: Config
+) -> Labeler:
+    tpu_labeler = new_tpu_labeler(manager, config)
+    return Merge(tpu_labeler, interconnect if interconnect is not None else Empty())

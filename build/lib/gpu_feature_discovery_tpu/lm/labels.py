@@ -1,0 +1,81 @@
+"""Label map + atomic file output contract.
+
+Reference: internal/lm/labels.go:29-114. The output file is the entire API
+surface consumed by the NFD worker ("local" feature source), so the write must
+be atomic: NFD must never observe a torn file. The reference writes to
+``<dir>/gfd-tmp/gfd-XXXX`` then ``os.Rename``; we keep exactly that contract
+with a ``tfd-tmp`` staging dir and ``os.replace``.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import shutil
+import sys
+import tempfile
+from typing import TextIO
+
+TMP_SUBDIR = "tfd-tmp"
+TMP_PREFIX = "tfd-"
+OUTPUT_MODE = 0o644
+
+
+class Labels(dict):
+    """A ``key=value`` label map. Also implements the Labeler protocol
+    (reference: internal/lm/labels.go:31-34 — Labels is itself a Labeler)."""
+
+    def labels(self) -> "Labels":
+        return self
+
+    def write_to(self, output: TextIO) -> int:
+        """Serialize as one ``key=value`` line per label (labels.go:55-66)."""
+        total = 0
+        for key, value in self.items():
+            total += output.write(f"{key}={value}\n")
+        return total
+
+    def write_to_file(self, path: str) -> None:
+        """Write labels to ``path`` atomically; empty path → stdout
+        (labels.go:37-52)."""
+        if not path:
+            self.write_to(sys.stdout)
+            return
+        buf = io.StringIO()
+        self.write_to(buf)
+        _write_file_atomically(path, buf.getvalue().encode(), OUTPUT_MODE)
+
+
+def _write_file_atomically(path: str, contents: bytes, perm: int) -> None:
+    """Stage into ``<dir>/tfd-tmp`` then rename over the target
+    (labels.go:68-114). The staging dir lives on the same filesystem as the
+    target so the rename is atomic."""
+    abs_path = os.path.abspath(path)
+    tmp_dir = os.path.join(os.path.dirname(abs_path), TMP_SUBDIR)
+    os.makedirs(tmp_dir, exist_ok=True)
+
+    fd, tmp_name = tempfile.mkstemp(prefix=TMP_PREFIX, dir=tmp_dir)
+    try:
+        with os.fdopen(fd, "wb") as f:
+            f.write(contents)
+        os.replace(tmp_name, abs_path)
+    except BaseException:
+        try:
+            os.remove(tmp_name)
+        except OSError:
+            pass
+        raise
+    os.chmod(abs_path, perm)
+
+
+def remove_output_file(path: str) -> None:
+    """Delete the output file and the staging dir on clean shutdown
+    (reference: cmd/gpu-feature-discovery/main.go:212-232). An empty path
+    means labels went to stdout and there is nothing to clean up."""
+    if not path:
+        return
+    abs_path = os.path.abspath(path)
+    tmp_dir = os.path.join(os.path.dirname(abs_path), TMP_SUBDIR)
+    shutil.rmtree(tmp_dir, ignore_errors=True)
+    if os.path.exists(abs_path):
+        os.remove(abs_path)

@@ -1,0 +1,36 @@
+"""Machine-type labeler.
+
+Reference: internal/lm/machine-type.go:30-51 — read the DMI product name,
+spaces → dashes, warn-and-"unknown" on failure (never fail the pass). On
+GCE TPU VMs the DMI product name is "Google Compute Engine"; the
+interconnect labeler later overrides ``tpu.machine`` with the precise GCE
+machine type (ct5p-hightpu-4t, ...) when metadata is available — merge
+ordering makes that override safe.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from gpu_feature_discovery_tpu.lm.labels import Labels
+
+log = logging.getLogger("tfd.lm")
+
+MACHINE_TYPE_UNKNOWN = "unknown"
+MACHINE_TYPE_LABEL = "google.com/tpu.machine"
+
+
+def new_machine_type_labeler(machine_type_path: str) -> Labels:
+    try:
+        machine_type = _get_machine_type(machine_type_path)
+    except (OSError, UnicodeDecodeError) as e:
+        log.warning("error getting machine type from %s: %s", machine_type_path, e)
+        machine_type = MACHINE_TYPE_UNKNOWN
+    return Labels({MACHINE_TYPE_LABEL: machine_type.replace(" ", "-")})
+
+
+def _get_machine_type(path: str) -> str:
+    if not path:
+        return MACHINE_TYPE_UNKNOWN
+    with open(path) as f:
+        return f.read().strip()

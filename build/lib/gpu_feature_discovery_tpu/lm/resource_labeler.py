@@ -1,0 +1,155 @@
+"""Per-resource label families.
+
+Reference: internal/lm/resource.go — the resourceLabeler helper (:113-226)
+and the two resource labeler constructors (:36-111). A "resource" is a
+Kubernetes extended-resource name (``google.com/tpu``,
+``google.com/tpu-2x2x1``) and its label family is
+``<resource>.product/count/replicas/...``.
+
+Sharing semantics are carried over intact (resource.go:155-226): a resource
+listed under sharing.timeSlicing with replicas>1 gets its replica count
+published and a ``-SHARED`` product suffix unless renamed; a ``None``
+sharing config means sharing is structurally disabled (replicas label 0) —
+that is how slice-enabled chips' base labels are published
+(NewGPUResourceLabelerWithoutSharing, resource.go:29-33).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+from gpu_feature_discovery_tpu.config.spec import Sharing
+from gpu_feature_discovery_tpu.lm.labeler import Empty, Labeler
+from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.models.chips import family_for_generation, spec_for
+from gpu_feature_discovery_tpu.resource.types import Chip
+
+FULL_TPU_RESOURCE = "google.com/tpu"
+SLICE_PRODUCT_INFIX = "SLICE"
+
+
+class ResourceLabeler:
+    """Label-key factory + sharing logic for one resource name
+    (resourceLabeler struct, resource.go:113-226)."""
+
+    def __init__(self, resource_name: str, sharing: Optional[Sharing] = None):
+        self.resource_name = resource_name
+        self.sharing = sharing
+
+    # -- key/value helpers ---------------------------------------------------
+    def key(self, suffix: str) -> str:
+        return f"{self.resource_name}.{suffix}"
+
+    def single(self, suffix: str, value: object) -> Labels:
+        return Labels({self.key(suffix): f"{value}"})
+
+    def labels(self, suffix_values: Dict[str, object]) -> Labels:
+        return Labels({self.key(s): f"{v}" for s, v in suffix_values.items()})
+
+    def update_label(self, labels: Labels, suffix: str, value: object) -> None:
+        labels[self.key(suffix)] = f"{value}"
+
+    # -- base family ---------------------------------------------------------
+    def base_labels(self, count: int, *parts: str) -> Labels:
+        out = Labels()
+        out.update(self.product_label(*parts))
+        out.update(self.single("count", count))
+        out.update(self.single("replicas", self._replicas()))
+        return out
+
+    def product_label(self, *parts: str) -> Labels:
+        stripped = [p.replace(" ", "-") for p in parts if p]
+        if not stripped:
+            return Labels()
+        if self.is_shared() and not self.is_renamed():
+            stripped.append("SHARED")
+        return self.single("product", "-".join(stripped))
+
+    def _replicas(self) -> int:
+        if self.sharing_disabled():
+            return 0
+        info = self.replication_info()
+        if info is not None and info.replicas > 1:
+            return info.replicas
+        return 1
+
+    # -- sharing state -------------------------------------------------------
+    def sharing_disabled(self) -> bool:
+        return self.sharing is None
+
+    def replication_info(self):
+        if self.sharing is None:
+            return None
+        return self.sharing.replication_info(self.resource_name)
+
+    def is_shared(self) -> bool:
+        info = self.replication_info()
+        return info is not None and info.replicas > 1
+
+    def is_renamed(self) -> bool:
+        info = self.replication_info()
+        return info is not None and bool(info.rename)
+
+
+def new_chip_resource_labeler(
+    sharing: Optional[Sharing], chip: Chip, count: int
+) -> Labeler:
+    """Full-chip resource labels (NewGPUResourceLabeler, resource.go:36-73):
+    product/count/replicas/memory + architecture family/generation labels,
+    plus TPU-specific tensorcores/sparsecores from the generation spec
+    tables."""
+    if count == 0:
+        return Empty()
+
+    model = chip.get_name()
+    memory_mb = chip.get_total_memory_mb()
+    rl = ResourceLabeler(FULL_TPU_RESOURCE, sharing)
+
+    labels = rl.base_labels(count, model)
+    if memory_mb:
+        labels.update(rl.single("memory", memory_mb))
+    labels.update(_architecture_labels(rl, chip))
+    return labels
+
+
+def new_slice_resource_labeler(
+    resource_name: str, sharing: Optional[Sharing], slice_dev: Chip, count: int
+) -> Labeler:
+    """Slice-partition resource labels (NewMIGResourceLabeler,
+    resource.go:76-111): product is <parent-model>-SLICE-<topology>; the
+    attribute family comes straight from get_attributes()."""
+    if count == 0:
+        return Empty()
+
+    parent = slice_dev.get_parent_chip()
+    model = parent.get_name()
+    topology = slice_dev.get_name()
+    rl = ResourceLabeler(resource_name, sharing)
+
+    labels = rl.base_labels(count, model, SLICE_PRODUCT_INFIX, topology)
+    labels.update(rl.labels(slice_dev.get_attributes()))
+    return labels
+
+
+def _architecture_labels(rl: ResourceLabeler, chip: Chip) -> Labels:
+    """family/generation labels (newArchitectureLabels, resource.go:239-258);
+    generation 0 → no labels, unknown generation → family "undefined"
+    (getArchFamily fallthrough)."""
+    generation, variant = chip.get_generation()
+    if generation == 0:
+        return Labels()
+
+    family = family_for_generation(generation, variant)
+    labels = rl.labels(
+        {
+            "family": family,
+            "generation.major": generation,
+            "generation.minor": variant,
+        }
+    )
+    spec = spec_for(family)
+    if spec is not None:
+        labels.update(
+            rl.labels({"tensorcores": spec.tensorcores, "sparsecores": spec.sparsecores})
+        )
+    return labels

@@ -1,0 +1,22 @@
+"""Timestamp labeler.
+
+Reference: internal/lm/timestamp.go:29-37 — ``gfd.timestamp`` → our
+``google.com/tfd.timestamp``; suppressed by --no-timestamp. The timestamp is
+the liveness signal e2e tests watch for on the Node object.
+"""
+
+from __future__ import annotations
+
+import time
+
+from gpu_feature_discovery_tpu.config.spec import Config
+from gpu_feature_discovery_tpu.lm.labeler import Empty, Labeler
+from gpu_feature_discovery_tpu.lm.labels import Labels
+
+TIMESTAMP_LABEL = "google.com/tfd.timestamp"
+
+
+def new_timestamp_labeler(config: Config) -> Labeler:
+    if config.flags.tfd.no_timestamp:
+        return Empty()
+    return Labels({TIMESTAMP_LABEL: str(int(time.time()))})

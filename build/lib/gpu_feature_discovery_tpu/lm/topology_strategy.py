@@ -1,0 +1,201 @@
+"""Slice/topology strategy engine — the MIG-strategy analog.
+
+Reference: internal/lm/mig-strategy.go (all of it) with the mapping set by
+BASELINE.json: strategy ``single`` expects the node's chips bound into ONE
+uniform slice shape and overloads the ``google.com/tpu.*`` labels with that
+slice's data; ``mixed`` publishes per-shape resources
+``google.com/tpu-<topology>.*``; ``none`` publishes full-chip labels only.
+
+The all-or-invalid validation of ``single`` is carried over exactly
+(mig-strategy.go:181-241): any slice-enabled chip exposing no slice, a mix
+of slice-enabled and plain chips, or more than one slice shape on the node
+each yield the INVALID label set (product ``<model>-SLICE-INVALID``,
+count/replicas/memory 0, mig-strategy.go:243-262 analog).
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+from typing import Dict, Optional
+
+from gpu_feature_discovery_tpu.config.spec import (
+    Config,
+    TOPOLOGY_STRATEGY_MIXED,
+    TOPOLOGY_STRATEGY_NONE,
+    TOPOLOGY_STRATEGY_SINGLE,
+)
+from gpu_feature_discovery_tpu.lm.labeler import Empty, Labeler, Merge
+from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.lm.resource_labeler import (
+    FULL_TPU_RESOURCE,
+    ResourceLabeler,
+    SLICE_PRODUCT_INFIX,
+    new_chip_resource_labeler,
+    new_slice_resource_labeler,
+)
+from gpu_feature_discovery_tpu.resource.types import Chip, Manager
+from gpu_feature_discovery_tpu.topology.slice_info import SliceInfo
+
+log = logging.getLogger("tfd.lm")
+
+STRATEGY_LABEL = "google.com/tpu.topology.strategy"
+MIXED_RESOURCE_PREFIX = "google.com/tpu-"
+
+
+def new_strategy_labeler(strategy: str) -> Labeler:
+    """``topology.strategy`` label; absent under ``none``
+    (internal/lm/strategy.go:20-28)."""
+    if strategy == TOPOLOGY_STRATEGY_NONE:
+        return Empty()
+    return Labels({STRATEGY_LABEL: strategy})
+
+
+@dataclass
+class _SliceResource:
+    """Tracks one slice shape while counting (migResource, mig-strategy.go:37-41)."""
+
+    name: str = ""
+    device: Optional[Chip] = None
+    count: int = 0
+
+
+def new_resource_labeler(manager: Manager, config: Config) -> Labeler:
+    """Top-level dispatch (NewResourceLabeler, mig-strategy.go:45-77):
+    full-chip labels always, slice labels according to the strategy."""
+    chips = manager.get_chips()
+    if not chips:
+        return Empty()
+
+    # One memoized SliceInfo per labeling pass: every is_slice_enabled /
+    # get_slices probe is real device I/O on a libtpu backend, so the chip
+    # grouping is shared by the full-chip and strategy labelers.
+    info = SliceInfo(manager)
+
+    strategy = config.flags.tpu_topology_strategy
+    full_chip_labels = _new_chip_labelers(info, config)
+
+    if strategy == TOPOLOGY_STRATEGY_NONE:
+        return full_chip_labels
+
+    return Merge(full_chip_labels, _new_slice_strategy_labeler(info, config))
+
+
+def _new_slice_strategy_labeler(info: SliceInfo, config: Config) -> Labeler:
+    """Strategy switch (newMigLabeler, mig-strategy.go:82-108)."""
+    strategy = config.flags.tpu_topology_strategy
+    if strategy == TOPOLOGY_STRATEGY_SINGLE:
+        labeler = _new_single_strategy_labeler(info, config)
+    elif strategy == TOPOLOGY_STRATEGY_MIXED:
+        labeler = _new_mixed_strategy_labeler(info, config)
+    else:
+        raise ValueError(f"unknown strategy: {strategy}")
+    return Merge(new_strategy_labeler(strategy), labeler)
+
+
+def _new_chip_labelers(info: SliceInfo, config: Config) -> Labeler:
+    """Full-chip labelers grouped by model (newGPULabelers,
+    mig-strategy.go:113-179): slice-enabled chips' labels are published
+    without sharing info; plain chips override same-model entries WITH
+    sharing info; counts span both groups; multiple models warn."""
+    chips_map = info.get_chips_map()
+
+    if not (chips_map[True] or chips_map[False]):
+        raise ValueError("no TPU chips detected")
+
+    counts: Dict[str, int] = {}
+    slice_bound: Dict[str, Chip] = {}
+    for chip in chips_map[True]:
+        name = chip.get_name()
+        slice_bound[name] = chip
+        counts[name] = counts.get(name, 0) + 1
+
+    plain: Dict[str, Chip] = {}
+    for chip in chips_map[False]:
+        name = chip.get_name()
+        plain[name] = chip
+        counts[name] = counts.get(name, 0) + 1
+
+    if len(counts) > 1:
+        log.warning("Multiple chip models detected: %s", sorted(counts))
+
+    labelers = []
+    for name, chip in slice_bound.items():
+        labelers.append(new_chip_resource_labeler(None, chip, counts[name]))
+    for name, chip in plain.items():
+        labelers.append(new_chip_resource_labeler(config.sharing, chip, counts[name]))
+
+    # Flattened eagerly like the reference (labelers.Labels(),
+    # mig-strategy.go:178) so later merges see one label map.
+    return Merge(*labelers).labels()
+
+
+def _new_single_strategy_labeler(info: SliceInfo, config: Config) -> Labeler:
+    """strategy=single (newMigStrategySingleLabeler, mig-strategy.go:181-241)."""
+    enabled = info.get_chips_with_slices_enabled()
+
+    # No slice-bound chips: equivalent to strategy none.
+    if not enabled:
+        return Empty()
+
+    if info.any_slice_enabled_chip_is_empty():
+        return _new_invalid_strategy_labeler(
+            enabled[0], "at least one chip is slice-bound but exposes no slice"
+        )
+
+    if info.get_chips_with_slices_disabled():
+        return _new_invalid_strategy_labeler(
+            enabled[0], "chips with slices enabled and disabled detected"
+        )
+
+    resources = _count_slice_resources(info, lambda topo: FULL_TPU_RESOURCE)
+    if len(resources) != 1:
+        return _new_invalid_strategy_labeler(
+            enabled[0], "more than one slice topology present on node"
+        )
+
+    return _new_slice_device_labelers(resources, config)
+
+
+def _new_mixed_strategy_labeler(info: SliceInfo, config: Config) -> Labeler:
+    """strategy=mixed (newMigStrategyMixedLabeler, mig-strategy.go:264-295):
+    slice-bound-but-empty chips are ignored; each shape becomes its own
+    ``google.com/tpu-<topology>`` resource."""
+    resources = _count_slice_resources(
+        info, lambda topo: MIXED_RESOURCE_PREFIX + topo
+    )
+    return _new_slice_device_labelers(resources, config)
+
+
+def _count_slice_resources(info: SliceInfo, name_fn) -> Dict[str, _SliceResource]:
+    resources: Dict[str, _SliceResource] = {}
+    for slice_dev in info.get_all_slices():
+        topo = slice_dev.get_name()
+        res = resources.setdefault(
+            topo, _SliceResource(name=name_fn(topo), device=slice_dev)
+        )
+        res.count += 1
+    return resources
+
+
+def _new_slice_device_labelers(
+    resources: Dict[str, _SliceResource], config: Config
+) -> Labeler:
+    labelers = [
+        new_slice_resource_labeler(res.name, config.sharing, res.device, res.count)
+        for res in resources.values()
+    ]
+    return Merge(*labelers)
+
+
+def _new_invalid_strategy_labeler(chip: Chip, reason: str) -> Labeler:
+    """INVALID label set (newInvalidMigStrategyLabeler,
+    mig-strategy.go:243-262)."""
+    log.warning("Invalid configuration detected for topology strategy single: %s", reason)
+    model = chip.get_name()
+    rl = ResourceLabeler(FULL_TPU_RESOURCE, sharing=None)
+    labels = rl.product_label(model, SLICE_PRODUCT_INFIX, "INVALID")
+    rl.update_label(labels, "count", 0)
+    rl.update_label(labels, "replicas", 0)
+    rl.update_label(labels, "memory", 0)
+    return labels

@@ -1,0 +1,49 @@
+"""Device-backed labeler: init → probe everything → shutdown.
+
+Reference: internal/lm/nvml.go:29-72 (NewNVMLLabeler). All hardware probing
+happens eagerly inside this constructor between manager.init() and
+manager.shutdown(); the returned labeler is a static label map. Zero chips →
+empty label set (the Null/fallback path), so non-TPU nodes publish nothing.
+"""
+
+from __future__ import annotations
+
+from gpu_feature_discovery_tpu.config.spec import Config
+from gpu_feature_discovery_tpu.lm.health import new_health_labeler
+from gpu_feature_discovery_tpu.lm.labeler import Empty, Labeler, Merge
+from gpu_feature_discovery_tpu.lm.machine_type import new_machine_type_labeler
+from gpu_feature_discovery_tpu.lm.topology_strategy import new_resource_labeler
+from gpu_feature_discovery_tpu.lm.versions import (
+    new_slice_capability_labeler,
+    new_version_labeler,
+)
+from gpu_feature_discovery_tpu.resource.types import Manager
+from gpu_feature_discovery_tpu.utils.timing import timed
+
+
+def new_tpu_labeler(manager: Manager, config: Config) -> Labeler:
+    with timed("tpu.init"):
+        manager.init()
+    try:
+        chips = manager.get_chips()
+        if not chips:
+            return Empty()
+
+        with timed("tpu.machine_type"):
+            machine_type = new_machine_type_labeler(config.flags.tfd.machine_type_file)
+        with timed("tpu.versions"):
+            versions = new_version_labeler(manager)
+        with timed("tpu.slice_capability"):
+            slice_capability = new_slice_capability_labeler(manager)
+        with timed("tpu.resources"):
+            resources = new_resource_labeler(manager, config)
+        with timed("tpu.health"):
+            health = new_health_labeler(manager, config)
+
+        # Flatten now: every probe happens inside init/shutdown.
+        return Merge(
+            machine_type, versions, slice_capability, resources, health
+        ).labels()
+    finally:
+        with timed("tpu.shutdown"):
+            manager.shutdown()

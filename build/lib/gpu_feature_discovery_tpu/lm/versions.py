@@ -1,0 +1,55 @@
+"""Driver/runtime version + slice-capability labelers.
+
+Reference: internal/lm/nvml.go:75-137. The GPU split (CUDA driver version
+from the kernel driver, CUDA runtime version from the library) maps to the
+TPU stack as libtpu version ("driver") and PJRT C API version ("runtime") —
+SURVEY.md section 2.2 NVML row: one libtpu/PJRT manager replaces both NVML
+and libcuda.
+"""
+
+from __future__ import annotations
+
+from gpu_feature_discovery_tpu.lm.labeler import Empty, Labeler
+from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.resource.types import Manager
+
+DRIVER_MAJOR = "google.com/tpu.driver.major"
+DRIVER_MINOR = "google.com/tpu.driver.minor"
+DRIVER_REV = "google.com/tpu.driver.rev"
+RUNTIME_MAJOR = "google.com/tpu.runtime.major"
+RUNTIME_MINOR = "google.com/tpu.runtime.minor"
+SLICE_CAPABLE = "google.com/tpu.slice.capable"
+
+
+def new_version_labeler(manager: Manager) -> Labels:
+    """libtpu "X.Y[.Z]" → driver.major/minor/rev; PJRT (major, minor) →
+    runtime.major/minor (nvml.go:75-106 semantics, including the 2-or-3
+    component version format check)."""
+    driver_version = manager.get_driver_version()
+    parts = driver_version.split(".")
+    if len(parts) < 2 or len(parts) > 3:
+        raise ValueError(
+            f'error getting driver version: version "{driver_version}" does not '
+            'match format "X.Y[.Z]"'
+        )
+    runtime_major, runtime_minor = manager.get_runtime_version()
+    return Labels(
+        {
+            DRIVER_MAJOR: parts[0],
+            DRIVER_MINOR: parts[1],
+            DRIVER_REV: parts[2] if len(parts) > 2 else "",
+            RUNTIME_MAJOR: str(runtime_major),
+            RUNTIME_MINOR: str(runtime_minor),
+        }
+    )
+
+
+def new_slice_capability_labeler(manager: Manager) -> Labeler:
+    """slice.capable truth table mirrors mig.capable (nvml.go:110-137): true
+    iff any chip on the node supports slice partitioning; empty with no
+    chips."""
+    chips = manager.get_chips()
+    if not chips:
+        return Empty()
+    capable = any(chip.is_slice_capable() for chip in chips)
+    return Labels({SLICE_CAPABLE: str(capable).lower()})

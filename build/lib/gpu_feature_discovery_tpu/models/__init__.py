@@ -1,0 +1,11 @@
+from gpu_feature_discovery_tpu.models.chips import ChipSpec, CHIP_SPECS, spec_for, family_for_generation
+from gpu_feature_discovery_tpu.models.accelerator_types import AcceleratorType, parse_accelerator_type
+
+__all__ = [
+    "ChipSpec",
+    "CHIP_SPECS",
+    "spec_for",
+    "family_for_generation",
+    "AcceleratorType",
+    "parse_accelerator_type",
+]

@@ -1,0 +1,187 @@
+"""Cloud TPU accelerator-type and topology string parsing.
+
+Accelerator types name a whole slice: ``v4-8``, ``v5p-128``, ``v5litepod-16``,
+``v6e-256`` — the trailing number is TensorCore count for v2-v4/v5p and chip
+count for v5e/v6e (Google's published convention). Topology strings name the
+chip grid: ``2x2x1`` (3D ICI generations) or ``4x4`` (2D generations).
+
+This module is pure parsing/arithmetic so the strategy engine and the
+interconnect labeler can derive chips/hosts/topology without touching
+hardware. It plays the role the MIG profile-name parsing plays in the
+reference (profile "1g.10gb" → slices/memory; here "v5p-128" → chips/hosts).
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+from gpu_feature_discovery_tpu.models.chips import ChipSpec, hosts_for, spec_for
+
+_ACCEL_RE = re.compile(r"^(?P<fam>[a-z0-9]+?)(?:pod)?-(?P<num>\d+)$")
+
+# Families whose accelerator-type suffix counts TensorCores, not chips.
+_CORE_COUNTED = {"v2", "v3", "v4", "v5p"}
+
+# Largest plausible accelerator-type suffix (v5p-12288 is the biggest
+# published pod; 4x headroom for future generations). Guards the
+# factorization fallback against unbounded metadata-supplied values.
+_MAX_SUFFIX = 65536
+
+
+@dataclass(frozen=True)
+class AcceleratorType:
+    name: str                     # normalized, e.g. "v5p-128"
+    spec: ChipSpec
+    chips: int                    # total chips in the slice
+    tensorcores: int              # total TensorCores in the slice
+    hosts: int                    # TPU VM hosts backing the slice
+    topology: Tuple[int, ...]     # chip grid, e.g. (4, 4, 4)
+
+    @property
+    def topology_str(self) -> str:
+        return "x".join(str(d) for d in self.topology)
+
+    @property
+    def multi_host(self) -> bool:
+        return self.hosts > 1
+
+
+# Provisioned topologies that the power-of-two rule cannot derive, keyed by
+# (family, chips). Cloud TPU ships a handful of non-power-of-two slice
+# shapes (e.g. v5e-24 is a 4x6 grid, not 1x1x24) and they must come from a
+# table, not arithmetic — the explicit-range spirit of getArchFamily
+# (/root/reference/internal/lm/resource.go:261-284).
+_NON_POW2_TOPOLOGY: dict = {
+    ("v5e", 12): (2, 6),
+    ("v5e", 24): (4, 6),
+    ("v6e", 12): (2, 6),
+    ("v6e", 24): (4, 6),
+    ("v4", 768): (8, 8, 12),      # v4-1536, the published non-cube pod shape
+    ("v5p", 6144): (16, 16, 24),  # v5p-12288, the full-pod shape
+}
+
+
+def _balanced_factorization(n: int, ndims: int) -> Tuple[int, ...]:
+    """Most-cubic factorization of ``n`` into ``ndims`` axes (ascending).
+
+    Last-resort fallback for non-power-of-two sizes absent from the table:
+    a near-cube grid (24 → 2x3x4) is the shape family Cloud TPU actually
+    provisions, unlike a degenerate 1x1xN line. Always succeeds: d=1 is a
+    valid first axis at every level, so (1, ..., 1, n) is the worst case.
+    """
+
+    def search(remaining: int, axes_left: int, minimum: int):
+        # May return None on RECURSIVE calls (ascending-order constraint:
+        # e.g. search(5, 2, 2) has no divisor of 5 in [2, sqrt(5)]); never
+        # at the top level, where minimum=1 admits (1, ..., 1, n).
+        if axes_left == 1:
+            return (remaining,)
+        pick = None
+        d = minimum
+        while d * d ** (axes_left - 1) <= remaining:
+            if remaining % d == 0:
+                rest = search(remaining // d, axes_left - 1, d)
+                if rest is not None:
+                    cand = (d,) + rest
+                    if pick is None or max(cand) - min(cand) < max(pick) - min(pick):
+                        pick = cand
+            d += 1
+        return pick
+
+    return tuple(search(n, ndims, 1))
+
+
+def _default_topology(spec: ChipSpec, chips: int) -> Tuple[int, ...]:
+    """Factor a chip count into the generation's default grid shape.
+
+    Matches the shapes Cloud TPU provisions: power-of-two sizes distribute
+    the exponent over the ICI axes (3D generations v4/v5p: 4 → 2x2x1,
+    8 → 2x2x2, 16 → 2x2x4, 32 → 2x4x4, 64 → 4x4x4; 2D generations v5e/v6e:
+    4 → 2x2, 8 → 2x4, 16 → 4x4); non-power-of-two sizes come from the
+    explicit _NON_POW2_TOPOLOGY table, with a balanced factorization as the
+    last resort for unlisted sizes.
+    """
+    n = max(1, chips)
+    ndims = spec.ici_dims
+    tabled = _NON_POW2_TOPOLOGY.get((spec.family, n))
+    if tabled is not None:
+        return tabled
+    if n & (n - 1) == 0:  # power of two: distribute the exponent over axes
+        dims = list(_pow2_dims(n, ndims))
+    else:
+        dims = list(_balanced_factorization(n, ndims))
+    # Write order: non-1 axes ascending, trailing 1s last (2x2x1, 2x2x4, 2x4).
+    non_one = sorted(d for d in dims if d > 1)
+    ones = [d for d in dims if d == 1]
+    return tuple(non_one + ones) if non_one else tuple(ones)
+
+
+def _pow2_dims(n: int, ndims: int) -> Tuple[int, ...]:
+    base, rem = divmod(n.bit_length() - 1, ndims)
+    return tuple(1 << (base + (1 if i < rem else 0)) for i in range(ndims))
+
+
+def parse_accelerator_type(name: str) -> Optional[AcceleratorType]:
+    """Parse e.g. "v4-8", "v5p-128", "v5litepod-16", "v6e-8"; None if the
+    string is not a TPU accelerator type."""
+    m = _ACCEL_RE.match(name.strip().lower())
+    if not m:
+        return None
+    fam = m.group("fam")
+    if fam == "v5lite":
+        fam = "v5e"
+    if fam == "v5litepod":
+        fam = "v5e"
+    spec = spec_for(fam)
+    if spec is None:
+        return None
+    num = int(m.group("num"))
+    if num <= 0 or num > _MAX_SUFFIX:
+        # The suffix arrives from env/metadata: a corrupt or hostile value
+        # must be rejected, not fed to the O(sqrt(n)) factorization below
+        # (and no real accelerator type is anywhere near the cap).
+        return None
+
+    if spec.family in _CORE_COUNTED:
+        # Suffix counts TensorCores and must cover whole chips (v4-7 is not a
+        # real accelerator type; rejecting beats emitting inconsistent labels).
+        if num % spec.tensorcores != 0:
+            return None
+        tensorcores = num
+        chips = num // spec.tensorcores
+    else:
+        chips = num
+        tensorcores = num * spec.tensorcores
+
+    hosts = hosts_for(spec, chips)
+    topology = _default_topology(spec, chips)
+    return AcceleratorType(
+        name=f"{spec.family}-{num}",
+        spec=spec,
+        chips=chips,
+        tensorcores=tensorcores,
+        hosts=hosts,
+        topology=topology,
+    )
+
+
+def parse_topology(topology: str) -> Optional[Tuple[int, ...]]:
+    """Parse a chip-grid string like "2x2x2" or "4x4"; None on malformed."""
+    parts = topology.strip().lower().split("x")
+    try:
+        dims = tuple(int(p) for p in parts)
+    except ValueError:
+        return None
+    if not dims or any(d <= 0 for d in dims):
+        return None
+    return dims
+
+
+def chips_in_topology(topology: str) -> Optional[int]:
+    dims = parse_topology(topology)
+    if dims is None:
+        return None
+    return math.prod(dims)

@@ -1,0 +1,105 @@
+"""TPU generation spec tables — the hardware "models" of this framework.
+
+This is the TPU analog of the reference's per-architecture knowledge: where
+GFD derives an arch family from the CUDA compute capability
+(internal/lm/resource.go:261-284 getArchFamily) and reads memory/attributes
+from NVML at runtime, TPU generations have fixed, publicly documented
+per-chip characteristics, so we table them. The tables also back the mock
+fixtures (resource/testing.py) and the per-generation attribute fallbacks
+when PJRT attribute coverage is missing (SURVEY.md "riskiest unknowns" (a)).
+
+Values are the published per-chip numbers for Cloud TPU:
+- v2: 8 GiB HBM/chip,  2 TensorCores, 2D 16x16 torus pods
+- v3: 16 GiB HBM/chip, 2 TensorCores, 2D 32x32 torus pods
+- v4: 32 GiB HBM/chip, 2 TensorCores, 3D torus (4x4x4 per 64-chip cube)
+- v5e: 16 GiB HBM/chip, 1 TensorCore, 2D 16x16 slices
+- v5p: 95 GiB HBM/chip, 2 TensorCores, 3D torus up to 16x20x28
+- v6e (Trillium): 32 GiB HBM/chip, 1 TensorCore, 2D 16x16 slices
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, Optional, Tuple
+
+
+@dataclass(frozen=True)
+class ChipSpec:
+    """Static description of one TPU chip generation/variant."""
+
+    family: str                 # "v5p" — arch-family label analog
+    generation: int             # 5    — compute.major analog
+    variant_rank: int           # 0 for base/e (efficiency), 1 for p (performance)
+    product: str                # "tpu-v5p" — product label stem
+    hbm_mb: int                 # per-chip HBM, MiB
+    tensorcores: int            # TensorCores per chip
+    sparsecores: int            # SparseCores per chip
+    chips_per_host: int         # chips per TPU VM host in multi-host slices
+    max_single_host_chips: int  # largest slice served by a single host
+    ici_dims: int               # ICI torus dimensionality (2 or 3)
+    ici_links_per_chip: int     # ICI links out of each chip
+    slice_capable: bool         # supports multi-chip slicing / sub-slices
+    default_topology: Tuple[int, int, int]  # single-host topology (x, y, z)
+
+    @property
+    def accelerator_prefix(self) -> str:
+        return self.family
+
+
+# Keyed by family string as it appears in accelerator types ("v5litepod" is
+# normalized to "v5e" by accelerator_types.parse_accelerator_type).
+CHIP_SPECS: Dict[str, ChipSpec] = {
+    "v2": ChipSpec("v2", 2, 0, "tpu-v2", 8 * 1024, 2, 0, 4, 4, 2, 4, True, (2, 2, 1)),
+    "v3": ChipSpec("v3", 3, 0, "tpu-v3", 16 * 1024, 2, 0, 4, 4, 2, 4, True, (2, 2, 1)),
+    "v4": ChipSpec("v4", 4, 0, "tpu-v4", 32 * 1024, 2, 4, 4, 4, 3, 6, True, (2, 2, 1)),
+    # v5e/v6e single-host machine shapes go up to 8 chips (ct5lp-hightpu-8t /
+    # ct6e-standard-8t); multi-host slices are provisioned 4 chips per VM.
+    "v5e": ChipSpec("v5e", 5, 0, "tpu-v5e", 16 * 1024, 1, 0, 4, 8, 2, 4, True, (2, 4, 1)),
+    "v5p": ChipSpec("v5p", 5, 1, "tpu-v5p", 95 * 1024, 2, 4, 4, 4, 3, 6, True, (2, 2, 1)),
+    "v6e": ChipSpec("v6e", 6, 0, "tpu-v6e", 32 * 1024, 1, 2, 4, 8, 2, 4, True, (2, 4, 1)),
+}
+
+# Map PJRT/JAX device-kind strings (e.g. "TPU v4", "TPU v5 lite", "TPU v5p",
+# "TPU v5e", "TPU v6 lite") to spec table keys.
+_DEVICE_KIND_ALIASES: Dict[str, str] = {
+    "tpu v2": "v2",
+    "tpu v3": "v3",
+    "tpu v4": "v4",
+    "tpu v4 lite": "v4",
+    "tpu v5": "v5p",
+    "tpu v5p": "v5p",
+    "tpu v5 lite": "v5e",
+    "tpu v5e": "v5e",
+    "tpu v5litepod": "v5e",
+    "tpu v6 lite": "v6e",
+    "tpu v6e": "v6e",
+}
+
+
+def spec_for(family_or_kind: str) -> Optional[ChipSpec]:
+    """Resolve a family string ("v5p") or a PJRT device-kind ("TPU v5p")
+    to its ChipSpec; None when unknown (caller falls back to generic labels,
+    mirroring getArchFamily's "undefined" return)."""
+    key = family_or_kind.strip().lower()
+    if key in CHIP_SPECS:
+        return CHIP_SPECS[key]
+    if key in _DEVICE_KIND_ALIASES:
+        return CHIP_SPECS[_DEVICE_KIND_ALIASES[key]]
+    return None
+
+
+def hosts_for(spec: ChipSpec, chips: int) -> int:
+    """TPU VM hosts backing a slice of ``chips`` chips: 1 while a single
+    host machine shape covers it, else ceil over the multi-host chips/VM."""
+    if chips <= spec.max_single_host_chips:
+        return 1
+    return -(-chips // spec.chips_per_host)
+
+
+def family_for_generation(generation: int, variant_rank: int) -> str:
+    """Arch-family name from (generation, variant) — the direct analog of
+    getArchFamily(computeMajor, computeMinor) (resource.go:261-284)."""
+    for spec in CHIP_SPECS.values():
+        if spec.generation == generation and spec.variant_rank == variant_rank:
+            return spec.family
+    return "undefined"

@@ -1,0 +1,8 @@
+from gpu_feature_discovery_tpu.native.shim import (
+    NativeShim,
+    ProbeResult,
+    load_native,
+    probe_libtpu,
+)
+
+__all__ = ["NativeShim", "ProbeResult", "load_native", "probe_libtpu"]

@@ -1,0 +1,77 @@
+/* PCI capability-list walker over a raw config space.
+ *
+ * C++ twin of pci/pciutil.py's PCIDevice.get_vendor_specific_capability,
+ * re-designed from the reference's pure-Go walker (internal/vgpu/
+ * pciutil.go:115-151): status-register capability bit at byte 0x06, first
+ * capability pointer at byte 0x34, then a linked list of
+ * {id, next, length, ...} records with loop and 0xff-corruption detection.
+ */
+
+#include "tfd_native.h"
+
+#include <string.h>
+
+namespace {
+
+constexpr size_t kMinConfigLen = 256;
+constexpr size_t kStatusByte = 0x06;
+constexpr unsigned char kStatusCapabilityList = 0x10;
+constexpr size_t kCapabilityListPtr = 0x34;
+constexpr size_t kCapIdOffset = 0;
+constexpr size_t kCapNextOffset = 1;
+constexpr size_t kCapLengthOffset = 2;
+constexpr unsigned char kVendorSpecificCapId = 0x09;
+
+}  // namespace
+
+extern "C" int tfd_pci_vendor_capability(const char* config, size_t config_len,
+                                         char* out, size_t out_len) {
+  if (config == nullptr || out == nullptr) {
+    return -TFD_ERROR_INVALID_ARGUMENT;
+  }
+  if (config_len < kMinConfigLen) {
+    return -TFD_ERROR_CONFIG_TOO_SHORT;
+  }
+  const unsigned char* cfg = reinterpret_cast<const unsigned char*>(config);
+
+  if ((cfg[kStatusByte] & kStatusCapabilityList) == 0) {
+    return 0;
+  }
+
+  bool visited[256] = {false};
+  size_t pos = cfg[kCapabilityListPtr];
+  while (pos != 0) {
+    if (pos + kCapLengthOffset >= config_len) {
+      break; /* corrupt pointer past the config space */
+    }
+    if (visited[pos]) {
+      break; /* chain looped */
+    }
+    unsigned char cap_id = cfg[pos + kCapIdOffset];
+    unsigned char next = cfg[pos + kCapNextOffset];
+    if (cap_id == 0xff) {
+      break; /* chain broken */
+    }
+    if (cap_id == kVendorSpecificCapId) {
+      /* Byte 2 is a length field only for vendor-specific capabilities
+       * (standard caps keep capability data there), so read/validate it
+       * only inside this branch. */
+      unsigned char length = cfg[pos + kCapLengthOffset];
+      if (length < 3) {
+        break; /* record shorter than its own header: corrupt */
+      }
+      size_t n = length;
+      if (pos + n > config_len) {
+        n = config_len - pos; /* clamp a lying length field */
+      }
+      if (n > out_len) {
+        return -TFD_ERROR_BUFFER_TOO_SMALL;
+      }
+      memcpy(out, cfg + pos, n);
+      return static_cast<int>(n);
+    }
+    visited[pos] = true;
+    pos = next;
+  }
+  return 0;
+}

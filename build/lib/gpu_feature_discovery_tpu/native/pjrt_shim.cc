@@ -1,0 +1,606 @@
+/* libtpu/PJRT probe: dlopen + GetPjrtApi version read, no client creation.
+ *
+ * The reference's native binding dlopens libcuda.so.1 lazily and probes
+ * cuInit before first use (internal/cuda/api.go:24-56). The TPU analog
+ * probes GetPjrtApi — the single well-known entry point every PJRT plugin
+ * (libtpu included) must export — and reads the API version straight off
+ * the returned struct header. Creating a PJRT client here would grab the
+ * TPU from the workload that owns it (SURVEY.md section 7 hard part #1),
+ * so the probe stops at the version struct.
+ */
+
+#include "tfd_native.h"
+
+#include <dlfcn.h>
+
+namespace {
+
+/* Minimal inline mirror of the PJRT C API header layout (the reference
+ * declares CUDA types inline the same way, cuda.go:26-101). The version
+ * fields live in a fixed-offset prefix that is ABI-stable by design:
+ * PJRT_Api begins {size_t struct_size; void* extension_start;
+ * PJRT_Api_Version pjrt_api_version;} and PJRT_Api_Version begins
+ * {size_t struct_size; void* extension_start; int major; int minor;}. */
+struct PjrtApiVersionPrefix {
+  size_t struct_size;
+  void* extension_start;
+  int major_version;
+  int minor_version;
+};
+
+struct PjrtApiPrefix {
+  size_t struct_size;
+  void* extension_start;
+  PjrtApiVersionPrefix version;
+};
+
+typedef const PjrtApiPrefix* (*GetPjrtApiFn)();
+
+/* Function-table prefix of PJRT_Api, through the entry points enumeration
+ * needs. The PJRT C API is append-only with struct_size versioning, so
+ * these offsets are stable for every plugin new enough to pass the
+ * struct_size check in tfd_enumerate (the same contract the reference
+ * leans on when it binds exactly 7 CUDA entry points by name,
+ * cuda.go:103-109 — here the "names" are fixed table slots). */
+struct PjrtApiTable {
+  size_t struct_size;
+  void* extension_start;
+  PjrtApiVersionPrefix version;
+  void* error_destroy;
+  void* error_message;
+  void* error_getcode;
+  void* plugin_initialize;
+  void* plugin_attributes;
+  void* event_destroy;
+  void* event_isready;
+  void* event_error;
+  void* event_await;
+  void* event_onready;
+  void* client_create;
+  void* client_destroy;
+  void* client_platform_name;
+  void* client_process_index;
+  void* client_platform_version;
+  void* client_devices;
+  void* client_addressable_devices;
+  void* client_lookup_device;
+  void* client_lookup_addressable_device;
+  void* client_addressable_memories;
+  void* client_compile;
+  void* client_default_device_assignment;
+  void* client_buffer_from_host_buffer;
+  void* device_description_id;
+  void* device_description_process_index;
+  void* device_description_attributes;
+  void* device_description_kind;
+  void* device_description_debug_string;
+  void* device_description_to_string;
+  void* device_get_description;
+};
+
+/* Argument structs, inline-declared like the reference's CUDA types
+ * (cuda.go:26-101). Every PJRT call takes {struct_size, extension_start,
+ * ...} and returns a PJRT_Error* (NULL = success). */
+struct ErrorDestroyArgs { size_t struct_size; void* ext; void* error; };
+struct PluginInitializeArgs { size_t struct_size; void* ext; };
+struct ClientCreateArgs {
+  size_t struct_size;
+  void* ext;
+  const void* create_options;
+  size_t num_options;
+  void* kv_get_callback;
+  void* kv_get_user_arg;
+  void* kv_put_callback;
+  void* kv_put_user_arg;
+  void* client;  /* out */
+  /* Appended by PJRT 0.57+ (non-blocking KV try-get); current plugins
+   * validate struct_size against the full 11-field layout. */
+  void* kv_try_get_callback;
+  void* kv_try_get_user_arg;
+};
+struct ClientDestroyArgs { size_t struct_size; void* ext; void* client; };
+struct ClientPlatformNameArgs {
+  size_t struct_size;
+  void* ext;
+  void* client;
+  const char* platform_name;  /* out */
+  size_t platform_name_size;  /* out */
+};
+struct ClientAddressableDevicesArgs {
+  size_t struct_size;
+  void* ext;
+  void* client;
+  void* const* addressable_devices;  /* out */
+  size_t num_addressable_devices;    /* out */
+};
+struct DeviceGetDescriptionArgs {
+  size_t struct_size;
+  void* ext;
+  void* device;
+  void* device_description;  /* out */
+};
+struct DeviceDescriptionIdArgs {
+  size_t struct_size;
+  void* ext;
+  void* device_description;
+  int id;  /* out */
+};
+struct DeviceDescriptionProcessIndexArgs {
+  size_t struct_size;
+  void* ext;
+  void* device_description;
+  int process_index;  /* out */
+};
+struct DeviceDescriptionKindArgs {
+  size_t struct_size;
+  void* ext;
+  void* device_description;
+  const char* device_kind;  /* out */
+  size_t device_kind_size;  /* out */
+};
+
+struct ErrorMessageArgs {
+  size_t struct_size;
+  void* ext;
+  void* error;
+  const char* message;  /* out */
+  size_t message_size;  /* out */
+};
+
+/* PJRT_NamedValue: the typed attribute record DeviceDescription_Attributes
+ * returns (the cuDeviceGetAttribute analog — CUDA enumerates attributes by
+ * integer id, PJRT by name). Declared inline like everything else here. */
+enum {
+  kPjrtNamedValueString = 0,
+  kPjrtNamedValueInt64 = 1,
+  kPjrtNamedValueInt64List = 2,
+  kPjrtNamedValueFloat = 3,
+  kPjrtNamedValueBool = 4,
+};
+struct PjrtNamedValue {
+  size_t struct_size;
+  void* ext;
+  const char* name;
+  size_t name_size;
+  int type; /* PJRT_NamedValue_Type */
+  union {
+    const char* string_value;
+    long long int64_value;
+    const long long* int64_array_value;
+    float float_value;
+    bool bool_value;
+  } v;
+  size_t value_size; /* list length for kInt64List */
+};
+struct DeviceDescriptionAttributesArgs {
+  size_t struct_size;
+  void* ext;
+  void* device_description;
+  size_t num_attributes;             /* out */
+  const PjrtNamedValue* attributes;  /* out */
+};
+
+bool attr_name_is(const PjrtNamedValue& a, const char* want) {
+  if (a.name == nullptr) return false;
+  size_t wlen = 0;
+  while (want[wlen] != '\0') ++wlen;
+  if (a.name_size != wlen) return false;
+  for (size_t i = 0; i < wlen; ++i) {
+    if (a.name[i] != want[i]) return false;
+  }
+  return true;
+}
+
+/* Exact-name allowlist for the HBM-capacity attribute. A substring match
+ * on "memory"/"hbm" would latch onto the first non-capacity attribute a
+ * future plugin exposes (memory_bandwidth, hbm_utilization, ...) and
+ * publish a wildly wrong size — capacity must be opted in by name. */
+bool attr_is_memory_capacity(const PjrtNamedValue& a) {
+  return attr_name_is(a, "memory_space_size") ||
+         attr_name_is(a, "memory_bytes") || attr_name_is(a, "memory_size") ||
+         attr_name_is(a, "hbm_bytes") || attr_name_is(a, "hbm_size_bytes") ||
+         attr_name_is(a, "hbm_size");
+}
+
+/* Client-create options ("key=value;..." -> PJRT_NamedValue[]). Some
+ * plugins refuse PJRT_Client_Create without specific named options — the
+ * C API makes options part of the create contract, so an enumeration
+ * path that cannot pass them simply cannot open such plugins. Parsing
+ * lives here (not Python) so the NamedValue memory management stays next
+ * to the call that consumes it. */
+struct CreateOptions {
+  char buf[2048];            /* mutable copy; names/strings point into it */
+  PjrtNamedValue vals[32];
+  size_t count = 0;
+};
+
+bool text_is_int64(const char* s) {
+  if (*s == '-') ++s;
+  if (*s == '\0') return false;
+  for (; *s != '\0'; ++s) {
+    if (*s < '0' || *s > '9') return false;
+  }
+  return true;
+}
+
+/* Returns TFD_SUCCESS or TFD_ERROR_INVALID_ARGUMENT (malformed segment,
+ * too many options, or spec longer than the buffer). */
+int parse_create_options(const char* spec, CreateOptions* o, char* err_msg,
+                         size_t err_msg_len) {
+  auto fail = [&](const char* what) {
+    if (err_msg != nullptr && err_msg_len > 0) {
+      size_t i = 0;
+      for (; what[i] != '\0' && i < err_msg_len - 1; ++i) err_msg[i] = what[i];
+      err_msg[i] = '\0';
+    }
+    return TFD_ERROR_INVALID_ARGUMENT;
+  };
+  size_t len = 0;
+  while (spec[len] != '\0') ++len;
+  if (len >= sizeof(o->buf)) return fail("create options too long");
+  for (size_t i = 0; i <= len; ++i) o->buf[i] = spec[i];
+
+  char* p = o->buf;
+  char* end = o->buf + len;
+  while (p < end) {
+    char* seg_end = p;
+    while (seg_end < end && *seg_end != ';') ++seg_end;
+    *seg_end = '\0';
+    if (*p != '\0') { /* empty segments (trailing ';') are tolerated */
+      if (o->count >= sizeof(o->vals) / sizeof(o->vals[0])) {
+        return fail("too many create options");
+      }
+      char forced = '\0';
+      if ((p[0] == 's' || p[0] == 'i' || p[0] == 'f' || p[0] == 'b') &&
+          p[1] == ':') {
+        forced = p[0];
+        p += 2;
+      }
+      char* eq = p;
+      while (*eq != '\0' && *eq != '=') ++eq;
+      if (*eq != '=' || eq == p) {
+        return fail("create option is not key=value");
+      }
+      *eq = '\0';
+      char* value = eq + 1;
+      PjrtNamedValue& nv = o->vals[o->count++];
+      nv.struct_size = sizeof(PjrtNamedValue);
+      nv.ext = nullptr;
+      nv.name = p;
+      nv.name_size = static_cast<size_t>(eq - p);
+      nv.value_size = 1;
+      bool is_true = false, is_false = false;
+      {
+        const char* t = "true";
+        const char* f = "false";
+        size_t ti = 0, fi = 0;
+        while (t[ti] != '\0' && value[ti] == t[ti]) ++ti;
+        is_true = t[ti] == '\0' && value[ti] == '\0';
+        while (f[fi] != '\0' && value[fi] == f[fi]) ++fi;
+        is_false = f[fi] == '\0' && value[fi] == '\0';
+      }
+      if (forced == 'b' || (forced == '\0' && (is_true || is_false))) {
+        if (!is_true && !is_false) return fail("b: value must be true|false");
+        nv.type = kPjrtNamedValueBool;
+        nv.v.bool_value = is_true;
+      } else if (forced == 'i' ||
+                 (forced == '\0' && text_is_int64(value))) {
+        if (!text_is_int64(value)) return fail("i: value is not an integer");
+        bool neg = value[0] == '-';
+        long long acc = 0;
+        for (const char* d = value + (neg ? 1 : 0); *d != '\0'; ++d) {
+          if (__builtin_mul_overflow(acc, 10, &acc) ||
+              __builtin_add_overflow(acc, *d - '0', &acc)) {
+            return fail("integer value out of int64 range");
+          }
+        }
+        nv.type = kPjrtNamedValueInt64;
+        /* -acc cannot overflow: acc <= LLONG_MAX, so -acc >= -LLONG_MAX >
+         * LLONG_MIN (LLONG_MIN itself is rejected one digit early). */
+        nv.v.int64_value = neg ? -acc : acc;
+      } else if (forced == 'f') {
+        /* Minimal decimal parser (no strtof: keep this file libc-light
+         * and locale-independent). Accepts [-]digits[.digits]. */
+        const char* d = value;
+        bool neg = *d == '-';
+        if (neg) ++d;
+        if (*d == '\0') return fail("f: value is not a number");
+        float acc = 0.0f;
+        for (; *d >= '0' && *d <= '9'; ++d) acc = acc * 10.0f + (*d - '0');
+        if (*d == '.') {
+          ++d;
+          float scale = 0.1f;
+          for (; *d >= '0' && *d <= '9'; ++d) {
+            acc += (*d - '0') * scale;
+            scale *= 0.1f;
+          }
+        }
+        if (*d != '\0') return fail("f: value is not a number");
+        nv.type = kPjrtNamedValueFloat;
+        nv.v.float_value = neg ? -acc : acc;
+      } else {
+        nv.type = kPjrtNamedValueString;
+        nv.v.string_value = value;
+        size_t vlen = 0;
+        while (value[vlen] != '\0') ++vlen;
+        nv.value_size = vlen;
+      }
+    }
+    p = seg_end + 1;
+  }
+  return TFD_SUCCESS;
+}
+
+typedef void* (*PjrtErrorFn)(void*);  /* generic PJRT_Error* f(Args*) */
+
+/* Call a PJRT entry point; on failure, copy the error message into err_msg
+ * (when provided) and destroy the error object. Returns true on success. */
+bool pjrt_call(const PjrtApiTable* api, void* fn_slot, void* args,
+               char* err_msg = nullptr, size_t err_msg_len = 0) {
+  if (fn_slot == nullptr) return false;
+  void* err = reinterpret_cast<PjrtErrorFn>(fn_slot)(args);
+  if (err == nullptr) return true;
+  if (err_msg != nullptr && err_msg_len > 0 && api->error_message != nullptr) {
+    ErrorMessageArgs msg_args = {sizeof(ErrorMessageArgs), nullptr, err,
+                                 nullptr, 0};
+    reinterpret_cast<PjrtErrorFn>(api->error_message)(&msg_args);
+    size_t n = msg_args.message_size;
+    if (n >= err_msg_len) n = err_msg_len - 1;
+    if (msg_args.message != nullptr) {
+      for (size_t i = 0; i < n; ++i) err_msg[i] = msg_args.message[i];
+      err_msg[n] = '\0';
+    }
+  }
+  if (api->error_destroy != nullptr) {
+    ErrorDestroyArgs destroy_args = {sizeof(ErrorDestroyArgs), nullptr, err};
+    reinterpret_cast<PjrtErrorFn>(api->error_destroy)(&destroy_args);
+  }
+  return false;
+}
+
+}  // namespace
+
+extern "C" int tfd_abi_version(void) { return TFD_NATIVE_ABI_VERSION; }
+
+extern "C" int tfd_probe_libtpu(const char* path, int* api_major,
+                                int* api_minor) {
+  if (path == nullptr || api_major == nullptr || api_minor == nullptr) {
+    return TFD_ERROR_INVALID_ARGUMENT;
+  }
+  *api_major = -1;
+  *api_minor = -1;
+
+  /* RTLD_LOCAL: a probe must not pollute the global symbol table the way
+   * the long-lived reference handle does (RTLD_GLOBAL, api.go:35) — the
+   * daemon's actual device work goes through PJRT in-process separately. */
+  void* handle = dlopen(path, RTLD_LAZY | RTLD_LOCAL);
+  if (handle == nullptr) {
+    return TFD_ERROR_LIB_NOT_FOUND;
+  }
+
+  GetPjrtApiFn get_api =
+      reinterpret_cast<GetPjrtApiFn>(dlsym(handle, "GetPjrtApi"));
+  if (get_api == nullptr) {
+    dlclose(handle);
+    return TFD_ERROR_SYMBOL_NOT_FOUND;
+  }
+
+  const PjrtApiPrefix* api = get_api();
+  if (api == nullptr) {
+    dlclose(handle);
+    return TFD_ERROR_NULL_API;
+  }
+
+  *api_major = api->version.major_version;
+  *api_minor = api->version.minor_version;
+  dlclose(handle);
+  return TFD_SUCCESS;
+}
+
+extern "C" int tfd_enumerate(const char* path, const char* create_options,
+                             tfd_device_info_t* out, size_t max_devices,
+                             size_t* n_devices, char* platform,
+                             size_t platform_len, char* err_msg,
+                             size_t err_msg_len) {
+  if (err_msg != nullptr && err_msg_len > 0) err_msg[0] = '\0';
+  if (path == nullptr || out == nullptr || n_devices == nullptr ||
+      platform == nullptr || platform_len == 0) {
+    return TFD_ERROR_INVALID_ARGUMENT;
+  }
+  *n_devices = 0;
+  platform[0] = '\0';
+
+  /* Stack-local: ctypes releases the GIL around this call, so a static
+   * buffer would race two concurrent enumerations (~3.5 KB is fine). */
+  CreateOptions opts;
+  opts.count = 0;
+  if (create_options != nullptr && create_options[0] != '\0') {
+    int rc = parse_create_options(create_options, &opts, err_msg, err_msg_len);
+    if (rc != TFD_SUCCESS) return rc;
+  }
+
+  void* handle = dlopen(path, RTLD_LAZY | RTLD_LOCAL);
+  if (handle == nullptr) {
+    return TFD_ERROR_LIB_NOT_FOUND;
+  }
+
+  GetPjrtApiFn get_api =
+      reinterpret_cast<GetPjrtApiFn>(dlsym(handle, "GetPjrtApi"));
+  if (get_api == nullptr) {
+    dlclose(handle);
+    return TFD_ERROR_SYMBOL_NOT_FOUND;
+  }
+  const PjrtApiTable* api =
+      reinterpret_cast<const PjrtApiTable*>(get_api());
+  if (api == nullptr) {
+    dlclose(handle);
+    return TFD_ERROR_NULL_API;
+  }
+  /* The plugin's table must at least reach the last slot we dereference.
+   * struct_size is the PJRT versioning contract, so an old plugin is
+   * detected here instead of via a wild pointer. */
+  if (api->struct_size < sizeof(PjrtApiTable)) {
+    dlclose(handle);
+    return TFD_ERROR_API_TOO_OLD;
+  }
+
+  /* Plugins require Plugin_Initialize before first use; tolerate a missing
+   * slot (pre-initialize-era plugins) but not a failing call. */
+  if (api->plugin_initialize != nullptr) {
+    PluginInitializeArgs init_args = {sizeof(PluginInitializeArgs), nullptr};
+    if (!pjrt_call(api, api->plugin_initialize, &init_args, err_msg,
+                   err_msg_len)) {
+      /* No dlclose past this point (see comment at the success path):
+       * Plugin_Initialize may already have spawned threads. */
+      return TFD_ERROR_PLUGIN_INIT;
+    }
+  }
+
+  ClientCreateArgs create_args = {sizeof(ClientCreateArgs), nullptr,
+                                  opts.count > 0 ? opts.vals : nullptr,
+                                  opts.count, nullptr, nullptr,
+                                  nullptr,  nullptr, nullptr, nullptr,
+                                  nullptr};
+  if (!pjrt_call(api, api->client_create, &create_args, err_msg,
+                 err_msg_len) ||
+      create_args.client == nullptr) {
+    return TFD_ERROR_CLIENT_CREATE;
+  }
+  void* client = create_args.client;
+  int rc = TFD_SUCCESS;
+
+  ClientPlatformNameArgs name_args = {sizeof(ClientPlatformNameArgs), nullptr,
+                                      client, nullptr, 0};
+  if (pjrt_call(api, api->client_platform_name, &name_args) &&
+      name_args.platform_name != nullptr) {
+    size_t n = name_args.platform_name_size;
+    if (n >= platform_len) n = platform_len - 1;
+    for (size_t i = 0; i < n; ++i) platform[i] = name_args.platform_name[i];
+    platform[n] = '\0';
+  } else {
+    rc = TFD_ERROR_ENUMERATE;
+  }
+
+  ClientAddressableDevicesArgs dev_args = {
+      sizeof(ClientAddressableDevicesArgs), nullptr, client, nullptr, 0};
+  if (rc == TFD_SUCCESS &&
+      pjrt_call(api, api->client_addressable_devices, &dev_args)) {
+    *n_devices = dev_args.num_addressable_devices;
+    size_t to_copy = dev_args.num_addressable_devices;
+    if (to_copy > max_devices) {
+      to_copy = max_devices;
+      rc = TFD_ERROR_BUFFER_TOO_SMALL;
+    }
+    for (size_t i = 0; i < to_copy; ++i) {
+      DeviceGetDescriptionArgs desc_args = {sizeof(DeviceGetDescriptionArgs),
+                                            nullptr,
+                                            dev_args.addressable_devices[i],
+                                            nullptr};
+      if (!pjrt_call(api, api->device_get_description, &desc_args) ||
+          desc_args.device_description == nullptr) {
+        rc = TFD_ERROR_ENUMERATE;
+        break;
+      }
+      void* desc = desc_args.device_description;
+
+      DeviceDescriptionIdArgs id_args = {sizeof(DeviceDescriptionIdArgs),
+                                         nullptr, desc, -1};
+      DeviceDescriptionProcessIndexArgs pi_args = {
+          sizeof(DeviceDescriptionProcessIndexArgs), nullptr, desc, -1};
+      DeviceDescriptionKindArgs kind_args = {
+          sizeof(DeviceDescriptionKindArgs), nullptr, desc, nullptr, 0};
+      if (!pjrt_call(api, api->device_description_id, &id_args) ||
+          !pjrt_call(api, api->device_description_process_index, &pi_args) ||
+          !pjrt_call(api, api->device_description_kind, &kind_args) ||
+          kind_args.device_kind == nullptr) {
+        rc = TFD_ERROR_ENUMERATE;
+        break;
+      }
+      out[i].id = id_args.id;
+      out[i].process_index = pi_args.process_index;
+      size_t kn = kind_args.device_kind_size;
+      if (kn >= sizeof(out[i].kind)) kn = sizeof(out[i].kind) - 1;
+      for (size_t k = 0; k < kn; ++k) out[i].kind[k] = kind_args.device_kind[k];
+      out[i].kind[kn] = '\0';
+
+      /* Real device attributes (cuDeviceGetAttribute/cuDeviceTotalMem
+       * analog, cuda-device.go:70-98). Best-effort by design: attribute
+       * coverage varies across plugin generations, so a missing slot or a
+       * failing call leaves the sentinels — the Python layer falls back to
+       * its spec tables exactly as it did before this path existed. */
+      out[i].coords_len = 0;
+      out[i].coords[0] = out[i].coords[1] = out[i].coords[2] = -1;
+      out[i].core_on_chip = -1;
+      out[i].memory_raw = -1;
+      DeviceDescriptionAttributesArgs attr_args = {
+          sizeof(DeviceDescriptionAttributesArgs), nullptr, desc, 0, nullptr};
+      if (api->device_description_attributes != nullptr &&
+          pjrt_call(api, api->device_description_attributes, &attr_args) &&
+          attr_args.attributes != nullptr) {
+        for (size_t a = 0; a < attr_args.num_attributes; ++a) {
+          const PjrtNamedValue& nv = attr_args.attributes[a];
+          if (nv.type == kPjrtNamedValueInt64List &&
+              attr_name_is(nv, "coords") && nv.v.int64_array_value != nullptr &&
+              nv.value_size >= 1 && nv.value_size <= 3) {
+            /* >3-D coords are NOT clamped: truncating would alias distinct
+             * chips and merge them in the dedup pass — leave the sentinel
+             * and let the spec-table fallback handle the unknown shape. */
+            for (size_t c = 0; c < nv.value_size; ++c) {
+              out[i].coords[c] = nv.v.int64_array_value[c];
+            }
+            out[i].coords_len = static_cast<int>(nv.value_size);
+          } else if (nv.type == kPjrtNamedValueInt64 &&
+                     attr_name_is(nv, "core_on_chip")) {
+            out[i].core_on_chip = nv.v.int64_value;
+          } else if (nv.type == kPjrtNamedValueInt64 &&
+                     out[i].memory_raw < 0 && attr_is_memory_capacity(nv)) {
+            out[i].memory_raw = nv.v.int64_value;
+          }
+        }
+      }
+    }
+  } else if (rc == TFD_SUCCESS) {
+    rc = TFD_ERROR_ENUMERATE;
+  }
+
+  /* Always release the TPU before returning — holding it past this call
+   * would defeat the opt-in contract in the header. The dlopen HANDLE is
+   * deliberately leaked: Plugin_Initialize/Client_Create may spawn
+   * background threads and process-global state that Client_Destroy does
+   * not tear down, so unmapping the .so could leave live threads on
+   * unmapped code (XLA itself never dlcloses PJRT plugins). The probe
+   * path's dlclose is safe because it never initializes the plugin. */
+  ClientDestroyArgs destroy_args = {sizeof(ClientDestroyArgs), nullptr,
+                                    client};
+  pjrt_call(api, api->client_destroy, &destroy_args);
+  return rc;
+}
+
+extern "C" const char* tfd_error_string(int code) {
+  switch (code) {
+    case TFD_SUCCESS:
+      return "TFD_SUCCESS";
+    case TFD_ERROR_INVALID_ARGUMENT:
+      return "TFD_ERROR_INVALID_ARGUMENT";
+    case TFD_ERROR_LIB_NOT_FOUND:
+      return "TFD_ERROR_LIB_NOT_FOUND";
+    case TFD_ERROR_SYMBOL_NOT_FOUND:
+      return "TFD_ERROR_SYMBOL_NOT_FOUND";
+    case TFD_ERROR_NULL_API:
+      return "TFD_ERROR_NULL_API";
+    case TFD_ERROR_CONFIG_TOO_SHORT:
+      return "TFD_ERROR_CONFIG_TOO_SHORT";
+    case TFD_ERROR_BUFFER_TOO_SMALL:
+      return "TFD_ERROR_BUFFER_TOO_SMALL";
+    case TFD_ERROR_API_TOO_OLD:
+      return "TFD_ERROR_API_TOO_OLD";
+    case TFD_ERROR_CLIENT_CREATE:
+      return "TFD_ERROR_CLIENT_CREATE";
+    case TFD_ERROR_ENUMERATE:
+      return "TFD_ERROR_ENUMERATE";
+    case TFD_ERROR_PLUGIN_INIT:
+      return "TFD_ERROR_PLUGIN_INIT";
+    default:
+      return "TFD_ERROR_UNKNOWN";
+  }
+}

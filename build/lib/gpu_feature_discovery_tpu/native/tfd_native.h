@@ -1,0 +1,112 @@
+/* Flat C ABI of libtfd_native.so, consumed by native/shim.py via ctypes.
+ *
+ * TPU re-design of the reference's cgo CUDA binding (internal/cuda/
+ * cuda.go:22-110): the needed foreign types are declared inline here — no
+ * TPU SDK headers required to build — and the TPU library itself is only
+ * ever dlopen'd at runtime, so this .so builds and loads on machines with
+ * no libtpu at all (the -Wl,--unresolved-symbols trick is unnecessary
+ * because nothing links against libtpu).
+ */
+#ifndef TFD_NATIVE_H_
+#define TFD_NATIVE_H_
+
+#include <stddef.h>
+
+#ifdef __cplusplus
+extern "C" {
+#endif
+
+/* Result codes (CUresult/consts.go:19-86 analog). Keep in sync with
+ * tfd_error_string(). */
+typedef enum {
+  TFD_SUCCESS = 0,
+  TFD_ERROR_INVALID_ARGUMENT = 1,
+  TFD_ERROR_LIB_NOT_FOUND = 2,     /* dlopen failed */
+  TFD_ERROR_SYMBOL_NOT_FOUND = 3,  /* GetPjrtApi missing (not a PJRT lib) */
+  TFD_ERROR_NULL_API = 4,          /* GetPjrtApi returned NULL */
+  TFD_ERROR_CONFIG_TOO_SHORT = 5,  /* PCI config space < 256 bytes */
+  TFD_ERROR_BUFFER_TOO_SMALL = 6,  /* output buffer cannot hold the record */
+  TFD_ERROR_API_TOO_OLD = 7,       /* PJRT table lacks the entry points */
+  TFD_ERROR_CLIENT_CREATE = 8,     /* PJRT_Client_Create failed */
+  TFD_ERROR_ENUMERATE = 9,         /* a device query failed post-create */
+  TFD_ERROR_PLUGIN_INIT = 10,      /* PJRT_Plugin_Initialize failed */
+} tfd_result_t;
+
+/* One enumerated device (the cuDeviceGet/cuDeviceGetName +
+ * cuDeviceGetAttribute/cuDeviceTotalMem record analog,
+ * internal/cuda/api.go:58-118, cuda-device.go:70-98). The attribute
+ * fields come from PJRT_DeviceDescription_Attributes and are sentinel'd
+ * when the plugin does not expose them — attribute coverage varies by
+ * generation (SURVEY.md "riskiest unknowns" (a)). */
+typedef struct {
+  int id;                 /* PJRT global device id */
+  int process_index;      /* owning process (host) within the slice */
+  char kind[64];          /* device kind, e.g. "TPU v5 lite" */
+  long long coords[3];    /* "coords" attribute (ICI grid position) */
+  int coords_len;         /* 0 when the plugin exposes no coords */
+  long long core_on_chip; /* "core_on_chip" attribute; -1 when absent */
+  long long memory_raw;   /* first int64 attribute whose name contains
+                             "memory" or "hbm", verbatim (bytes vs MiB is
+                             decided Python-side); -1 when absent */
+} tfd_device_info_t;
+
+/* ABI version of THIS header's structs. Bump whenever tfd_device_info_t
+ * (or any other ctypes-crossed layout or signature) changes; shim.py
+ * refuses to load a .so whose tfd_abi_version() disagrees, so a stale
+ * prebuilt library degrades to the pure-Python fallback instead of
+ * parsing device records with the wrong stride. */
+#define TFD_NATIVE_ABI_VERSION 3
+int tfd_abi_version(void);
+
+/* dlopen(path) + GetPjrtApi() probe; writes the PJRT C API version into
+ * *api_major / *api_minor on success. Never creates a PJRT client — the
+ * probe must not seize the TPU from the workload that owns it. */
+int tfd_probe_libtpu(const char* path, int* api_major, int* api_minor);
+
+/* Human-readable name for a tfd_result_t (cuda/result.go analog). */
+const char* tfd_error_string(int code);
+
+/* Full enumeration WITHOUT any ML runtime in-process: dlopen(path),
+ * GetPjrtApi, PJRT_Plugin_Initialize, PJRT_Client_Create, list the
+ * client's addressable devices (id / process index / kind) and the
+ * platform name, then destroy the client (the dlopen handle is leaked
+ * once the plugin initialized — plugins spawn threads that outlive the
+ * client, so unmapping would be unsafe). Mirrors the reference's
+ * 7-entry-point CUDA enumeration (internal/cuda/cuda.go:103-109,
+ * api.go:58-118).
+ *
+ * CREATING THE CLIENT SEIZES THE TPU for the call's duration — callers
+ * must gate this behind explicit opt-in (--native-enumeration) so it
+ * never contends with a workload that owns the chip. The probe path
+ * (tfd_probe_libtpu) stays client-free for exactly that reason.
+ *
+ * create_options (optional, may be NULL/empty) parameterizes
+ * PJRT_Client_Create with typed PJRT_NamedValue records — some plugins
+ * REQUIRE named options to create a client at all (the PJRT C API makes
+ * them part of the create contract). Grammar: ";"-separated `key=value`
+ * pairs. Value type is inferred (`true`/`false` -> Bool, integer text ->
+ * Int64, else String) and can be forced with a `s:`/`i:`/`f:`/`b:` key
+ * prefix, e.g. "topology=v5e:2x2;rank=4294967295;s:build=true".
+ *
+ * Writes at most max_devices records and the true count into *n_devices
+ * (TFD_ERROR_BUFFER_TOO_SMALL when truncated); platform receives the
+ * NUL-terminated platform name ("tpu"); err_msg (optional, may be NULL)
+ * receives the PJRT error message when initialization/creation fails. */
+int tfd_enumerate(const char* path, const char* create_options,
+                  tfd_device_info_t* out, size_t max_devices,
+                  size_t* n_devices, char* platform, size_t platform_len,
+                  char* err_msg, size_t err_msg_len);
+
+/* Walk the PCI capability linked list of a 256-byte config space and copy
+ * the vendor-specific (id 0x09) record into out. Returns the record length
+ * (> 0), 0 when no vendor-specific capability exists, or a negative
+ * tfd_result_t on error. C++ twin of PCIDevice.get_vendor_specific_capability
+ * (pci/pciutil.py), itself a re-design of pciutil.go:115-151. */
+int tfd_pci_vendor_capability(const char* config, size_t config_len,
+                              char* out, size_t out_len);
+
+#ifdef __cplusplus
+}
+#endif
+
+#endif /* TFD_NATIVE_H_ */

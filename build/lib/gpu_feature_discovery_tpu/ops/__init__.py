@@ -1,0 +1,20 @@
+"""On-chip compute path: TPU health-check / burn-in kernels.
+
+The reference is a pure discovery agent with no on-device compute; its
+deepest hardware interaction is an NVML attribute read. On TPU the
+idiomatic equivalent of "is this accelerator actually usable" goes further:
+a feature-discovery agent can run a tiny on-chip workload to verify the
+MXU, HBM, and ICI fabric are healthy and to label achieved performance.
+These kernels are that workload, built
+jax-first: static shapes, lax.scan depth loops, bf16 matmuls sized for the
+128x128 MXU, and shard_map + psum/ppermute over a jax.sharding.Mesh for
+slice-wide connectivity sweeps.
+"""
+
+from gpu_feature_discovery_tpu.ops.healthcheck import (  # noqa: F401
+    burnin_flops,
+    ici_ring_sweep,
+    make_burnin_step,
+    make_slice_train_step,
+    measure_chip_health,
+)

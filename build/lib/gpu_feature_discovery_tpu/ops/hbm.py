@@ -1,0 +1,131 @@
+"""Pallas HBM read-bandwidth probe.
+
+The second axis of chip health next to the MXU burn-in (healthcheck.py):
+degraded HBM shows up as low sustained read bandwidth even when matmuls
+still produce finite numbers. A plain jnp copy would measure XLA's fusion
+choices as much as the memory system, so the probe is a hand-written
+pallas kernel that streams the buffer HBM→VMEM with double-buffered async
+DMA (two slots: chunk i+1 is in flight while chunk i reduces on the VPU)
+and folds every chunk into a running sum — the reduction consumes each
+byte, so the copies cannot be elided.
+
+On CPU (tests, dev boxes) the kernel runs in interpret mode; the number it
+produces there is meaningless as bandwidth but exercises the exact same
+kernel logic.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+LANES = 128          # last dim is always 128 on TPU
+CHUNK_ROWS = 512     # (512, 128) f32 = 256 KiB per slot; 2 slots = 512 KiB VMEM
+N_BUFFERS = 2
+
+
+def _bandwidth_kernel(hbm_ref, out_ref):
+    """Stream hbm_ref (rows, LANES) through VMEM in CHUNK_ROWS chunks,
+    double-buffered, reducing each chunk into a scalar accumulator."""
+    num_chunks = hbm_ref.shape[0] // CHUNK_ROWS
+
+    def body(scratch, acc, sem_ref):
+        def get_dma(slot, chunk_idx):
+            return pltpu.make_async_copy(
+                hbm_ref.at[pl.ds(chunk_idx * CHUNK_ROWS, CHUNK_ROWS)],
+                scratch.at[slot],
+                sem_ref.at[slot],
+            )
+
+        get_dma(0, 0).start()
+        acc[0, 0] = jnp.float32(0.0)
+
+        def loop_body(chunk_idx, _):
+            current = chunk_idx % N_BUFFERS
+            nxt = (chunk_idx + 1) % N_BUFFERS
+
+            @pl.when(chunk_idx + 1 < num_chunks)
+            def _():
+                get_dma(nxt, chunk_idx + 1).start()
+
+            get_dma(current, chunk_idx).wait()
+            acc[0, 0] = acc[0, 0] + jnp.sum(scratch[current])
+
+        jax.lax.fori_loop(0, num_chunks, loop_body, None)
+        out_ref[0, 0] = acc[0, 0]
+
+    pl.run_scoped(
+        body,
+        scratch=pltpu.VMEM((N_BUFFERS, CHUNK_ROWS, LANES), jnp.float32),
+        acc=pltpu.SMEM((1, 1), jnp.float32),  # scalar stores live in SMEM
+        sem_ref=pltpu.SemaphoreType.DMA((N_BUFFERS,)),
+    )
+
+
+def hbm_stream_sum(buf: jax.Array, interpret: bool = False) -> jax.Array:
+    """Reduce ``buf`` (rows multiple of CHUNK_ROWS, LANES wide) through the
+    streaming kernel; returns the (1, 1) sum."""
+    if buf.ndim != 2 or buf.shape[1] != LANES or buf.shape[0] % CHUNK_ROWS:
+        raise ValueError(
+            f"buffer must be (k*{CHUNK_ROWS}, {LANES}), got {buf.shape}"
+        )
+    return pl.pallas_call(
+        _bandwidth_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, 1), jnp.float32),
+        in_specs=[pl.BlockSpec(memory_space=pl.ANY)],  # stays in HBM
+        out_specs=pl.BlockSpec(memory_space=pltpu.SMEM),
+        interpret=interpret,
+    )(buf)
+
+
+def _on_tpu(device) -> bool:
+    platform = device.platform if device is not None else jax.devices()[0].platform
+    return platform == "tpu"
+
+
+@functools.lru_cache(maxsize=2)
+def _jitted_stream_sum(interpret: bool):
+    """One jitted entry point per interpret mode: a fresh jit-of-partial
+    per call would defeat the jit cache and recompile the pallas kernel on
+    every labeling cycle."""
+    return jax.jit(functools.partial(hbm_stream_sum, interpret=interpret))
+
+
+def measure_hbm_bandwidth(
+    total_mib: int = 256,
+    iters: int = 4,
+    device=None,
+    interpret: Optional[bool] = None,
+) -> dict:
+    """Time the streaming kernel over a ``total_mib`` buffer and report
+    sustained HBM read bandwidth in GiB/s (best of ``iters``).
+
+    ``interpret`` defaults to auto: real kernel on TPU, interpreter
+    elsewhere (where ``gbps`` is not a hardware measurement).
+    """
+    if interpret is None:
+        interpret = not _on_tpu(device)
+    rows = max(1, (total_mib * 1024 * 1024) // (LANES * 4) // CHUNK_ROWS) * CHUNK_ROWS
+    buf = jnp.ones((rows, LANES), jnp.float32)
+    if device is not None:
+        buf = jax.device_put(buf, device)
+    fn = _jitted_stream_sum(interpret)
+    total = jax.block_until_ready(fn(buf))  # compile + warm
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(buf))
+        best = min(best, time.perf_counter() - t0)
+    return {
+        "gbps": buf.nbytes / best / 2**30,
+        "seconds": best,
+        "bytes": buf.nbytes,
+        "checksum_ok": bool(total[0, 0] == rows * LANES),
+        "interpreted": interpret,
+    }

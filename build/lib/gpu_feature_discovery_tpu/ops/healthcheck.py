@@ -1,0 +1,329 @@
+"""TPU health-check kernels: MXU burn-in, HBM probe, ICI sweep, train step.
+
+No counterpart in the reference (it labels hardware without computing on
+it); this is the TPU-native extension backing the health labeler
+(lm/health.py, gated by --with-burnin) and the multi-chip slice-validation
+path. Design notes:
+
+- The burn-in is a depth-chained bf16 matmul under ``lax.scan`` — one fused
+  XLA computation whose FLOPs live on the MXU. Shapes are static and
+  multiples of 128 so XLA tiles them onto the 128x128 systolic array
+  without padding waste.
+- Per-step RMS normalization keeps activations finite for any depth, so
+  "all outputs finite" is a meaningful chip-health signal rather than an
+  overflow lottery.
+- The slice-wide checks use ``shard_map`` over a ``jax.sharding.Mesh``:
+  ``psum`` exercises the all-reduce path and ``ppermute`` walks every
+  nearest-neighbor ring link, which on hardware rides the ICI torus.
+- ``make_slice_train_step`` is a miniature data+tensor-parallel MLP train
+  step (Megatron-style column/row sharding with a psum seam). It exists so
+  multi-host slice acceptance can compile and run the collectives a real
+  workload would, on tiny shapes.
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding
+from jax.sharding import PartitionSpec as P
+
+try:  # JAX >= 0.4.35 exports shard_map at the top level
+    shard_map = jax.shard_map  # type: ignore[attr-defined]
+except AttributeError:  # pragma: no cover - older JAX
+    from jax.experimental.shard_map import shard_map  # type: ignore
+
+
+# ---------------------------------------------------------------------------
+# Single-chip MXU burn-in
+# ---------------------------------------------------------------------------
+
+def burnin_step(x: jax.Array, ws: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """One burn-in pass: chain ``x @ ws[i]`` for every layer of ``ws``.
+
+    Returns ``(checksum, rms)``; a healthy chip yields finite values for
+    both. Jittable, static-shaped, scan-based — the whole chain compiles to
+    one XLA program with the matmuls on the MXU and the normalization fused
+    into their epilogues.
+    """
+
+    def layer(carry, w):
+        y = jnp.dot(carry, w, preferred_element_type=jnp.float32)
+        # RMS-normalize in f32, then return to the matmul dtype. Keeps the
+        # chain numerically bounded at any depth.
+        rms = jnp.sqrt(jnp.mean(jnp.square(y)) + 1e-6)
+        return (y / rms).astype(carry.dtype), rms
+
+    out, rmss = lax.scan(layer, x, ws)
+    return jnp.sum(out.astype(jnp.float32)), rmss[-1]
+
+
+def make_burnin_step(
+    size: int = 512, depth: int = 8, dtype=jnp.bfloat16
+) -> Tuple[callable, Tuple[jax.Array, jax.Array]]:
+    """Build the burn-in fn + deterministic example args.
+
+    ``size`` defaults to a multiple of 256 so bf16 tiles (16x128 min) pack
+    the MXU exactly. Returns the *unjitted* fn — callers jit it (the driver
+    compile-checks ``jax.jit(fn)(*args)``).
+    """
+    key = jax.random.PRNGKey(0)
+    kx, kw = jax.random.split(key)
+    x = jax.random.normal(kx, (size, size), dtype=jnp.float32).astype(dtype)
+    ws = jax.random.normal(kw, (depth, size, size), dtype=jnp.float32).astype(dtype)
+    ws = ws / jnp.sqrt(jnp.float32(size)).astype(dtype)
+    return burnin_step, (x, ws)
+
+
+def burnin_flops(size: int, depth: int) -> float:
+    """FLOPs of one burn-in pass (matmuls only: depth * 2 * size^3)."""
+    return 2.0 * depth * size**3
+
+
+@functools.lru_cache(maxsize=None)
+def _jitted_burnin(size: int, depth: int, dtype) -> Tuple[callable, jax.Array, jax.Array]:
+    """One jitted burn-in per (size, depth, dtype), cached for the process
+    lifetime (same rationale as hbm.py's _jitted_stream_sum): the daemon
+    calls this every labeling cycle for every device, and a fresh
+    ``jax.jit`` wrapper per call would re-trace and occupy the chip for
+    compile time each cycle."""
+    fn, (x, ws) = make_burnin_step(size=size, depth=depth, dtype=dtype)
+    return jax.jit(fn), x, ws
+
+
+def measure_chip_health(
+    size: int = 512,
+    depth: int = 8,
+    iters: int = 4,
+    device=None,
+    dtype=jnp.bfloat16,
+) -> dict:
+    """Run the burn-in on one chip and report health + achieved TFLOP/s.
+
+    ``healthy`` is "every output finite"; ``tflops`` is the
+    best-of-``iters`` sustained matmul rate, which on a healthy TPU should
+    sit near the chip's bf16 peak.
+    """
+    step, x, ws = _jitted_burnin(size, depth, dtype)
+    if device is not None:
+        x, ws = jax.device_put(x, device), jax.device_put(ws, device)
+    checksum, rms = jax.block_until_ready(step(x, ws))  # compile + warm
+    best = float("inf")
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        jax.block_until_ready(step(x, ws))
+        best = min(best, time.perf_counter() - t0)
+    healthy = bool(jnp.isfinite(checksum)) and bool(jnp.isfinite(rms))
+    return {
+        "healthy": healthy,
+        "tflops": burnin_flops(size, depth) / best / 1e12,
+        "seconds": best,
+    }
+
+
+def measure_node_health(
+    size: int = 512,
+    depth: int = 8,
+    iters: int = 4,
+    ici: Optional[bool] = None,
+    devices: Optional[list] = None,
+) -> dict:
+    """Burn in EVERY local device and aggregate: a node is healthy only if
+    all of its chips are, and the published rate is the worst chip's (the
+    slowest chip governs what a workload will see).
+
+    ``devices`` lets the caller pass an already-acquired device list (the
+    health labeler acquires first so it can tell "cannot acquire" apart
+    from "acquired but failing"); default is every local device.
+
+    On real TPUs the HBM streaming probe (ops/hbm.py) runs too; elsewhere
+    ``hbm_gbps`` is None — the interpreter would be slow and the number
+    meaningless as bandwidth. ``ici`` (auto: multi-chip TPU nodes) rings
+    the local chips with ppermute to verify every intra-host ICI link.
+    """
+    if devices is None:
+        devices = jax.local_devices()
+    on_tpu = all(d.platform == "tpu" for d in devices)
+    reports = [
+        measure_chip_health(size=size, depth=depth, iters=iters, device=d)
+        for d in devices
+    ]
+    hbm_gbps = None
+    if on_tpu:
+        from gpu_feature_discovery_tpu.ops.hbm import measure_hbm_bandwidth
+
+        hbm = [
+            measure_hbm_bandwidth(total_mib=64, iters=2, device=d)
+            for d in devices
+        ]
+        if all(r["checksum_ok"] for r in hbm):
+            hbm_gbps = min(r["gbps"] for r in hbm)
+    if ici is None:
+        ici = on_tpu and len(devices) > 1
+    elif ici and len(devices) < 2:
+        # An explicit request must fail loudly, not silently report
+        # "not measured" — a single device has no ring to sweep.
+        raise ValueError("ici sweep requested but only one local device")
+    ici_ok = None
+    if ici:
+        import numpy as np
+
+        sweep = ici_ring_sweep(Mesh(np.array(devices), ("ring",)))
+        ici_ok = sweep["links_ok"] and sweep["allreduce_ok"]
+    return {
+        "healthy": all(r["healthy"] for r in reports),
+        "tflops": min(r["tflops"] for r in reports),
+        "hbm_gbps": hbm_gbps,
+        "ici_ok": ici_ok,
+        "chips": len(reports),
+    }
+
+
+# ---------------------------------------------------------------------------
+# Slice-wide ICI connectivity sweep
+# ---------------------------------------------------------------------------
+
+def ici_ring_sweep(mesh: Mesh) -> dict:
+    """Walk every ring link of every mesh axis and all-reduce a checksum.
+
+    Every device derives its row-major linear rank from its mesh
+    coordinates, then a ``ppermute`` ring shift along each axis delivers the
+    left neighbor's rank — a dead or misrouted ICI link shows up as a wrong
+    neighbor value. A final ``psum`` over all axes verifies the all-reduce
+    path. Returns per-link and reduction pass/fail.
+    """
+    axes = tuple(mesh.axis_names)
+    shape = mesh.devices.shape
+    sizes = dict(zip(axes, shape))
+    n = mesh.devices.size
+    ndim = len(axes)
+    cell = (1,) * ndim  # each device's block of the mesh-shaped output
+
+    @functools.partial(
+        shard_map, mesh=mesh, in_specs=(), out_specs=(P(*axes), P(*axes))
+    )
+    def sweep():
+        # Row-major linear rank from mesh coordinates.
+        rank = jnp.int32(0)
+        for ax in axes:
+            rank = rank * sizes[ax] + lax.axis_index(ax)
+        ok = jnp.bool_(True)
+        stride = 1
+        strides = {}
+        for ax in reversed(axes):
+            strides[ax] = stride
+            stride *= sizes[ax]
+        for ax in axes:
+            size = sizes[ax]
+            idx = lax.axis_index(ax)
+            got = lax.ppermute(
+                rank, ax, perm=[(i, (i + 1) % size) for i in range(size)]
+            )
+            prev_idx = jnp.where(idx == 0, size - 1, idx - 1)
+            expect = rank + (prev_idx - idx) * strides[ax]
+            ok = jnp.logical_and(ok, got == expect)
+        total = rank
+        for ax in axes:
+            total = lax.psum(total, ax)
+        return jnp.reshape(ok, cell), jnp.reshape(total, cell)
+
+    with mesh:
+        ok, total = jax.jit(sweep)()
+    expected_total = n * (n - 1) // 2
+    return {
+        "links_ok": bool(jnp.all(ok)),
+        "allreduce_ok": bool(jnp.all(total == expected_total)),
+        "devices": n,
+    }
+
+
+# ---------------------------------------------------------------------------
+# Miniature DP+TP train step for slice acceptance
+# ---------------------------------------------------------------------------
+
+def make_slice_train_step(
+    mesh: Mesh,
+    batch: int = 32,
+    d_model: int = 128,
+    d_hidden: int = 256,
+    data_axis: str = "data",
+    model_axis: str = "model",
+):
+    """Build a jitted DP+TP MLP train step sharded over ``mesh``.
+
+    Sharding layout (the standard Megatron split, expressed as jax
+    shardings so XLA inserts the collectives):
+      - batch sharded over ``data_axis`` (DP),
+      - W1 column-sharded / W2 row-sharded over ``model_axis`` (TP) — the
+        forward needs one psum over ``model_axis`` at the W2 seam,
+      - gradients all-reduced over ``data_axis`` by XLA automatically.
+
+    Returns ``(step, (params, x, y))`` with everything device_put onto the
+    mesh. One call = forward + backward + SGD update: the collectives a
+    real slice workload exercises, on tiny shapes.
+    """
+    repl = NamedSharding(mesh, P())
+    x_sh = NamedSharding(mesh, P(data_axis, None))
+    w1_sh = NamedSharding(mesh, P(None, model_axis))
+    w2_sh = NamedSharding(mesh, P(model_axis, None))
+
+    key = jax.random.PRNGKey(7)
+    k1, k2, kx, ky = jax.random.split(key, 4)
+    params = {
+        "w1": jax.device_put(
+            jax.random.normal(k1, (d_model, d_hidden), jnp.float32)
+            / jnp.sqrt(d_model),
+            w1_sh,
+        ),
+        "w2": jax.device_put(
+            jax.random.normal(k2, (d_hidden, d_model), jnp.float32)
+            / jnp.sqrt(d_hidden),
+            w2_sh,
+        ),
+    }
+    x = jax.device_put(jax.random.normal(kx, (batch, d_model), jnp.float32), x_sh)
+    y = jax.device_put(jax.random.normal(ky, (batch, d_model), jnp.float32), x_sh)
+
+    def loss_fn(p, xb, yb):
+        h = jax.nn.relu(xb @ p["w1"])
+        out = h @ p["w2"]
+        return jnp.mean(jnp.square(out - yb))
+
+    @functools.partial(
+        jax.jit,
+        in_shardings=({"w1": w1_sh, "w2": w2_sh}, x_sh, x_sh),
+        out_shardings=({"w1": w1_sh, "w2": w2_sh}, repl),
+    )
+    def step(p, xb, yb):
+        loss, grads = jax.value_and_grad(loss_fn)(p, xb, yb)
+        new_p = jax.tree_util.tree_map(lambda w, g: w - 0.01 * g, p, grads)
+        return new_p, loss
+
+    return step, (params, x, y)
+
+
+def build_mesh(
+    n_devices: int, devices: Optional[list] = None, axis_names=("data", "model")
+) -> Mesh:
+    """Factor ``n_devices`` into a 2D (data, model) mesh — widest model
+    axis that divides the device count, so both axes see real collectives
+    whenever n is composite."""
+    devices = (devices or jax.devices())[:n_devices]
+    if len(devices) < n_devices:
+        raise RuntimeError(f"need {n_devices} devices, have {len(devices)}")
+    # Largest model-axis size <= sqrt(n) that divides n, so both axes carry
+    # real collectives whenever n is composite (8 -> 4x2, 4 -> 2x2).
+    model = 1
+    for cand in range(int(n_devices**0.5), 0, -1):
+        if n_devices % cand == 0:
+            model = cand
+            break
+    import numpy as np
+
+    dev_array = np.array(devices).reshape(n_devices // model, model)
+    return Mesh(dev_array, axis_names)

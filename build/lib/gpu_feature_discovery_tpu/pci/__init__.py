@@ -1,0 +1,15 @@
+from gpu_feature_discovery_tpu.pci.pciutil import (
+    GOOGLE_PCI_VENDOR_ID,
+    GooglePCI,
+    MockGooglePCI,
+    PCIDevice,
+    SysfsGooglePCI,
+)
+
+__all__ = [
+    "GOOGLE_PCI_VENDOR_ID",
+    "GooglePCI",
+    "MockGooglePCI",
+    "PCIDevice",
+    "SysfsGooglePCI",
+]

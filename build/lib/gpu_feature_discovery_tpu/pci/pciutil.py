@@ -1,0 +1,272 @@
+"""PCI sysfs probing for Google TPU devices.
+
+Re-design of the reference's NVIDIA PCI scanner + config-space capability
+walker (internal/vgpu/pciutil.go:70-177) for the Google vendor id 0x1ae0:
+scan ``/sys/bus/pci/devices``, read vendor/class/config, and walk the PCI
+capability linked list (status bit 0x10 at byte 0x06, first-cap pointer at
+byte 0x34, vendor-specific capability id 0x09) with loop/0xff-break
+detection. The walker's real work on TPU VMs is presence/inventory — the
+"is there a TPU-class function on this bus" probe used by the factory
+autodetect and the interconnect labeler — since TPU host-driver metadata
+comes from the metadata server rather than config space.
+
+A C++ twin of this walker lives in native/pci_caps.cc; this pure-Python
+path is the fallback when the native library is not built.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass, field
+from typing import List, Optional, Protocol
+
+PCI_DEVICES_ROOT = "/sys/bus/pci/devices"
+GOOGLE_PCI_VENDOR_ID = "0x1ae0"
+
+PCI_STATUS_BYTE = 0x06
+PCI_STATUS_CAPABILITY_LIST = 0x10
+PCI_CAPABILITY_LIST = 0x34
+PCI_CAPABILITY_LIST_ID = 0
+PCI_CAPABILITY_LIST_NEXT = 1
+PCI_CAPABILITY_LENGTH = 2
+PCI_CAPABILITY_VENDOR_SPECIFIC_ID = 0x09
+
+
+class PCIError(Exception):
+    pass
+
+
+@dataclass
+class PCIDevice:
+    """One PCI function (PCIDevice struct, pciutil.go:33-40)."""
+
+    path: str
+    address: str
+    vendor: str
+    device_class: str
+    config: bytes = field(repr=False, default=b"")
+
+    def get_vendor_specific_capability(self) -> Optional[bytes]:
+        """Walk the capability list and return the vendor-specific capability
+        record, or None (GetVendorSpecificCapability, pciutil.go:115-151).
+        Needs the full 256-byte config space, which sysfs only exposes to
+        privileged readers."""
+        if len(self.config) < 256:
+            raise PCIError(
+                f"entire PCI configuration is not read for device {self.address}. "
+                "Run with privileged mode to read complete PCI configuration data"
+            )
+        if self.config[PCI_STATUS_BYTE] & PCI_STATUS_CAPABILITY_LIST == 0:
+            return None
+
+        visited = set()
+        pos = self.config[PCI_CAPABILITY_LIST]
+        while pos != 0:
+            if pos + PCI_CAPABILITY_LENGTH >= len(self.config):
+                break  # corrupt pointer past the config space
+            cap_id = self.config[pos + PCI_CAPABILITY_LIST_ID]
+            nxt = self.config[pos + PCI_CAPABILITY_LIST_NEXT]
+            if pos in visited:  # chain looped
+                break
+            if cap_id == 0xFF:  # chain broken
+                break
+            if cap_id == PCI_CAPABILITY_VENDOR_SPECIFIC_ID:
+                # Byte 2 is a length field only for vendor-specific caps
+                # (for standard caps it is capability data), so it is read
+                # and validated only here.
+                length = self.config[pos + PCI_CAPABILITY_LENGTH]
+                if length < 3:  # record shorter than its own header: corrupt
+                    break
+                return self.config[pos : pos + length]
+            visited.add(pos)
+            pos = nxt
+        return None
+
+
+@dataclass(frozen=True)
+class HostInterfaceInfo:
+    """Decoded vendor-specific capability record (Device.GetInfo analog,
+    vgpu.go:108-153). The reference walks sub-records to record-id 0 and
+    reads fixed 10-byte host-driver version + branch fields; the TPU
+    record is self-describing instead: a NUL-terminated ASCII signature
+    naming the host interface (e.g. ``TPUICI``), a one-byte record id
+    (0 = host-driver info, mirroring the reference's record id 0), then
+    NUL-terminated strings — driver version, then optional branch."""
+
+    signature: str
+    driver_version: str = ""
+    driver_branch: str = ""
+
+
+def decode_vendor_capability(cap: bytes) -> Optional[HostInterfaceInfo]:
+    """Decode the record returned by get_vendor_specific_capability, or
+    None when it is absent/malformed. Malformed records are a normal
+    hardware condition (a future device revision, a truncated read), so
+    this never raises — warn-don't-fail lives with the caller."""
+    if not cap or len(cap) < 4 or cap[0] != PCI_CAPABILITY_VENDOR_SPECIFIC_ID:
+        return None
+    body = cap[3 : cap[PCI_CAPABILITY_LENGTH]]
+    sig_end = body.find(0)
+    if sig_end <= 0:
+        return None
+    try:
+        signature = body[:sig_end].decode("ascii")
+    except UnicodeDecodeError:
+        return None
+    if not signature.isprintable():
+        return None
+    rest = body[sig_end + 1 :]
+    if not rest or rest[0] != 0:  # unknown record id: signature-only
+        return HostInterfaceInfo(signature=signature)
+    # The fields are POSITIONAL (version, then branch — the reference's
+    # record is two fixed 10-byte slots, vgpu.go:108-153): an empty first
+    # field means "no version", it must not promote the branch into the
+    # version slot.
+    fields = rest[1:].split(b"\x00")
+    strings = []
+    for raw in fields[:2]:
+        try:
+            s = raw.decode("ascii")
+        except UnicodeDecodeError:
+            break  # garbage after the good strings: keep what parsed
+        if not s.isprintable():
+            break
+        strings.append(s)
+    return HostInterfaceInfo(
+        signature=signature,
+        driver_version=strings[0] if strings else "",
+        driver_branch=strings[1] if len(strings) > 1 else "",
+    )
+
+
+class GooglePCI(Protocol):
+    """Scanner interface (NvidiaPCI, pciutil.go:28-30)."""
+
+    def devices(self) -> List[PCIDevice]: ...
+
+
+class SysfsGooglePCI:
+    """Sysfs-backed scanner (NvidiaPCILib.Devices, pciutil.go:70-113),
+    filtered to the Google vendor id."""
+
+    def __init__(self, root: str = PCI_DEVICES_ROOT, vendor_id: str = GOOGLE_PCI_VENDOR_ID):
+        self.root = root
+        self.vendor_id = vendor_id
+
+    def devices(self) -> List[PCIDevice]:
+        try:
+            entries = sorted(os.listdir(self.root))
+        except OSError as e:
+            raise PCIError(f"unable to read PCI bus devices: {e}") from e
+
+        found: List[PCIDevice] = []
+        for address in entries:
+            device_path = os.path.join(self.root, address)
+            try:
+                vendor = _read_text(os.path.join(device_path, "vendor"))
+            except OSError as e:
+                raise PCIError(
+                    f"unable to read PCI device vendor id for {address}: {e}"
+                ) from e
+            if vendor != self.vendor_id:
+                continue
+
+            try:
+                device_class = _read_text(os.path.join(device_path, "class"))
+                config = _read_bytes(os.path.join(device_path, "config"))
+            except OSError as e:
+                raise PCIError(
+                    f"unable to read PCI device data for {address}: {e}"
+                ) from e
+
+            found.append(
+                PCIDevice(
+                    path=device_path,
+                    address=address,
+                    vendor=vendor,
+                    device_class=device_class[:6],
+                    config=config,
+                )
+            )
+        return found
+
+
+class MockGooglePCI:
+    """Fixture scanner (NewMockNvidiaPCI, pciutil.go:180-204) built from
+    synthesized config spaces rather than captured blobs."""
+
+    def __init__(self, devices: Optional[List[PCIDevice]] = None):
+        self._devices = devices if devices is not None else default_mock_devices()
+
+    def devices(self) -> List[PCIDevice]:
+        return list(self._devices)
+
+
+def build_config_space(
+    vendor: int = 0x1AE0,
+    device: int = 0x0027,
+    capabilities: Optional[List[bytes]] = None,
+    size: int = 256,
+) -> bytes:
+    """Synthesize a PCI config space with a well-formed capability chain —
+    the golden-blob generator for tier-1 walker tests (the reference checks
+    in two captured 256-byte arrays; generating keeps the binary format
+    executable documentation instead)."""
+    cfg = bytearray(size)
+    cfg[0:2] = vendor.to_bytes(2, "little")
+    cfg[2:4] = device.to_bytes(2, "little")
+    caps = capabilities or []
+    if caps:
+        cfg[PCI_STATUS_BYTE] |= PCI_STATUS_CAPABILITY_LIST
+        pos = 0x40
+        cfg[PCI_CAPABILITY_LIST] = pos
+        for i, cap in enumerate(caps):
+            end = pos + len(cap)
+            if end > size:
+                raise ValueError("capabilities overflow config space")
+            cfg[pos:end] = cap
+            nxt = 0 if i == len(caps) - 1 else (end + 3) & ~3
+            cfg[pos + PCI_CAPABILITY_LIST_NEXT] = nxt
+            pos = nxt if nxt else pos
+    return bytes(cfg)
+
+
+def make_capability(cap_id: int, body: bytes) -> bytes:
+    """id, next (patched by build_config_space), length, body."""
+    length = 3 + len(body)
+    return bytes([cap_id, 0, length]) + body
+
+
+def default_mock_devices() -> List[PCIDevice]:
+    """Two synthetic devices: a TPU function with a vendor-specific
+    capability and one without any capability chain."""
+    with_cap = build_config_space(
+        capabilities=[
+            make_capability(0x01, b"\x00\x00"),  # power management
+            make_capability(
+                PCI_CAPABILITY_VENDOR_SPECIFIC_ID,
+                b"TPUICI\x00\x001.9.0\x00prod\x00",
+            ),
+        ]
+    )
+    without_cap = build_config_space()
+    return [
+        PCIDevice(
+            path="", address="0000:00:04.0", vendor=GOOGLE_PCI_VENDOR_ID,
+            device_class="0x0880", config=with_cap,
+        ),
+        PCIDevice(
+            path="", address="0000:00:05.0", vendor=GOOGLE_PCI_VENDOR_ID,
+            device_class="0x0880", config=without_cap,
+        ),
+    ]
+
+
+def _read_text(path: str) -> str:
+    with open(path) as f:
+        return f.read().strip()
+
+
+def _read_bytes(path: str) -> bytes:
+    with open(path, "rb") as f:
+        return f.read()

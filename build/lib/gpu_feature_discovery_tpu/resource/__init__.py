@@ -1,0 +1,11 @@
+from gpu_feature_discovery_tpu.resource.types import Chip, Manager, ResourceError
+from gpu_feature_discovery_tpu.resource.null import NullManager
+from gpu_feature_discovery_tpu.resource.fallback import FallbackToNullOnInitError
+
+__all__ = [
+    "Chip",
+    "Manager",
+    "ResourceError",
+    "NullManager",
+    "FallbackToNullOnInitError",
+]

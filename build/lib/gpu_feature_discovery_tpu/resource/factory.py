@@ -1,0 +1,252 @@
+"""Backend factory + platform autodetect.
+
+Reference: internal/resource/factory.go:27-73 — probe the platform, pick
+the manager, and wrap it with the fallback decorator unless
+--fail-on-init-error. The TPU probe chain (extended by the JAX/PJRT and
+native-shim backends) is:
+
+1. ``TFD_BACKEND`` env override — explicit backend selection; ``mock:<type>``
+   variants exist for integration tests on CPU-only machines (the reference
+   achieves the same with its mock-NVML container tests).
+2. libtpu present (native shim dlopen probe, or TPU chips on the PCI bus,
+   or a TPU VM metadata environment) → PJRT/JAX-backed manager, then the
+   native C-API enumeration (opt-in via --native-enumeration), then the
+   metadata inventory.
+3. Otherwise → Null manager (non-TPU node: no labels).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Callable, Dict, Optional
+
+from gpu_feature_discovery_tpu.config.spec import Config
+from gpu_feature_discovery_tpu.resource.fallback import FallbackToNullOnInitError
+from gpu_feature_discovery_tpu.resource.null import NullManager
+from gpu_feature_discovery_tpu.resource.types import Manager
+
+log = logging.getLogger("tfd.resource")
+
+BACKEND_ENV = "TFD_BACKEND"
+
+
+def new_manager(config: Config) -> Manager:
+    """NewManager (factory.go:27-30)."""
+    return with_config(_get_manager(config), config)
+
+
+def with_config(manager: Manager, config: Config) -> Manager:
+    """WithConfig (factory.go:33-39)."""
+    if config.flags.fail_on_init_error:
+        return manager
+    return FallbackToNullOnInitError(manager)
+
+
+def _mock_backend(accel_type: str) -> Manager:
+    from gpu_feature_discovery_tpu.resource.testing import new_single_host_manager
+
+    return new_single_host_manager(accel_type)
+
+
+def _mock_slice_backend(accel_type: str) -> Manager:
+    from gpu_feature_discovery_tpu.resource.testing import new_uniform_slice_manager
+
+    return new_uniform_slice_manager(accel_type)
+
+
+def _mock_worker_backend(accel_type: str) -> Manager:
+    """``mock-worker:<accel_type>`` — one worker of a multi-host slice
+    (only this host's chips, bound to the full slice topology)."""
+    from gpu_feature_discovery_tpu.resource.testing import (
+        new_multihost_worker_manager,
+    )
+
+    return new_multihost_worker_manager(accel_type)
+
+
+def _mock_mixed_backend(spec: str) -> Manager:
+    """``mock-mixed:<family>[:<topo>,<topo>,...]`` — one chip per listed
+    slice topology (defaults to the builder's heterogeneous set)."""
+    from gpu_feature_discovery_tpu.resource.testing import new_mixed_slice_manager
+
+    family, _, topos = spec.partition(":")
+    if topos:
+        return new_mixed_slice_manager(
+            family, topologies=[[t] for t in topos.split(",") if t]
+        )
+    return new_mixed_slice_manager(family)
+
+
+def _get_manager(config: Config) -> Manager:
+    backend = os.environ.get(BACKEND_ENV, "auto").strip().lower()
+
+    if backend.startswith("mock:"):
+        accel = backend.split(":", 1)[1]
+        log.info("Using mock manager (%s)", accel)
+        return _mock_backend(accel)
+    if backend.startswith("mock-slice:"):
+        accel = backend.split(":", 1)[1]
+        log.info("Using mock uniform-slice manager (%s)", accel)
+        return _mock_slice_backend(accel)
+    if backend.startswith("mock-worker:"):
+        accel = backend.split(":", 1)[1]
+        log.info("Using mock multi-host worker manager (%s)", accel)
+        return _mock_worker_backend(accel)
+    if backend.startswith("mock-mixed:"):
+        family = backend.split(":", 1)[1]
+        log.info("Using mock mixed-slice manager (%s)", family)
+        return _mock_mixed_backend(family)
+    if backend == "null":
+        log.info("Using null manager (forced)")
+        return NullManager()
+    if backend in ("jax", "pjrt"):
+        manager = _try_jax_manager(config)
+        if manager is None:
+            raise RuntimeError("TFD_BACKEND=jax requested but jax backend unavailable")
+        return manager
+    if backend == "native":
+        # Forced selection bypasses the opt-in flag: naming the backend IS
+        # the opt-in (the operator typed it knowing it seizes the chip).
+        manager = _try_native_manager(config, forced=True)
+        if manager is None:
+            raise RuntimeError(
+                "TFD_BACKEND=native requested but native enumeration unavailable"
+            )
+        log.info("Using native (PJRT C API) manager (forced)")
+        return manager
+    if backend in ("hostinfo", "metadata"):
+        # Eager availability check: a forced backend must fail loudly at
+        # factory time (matching TFD_BACKEND=jax), not be silently swapped
+        # for null by the fallback wrapper.
+        manager = _try_hostinfo_manager(config)
+        if manager is None:
+            raise RuntimeError(
+                "TFD_BACKEND=hostinfo requested but no TPU VM metadata available"
+            )
+        log.info("Using hostinfo (metadata) manager (forced)")
+        return manager
+
+    # Auto detection: PJRT first, metadata-derived inventory second, null
+    # last — the hasNVML -> isTegra -> null chain (factory.go:54-73) with
+    # TPU probes.
+    has_tpu, reason = _detect_tpu_platform(config)
+    log.info("Detected %sTPU platform: %s", "" if has_tpu else "non-", reason)
+    if has_tpu:
+        # Eager verification is itself gated on the degradation contract:
+        # --fail-on-init-error=true means "init failures exit 1 loudly", so
+        # the jax manager must stay lazy and crash in run() — eagerly
+        # catching its init error here would silently select a degraded
+        # backend the operator asked not to get silently.
+        manager = _try_jax_manager(
+            config, eager=not config.flags.fail_on_init_error
+        )
+        if manager is not None:
+            log.info("Using PJRT (jax) manager")
+            return manager
+        manager = _try_native_manager(config)
+        if manager is not None:
+            log.info("Using native (PJRT C API) manager; jax unavailable")
+            return manager
+        manager = _try_hostinfo_manager(config)
+        if manager is not None:
+            log.info("Using hostinfo (metadata) manager; PJRT unavailable")
+            return manager
+        log.warning("TPU detected but no backend usable; using null manager")
+
+    log.warning("No valid resources detected; using empty manager.")
+    return NullManager()
+
+
+def _detect_tpu_platform(config: Config) -> tuple:
+    """hasNvml/isTegra probe analog (factory.go:54-57): native libtpu dlopen
+    probe, then TPU functions on the PCI bus, then a TPU VM environment."""
+    from gpu_feature_discovery_tpu.native.shim import probe_libtpu
+
+    probed = probe_libtpu(config.flags.libtpu_path or None)
+    if probed.found:
+        return True, f"libtpu loadable ({probed.source})"
+
+    try:
+        from gpu_feature_discovery_tpu.pci.pciutil import SysfsGooglePCI
+
+        if SysfsGooglePCI().devices():
+            return True, "Google PCI functions present on /sys/bus/pci"
+    except Exception:  # noqa: BLE001 - absence of sysfs is a non-TPU signal
+        pass
+
+    env = os.environ
+    if env.get("TPU_ACCELERATOR_TYPE") or env.get("TPU_WORKER_ID"):
+        return True, "TPU environment variables present"
+    return False, "no libtpu, no TPU PCI functions, no TPU environment"
+
+
+def _try_jax_manager(config: Config, eager: bool = False) -> Optional[Manager]:
+    """JaxManager, or None when jax is unusable.
+
+    ``eager`` (the auto chain) verifies usability by running init() NOW —
+    construction alone cannot fail (jax imports lazily inside init), so
+    without this the chain would never fall through to native/hostinfo: a
+    broken/absent jax would only surface at init() where the fallback
+    wrapper swaps in Null (no labels) instead of a degraded backend
+    (ADVICE r2 medium). init() is idempotent and the PJRT client is held
+    for the process lifetime anyway, so the eager call costs nothing
+    extra on a healthy node. Forced TFD_BACKEND=jax keeps lazy init so
+    the --fail-on-init-error contract decides how init failures surface.
+    """
+    from gpu_feature_discovery_tpu.config.spec import ConfigError
+
+    try:
+        from gpu_feature_discovery_tpu.resource.jax_backend import JaxManager
+
+        manager = JaxManager(config)
+        if eager:
+            manager.init()
+        return manager
+    except ConfigError:
+        # init() re-raises a typo'd TFD_HERMETIC/TFD_NO_METADATA as a hard
+        # config error; falling through to another backend would silently
+        # ignore the flag the operator mistyped.
+        raise
+    except Exception as e:  # noqa: BLE001 - backend optional by design
+        log.warning("jax backend unavailable: %s", e)
+        return None
+
+
+def _try_native_manager(config: Config, forced: bool = False) -> Optional[Manager]:
+    """Native PJRT C-API enumeration — OPT-IN (--native-enumeration), since
+    creating a client briefly seizes the TPU; a forced TFD_BACKEND=native
+    counts as opt-in. Availability (libtpu + built .so) is checked eagerly
+    so the auto chain can fall through to hostinfo."""
+    if not forced and not config.flags.native_enumeration:
+        return None
+    try:
+        from gpu_feature_discovery_tpu.native.shim import load_native, probe_libtpu
+        from gpu_feature_discovery_tpu.resource.native_backend import NativeManager
+
+        if load_native() is None:
+            return None
+        if not probe_libtpu(config.flags.libtpu_path or None).found:
+            return None
+        return NativeManager(config)
+    except Exception as e:  # noqa: BLE001 - backend optional by design
+        log.warning("native backend unavailable: %s", e)
+        return None
+
+
+def _try_hostinfo_manager(config: Config) -> Optional[Manager]:
+    """Metadata inventory is only a valid backend when the environment
+    actually names an accelerator type (the isTegra analog probe)."""
+    try:
+        from gpu_feature_discovery_tpu.hostinfo.provider import discover_host_info
+        from gpu_feature_discovery_tpu.resource.hostinfo_backend import (
+            HostinfoManager,
+        )
+
+        info = discover_host_info()
+        if info is None or not info.accelerator_type:
+            return None
+        return HostinfoManager(config, info=info)
+    except Exception as e:  # noqa: BLE001 - backend optional by design
+        log.warning("hostinfo backend unavailable: %s", e)
+        return None

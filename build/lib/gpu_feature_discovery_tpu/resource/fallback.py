@@ -1,0 +1,42 @@
+"""Fallback-to-null resilience decorator.
+
+Reference: internal/resource/fallback.go:23-64. When
+``--fail-on-init-error=false``, an init failure (libtpu missing, TPU held
+busy by another pod — SURVEY.md section 5 failure-detection note) swaps in
+the Null manager: the node quietly publishes no TPU labels instead of
+crash-looping the daemonset.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import List, Tuple
+
+from gpu_feature_discovery_tpu.resource.null import NullManager
+from gpu_feature_discovery_tpu.resource.types import Chip, Manager
+
+log = logging.getLogger("tfd.resource")
+
+
+class FallbackToNullOnInitError(Manager):
+    def __init__(self, manager: Manager):
+        self._wraps = manager
+
+    def init(self) -> None:
+        try:
+            self._wraps.init()
+        except Exception as e:  # noqa: BLE001 - any backend failure falls back
+            log.warning("failed to initialize resource manager: %s", e)
+            self._wraps = NullManager()
+
+    def shutdown(self) -> None:
+        self._wraps.shutdown()
+
+    def get_chips(self) -> List[Chip]:
+        return self._wraps.get_chips()
+
+    def get_driver_version(self) -> str:
+        return self._wraps.get_driver_version()
+
+    def get_runtime_version(self) -> Tuple[int, int]:
+        return self._wraps.get_runtime_version()

@@ -1,0 +1,164 @@
+"""Metadata-derived device manager — the CUDA/Tegra fallback analog.
+
+Reference: internal/resource/cuda-lib.go:25-88 + cuda-device.go:25-98 — a
+second, degraded backend for nodes where the primary library (NVML) is
+unavailable but the hardware is still real. On TPU VMs the analogous
+situation is a daemonset pod without device access (no libtpu, no usable
+PJRT client — e.g. the TPU is owned by another container) on a node whose
+TPU VM environment/metadata still states exactly what hardware is present.
+This manager synthesizes the chip inventory from the accelerator type and
+the per-generation spec tables (models/chips.py).
+
+Degradation matches the reference's: the CUDA manager hardcodes its driver
+version to "unknown.unknown.unknown" (cuda-lib.go:68-70); here the libtpu
+version is unknown the same way, while the PJRT API version can still come
+from the native shim's probe when only client *creation* is impossible.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Dict, List, Optional, Tuple
+
+from gpu_feature_discovery_tpu.config.spec import Config
+from gpu_feature_discovery_tpu.hostinfo.provider import discover_host_info
+from gpu_feature_discovery_tpu.hostinfo.tpu_env import (
+    HostInfo,
+    _parse_bounds as parse_bounds,
+)
+from gpu_feature_discovery_tpu.models import parse_accelerator_type
+from gpu_feature_discovery_tpu.models.chips import ChipSpec
+from gpu_feature_discovery_tpu.resource.slice_partition import SlicePartition
+from gpu_feature_discovery_tpu.resource.types import Chip, Manager, ResourceError
+
+log = logging.getLogger("tfd.resource")
+
+UNKNOWN_DRIVER_VERSION = "unknown.unknown.unknown"  # cuda-lib.go:68-70 analog
+
+
+class StaticSlice(SlicePartition):
+    """Slice partition synthesized from the slice topology string (the
+    nvml-mig-device analog, facts from the spec tables instead of NVML).
+    All behavior lives in the shared SlicePartition — the PJRT backend
+    binds the same partition type to live chips."""
+
+
+class StaticChip(Chip):
+    """One chip known only through the spec tables (cuda-device analog).
+
+    ``memory_mb`` overrides the spec table when the caller measured the
+    real value (the native backend's attribute-backed enumeration)."""
+
+    def __init__(
+        self,
+        spec: ChipSpec,
+        slice_topology: str = "",
+        memory_mb: Optional[int] = None,
+    ):
+        self._spec = spec
+        self._memory_mb = memory_mb if memory_mb else spec.hbm_mb
+        self._slices = (
+            [StaticSlice(slice_topology, self, spec, per_chip_memory_mb=memory_mb)]
+            if slice_topology
+            else []
+        )
+
+    def is_slice_enabled(self) -> bool:
+        return bool(self._slices)
+
+    def is_slice_capable(self) -> bool:
+        return self._spec.slice_capable
+
+    def get_slices(self) -> List[Chip]:
+        return list(self._slices)
+
+    def get_attributes(self) -> Dict[str, object]:
+        raise ResourceError("get_attributes only supported for slice partitions")
+
+    def get_name(self) -> str:
+        return self._spec.product
+
+    def get_total_memory_mb(self) -> int:
+        return self._memory_mb
+
+    def get_parent_chip(self) -> Chip:
+        raise ResourceError("get_parent_chip only supported for slice partitions")
+
+    def get_generation(self) -> Tuple[int, int]:
+        return (self._spec.generation, self._spec.variant_rank)
+
+
+class HostinfoManager(Manager):
+    """Chip inventory from TPU VM metadata when PJRT is unusable."""
+
+    def __init__(self, config: Config, info: Optional[HostInfo] = None):
+        self._config = config
+        self._info = info
+        self._chips: Optional[List[Chip]] = None
+        self._probed = None
+
+    def init(self) -> None:
+        if self._info is None:
+            self._info = discover_host_info()
+        if self._info is None or not self._info.accelerator_type:
+            raise ResourceError(
+                "no TPU VM metadata available to enumerate chips from"
+            )
+        if self._probed is None:
+            from gpu_feature_discovery_tpu.native.shim import probe_libtpu
+
+            self._probed = probe_libtpu(self._config.flags.libtpu_path or None)
+
+    def shutdown(self) -> None:  # nothing held
+        pass
+
+    def _local_chip_count(self, spec: ChipSpec, slice_chips: int) -> int:
+        """Chips on THIS host: the whole slice on single-host shapes, else
+        the per-host share (bounds from metadata beat the spec table)."""
+        info = self._info
+        if info is not None and info.chips_per_host_bounds:
+            dims = parse_bounds(info.chips_per_host_bounds)
+            if dims:
+                return min(math.prod(dims), slice_chips)
+        if slice_chips <= spec.max_single_host_chips:
+            return slice_chips
+        return min(spec.chips_per_host, slice_chips)
+
+    def get_chips(self) -> List[Chip]:
+        if self._chips is not None:
+            return list(self._chips)
+        if self._info is None:
+            self._chips = []
+            return []
+        at = parse_accelerator_type(self._info.accelerator_type)
+        if at is None:
+            log.warning(
+                "unrecognized accelerator type %r; no chips",
+                self._info.accelerator_type,
+            )
+            self._chips = []
+            return []
+        topology = self._info.resolved_topology()
+        count = self._local_chip_count(at.spec, at.chips)
+        self._chips = [
+            StaticChip(at.spec, slice_topology=topology) for _ in range(count)
+        ]
+        return list(self._chips)
+
+    def get_driver_version(self) -> str:
+        # Always the honest degradation (cuda-lib.go:68-70): without a
+        # usable client the libtpu DISTRIBUTION version is unknowable. The
+        # PJRT C API version the native probe can still read is a runtime
+        # fact, not a driver version — labeling it here would publish
+        # tpu.driver.major=0 and mislead every consumer keying on it; it is
+        # surfaced through get_runtime_version() instead.
+        return UNKNOWN_DRIVER_VERSION
+
+    def get_runtime_version(self) -> Tuple[int, int]:
+        if self._probed and self._probed.found and self._probed.api_major >= 0:
+            return (self._probed.api_major, self._probed.api_minor)
+        # Degrade like the driver version rather than failing the whole
+        # labeler (the reference's CUDA path labels "unknown" strings; the
+        # runtime labels are numeric, so 0.0 is the unknown sentinel).
+        return (0, 0)

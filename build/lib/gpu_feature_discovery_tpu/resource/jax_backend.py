@@ -1,0 +1,287 @@
+"""PJRT-backed device manager via JAX — the NVML-manager analog.
+
+Reference: internal/resource/nvml-lib.go:24-97 + nvml-device.go:26-88. On a
+TPU node the runtime stack is libtpu (the "driver") spoken through the PJRT
+C API; JAX is the canonical in-process PJRT client, so chip enumeration and
+attributes come from ``jax.devices("tpu")`` while version facts come from
+the libtpu distribution and the PJRT plugin.
+
+Lifecycle note (SURVEY.md section 7 hard part #1): creating a PJRT client
+grabs the TPU. Unlike NVML's cheap Init/Shutdown-per-cycle, this manager
+creates the client once on first init() and holds it for the process
+lifetime; shutdown() is a no-op by design. The daemon's labeling loop is
+therefore O(label math) per cycle rather than O(client creation) — this is
+how the <100ms p50 target is met (BASELINE.json).
+
+Slice awareness (the IsMigEnabled/GetMigDevices analog,
+internal/resource/nvml-device.go:40-56): every enumerated chip is bound
+into its provisioned slice the way a MIG-enabled GPU exposes MIG devices.
+The slice topology is resolved once at init() from two sources, in order:
+
+1. **Provisioning metadata** — TPU_TOPOLOGY / ACCELERATOR_TYPE from the
+   TPU VM environment or GCE metadata (the same facts the hostinfo
+   fallback backend inventories from), and
+2. **The live fabric** — the bounding box of the global PJRT device
+   coordinates (``jax.devices("tpu")`` spans the whole slice on Cloud TPU
+   multi-host deployments), a source NVML has no analog for.
+
+With neither available the chips stay unbound and the strategy engine
+treats the node as slice-less (strategy none semantics), matching the
+reference's non-MIG GPU path.
+
+The per-generation ChipSpec tables back-fill attributes PJRT does not
+expose uniformly across v4/v5e/v5p ("riskiest unknown" (a), SURVEY.md
+section 7).
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import List, Optional, Tuple
+
+from gpu_feature_discovery_tpu.config.spec import Config
+from gpu_feature_discovery_tpu.models.chips import ChipSpec, spec_for
+from gpu_feature_discovery_tpu.resource.slice_partition import SlicePartition
+from gpu_feature_discovery_tpu.resource.types import Chip, Manager, ResourceError
+
+log = logging.getLogger("tfd.resource")
+
+
+class JaxChip(Chip):
+    """One enumerated TPU chip (all TensorCores of one chip appear as one
+    PJRT device on the megacore generations; on v2/v3 each core is a PJRT
+    device — we merge per chip via (process_index, coords))."""
+
+    def __init__(
+        self,
+        device,
+        spec: Optional[ChipSpec],
+        memory_mb: int,
+        slice_topology: str = "",
+    ):
+        self._device = device
+        self._spec = spec
+        self._memory_mb = memory_mb
+        self._slices: List[Chip] = []
+        if slice_topology and spec is not None:
+            self._slices = [
+                SlicePartition(
+                    slice_topology, self, spec, per_chip_memory_mb=memory_mb or None
+                )
+            ]
+
+    def is_slice_enabled(self) -> bool:
+        return bool(self._slices)
+
+    def is_slice_capable(self) -> bool:
+        return self._spec.slice_capable if self._spec else False
+
+    def get_slices(self) -> List[Chip]:
+        return list(self._slices)
+
+    def get_attributes(self):
+        raise ResourceError("get_attributes only supported for slice partitions")
+
+    def get_name(self) -> str:
+        if self._spec:
+            return self._spec.product
+        # Unknown generation: normalize the PJRT device kind ("TPU v9" →
+        # "tpu-v9") so the product label stays well-formed.
+        return str(getattr(self._device, "device_kind", "tpu")).lower().replace(" ", "-")
+
+    def get_total_memory_mb(self) -> int:
+        return self._memory_mb
+
+    def get_parent_chip(self) -> Chip:
+        raise ResourceError("get_parent_chip only supported for slice partitions")
+
+    def get_generation(self) -> Tuple[int, int]:
+        if self._spec:
+            return (self._spec.generation, self._spec.variant_rank)
+        return (0, 0)
+
+
+class JaxManager(Manager):
+    def __init__(self, config: Config):
+        self._config = config
+        self._devices = None  # created once, held (see module docstring)
+        self._all_devices: list = []
+        self._slice_topology = ""
+
+    def init(self) -> None:
+        if self._devices is not None:
+            return
+        try:
+            devices, all_devices = _enumerate_tpu_devices()
+        except Exception as e:  # noqa: BLE001 - backend init failures funnel
+            raise ResourceError(f"failed to initialize PJRT TPU client: {e}") from e
+        if not devices:
+            raise ResourceError("PJRT client reports no TPU devices")
+        self._devices = devices
+        self._all_devices = all_devices
+        self._slice_topology = self._resolve_slice_topology()
+        if self._slice_topology:
+            log.info("chips bound into slice topology %s", self._slice_topology)
+        else:
+            log.info("no slice topology resolvable; chips stay unbound")
+
+    def shutdown(self) -> None:
+        # Deliberate no-op: dropping the PJRT client mid-run would release
+        # and re-seize the TPU every cycle (nvml.Shutdown analog does not
+        # apply; see module docstring).
+        pass
+
+    def _resolve_slice_topology(self) -> str:
+        """Topology of the slice the local chips are provisioned into;
+        "" when unknowable (then chips stay unbound)."""
+        # Source 1: provisioning metadata — the truth the scheduler acted
+        # on (the same inventory path hostinfo_backend uses), honoring the
+        # TFD_HERMETIC/TFD_NO_METADATA escape hatches.
+        from gpu_feature_discovery_tpu.config.spec import ConfigError
+
+        try:
+            from gpu_feature_discovery_tpu.hostinfo.provider import (
+                discover_host_info_gated,
+            )
+
+            info = discover_host_info_gated()
+            if info is not None:
+                topo = info.resolved_topology()
+                if topo:
+                    return topo
+        except ConfigError:
+            # A typo'd TFD_HERMETIC/TFD_NO_METADATA is a hard config error
+            # everywhere else — swallowing it here would silently skip the
+            # metadata source and mislabel the node.
+            raise
+        except Exception as e:  # noqa: BLE001 - metadata optional by design
+            log.debug("no host metadata for slice topology: %s", e)
+        # Source 2: the live fabric — global device coords bounding box.
+        spec = None
+        if self._devices:
+            spec = spec_for(str(getattr(self._devices[0], "device_kind", "")))
+        return _topology_from_coords(
+            self._all_devices, ndims=spec.ici_dims if spec else None
+        )
+
+    def get_chips(self) -> List[Chip]:
+        if self._devices is None:
+            return []
+        chips: List[Chip] = []
+        seen = set()
+        for d in self._devices:
+            coords = tuple(getattr(d, "coords", ()) or ())
+            key = (getattr(d, "process_index", 0), coords or d.id)
+            if key in seen:
+                continue  # second TensorCore of the same chip (v2/v3)
+            seen.add(key)
+            spec = spec_for(str(getattr(d, "device_kind", "")))
+            chips.append(
+                JaxChip(
+                    d,
+                    spec,
+                    _memory_mb(d, spec),
+                    slice_topology=self._slice_topology,
+                )
+            )
+        return chips
+
+    def get_driver_version(self) -> str:
+        """libtpu distribution version — the driver-version analog."""
+        for dist in ("libtpu", "libtpu-nightly"):
+            try:
+                from importlib.metadata import version
+
+                return version(dist)
+            except Exception:  # noqa: BLE001
+                continue
+        try:
+            import jaxlib
+
+            return jaxlib.version.__version__
+        except Exception as e:  # noqa: BLE001
+            raise ResourceError(f"cannot determine libtpu version: {e}") from e
+
+    def get_runtime_version(self) -> Tuple[int, int]:
+        """PJRT C API version (major, minor) from the live backend, falling
+        back to the jaxlib (XLA runtime) version."""
+        try:
+            # jax.extend.backend is a submodule: it must be imported
+            # explicitly, `import jax` alone does not expose it.
+            import jax.extend.backend as jax_backend
+
+            backend = jax_backend.get_backend("tpu")
+            pv = str(getattr(backend, "platform_version", ""))
+            # e.g. "PJRT C API 0.51 (...)" — extract the first maj.min pair.
+            import re
+
+            m = re.search(r"(\d+)\.(\d+)", pv)
+            if m:
+                return (int(m.group(1)), int(m.group(2)))
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            import jaxlib
+
+            major, minor = jaxlib.version.__version__.split(".")[:2]
+            return (int(major), int(minor))
+        except Exception as e:  # noqa: BLE001
+            raise ResourceError(f"cannot determine PJRT runtime version: {e}") from e
+
+
+def _enumerate_tpu_devices() -> Tuple[list, list]:
+    """(local, global) TPU device lists from the held PJRT client.
+
+    local_devices is the label inventory: labels are a per-NODE contract
+    (like nvidia.com/gpu.count) and on a multi-host slice jax.devices()
+    reports slice-global chips. The global list still matters — its
+    coordinate bounding box is the live slice topology. Module-level so
+    tests can monkeypatch the enumeration without a TPU.
+    """
+    import jax
+
+    return jax.local_devices(backend="tpu"), jax.devices("tpu")
+
+
+def _topology_from_coords(devices: list, ndims: Optional[int] = None) -> str:
+    """Slice topology from the device-coordinate bounding box; "" when the
+    coords are absent, ragged, or don't form a dense grid (a sparse box
+    means donated/failed chips — guessing a topology would mislabel).
+
+    ``ndims`` trims trailing singleton axes down to the generation's ICI
+    dimensionality (v5e coords are 3-vectors with z always 0, but its
+    topology vocabulary is 2D: "2x2", not "2x2x1").
+    """
+    coords = []
+    for d in devices:
+        c = getattr(d, "coords", None)
+        if c is None:
+            return ""
+        coords.append(tuple(c))
+    if not coords or len({len(c) for c in coords}) != 1:
+        return ""
+    unique = set(coords)
+    rank = len(coords[0])
+    dims = [
+        max(c[i] for c in unique) - min(c[i] for c in unique) + 1
+        for i in range(rank)
+    ]
+    if math.prod(dims) != len(unique):
+        return ""  # not a dense grid
+    if ndims:
+        while len(dims) > ndims and dims[-1] == 1:
+            dims.pop()
+    return "x".join(str(d) for d in dims)
+
+
+def _memory_mb(device, spec: Optional[ChipSpec]) -> int:
+    """Live HBM size when the runtime exposes it, else the spec table."""
+    try:
+        stats = device.memory_stats()
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if limit:
+            return int(limit) // (1024 * 1024)
+    except Exception:  # noqa: BLE001 - memory_stats unsupported on some kinds
+        pass
+    return spec.hbm_mb if spec else 0

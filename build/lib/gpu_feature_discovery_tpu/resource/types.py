@@ -1,0 +1,84 @@
+"""Device-backend seam: the Manager/Chip interfaces.
+
+TPU re-design of the reference's resource interfaces
+(internal/resource/types.go:21-41). The label engine (lm/) only ever sees
+these two abstractions — never libtpu/PJRT/JAX types — so backends plug in
+beneath this line exactly like the NVML/CUDA/Null managers do in the
+reference (factory seam, SURVEY.md section 1).
+
+Vocabulary mapping (GPU → TPU):
+
+- Device                      → Chip
+- MIG device                  → Slice partition (a sub-grid of the chip
+  fabric a chip is bound into, named by its topology string, e.g. "2x2x1")
+- IsMigCapable                → is_slice_capable  (generation supports slicing)
+- IsMigEnabled                → is_slice_enabled  (chip bound into a slice)
+- GetMigDevices               → get_slices
+- GetDeviceHandleFromMigDeviceHandle → get_parent_chip
+- GetCudaComputeCapability    → get_generation  ((major, variant_rank))
+- GetDriverVersion            → get_driver_version  (libtpu version string)
+- GetCudaDriverVersion        → get_runtime_version (PJRT C API (major, minor))
+"""
+
+from __future__ import annotations
+
+from abc import ABC, abstractmethod
+from typing import Dict, List, Tuple
+
+
+class ResourceError(Exception):
+    """Raised by backends for probe failures (CUresult/nvml.Return analog)."""
+
+
+class Chip(ABC):
+    """One TPU chip, or one slice partition when returned by get_slices().
+
+    Slice partitions support get_attributes()/get_parent_chip(); full chips
+    raise ResourceError there, mirroring nvmlDevice vs nvmlMigDevice
+    (internal/resource/nvml-device.go:26-88, nvml-mig-device.go:35-105).
+    """
+
+    @abstractmethod
+    def is_slice_enabled(self) -> bool: ...
+
+    @abstractmethod
+    def is_slice_capable(self) -> bool: ...
+
+    @abstractmethod
+    def get_slices(self) -> List["Chip"]: ...
+
+    @abstractmethod
+    def get_attributes(self) -> Dict[str, object]: ...
+
+    @abstractmethod
+    def get_name(self) -> str: ...
+
+    @abstractmethod
+    def get_total_memory_mb(self) -> int: ...
+
+    @abstractmethod
+    def get_parent_chip(self) -> "Chip": ...
+
+    @abstractmethod
+    def get_generation(self) -> Tuple[int, int]: ...
+
+
+class Manager(ABC):
+    """A device backend (internal/resource/types.go:22-28 analog)."""
+
+    @abstractmethod
+    def init(self) -> None: ...
+
+    @abstractmethod
+    def shutdown(self) -> None: ...
+
+    @abstractmethod
+    def get_chips(self) -> List[Chip]: ...
+
+    @abstractmethod
+    def get_driver_version(self) -> str:
+        """libtpu version string "X.Y[.Z]"."""
+
+    @abstractmethod
+    def get_runtime_version(self) -> Tuple[int, int]:
+        """PJRT C API (major, minor)."""

@@ -1,0 +1,3 @@
+from gpu_feature_discovery_tpu.topology.slice_info import SliceInfo
+
+__all__ = ["SliceInfo"]

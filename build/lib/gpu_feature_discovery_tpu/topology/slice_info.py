@@ -1,0 +1,54 @@
+"""Slice grouping + validation helpers for the topology-strategy engine.
+
+The internal/mig package analog (internal/mig/mig.go:32-124): group the
+node's chips by whether they are bound into a slice partition, memoized so
+one label pass probes each chip once.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+from gpu_feature_discovery_tpu.resource.types import Chip, Manager
+
+
+class SliceInfo:
+    """Per-pass view of the node's chips keyed by slice binding
+    (mig.DeviceInfo analog)."""
+
+    def __init__(self, manager: Manager):
+        self._manager = manager
+        self._chips_map: Optional[Dict[bool, List[Chip]]] = None
+
+    def get_chips_map(self) -> Dict[bool, List[Chip]]:
+        """Chips grouped by is_slice_enabled(); built on first use
+        (mig.go:41-64)."""
+        if self._chips_map is None:
+            grouped: Dict[bool, List[Chip]] = {True: [], False: []}
+            for chip in self._manager.get_chips():
+                grouped[chip.is_slice_enabled()].append(chip)
+            self._chips_map = grouped
+        return self._chips_map
+
+    def get_chips_with_slices_enabled(self) -> List[Chip]:
+        return self.get_chips_map()[True]
+
+    def get_chips_with_slices_disabled(self) -> List[Chip]:
+        return self.get_chips_map()[False]
+
+    def any_slice_enabled_chip_is_empty(self) -> bool:
+        """True when some slice-enabled chip exposes no slice partitions —
+        an invalid configuration under strategy=single (mig.go:85-106;
+        vacuously true for the empty set, as in the reference)."""
+        enabled = self.get_chips_with_slices_enabled()
+        if not enabled:
+            return True
+        return any(not chip.get_slices() for chip in enabled)
+
+    def get_all_slices(self) -> List[Chip]:
+        """Every slice partition across all slice-enabled chips
+        (mig.go:109-124)."""
+        slices: List[Chip] = []
+        for chip in self.get_chips_with_slices_enabled():
+            slices.extend(chip.get_slices())
+        return slices

@@ -1,0 +1,38 @@
+"""Pin JAX to an n-device virtual CPU platform (test/dry-run harnesses).
+
+Multi-chip sharding code is validated on virtual CPU devices
+(``--xla_force_host_platform_device_count``) because real multi-chip
+hardware is not present in CI. The pin must happen before the first device
+query — JAX freezes its backend on init — and must go through
+``jax.config`` because this image's sitecustomize overrides the
+``JAX_PLATFORMS`` env var after import.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+_COUNT_FLAG = "--xla_force_host_platform_device_count"
+
+
+def pin_virtual_cpu_devices(n_devices: int) -> None:
+    """Ensure >= n_devices virtual CPU devices and pin the cpu platform.
+
+    An existing count flag is raised when too small and left alone when
+    already sufficient, so nested harnesses (conftest then dryrun) compose.
+    No-op protection against an already-initialized backend is not possible
+    — callers get a clear "need N devices" error from mesh construction.
+    """
+    flags = os.environ.get("XLA_FLAGS", "")
+    m = re.search(rf"{_COUNT_FLAG}=(\d+)", flags)
+    if m is None:
+        os.environ["XLA_FLAGS"] = (flags + f" {_COUNT_FLAG}={n_devices}").strip()
+    elif int(m.group(1)) < n_devices:
+        os.environ["XLA_FLAGS"] = flags.replace(
+            m.group(0), f"{_COUNT_FLAG}={n_devices}"
+        )
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

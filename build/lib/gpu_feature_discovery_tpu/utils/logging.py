@@ -1,0 +1,14 @@
+"""Logging setup matching the reference's stdlib-log-to-stderr style
+(reference: cmd/gpu-feature-discovery/main.go uses Go's log package)."""
+
+import logging
+import sys
+
+
+def setup(debug: bool = False) -> None:
+    logging.basicConfig(
+        stream=sys.stderr,
+        level=logging.DEBUG if debug else logging.INFO,
+        format="%(asctime)s %(name)s: %(message)s",
+        datefmt="%Y/%m/%d %H:%M:%S",
+    )

@@ -1,0 +1,29 @@
+"""Per-labeler duration tracing.
+
+The reference has no tracing at all (SURVEY.md section 5); we add a light
+per-stage timer to prove the <100ms label-generation p50 target from
+BASELINE.json, logged at debug level and queryable by bench.py.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from contextlib import contextmanager
+from typing import Dict, Iterator
+
+log = logging.getLogger("tfd.timing")
+
+# Most recent duration (seconds) per stage name; overwritten on every pass.
+last_durations: Dict[str, float] = {}
+
+
+@contextmanager
+def timed(stage: str) -> Iterator[None]:
+    start = time.perf_counter()
+    try:
+        yield
+    finally:
+        elapsed = time.perf_counter() - start
+        last_durations[stage] = elapsed
+        log.debug("stage %s took %.3f ms", stage, elapsed * 1e3)

@@ -27,8 +27,7 @@ def test_configuration_doc_covers_every_flag():
         assert f"`--{fd.name}`" in doc, f"flag --{fd.name} undocumented"
         for env in fd.env_vars:
             assert f"`{env}`" in doc, f"env alias {env} undocumented"
-        # The default must appear on the flag's table row (number/string/
-        # bool rendering is prose, so just require the row mentions it).
+        # The default must appear on the flag's table row.
         row = next(
             line for line in doc.splitlines() if f"`--{fd.name}`" in line
         )
@@ -36,6 +35,15 @@ def test_configuration_doc_covers_every_flag():
             assert f"`{str(fd.default).lower()}`" in row, (
                 f"--{fd.name} default not documented"
             )
+        elif isinstance(fd.default, (int, float)):
+            rendered = (
+                str(int(fd.default))
+                if float(fd.default).is_integer()
+                else str(fd.default)
+            )
+            assert rendered in row, f"--{fd.name} default not documented"
+        elif isinstance(fd.default, str) and fd.default:
+            assert fd.default in row, f"--{fd.name} default not documented"
     for env in CONFIG_FILE_ENV_VARS:
         assert f"`{env}`" in doc
 
@@ -51,7 +59,7 @@ def test_configuration_doc_names_no_phantom_flags():
         assert m.group(1) in known, f"doc names unknown flag --{m.group(1)}"
 
 
-def test_configuration_doc_config_file_keys_parse():
+def test_configuration_doc_config_file_keys_parse(tmp_path):
     """The YAML example in the doc must round-trip through the real
     config-file parser — a renamed camelCase key fails here."""
     import yaml
@@ -63,15 +71,9 @@ def test_configuration_doc_config_file_keys_parse():
     parsed = yaml.safe_load(block)
     assert parsed["version"] == "v1"
 
-    import tempfile
-
-    with tempfile.NamedTemporaryFile("w", suffix=".yaml", delete=False) as f:
-        f.write(block)
-        path = f.name
-    try:
-        config = spec.parse_config_file(path)
-    finally:
-        os.unlink(path)
+    path = tmp_path / "example.yaml"
+    path.write_text(block)
+    config = spec.parse_config_file(str(path))
     assert config.flags.tpu_topology_strategy == "single"
     assert config.flags.fail_on_init_error is False
     assert config.flags.tfd.sleep_interval == 60.0
@@ -79,32 +81,71 @@ def test_configuration_doc_config_file_keys_parse():
     assert config.sharing.time_slicing.resources[0].replicas == 4
 
 
+def _golden_label_keys():
+    """Every label key the golden suite pins the daemon emitting, derived
+    mechanically from tests/expected-output-*.txt (regex goldens escape
+    dots/slashes; exact-value goldens are plain key=value). Concrete
+    mixed-strategy topologies normalize to the doc's `tpu-<topology>.`
+    placeholder; health keys come from lm/health.py since no golden can
+    pin on-chip measurements."""
+    keys = set()
+    here = os.path.dirname(os.path.abspath(__file__))
+    import glob
+
+    for path in glob.glob(os.path.join(here, "expected-output*.txt")):
+        with open(path) as f:
+            for line in f:
+                key = line.split("=", 1)[0].strip()
+                if not key:
+                    continue
+                key = key.replace("\\.", ".").replace("\\/", "/")
+                key = key.removeprefix("google.com/")
+                # Concrete AND regex-class mixed-family prefixes both
+                # normalize to the doc's placeholder.
+                key = re.sub(
+                    r"^tpu-([0-9]+x[0-9]+(x[0-9]+)?|\[0-9x\]\+)\.",
+                    "tpu-<topology>.",
+                    key,
+                )
+                keys.add(key)
+    from gpu_feature_discovery_tpu.lm import health
+
+    keys.update(
+        v.removeprefix("google.com/")
+        for k, v in vars(health).items()
+        if k.startswith("HEALTH_")
+    )
+    return keys
+
+
 def test_labels_doc_covers_emitted_label_families():
-    """Every label key family the labelers can emit must appear in
-    docs/labels.md (checked by key, values are prose)."""
+    """Every label key the goldens pin (plus the health family) must
+    appear in docs/labels.md — deleting a doc row or adding an
+    undocumented label fails here."""
     doc = read("labels.md")
-    families = [
-        "tpu.product", "tpu.count", "tpu.replicas", "tpu.memory",
-        "tpu.family", "tpu.generation.major", "tpu.generation.minor",
-        "tpu.tensorcores", "tpu.sparsecores", "tpu.slice.capable",
-        "tpu.driver.major", "tpu.runtime.major", "tpu.machine",
-        "tfd.timestamp", "tpu.topology.strategy", "tpu.slice.chips",
-        "tpu.slice.hosts", "tpu.slice.memory", "tpu.ici.links",
-        "tpu.health.ok", "tpu.health.matmul-tflops", "tpu.health.hbm-gbps",
-        "tpu.health.probe-ms", "tpu.multihost.worker-id",
-        "tpu.pci.host-interface", "tpu.pci.host-driver-version",
-    ]
     # The doc collapses sibling keys into one row (`tpu.generation.
     # major/minor`, `tpu.slice.chips/hosts/memory`): expand every
     # backticked slash-run into its member keys before matching.
     documented = set()
-    for token in re.findall(r"`google\.com/([a-z0-9./_-]+)`", doc):
+    for token in re.findall(
+        r"`google\.com/([a-zA-Z0-9./_<>-]+)`", doc
+    ):
         parts = token.split("/")
-        documented.add(parts[0])
-        base = parts[0].rsplit(".", 1)[0]
+        prev = parts[0]
+        documented.add(prev)
         for sibling in parts[1:]:
-            documented.add(f"{base}.{sibling}")
-    for fam in families:
-        assert any(d == fam or d.startswith(fam + ".") for d in documented), (
-            f"label family {fam} undocumented in labels.md"
-        )
+            # A sibling replaces trailing components of the previous key;
+            # how many is ambiguous in prose (`topology.x/y/z/ici.links`:
+            # `y` replaces one of `topology.x`, `ici.links` replaces two
+            # of `topology.z`), so admit every depth — over-generation
+            # cannot produce false failures in a coverage check.
+            comps = prev.split(".")
+            for depth in range(1, len(comps)):
+                documented.add(".".join(comps[:-depth] + [sibling]))
+            prev = ".".join(comps[:-1] + [sibling])
+    missing = sorted(
+        fam
+        for fam in _golden_label_keys()
+        if not any(d == fam or d.startswith(fam + ".") for d in documented)
+    )
+    assert not missing, f"label families undocumented in labels.md: {missing}"
