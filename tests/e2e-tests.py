@@ -13,6 +13,9 @@ tests/test_e2e_script.py, against kind in CI, and against a real GKE TPU
 node pool.
 
 Usage: python tests/e2e-tests.py TFD_YAML_PATH NFD_YAML_PATH [GOLDEN_PATH]
+       python tests/e2e-tests.py --skip-deploy [GOLDEN_PATH]
+--skip-deploy watches and asserts only — for deployments made by another
+tool (the helm-install CI scenario).
 Env: KUBECONFIG selects the cluster; TFD_E2E_WATCH_TIMEOUT_S overrides
 the 180 s watch budget (tests use a short one).
 """
@@ -48,11 +51,23 @@ def check_labels(expected_regexs, labels):
 
 
 def main():
-    if len(sys.argv) not in (3, 4):
-        print(f"Usage: {sys.argv[0]} TFD_YAML NFD_YAML [GOLDEN]", file=sys.stderr)
+    argv = list(sys.argv[1:])
+    skip_deploy = "--skip-deploy" in argv
+    if skip_deploy:
+        argv.remove("--skip-deploy")
+    if (skip_deploy and len(argv) > 1) or (
+        not skip_deploy and len(argv) not in (2, 3)
+    ):
+        print(
+            f"Usage: {sys.argv[0]} TFD_YAML NFD_YAML [GOLDEN]\n"
+            f"       {sys.argv[0]} --skip-deploy [GOLDEN]",
+            file=sys.stderr,
+        )
         return 1
-    golden = sys.argv[3] if len(sys.argv) == 4 else os.path.join(
-        HERE, "expected-output.txt"
+    golden = (
+        argv[-1]
+        if (skip_deploy and argv) or (not skip_deploy and len(argv) == 3)
+        else os.path.join(HERE, "expected-output.txt")
     )
 
     print("Running E2E tests for TFD")
@@ -72,24 +87,40 @@ def main():
         for n in nodes
     }
 
-    print("Deploying NFD and TFD")
-    # NFD first: its manifest creates the node-feature-discovery namespace
-    # the TFD DaemonSet deploys into — the reverse order 404s on a fresh
-    # cluster.
-    deploy_yaml_file(client, sys.argv[2])
-    deploy_yaml_file(client, sys.argv[1])
+    if skip_deploy:
+        print("Skipping deploy (deployed externally)")
+    else:
+        print("Deploying NFD and TFD")
+        # NFD first: its manifest creates the node-feature-discovery
+        # namespace the TFD DaemonSet deploys into — the reverse order
+        # 404s on a fresh cluster.
+        deploy_yaml_file(client, argv[1])
+        deploy_yaml_file(client, argv[0])
 
     print("Watching node updates")
     labeled_node = None
+    # The label may have landed BEFORE the watch opens (always possible
+    # in --skip-deploy mode, where deployment happened in an earlier
+    # step): check the list snapshot first — a watch starting at "now"
+    # would never see it.
+    for n in client.get("/api/v1/nodes").get("items", []):
+        if TIMESTAMP_LABEL in (n["metadata"].get("labels") or {}):
+            labeled_node = n["metadata"]["name"]
+            print(f"Timestamp label already on {labeled_node}. Not watching")
+            break
     # timeoutSeconds is server-side: the stream ends cleanly at expiry
     # instead of raising a client read timeout.
-    for event in client.watch("/api/v1/nodes", timeout_s=WATCH_TIMEOUT_S):
-        if event.get("type") == "MODIFIED":
-            labels = event["object"]["metadata"].get("labels") or {}
-            if TIMESTAMP_LABEL in labels:
-                labeled_node = event["object"]["metadata"]["name"]
-                print(f"Timestamp label found on {labeled_node}. Stop watching")
-                break
+    if labeled_node is None:
+        for event in client.watch("/api/v1/nodes", timeout_s=WATCH_TIMEOUT_S):
+            if event.get("type") == "MODIFIED":
+                labels = event["object"]["metadata"].get("labels") or {}
+                if TIMESTAMP_LABEL in labels:
+                    labeled_node = event["object"]["metadata"]["name"]
+                    print(
+                        f"Timestamp label found on {labeled_node}. "
+                        "Stop watching"
+                    )
+                    break
     if labeled_node is None:
         print("Timestamp label never appeared", file=sys.stderr)
         return 1

@@ -461,6 +461,81 @@ def test_k8s_stdlib_tls_client_certs(tmp_path):
         api.shutdown()
 
 
+def test_e2e_script_skip_deploy_watches_only(tmp_path):
+    """--skip-deploy (the helm-install CI scenario): the script must POST
+    nothing and still pass once the externally-deployed daemon's labels
+    land."""
+    features_file = tmp_path / "features.d" / "tfd"
+    features_file.parent.mkdir()
+    run_tfd_daemon_oneshot(features_file)
+
+    api = FakeKubeApi(str(features_file))
+    api.tfd_deployed.set()  # the external deployment already happened
+    env = dict(os.environ)
+    env["KUBECONFIG"] = write_kubeconfig(tmp_path, api.url)
+    env["TFD_E2E_WATCH_TIMEOUT_S"] = "10"
+    try:
+        result = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(HERE, "e2e-tests.py"),
+                "--skip-deploy",
+                os.path.join(HERE, "expected-output.txt"),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=180,
+            env=env,
+        )
+        assert result.returncode == 0, (
+            f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+        )
+        assert "Skipping deploy" in result.stdout
+        assert api.created == []  # watch-only: nothing was POSTed
+    finally:
+        api.shutdown()
+
+
+def test_e2e_script_sees_label_that_landed_before_watch(tmp_path):
+    """A watch starts at 'now': when the externally-deployed daemon's
+    labels landed before the script ran (always possible in the helm
+    scenario), the list snapshot must satisfy the check — the watch
+    would never emit."""
+    features_file = tmp_path / "features.d" / "tfd"
+    features_file.parent.mkdir()
+    run_tfd_daemon_oneshot(features_file)
+
+    api = FakeKubeApi(str(features_file))
+    # Labels already applied; the watch will never fire (tfd_deployed
+    # stays unset, so the fake's watch emits nothing and expires).
+    with open(features_file) as f:
+        api.node_labels.update(
+            dict(line.strip().split("=", 1) for line in f if "=" in line)
+        )
+    env = dict(os.environ)
+    env["KUBECONFIG"] = write_kubeconfig(tmp_path, api.url)
+    env["TFD_E2E_WATCH_TIMEOUT_S"] = "3"
+    try:
+        result = subprocess.run(
+            [
+                sys.executable,
+                os.path.join(HERE, "e2e-tests.py"),
+                "--skip-deploy",
+                os.path.join(HERE, "expected-output.txt"),
+            ],
+            capture_output=True,
+            text=True,
+            timeout=120,
+            env=env,
+        )
+        assert result.returncode == 0, (
+            f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+        )
+        assert "already on fake-node-1" in result.stdout
+    finally:
+        api.shutdown()
+
+
 def _token_kubeconfig(tmp_path, server_url, user):
     path = tmp_path / "kubeconfig-token"
     path.write_text(

@@ -86,6 +86,29 @@ def test_helm_template_nfd_deploy_false_renders_tfd_only():
 
 
 @needs_helm
+def test_helm_template_extra_env():
+    """extraEnv appends literal env vars after the value-mapped flags —
+    how the kind helm e2e injects TFD_BACKEND (docs/configuration.md)."""
+    import yaml
+
+    out = helm(
+        "template", "tfd", CHART, "-n", "node-feature-discovery",
+        "--set", "extraEnv[0].name=TFD_BACKEND",
+        "--set", "extraEnv[0].value=mock:v4-8",
+    )
+    docs = [d for d in yaml.safe_load_all(out) if d]
+    (ds,) = [
+        d for d in docs
+        if d.get("kind") == "DaemonSet"
+        and "tpu-feature-discovery" in d["metadata"]["name"]
+    ]
+    (container,) = ds["spec"]["template"]["spec"]["containers"]
+    env = {e["name"]: e["value"] for e in container["env"]}
+    assert env["TFD_BACKEND"] == "mock:v4-8"
+    assert "TFD_TPU_TOPOLOGY_STRATEGY" in env  # flag-mapped envs intact
+
+
+@needs_helm
 def test_helm_template_value_overrides_reach_env():
     """Chart values flow to the daemon's env contract (the reference's
     values->env mapping, templates/daemonset.yml:56-75)."""

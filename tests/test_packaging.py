@@ -11,6 +11,7 @@ binary (Dockerfile.ubi8:28).
 """
 
 import os
+import shutil
 import subprocess
 import sys
 import zipfile
@@ -23,12 +24,24 @@ REPO_ROOT = os.path.dirname(HERE)
 
 @pytest.fixture(scope="module")
 def wheel(tmp_path_factory):
+    # Build from a COPY of the tree: an in-tree build would drop build/
+    # and .egg-info/ into the checkout, and a stale build/lib from a
+    # previous run can resurrect deleted modules into the wheel (the
+    # exact regression class this test exists to catch).
+    src = tmp_path_factory.mktemp("src")
+    for name in ("pyproject.toml", "README.md", "constraints.txt"):
+        shutil.copy2(os.path.join(REPO_ROOT, name), src / name)
+    shutil.copytree(
+        os.path.join(REPO_ROOT, "gpu_feature_discovery_tpu"),
+        src / "gpu_feature_discovery_tpu",
+        ignore=shutil.ignore_patterns("__pycache__"),
+    )
     dist = tmp_path_factory.mktemp("dist")
     result = subprocess.run(
         [
             sys.executable, "-m", "pip", "wheel",
             "--no-deps", "--no-build-isolation", "--no-index",
-            "-w", str(dist), REPO_ROOT,
+            "-w", str(dist), str(src),
         ],
         capture_output=True,
         text=True,
