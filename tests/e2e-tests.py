@@ -99,15 +99,20 @@ def main():
 
     print("Watching node updates")
     labeled_node = None
-    # The label may have landed BEFORE the watch opens (always possible
-    # in --skip-deploy mode, where deployment happened in an earlier
-    # step): check the list snapshot first — a watch starting at "now"
-    # would never see it.
-    for n in client.get("/api/v1/nodes").get("items", []):
-        if TIMESTAMP_LABEL in (n["metadata"].get("labels") or {}):
-            labeled_node = n["metadata"]["name"]
-            print(f"Timestamp label already on {labeled_node}. Not watching")
-            break
+    # In --skip-deploy mode the label may have landed BEFORE the watch
+    # opens (deployment happened in an earlier step): check the list
+    # snapshot first — a watch starting at "now" would never see it.
+    # Deploy mode must NOT take this shortcut: a stale timestamp from a
+    # previous deployment would pass without validating the new one; the
+    # fresh daemon's first cycle always produces a MODIFIED event.
+    if skip_deploy:
+        for n in client.get("/api/v1/nodes").get("items", []):
+            if TIMESTAMP_LABEL in (n["metadata"].get("labels") or {}):
+                labeled_node = n["metadata"]["name"]
+                print(
+                    f"Timestamp label already on {labeled_node}. Not watching"
+                )
+                break
     # timeoutSeconds is server-side: the stream ends cleanly at expiry
     # instead of raising a client read timeout.
     if labeled_node is None:

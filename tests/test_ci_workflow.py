@@ -1,0 +1,78 @@
+"""CI workflow sanity: the jobs reference scripts, manifests, and goldens
+by path, and none of it executes on this machine — a typo'd path would
+surface only as a red run on a real Actions runner. Pin mechanically
+what can be pinned: every repo path a `run:` step mentions must exist,
+and the docker-e2e matrix rows must be internally consistent."""
+
+import os
+import re
+
+import yaml
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO_ROOT = os.path.dirname(HERE)
+WORKFLOW = os.path.join(REPO_ROOT, ".github", "workflows", "ci.yml")
+
+
+def load_workflow():
+    with open(WORKFLOW) as f:
+        return yaml.safe_load(f)
+
+
+def iter_run_steps(wf):
+    for job_name, job in wf["jobs"].items():
+        for step in job.get("steps", []):
+            if "run" in step:
+                yield job_name, step
+
+
+def test_every_repo_path_in_run_steps_exists():
+    wf = load_workflow()
+    pattern = re.compile(
+        r"(?:^|[\s\"'=])((?:tests|deployments|gpu_feature_discovery_tpu|docs)"
+        r"/[A-Za-z0-9._/-]+)"
+    )
+    checked = 0
+    for job, step in iter_run_steps(wf):
+        for m in pattern.finditer(step["run"]):
+            path = m.group(1)
+            assert os.path.exists(os.path.join(REPO_ROOT, path)), (
+                f"job {job!r} step {step.get('name', '?')!r} references "
+                f"missing path {path}"
+            )
+            checked += 1
+    assert checked >= 10  # the guard itself must keep matching something
+
+
+def test_docker_e2e_matrix_rows_are_consistent():
+    wf = load_workflow()
+    rows = wf["jobs"]["docker-e2e"]["strategy"]["matrix"]["include"]
+    assert {r["scenario"] for r in rows} >= {"base", "topology-single", "helm"}
+    for row in rows:
+        assert os.path.exists(os.path.join(REPO_ROOT, row["golden"])), row
+        if row["scenario"] != "helm":
+            assert os.path.exists(os.path.join(REPO_ROOT, row["manifest"])), row
+        # The backend grammar must be one the factory accepts.
+        assert row["backend"].startswith(
+            ("mock:", "mock-slice:", "mock-worker:", "mock-mixed:")
+        ), row
+
+
+def test_helm_scenario_gating_covers_all_e2e_steps():
+    """Every step that deploys or asserts must be gated onto exactly one
+    arm (helm vs static) — an ungated deploy step would run twice."""
+    wf = load_workflow()
+    steps = wf["jobs"]["docker-e2e"]["steps"]
+    arms = {"helm": 0, "static": 0}
+    for step in steps:
+        run = step.get("run", "")
+        if any(
+            cmd in run
+            for cmd in ("e2e-tests.py", "helm install", "ci-prepare-e2e")
+        ):
+            cond = step.get("if", "")
+            assert "matrix.scenario" in cond, (
+                f"ungated deploy/assert step: {step.get('name', '?')}"
+            )
+            arms["helm" if "== 'helm'" in cond else "static"] += 1
+    assert arms["helm"] == 2 and arms["static"] == 2
