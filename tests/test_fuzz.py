@@ -127,6 +127,58 @@ def test_walkers_agree_on_random_config_spaces(config):
     assert native.pci_vendor_capability(config) == _python_walk(config)
 
 
+# Random bytes almost never start with the 0x09 capability id, so fuzz
+# BOTH raw bytes (header/guard paths) and header-prefixed bodies (the
+# record-id / signature / field-split parsing paths).
+@given(
+    st.one_of(
+        st.binary(max_size=64),
+        st.binary(max_size=61).map(
+            lambda b: bytes([0x09, 0x00, len(b) + 3]) + b
+        ),
+    )
+)
+@settings(max_examples=400)
+def test_decode_vendor_capability_never_raises(cap):
+    """Arbitrary capability bytes (truncated reads, corrupt records, a
+    future device revision) must decode to None or a HostInterfaceInfo
+    with printable-ASCII strings — never raise (warn-don't-fail lives
+    with the caller)."""
+    from gpu_feature_discovery_tpu.pci.pciutil import decode_vendor_capability
+
+    info = decode_vendor_capability(cap)
+    if info is not None:
+        assert info.signature and info.signature.isprintable()
+        for s in (info.driver_version, info.driver_branch):
+            assert s == "" or s.isprintable()
+
+
+# Printable non-control ASCII only: every generated example must exercise
+# the positional property, not vacuously pass a filter.
+_FIELD_ALPHABET = string.ascii_letters + string.digits + string.punctuation + " "
+
+
+@given(st.text(alphabet=_FIELD_ALPHABET, max_size=40),
+       st.text(alphabet=_FIELD_ALPHABET, max_size=40))
+@settings(max_examples=200)
+def test_decode_vendor_capability_positional_fields(version, branch):
+    """Any printable-ASCII (version, branch) pair embedded in a record-id-0
+    body decodes back POSITIONALLY — an empty version must never promote
+    the branch into the version slot (r3 review finding)."""
+    from gpu_feature_discovery_tpu.pci.pciutil import (
+        decode_vendor_capability,
+        make_capability,
+    )
+
+    body = b"TPUICI\x00\x00" + version.encode() + b"\x00" + branch.encode() + b"\x00"
+    cap = make_capability(0x09, body)
+    info = decode_vendor_capability(cap)
+    assert info is not None
+    assert info.signature == "TPUICI"
+    assert info.driver_version == version
+    assert info.driver_branch == branch
+
+
 # ---------------------------------------------------------------------------
 # label file round trip
 # ---------------------------------------------------------------------------
