@@ -47,10 +47,24 @@ def test_every_repo_path_in_run_steps_exists():
 def test_docker_e2e_matrix_rows_are_consistent():
     wf = load_workflow()
     rows = wf["jobs"]["docker-e2e"]["strategy"]["matrix"]["include"]
-    assert {r["scenario"] for r in rows} >= {"base", "topology-single", "helm"}
+    assert {r["scenario"] for r in rows} >= {
+        "base", "topology-single", "helm", "oneshot-job"
+    }
+    job_runs = "\n".join(
+        step["run"] for step in wf["jobs"]["docker-e2e"]["steps"]
+        if "run" in step
+    )
     for row in rows:
         assert os.path.exists(os.path.join(REPO_ROOT, row["golden"])), row
-        if row["scenario"] != "helm":
+        if row["scenario"] == "helm":
+            continue
+        if row["manifest"].startswith("/tmp/"):
+            # Generated manifests must actually be generated: some step
+            # in the same job has to redirect into that exact path.
+            assert f"> {row['manifest']}" in job_runs, (
+                f"no step writes {row['manifest']}"
+            )
+        else:
             assert os.path.exists(os.path.join(REPO_ROOT, row["manifest"])), row
         # The backend grammar must be one the factory accepts.
         assert row["backend"].startswith(

@@ -107,14 +107,18 @@ class FakeKubeApi:
                     with state.lock:
                         # An AlreadyExists namespace still exists.
                         state.namespaces.add(name)
+                is_tfd_workload = (
+                    kind in ("DaemonSet", "Job")
+                    and "tpu-feature-discovery" in name
+                )
                 if kind in state.conflict_kinds:
-                    if kind == "DaemonSet" and "tpu-feature-discovery" in name:
+                    if is_tfd_workload:
                         # The stale daemon from the previous deploy is
                         # still running and relabeling.
                         state.tfd_deployed.set()
                     return self._json({"reason": "AlreadyExists"}, code=409)
                 state.created.append((self.path, kind, name))
-                if kind == "DaemonSet" and "tpu-feature-discovery" in name:
+                if is_tfd_workload:
                     state.tfd_deployed.set()
                 self._json(body, code=201)
 
@@ -264,8 +268,11 @@ def run_e2e(
             "tpu-feature-discovery-daemonset-with-topology-single.yaml",
             "expected-output-topology-single.txt",
         ),
+        # The oneshot Job template ("JOB" = instantiated in the test via
+        # NODE_NAME substitution), also a kind CI scenario.
+        ("mock:v4-8", "none", "JOB", "expected-output.txt"),
     ],
-    ids=["base", "topology-single"],
+    ids=["base", "topology-single", "oneshot-job"],
 )
 def test_e2e_script_against_fake_cluster(
     tmp_path, backend, strategy, manifest, golden
@@ -273,6 +280,17 @@ def test_e2e_script_against_fake_cluster(
     features_file = tmp_path / "features.d" / "tfd"
     features_file.parent.mkdir()
     run_tfd_daemon_oneshot(features_file, strategy=strategy, backend=backend)
+
+    if manifest == "JOB":
+        template = os.path.join(
+            REPO_ROOT,
+            "deployments/static/tpu-feature-discovery-job.yaml.template",
+        )
+        with open(template) as f:
+            substituted = f.read().replace("NODE_NAME", NODE_NAME)
+        manifest = str(tmp_path / "tfd-job.yaml")
+        with open(manifest, "w") as f:
+            f.write(substituted)
 
     api = FakeKubeApi(str(features_file))
     try:
@@ -300,10 +318,16 @@ def test_e2e_script_against_fake_cluster(
                 "ClusterRoleBinding") in posted
         assert ("/apis/apps/v1/namespaces/node-feature-discovery/deployments",
                 "Deployment") in posted
-        # Everything in both manifests deployed: 2 DaemonSets (TFD + the
-        # NFD worker) and the nfd.yaml supporting objects.
+        # Everything in both manifests deployed. TFD arrives as a Job in
+        # the oneshot scenario (batch API group), as a DaemonSet otherwise;
+        # the NFD worker is always the other DaemonSet.
         kinds = sorted(kind for _, kind, _ in api.created)
-        assert kinds.count("DaemonSet") == 2
+        if manifest.endswith("tfd-job.yaml"):
+            assert ("/apis/batch/v1/namespaces/node-feature-discovery/jobs",
+                    "Job") in posted
+            assert kinds.count("DaemonSet") == 1
+        else:
+            assert kinds.count("DaemonSet") == 2
     finally:
         api.shutdown()
 
