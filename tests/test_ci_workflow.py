@@ -48,7 +48,7 @@ def test_docker_e2e_matrix_rows_are_consistent():
     wf = load_workflow()
     rows = wf["jobs"]["docker-e2e"]["strategy"]["matrix"]["include"]
     assert {r["scenario"] for r in rows} >= {
-        "base", "topology-single", "helm", "oneshot-job"
+        "base", "topology-single", "topology-mixed", "helm", "oneshot-job"
     }
     job_runs = "\n".join(
         step["run"] for step in wf["jobs"]["docker-e2e"]["steps"]

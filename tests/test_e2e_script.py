@@ -268,11 +268,18 @@ def run_e2e(
             "tpu-feature-discovery-daemonset-with-topology-single.yaml",
             "expected-output-topology-single.txt",
         ),
+        (
+            "mock-mixed:v5e:2x2,2x2",
+            "mixed",
+            "deployments/static/"
+            "tpu-feature-discovery-daemonset-with-topology-mixed.yaml",
+            "expected-output-topology-mixed.txt",
+        ),
         # The oneshot Job template ("JOB" = instantiated in the test via
         # NODE_NAME substitution), also a kind CI scenario.
         ("mock:v4-8", "none", "JOB", "expected-output.txt"),
     ],
-    ids=["base", "topology-single", "oneshot-job"],
+    ids=["base", "topology-single", "topology-mixed", "oneshot-job"],
 )
 def test_e2e_script_against_fake_cluster(
     tmp_path, backend, strategy, manifest, golden
