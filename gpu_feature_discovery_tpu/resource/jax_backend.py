@@ -108,6 +108,7 @@ class JaxManager(Manager):
         self._devices = None  # created once, held (see module docstring)
         self._all_devices: list = []
         self._slice_topology = ""
+        self._driver_version: Optional[str] = None
 
     def init(self) -> None:
         if self._devices is not None:
@@ -188,18 +189,28 @@ class JaxManager(Manager):
         return chips
 
     def get_driver_version(self) -> str:
-        """libtpu distribution version — the driver-version analog."""
+        """libtpu distribution version — the driver-version analog.
+
+        Memoized for the manager's lifetime: the loaded library cannot
+        change under a live process (unlike NVML, where the reference's
+        per-cycle re-probe is a cheap C call, this walks installed-package
+        metadata — ~0.6 ms/cycle, 2/3 of the whole labeling pass), and a
+        SIGHUP reload builds a fresh manager which re-reads."""
+        if self._driver_version is not None:
+            return self._driver_version
         for dist in ("libtpu", "libtpu-nightly"):
             try:
                 from importlib.metadata import version
 
-                return version(dist)
+                self._driver_version = version(dist)
+                return self._driver_version
             except Exception:  # noqa: BLE001
                 continue
         try:
             import jaxlib
 
-            return jaxlib.version.__version__
+            self._driver_version = jaxlib.version.__version__
+            return self._driver_version
         except Exception as e:  # noqa: BLE001
             raise ResourceError(f"cannot determine libtpu version: {e}") from e
 
