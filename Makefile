@@ -7,12 +7,16 @@ VERSION ?= 0.1.0
 
 COV_MIN ?= 75
 
-.PHONY: all native test coverage integration bench check-yamls lint helm-check clean docker-build
+.PHONY: all native native-selftest test coverage integration bench check-yamls lint helm-check clean docker-build
 
 all: native test
 
 native:
 	$(MAKE) -C gpu_feature_discovery_tpu/native
+
+# ASan/UBSan over the native parsers (the -race analog, SURVEY.md §5).
+native-selftest:
+	$(MAKE) -C gpu_feature_discovery_tpu/native selftest
 
 test: native
 	$(PYTHON) -m pytest tests/ -q

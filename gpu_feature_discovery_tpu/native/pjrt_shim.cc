@@ -333,6 +333,25 @@ int parse_create_options(const char* spec, CreateOptions* o, char* err_msg,
 
 typedef void* (*PjrtErrorFn)(void*);  /* generic PJRT_Error* f(Args*) */
 
+}  // namespace
+
+#ifdef TFD_TESTING
+/* Sanitizer self-test hook (native/selftest.cc): drives the option
+ * parser directly under ASan/UBSan — the Go `-race` analog SURVEY.md
+ * section 5 calls for. Not compiled into the production library. */
+extern "C" int tfd_test_parse_create_options(const char* spec, char* err_msg,
+                                             size_t err_msg_len,
+                                             size_t* n_parsed) {
+  CreateOptions opts;
+  opts.count = 0;
+  int rc = parse_create_options(spec, &opts, err_msg, err_msg_len);
+  if (n_parsed != nullptr) *n_parsed = opts.count;
+  return rc;
+}
+#endif
+
+namespace {
+
 /* Call a PJRT entry point; on failure, copy the error message into err_msg
  * (when provided) and destroy the error object. Returns true on success. */
 bool pjrt_call(const PjrtApiTable* api, void* fn_slot, void* args,

@@ -103,6 +103,33 @@ def test_probe_so_without_getpjrtapi(native):
     assert not ok
 
 
+def test_sanitizer_selftest(native):
+    """ASan/UBSan over the untrusted-byte parsers (option grammar + PCI
+    walker), the Go -race analog SURVEY.md section 5 calls for. Skips
+    where the sanitizer runtime isn't installed; any memory error or UB
+    in ~40k fuzz iterations aborts the binary and fails here."""
+    build = subprocess.run(
+        ["make", "-C", NATIVE_DIR, "tfd_selftest"],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    if build.returncode != 0:
+        if "asan" in build.stderr or "sanitize" in build.stderr:
+            pytest.skip("sanitizer runtime unavailable")
+        pytest.fail(f"selftest build broke:\n{build.stderr[-2000:]}")
+    run = subprocess.run(
+        [os.path.join(NATIVE_DIR, "tfd_selftest")],
+        capture_output=True,
+        text=True,
+        timeout=300,
+    )
+    assert run.returncode == 0, (
+        f"sanitizer selftest failed:\n{run.stdout}\n{run.stderr[-3000:]}"
+    )
+    assert "selftest: OK" in run.stdout
+
+
 def test_error_strings(native):
     assert native.error_string(0) == "TFD_SUCCESS"
     assert native.error_string(2) == "TFD_ERROR_LIB_NOT_FOUND"
