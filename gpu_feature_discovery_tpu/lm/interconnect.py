@@ -14,7 +14,7 @@ import logging
 from typing import Optional
 
 from gpu_feature_discovery_tpu.hostinfo.tpu_env import HostInfo
-from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.lm.labels import Labels, label_safe_value
 from gpu_feature_discovery_tpu.pci.pciutil import (
     GooglePCI,
     PCIError,
@@ -83,22 +83,36 @@ def _host_interface_labels(devices) -> Labels:
         info = decode_vendor_capability(cap)
         if info is None:
             continue
-        labels[HOST_INTERFACE] = info.signature
-        if info.driver_version:
-            labels[HOST_DRIVER_VERSION] = info.driver_version
-        if info.driver_branch:
-            labels[HOST_DRIVER_BRANCH] = info.driver_branch
+        # Record strings are device-supplied printable ASCII, which is a
+        # wider charset than k8s label values — NFD silently drops labels
+        # with invalid values, so sanitize (same treatment as the DMI
+        # machine type). Fallback is "": a string the sanitizer empties
+        # (e.g. "??") stays ABSENT, per docs/labels.md — sanitization must
+        # not invent an "unknown" the record never carried.
+        signature = label_safe_value(info.signature, fallback="")
+        if not signature:
+            continue
+        labels[HOST_INTERFACE] = signature
+        version = label_safe_value(info.driver_version, fallback="")
+        if version:
+            labels[HOST_DRIVER_VERSION] = version
+        branch = label_safe_value(info.driver_branch, fallback="")
+        if branch:
+            labels[HOST_DRIVER_BRANCH] = branch
         break
     return labels
 
 
 def _host_info_labels(info: HostInfo) -> Labels:
+    # Every string here originates in the TPU VM env / tpu-env file —
+    # free-form host input, same sanitization rationale as the PCI record
+    # strings above (numeric/boolean fields are constructed, not copied).
     labels = Labels()
     if info.accelerator_type:
-        labels[ACCEL_TYPE] = info.accelerator_type
+        labels[ACCEL_TYPE] = label_safe_value(info.accelerator_type)
     topology = info.resolved_topology()
     if topology:
-        labels[SLICE_TOPOLOGY] = topology
+        labels[SLICE_TOPOLOGY] = label_safe_value(topology)
 
     multi = info.multi_host
     labels[MULTIHOST_PRESENT] = str(multi).lower()
@@ -109,14 +123,18 @@ def _host_info_labels(info: HostInfo) -> Labels:
         if count is not None:
             labels[WORKER_COUNT] = str(count)
         if info.chips_per_host_bounds:
-            labels[CHIPS_PER_HOST] = info.chips_per_host_bounds.replace(",", "x")
+            labels[CHIPS_PER_HOST] = label_safe_value(
+                info.chips_per_host_bounds.replace(",", "x")
+            )
 
     for axis, wrapped in zip("xyz", info.wrap):
         labels[f"{WRAP_PREFIX}.{axis}"] = str(wrapped).lower()
 
     # The precise GCE machine type beats the DMI product name when known
-    # (merge order: interconnect runs after the device labeler).
-    machine = info.raw.get("MACHINE_TYPE", "")
+    # (merge order: interconnect runs after the device labeler) — but an
+    # override that sanitizes to nothing must not clobber the sanitized
+    # DMI value with garbage.
+    machine = label_safe_value(info.raw.get("MACHINE_TYPE", ""), fallback="")
     if machine:
         labels[MACHINE] = machine
     return labels

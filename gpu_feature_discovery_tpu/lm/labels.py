@@ -20,6 +20,26 @@ TMP_SUBDIR = "tfd-tmp"
 TMP_PREFIX = "tfd-"
 OUTPUT_MODE = 0o644
 
+# Kubernetes label-value charset ([A-Za-z0-9]([A-Za-z0-9_.-]*[A-Za-z0-9])?,
+# max 63). NFD silently DROPS labels whose values violate it, so values
+# sourced from free-form host strings (DMI product name, PCI record text)
+# must be sanitized or the label vanishes without a trace. The reference
+# only swaps spaces for dashes (machine-type.go:44) and loses e.g. a DMI
+# name containing parentheses.
+_LABEL_VALUE_SAFE = frozenset(
+    "ABCDEFGHIJKLMNOPQRSTUVWXYZabcdefghijklmnopqrstuvwxyz0123456789_.-"
+)
+LABEL_VALUE_MAX = 63
+
+
+def label_safe_value(value: str, fallback: str = "unknown") -> str:
+    """Coerce a free-form string into a valid k8s label value: disallowed
+    characters become dashes, the result is trimmed to valid start/end
+    characters and 63 chars; empty results take the fallback."""
+    safe = "".join(c if c in _LABEL_VALUE_SAFE else "-" for c in value)
+    safe = safe[:LABEL_VALUE_MAX].strip("_.-")
+    return safe if safe else fallback
+
 
 class Labels(dict):
     """A ``key=value`` label map. Also implements the Labeler protocol

@@ -12,7 +12,7 @@ from __future__ import annotations
 
 import logging
 
-from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.lm.labels import Labels, label_safe_value
 
 log = logging.getLogger("tfd.lm")
 
@@ -26,7 +26,11 @@ def new_machine_type_labeler(machine_type_path: str) -> Labels:
     except (OSError, UnicodeDecodeError) as e:
         log.warning("error getting machine type from %s: %s", machine_type_path, e)
         machine_type = MACHINE_TYPE_UNKNOWN
-    return Labels({MACHINE_TYPE_LABEL: machine_type.replace(" ", "-")})
+    # label_safe_value subsumes the reference's spaces→dashes and also
+    # survives DMI names NFD would otherwise drop ("... (Gen 9)").
+    return Labels(
+        {MACHINE_TYPE_LABEL: label_safe_value(machine_type, MACHINE_TYPE_UNKNOWN)}
+    )
 
 
 def _get_machine_type(path: str) -> str:

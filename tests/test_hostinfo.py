@@ -208,6 +208,78 @@ def test_interconnect_host_interface_labels():
     assert labels["google.com/tpu.pci.host-driver-branch"] == "prod"
 
 
+def test_interconnect_sanitizes_record_strings_to_label_values():
+    """Device-supplied record text is printable ASCII, a wider charset
+    than k8s label values; NFD drops invalid values silently, so the
+    labeler must sanitize (same treatment as the DMI machine type)."""
+    from gpu_feature_discovery_tpu.pci.pciutil import (
+        PCIDevice,
+        build_config_space,
+        make_capability,
+    )
+
+    cfg = build_config_space(
+        capabilities=[
+            make_capability(0x09, b"TPU ICI\x00\x001.9 (beta)\x00pre/prod\x00")
+        ]
+    )
+    dev = PCIDevice(path="", address="0000:00:05.0", vendor="0x1ae0",
+                    device_class="0x0880", config=cfg)
+
+    class OnePCI:
+        def devices(self):
+            return [dev]
+
+    labels = InterconnectLabeler(pci=OnePCI()).labels()
+    assert labels["google.com/tpu.pci.host-interface"] == "TPU-ICI"
+    assert labels["google.com/tpu.pci.host-driver-version"] == "1.9--beta"
+    assert labels["google.com/tpu.pci.host-driver-branch"] == "pre-prod"
+
+
+def test_interconnect_sanitization_never_invents_absent_labels():
+    """A record string the sanitizer empties ('??') must stay ABSENT —
+    sanitization must not publish an 'unknown' the record never carried
+    (docs/labels.md: absent when the record omits it)."""
+    from gpu_feature_discovery_tpu.pci.pciutil import (
+        PCIDevice,
+        build_config_space,
+        make_capability,
+    )
+
+    cfg = build_config_space(
+        capabilities=[make_capability(0x09, b"TPUICI\x00\x00??\x00(-)\x00")]
+    )
+    dev = PCIDevice(path="", address="0000:00:05.0", vendor="0x1ae0",
+                    device_class="0x0880", config=cfg)
+
+    class OnePCI:
+        def devices(self):
+            return [dev]
+
+    labels = InterconnectLabeler(pci=OnePCI()).labels()
+    assert labels["google.com/tpu.pci.host-interface"] == "TPUICI"
+    assert "google.com/tpu.pci.host-driver-version" not in labels
+    assert "google.com/tpu.pci.host-driver-branch" not in labels
+
+
+def test_hostinfo_labels_sanitize_env_strings(monkeypatch):
+    """tpu-env/metadata strings are free-form host input: an invalid
+    MACHINE_TYPE override must not clobber the sanitized DMI value with a
+    label NFD would drop, and accelerator-type sanitizes like the rest."""
+    from gpu_feature_discovery_tpu.hostinfo.tpu_env import host_info_from_mapping
+    from gpu_feature_discovery_tpu.lm.interconnect import _host_info_labels
+
+    info = host_info_from_mapping(
+        {
+            "ACCELERATOR_TYPE": "v5e 8 (beta)",
+            "MACHINE_TYPE": "ct5lp hightpu 4t!",
+        }
+    )
+    labels = _host_info_labels(info)
+    assert labels["google.com/tpu.slice.accelerator-type"] == "v5e-8--beta"
+    assert labels["google.com/tpu.machine"] == "ct5lp-hightpu-4t"
+
+
 def test_interconnect_tolerates_short_config_space():
     # Unprivileged containers see a 64-byte config space; the capability
     # read raises PCIError, and the labeler must keep the presence labels

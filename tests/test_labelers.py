@@ -94,6 +94,31 @@ def test_machine_type_reads_and_dashes(tmp_path):
     }
 
 
+def test_machine_type_sanitized_to_label_value_charset(tmp_path):
+    """NFD silently drops labels with invalid values: a DMI name with
+    parentheses/slashes must be coerced, not published verbatim (goes
+    beyond the reference's spaces-only replacement, machine-type.go:44)."""
+    f = tmp_path / "product_name"
+    f.write_text("ThinkPad X1 (Gen 9) rev/2\n")
+    (value,) = new_machine_type_labeler(str(f)).values()
+    import re
+
+    assert re.fullmatch(r"[A-Za-z0-9]([A-Za-z0-9_.-]*[A-Za-z0-9])?", value)
+    assert value == "ThinkPad-X1--Gen-9--rev-2"
+
+
+def test_label_safe_value_edges():
+    from gpu_feature_discovery_tpu.lm.labels import label_safe_value
+
+    assert label_safe_value("ok-1.2_3") == "ok-1.2_3"
+    assert label_safe_value("(weird)") == "weird"
+    assert label_safe_value("---") == "unknown"
+    assert label_safe_value("", fallback="fb") == "fb"
+    assert len(label_safe_value("x" * 100)) == 63
+    # Trimming happens AFTER the cut so the result never ends invalid.
+    assert not label_safe_value("x" * 62 + "..").endswith(".")
+
+
 def test_machine_type_unknown_on_missing_file(tmp_path):
     labels = new_machine_type_labeler(str(tmp_path / "nope"))
     assert labels == {"google.com/tpu.machine": "unknown"}
