@@ -108,11 +108,15 @@ def _host_info_labels(info: HostInfo) -> Labels:
     # free-form host input, same sanitization rationale as the PCI record
     # strings above (numeric/boolean fields are constructed, not copied).
     labels = Labels()
-    if info.accelerator_type:
-        labels[ACCEL_TYPE] = label_safe_value(info.accelerator_type)
-    topology = info.resolved_topology()
+    # fallback="" everywhere: a string that sanitizes to nothing stays
+    # ABSENT — sanitization must never invent an "unknown" the host never
+    # stated (same rule as the PCI record strings above).
+    accel = label_safe_value(info.accelerator_type or "", fallback="")
+    if accel:
+        labels[ACCEL_TYPE] = accel
+    topology = label_safe_value(info.resolved_topology() or "", fallback="")
     if topology:
-        labels[SLICE_TOPOLOGY] = label_safe_value(topology)
+        labels[SLICE_TOPOLOGY] = topology
 
     multi = info.multi_host
     labels[MULTIHOST_PRESENT] = str(multi).lower()
@@ -122,10 +126,11 @@ def _host_info_labels(info: HostInfo) -> Labels:
         count = info.resolved_worker_count()
         if count is not None:
             labels[WORKER_COUNT] = str(count)
-        if info.chips_per_host_bounds:
-            labels[CHIPS_PER_HOST] = label_safe_value(
-                info.chips_per_host_bounds.replace(",", "x")
-            )
+        cph = label_safe_value(
+            (info.chips_per_host_bounds or "").replace(",", "x"), fallback=""
+        )
+        if cph:
+            labels[CHIPS_PER_HOST] = cph
 
     for axis, wrapped in zip("xyz", info.wrap):
         labels[f"{WRAP_PREFIX}.{axis}"] = str(wrapped).lower()

@@ -87,8 +87,15 @@ class JaxChip(Chip):
         if self._spec:
             return self._spec.product
         # Unknown generation: normalize the PJRT device kind ("TPU v9" →
-        # "tpu-v9") so the product label stays well-formed.
-        return str(getattr(self._device, "device_kind", "tpu")).lower().replace(" ", "-")
+        # "tpu-v9"). Full label-charset sanitization, not just spaces —
+        # a kind like "TPU v9 (preview)" would otherwise produce a
+        # product label NFD silently drops (lm/labels.py rationale).
+        from gpu_feature_discovery_tpu.lm.labels import label_safe_value
+
+        return label_safe_value(
+            str(getattr(self._device, "device_kind", "tpu")).lower(),
+            fallback="tpu",
+        )
 
     def get_total_memory_mb(self) -> int:
         return self._memory_mb

@@ -278,6 +278,27 @@ def test_hostinfo_labels_sanitize_env_strings(monkeypatch):
     labels = _host_info_labels(info)
     assert labels["google.com/tpu.slice.accelerator-type"] == "v5e-8--beta"
     assert labels["google.com/tpu.machine"] == "ct5lp-hightpu-4t"
+    # A string that sanitizes to nothing stays ABSENT — no invented
+    # "unknown" for a fact the host never stated.
+    empty = _host_info_labels(
+        host_info_from_mapping({"ACCELERATOR_TYPE": "??", "MACHINE_TYPE": "-"})
+    )
+    assert "google.com/tpu.slice.accelerator-type" not in empty
+    assert "google.com/tpu.machine" not in empty
+
+
+def test_jax_chip_sanitizes_unknown_device_kind():
+    """An unknown-generation PJRT kind with label-hostile characters must
+    still yield a valid product stem."""
+    from gpu_feature_discovery_tpu.resource.jax_backend import JaxChip
+
+    class Dev:
+        id = 0
+        process_index = 0
+        device_kind = "TPU v9 (preview)"
+        coords = (0, 0)
+
+    assert JaxChip(Dev(), None, 1024).get_name() == "tpu-v9--preview"
 
 
 def test_interconnect_tolerates_short_config_space():

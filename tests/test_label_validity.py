@@ -1,0 +1,85 @@
+"""Kubernetes label-syntax invariant over the full labeler stack.
+
+NFD SILENTLY drops any label whose key or value violates the k8s
+grammar, so an invalid label is a label that vanishes from the Node with
+no error anywhere. Golden files can't catch this generically (they pin
+specific scenarios); this sweeps every mock backend x strategy the suite
+knows and asserts every emitted key and value parses — the mechanical
+guarantee behind lm/labels.py label_safe_value."""
+
+import re
+
+import pytest
+
+from gpu_feature_discovery_tpu.config.flags import new_config
+from gpu_feature_discovery_tpu.lm.interconnect import InterconnectLabeler
+from gpu_feature_discovery_tpu.lm.labeler import Merge
+from gpu_feature_discovery_tpu.lm.labelers import new_labelers
+from gpu_feature_discovery_tpu.lm.timestamp import new_timestamp_labeler
+from gpu_feature_discovery_tpu.resource import factory
+
+# qualified name: optional DNS-1123-subdomain prefix / name segment.
+_NAME = re.compile(r"[A-Za-z0-9]([-A-Za-z0-9_.]*[A-Za-z0-9])?$")
+_DNS_LABEL = re.compile(r"[a-z0-9]([-a-z0-9]*[a-z0-9])?$")
+_VALUE = re.compile(r"([A-Za-z0-9]([-A-Za-z0-9_.]*[A-Za-z0-9])?)?$")
+
+
+def assert_valid_label(key: str, value: str):
+    prefix, slash, name = key.rpartition("/")
+    assert name, f"empty label name in {key!r}"
+    assert len(name) <= 63 and _NAME.match(name), f"invalid name: {key!r}"
+    if slash:
+        assert len(prefix) <= 253, f"prefix too long: {key!r}"
+        for part in prefix.split("."):
+            assert _DNS_LABEL.match(part), f"invalid prefix: {key!r}"
+    assert len(value) <= 63 and _VALUE.match(value), (
+        f"invalid value for {key}: {value!r}"
+    )
+
+
+SCENARIOS = [
+    ("mock:v4-8", "none", {}),
+    ("mock:v5e-8", "none", {}),
+    ("mock:v5p-8", "single", {}),
+    ("mock-slice:v4-8", "single", {}),
+    ("mock-slice:v5e-16", "mixed", {}),
+    ("mock-mixed:v5e:2x2,2x2", "mixed", {}),
+    ("mock-worker:v5p-64", "single", {}),
+    # Free-form host strings flowing through the interconnect labeler —
+    # the values label_safe_value exists for.
+    (
+        "mock:v4-8",
+        "none",
+        {
+            "TPU_ACCELERATOR_TYPE": "v4 8 (custom build!)",
+            "MACHINE_TYPE": "weird host / name",
+            "TPU_WORKER_ID": "0",
+            "TPU_WORKER_HOSTNAMES": "a,b",
+        },
+    ),
+]
+
+
+@pytest.mark.parametrize("backend,strategy,hostenv", SCENARIOS)
+def test_every_emitted_label_is_k8s_valid(monkeypatch, backend, strategy,
+                                          hostenv):
+    monkeypatch.setenv("TFD_BACKEND", backend)
+    if hostenv:
+        monkeypatch.setenv("TFD_NO_METADATA", "1")
+        monkeypatch.delenv("TFD_HERMETIC", raising=False)
+        for k, v in hostenv.items():
+            monkeypatch.setenv(k, v)
+    else:
+        monkeypatch.setenv("TFD_HERMETIC", "1")
+    config = new_config(
+        cli_values={"tpu-topology-strategy": strategy}, environ={}
+    )
+    manager = factory._get_manager(config)
+    manager.init()
+    labels = Merge(
+        new_timestamp_labeler(config),
+        new_labelers(manager, InterconnectLabeler(), config),
+    ).labels()
+    assert labels, f"{backend}/{strategy} emitted nothing"
+    for key, value in labels.items():
+        assert_valid_label(str(key), str(value))
