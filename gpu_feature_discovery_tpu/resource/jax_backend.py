@@ -41,6 +41,7 @@ import math
 from typing import List, Optional, Tuple
 
 from gpu_feature_discovery_tpu.config.spec import Config
+from gpu_feature_discovery_tpu.lm.labels import label_safe_value
 from gpu_feature_discovery_tpu.models.chips import ChipSpec, spec_for
 from gpu_feature_discovery_tpu.resource.slice_partition import SlicePartition
 from gpu_feature_discovery_tpu.resource.types import Chip, Manager, ResourceError
@@ -90,8 +91,6 @@ class JaxChip(Chip):
         # "tpu-v9"). Full label-charset sanitization, not just spaces —
         # a kind like "TPU v9 (preview)" would otherwise produce a
         # product label NFD silently drops (lm/labels.py rationale).
-        from gpu_feature_discovery_tpu.lm.labels import label_safe_value
-
         return label_safe_value(
             str(getattr(self._device, "device_kind", "tpu")).lower(),
             fallback="tpu",

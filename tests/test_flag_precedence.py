@@ -1,0 +1,109 @@
+"""Mechanical precedence sweep: CLI > env > file > default for EVERY flag.
+
+The reference's config system defines one precedence rule
+(config.go:40-57, flags.go:29-40) but tests it flag-by-flag; a new flag
+wired into only two of the three layers would pass those. This sweep
+derives the cases from FLAG_DEFS so every current and future flag gets
+all three dominance checks — and fails loudly when a new flag is not
+added to the config-file key map below."""
+
+import pytest
+import yaml
+
+from gpu_feature_discovery_tpu.config.flags import FLAG_DEFS, new_config
+
+# flag name -> (config-file section, camelCase key). parse_config_file
+# has no per-flag table to introspect, so this map is maintained here;
+# test_every_flag_is_mapped makes forgetting a new flag a test failure.
+FILE_KEYS = {
+    "tpu-topology-strategy": ("flags", "tpuTopologyStrategy"),
+    "fail-on-init-error": ("flags", "failOnInitError"),
+    "libtpu-path": ("flags", "libtpuPath"),
+    "native-enumeration": ("flags", "nativeEnumeration"),
+    "pjrt-create-options": ("flags", "pjrtCreateOptions"),
+    "oneshot": ("tfd", "oneshot"),
+    "no-timestamp": ("tfd", "noTimestamp"),
+    "sleep-interval": ("tfd", "sleepInterval"),
+    "output-file": ("tfd", "outputFile"),
+    "with-burnin": ("tfd", "withBurnin"),
+    "burnin-interval": ("tfd", "burninInterval"),
+    "machine-type-file": ("tfd", "machineTypeFile"),
+}
+
+# Two distinct valid raw values per flag (a wins the dominance checks).
+VALUE_PAIRS = {
+    "tpu-topology-strategy": ("single", "mixed"),
+    "sleep-interval": ("30s", "45s"),
+    "burnin-interval": ("3", "7"),
+}
+
+
+def _pair(fd):
+    """(a, b) with a != b and a != default: a is the value the dominant
+    layer carries, so a test can never pass by falling through to the
+    default (the fail-on-init-error default is True — 'true' as the
+    winner would make the file-layer check vacuous)."""
+    if fd.name in VALUE_PAIRS:
+        a, b = VALUE_PAIRS[fd.name]
+    elif fd.parse is str:
+        a, b = ("/value-a", "/value-b")
+    else:  # strict bool parsers
+        a, b = ("false", "true") if fd.default is True else ("true", "false")
+    assert fd.parse(a) != fd.default, fd.name
+    return a, b
+
+
+def _file_config(tmp_path, fd, raw):
+    section, key = FILE_KEYS[fd.name]
+    doc = {"version": "v1", "flags": {}}
+    if section == "flags":
+        doc["flags"][key] = yaml.safe_load(raw) if raw in ("true", "false") else raw
+    else:
+        doc["flags"]["tfd"] = {
+            key: yaml.safe_load(raw) if raw in ("true", "false") else raw
+        }
+    path = tmp_path / f"{fd.name}.yaml"
+    path.write_text(yaml.safe_dump(doc))
+    return str(path)
+
+
+def test_every_flag_is_mapped():
+    assert {fd.name for fd in FLAG_DEFS} == set(FILE_KEYS), (
+        "new flag: add its config-file section/key to FILE_KEYS (and its "
+        "parse_config_file wiring, which this sweep then verifies)"
+    )
+
+
+@pytest.mark.parametrize("fd", FLAG_DEFS, ids=lambda fd: fd.name)
+def test_cli_beats_env(fd):
+    a, b = _pair(fd)
+    config = new_config(
+        cli_values={fd.name: a}, environ={fd.env_vars[0]: b}
+    )
+    assert fd.getter(config) == fd.parse(a)
+
+
+@pytest.mark.parametrize(
+    "fd,alias",
+    [(fd, env) for fd in FLAG_DEFS for env in fd.env_vars],
+    ids=lambda v: v if isinstance(v, str) else v.name,
+)
+def test_env_beats_file(fd, alias, tmp_path):
+    """Every alias individually carries the layer — a primary TFD_* alias
+    that stopped resolving would otherwise hide behind its legacy twin."""
+    a, b = _pair(fd)
+    config = new_config(
+        cli_values={},
+        environ={alias: a},
+        config_file=_file_config(tmp_path, fd, b),
+    )
+    assert fd.getter(config) == fd.parse(a)
+
+
+@pytest.mark.parametrize("fd", FLAG_DEFS, ids=lambda fd: fd.name)
+def test_file_beats_default(fd, tmp_path):
+    a, _ = _pair(fd)
+    config = new_config(
+        cli_values={}, environ={}, config_file=_file_config(tmp_path, fd, a)
+    )
+    assert fd.getter(config) == fd.parse(a)
