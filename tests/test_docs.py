@@ -118,6 +118,31 @@ def _golden_label_keys():
     return keys
 
 
+def test_examples_reference_only_real_labels():
+    """examples/ selectors must key on labels the stack actually emits —
+    the r3 slice.* key rename is exactly the kind of change that rots
+    examples silently."""
+    import glob
+
+    emitted = _golden_label_keys()
+    examples = glob.glob(
+        os.path.join(os.path.dirname(DOCS), "examples", "*.yaml")
+    )
+    assert examples
+    checked = 0
+    for path in examples:
+        with open(path) as f:
+            text = f.read()
+        for m in re.finditer(r"google\.com/(tpu[a-z0-9._-]*|tfd[a-z0-9._-]*)",
+                             text):
+            key = m.group(1)
+            if key == "tpu":  # the extended-resource name, not a label
+                continue
+            assert key in emitted, f"{path} references unknown label {key}"
+            checked += 1
+    assert checked >= 3  # the guard must keep matching something
+
+
 def test_labels_doc_covers_emitted_label_families():
     """Every label key the goldens pin (plus the health family) must
     appear in docs/labels.md — deleting a doc row or adding an
