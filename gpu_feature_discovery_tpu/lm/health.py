@@ -163,6 +163,14 @@ def new_health_labeler(manager: Manager, config: Config) -> Labeler:
         sched.cached = labels if sched.consecutive_failures >= 2 else None
         return labels
     probe_ms = (time.perf_counter() - t0) * 1e3
+    # Per-phase cost breakdown (VERDICT r3 item 3): where the chip-seizure
+    # time goes, and which clock produced the rates (device-profiler on
+    # real TPUs; wall-clock on fallback platforms).
+    log.debug(
+        "burn-in probe timing=%s phases=%s",
+        report.get("timing"),
+        report.get("phases"),
+    )
     labels = Labels(
         {
             HEALTH_OK: str(report["healthy"]).lower(),

@@ -1,0 +1,122 @@
+"""On-device kernel timing via the JAX profiler's trace export.
+
+Host wall-clock is the wrong clock for probe kernels: dispatch is async,
+``jax.block_until_ready`` can return before the device finishes on
+virtualized PJRT transports, and a synchronization round-trip over a
+tunneled transport costs ~100 ms regardless of kernel size. Timed from the
+host, a 0.1 ms HBM sweep therefore "measures" ~100 ms — the label pipeline
+saw 0.3-0.8 GiB/s on a ~500 GiB/s chip (and ~0.02 TFLOP/s for the MXU
+burn-in) and rightly refused to publish.
+
+The profiler does not have that problem: ``jax.profiler.trace`` records
+each kernel's execution window on the DEVICE plane of the trace — the
+accelerator's own account of when the kernel ran — so the duration is
+immune to dispatch, tunnel, and sync latency. This module runs a workload
+under a trace and returns those device-plane durations grouped by the
+jitted function's name.
+
+Sync protocol: ``work()`` MUST force completion of everything it wants
+timed (a host readback of each final result does it) — device work still
+in flight when the trace stops may be missing from the export. On
+platforms with no device plane (CPU test meshes) or no working profiler
+the result is ``{}`` and callers fall back to wall-clock timing.
+
+No reference counterpart (the reference never computes on the GPU); this
+backs the burn-in health labels (lm/health.py) per VERDICT r3 items 2-3.
+"""
+
+from __future__ import annotations
+
+import glob
+import gzip
+import json
+import logging
+import os
+import re
+import shutil
+import tempfile
+from typing import Any, Callable, Dict, List, Tuple
+
+log = logging.getLogger("tfd.ops")
+
+# 'jit_burnin_step(15142215854000206875)' -> 'burnin_step'
+_EVENT_NAME = re.compile(r"^jit_?(?P<name>.*?)(?:\(\d+\))?$")
+
+DeviceDurations = Dict[str, Dict[str, List[float]]]  # name -> plane -> [sec]
+
+
+def parse_trace_durations(trace_dir: str) -> DeviceDurations:
+    """Parse the newest chrome-trace export under ``trace_dir``.
+
+    Returns ``{kernel_name: {device_plane: [seconds, ...]}}`` for complete
+    ("X") events on planes whose process name starts with ``/device:``
+    (``/device:TPU:0`` on hardware). Host-plane python/runtime events are
+    excluded — they carry the dispatch latency this module exists to avoid.
+    Event names are normalized through the ``jit_<fn>(<hash>)`` pattern the
+    profiler uses for module-level executions; ``dur`` is microseconds per
+    the chrome trace format.
+    """
+    exports = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.trace.json.gz"), recursive=True)
+    )
+    if not exports:
+        return {}
+    with gzip.open(exports[-1]) as f:
+        trace = json.load(f)
+    events = trace.get("traceEvents", [])
+    planes = {
+        e["pid"]: e["args"]["name"]
+        for e in events
+        if e.get("ph") == "M"
+        and e.get("name") == "process_name"
+        and str(e.get("args", {}).get("name", "")).startswith("/device:")
+    }
+    out: DeviceDurations = {}
+    for e in events:
+        if e.get("ph") != "X" or e.get("pid") not in planes:
+            continue
+        m = _EVENT_NAME.match(str(e.get("name", "")))
+        if not m or not str(e.get("name", "")).startswith("jit"):
+            continue
+        name = m.group("name")
+        out.setdefault(name, {}).setdefault(planes[e["pid"]], []).append(
+            float(e.get("dur", 0)) / 1e6
+        )
+    return out
+
+
+def profile_device_durations(
+    work: Callable[[], Any],
+) -> Tuple[Any, DeviceDurations]:
+    """Run ``work()`` under a profiler trace; return its result plus the
+    device-plane durations of every jitted kernel it executed.
+
+    ``work`` must synchronize (read back) its results before returning so
+    the device retires everything inside the trace window. Returns
+    ``(result, {})`` when tracing fails or the platform exports no device
+    plane — callers treat that as "no on-device clock available".
+    """
+    import jax
+
+    tmp = tempfile.mkdtemp(prefix="tfd-trace-")
+    try:
+        # start/stop split (not the context manager) so a profiler failure
+        # is distinguishable from a workload failure: the probe must never
+        # die — or run twice — because the profiler did.
+        try:
+            jax.profiler.start_trace(tmp)
+        except Exception as e:  # noqa: BLE001 - profiler support is optional
+            log.debug("profiler start_trace unavailable (%s); running untraced", e)
+            return work(), {}
+        traced = True
+        try:
+            result = work()
+        finally:
+            try:
+                jax.profiler.stop_trace()
+            except Exception as e:  # noqa: BLE001
+                log.debug("profiler stop_trace failed: %s", e)
+                traced = False
+        return result, parse_trace_durations(tmp) if traced else {}
+    finally:
+        shutil.rmtree(tmp, ignore_errors=True)

@@ -24,6 +24,8 @@ path. Design notes:
 from __future__ import annotations
 
 import functools
+import logging
+import statistics
 import time
 from typing import Optional, Tuple
 
@@ -37,6 +39,17 @@ try:  # JAX >= 0.4.35 exports shard_map at the top level
     shard_map = jax.shard_map  # type: ignore[attr-defined]
 except AttributeError:  # pragma: no cover - older JAX
     from jax.experimental.shard_map import shard_map  # type: ignore
+
+log = logging.getLogger("tfd.ops")
+
+# Trace-event name the profiler derives from the jitted burn-in fn
+# (device_timing.parse_trace_durations matches on it).
+BURNIN_KERNEL_NAME = "burnin_step"
+
+# Once a traced probe yields no usable device plane, stop trying for the
+# rest of the process: the traced attempt's work is discarded on failure,
+# so retrying every cycle would double the chip seizure forever.
+_device_clock_unavailable = False
 
 
 # ---------------------------------------------------------------------------
@@ -126,6 +139,162 @@ def measure_chip_health(
     }
 
 
+@functools.lru_cache(maxsize=None)
+def _jitted_health_pack():
+    """Pack the per-device probe outputs into one (3,) f32 vector so the
+    traced probe synchronizes with a SINGLE host readback per device —
+    every extra readback is a full transport round-trip (~100 ms on a
+    tunneled PJRT, the latency VERDICT r3 items 2-3 are about)."""
+
+    def health_pack(checksum, rms, hbm_total):
+        return jnp.stack(
+            [
+                checksum.astype(jnp.float32),
+                rms.astype(jnp.float32),
+                hbm_total.reshape(()).astype(jnp.float32),
+            ]
+        )
+
+    return jax.jit(health_pack)
+
+
+def _measure_node_health_traced(
+    devices: list,
+    size: int = 512,
+    depth: int = 8,
+    iters: int = 4,
+    dtype=jnp.bfloat16,
+    hbm_mib: int = 256,
+    hbm_iters: int = 3,
+) -> Optional[dict]:
+    """Probe every device with ON-DEVICE timing: dispatch the burn-in and
+    HBM kernels under a profiler trace, sync once per device, and read the
+    kernels' execution durations off the trace's device plane
+    (device_timing.py — immune to dispatch/tunnel latency, which on this
+    class of transport exceeds the kernel time by 1000x).
+
+    Rates are median-of-iters per chip, worst chip published. Returns None
+    when the trace exports no device plane (no profiler, or a platform
+    that doesn't emit one) — the caller falls back to wall-clock timing.
+    """
+    import numpy as np
+
+    from gpu_feature_discovery_tpu.ops import device_timing
+    from gpu_feature_discovery_tpu.ops.hbm import (
+        HBM_KERNEL_NAME,
+        LANES,
+        _jitted_stream_sum,
+        probe_rows,
+    )
+
+    t0 = time.perf_counter()
+    step, x, ws = _jitted_burnin(size, depth, dtype)
+    hbm_fn = _jitted_stream_sum(False)
+    rows = probe_rows(hbm_mib)
+    pack = _jitted_health_pack()
+
+    def work():
+        packed = []
+        for d in devices:
+            xb, wsb = jax.device_put(x, d), jax.device_put(ws, d)
+            with jax.default_device(d):
+                # On-device fill: never streams hbm_mib over the transport.
+                buf = jnp.ones((rows, LANES), jnp.float32)
+            cs = rms = total = None
+            for _ in range(max(1, iters)):
+                cs, rms = step(xb, wsb)
+            for _ in range(max(1, hbm_iters)):
+                total = hbm_fn(buf)
+            packed.append(pack(cs, rms, total))
+        # One blocking readback per device forces every queued kernel to
+        # retire inside the trace window (device_timing's sync protocol).
+        return [np.asarray(p) for p in packed]
+
+    packed, durs = device_timing.profile_device_durations(work)
+    trace_ms = (time.perf_counter() - t0) * 1e3
+    burnin_durs = durs.get(BURNIN_KERNEL_NAME, {})
+    hbm_durs = durs.get(HBM_KERNEL_NAME, {})
+    if len(burnin_durs) < len(devices) or len(hbm_durs) < len(devices):
+        # Missing plane(s) — including a PARTIAL export that dropped one
+        # device: publishing min() over the planes that survived could
+        # report a healthy chip's rate while hiding the degraded one,
+        # breaking worst-chip-wins. Fall back to wall-clock, which times
+        # every device.
+        return None
+    t1 = time.perf_counter()
+    nbytes = rows * LANES * 4
+    burnin_ms = {p: statistics.median(ds) * 1e3 for p, ds in burnin_durs.items()}
+    hbm_ms = {p: statistics.median(ds) * 1e3 for p, ds in hbm_durs.items()}
+    tflops = min(
+        burnin_flops(size, depth) / (ms / 1e3) / 1e12 for ms in burnin_ms.values()
+    )
+    gbps = min(nbytes / (ms / 1e3) / 2**30 for ms in hbm_ms.values())
+    healthy = all(
+        bool(np.isfinite(p[0])) and bool(np.isfinite(p[1])) for p in packed
+    )
+    # Sum-of-ones checksum: exact in f32 because each chunk adds 65536
+    # (CHUNK_ROWS*LANES), a multiple of the float spacing up to 2^32.
+    checksum_ok = all(float(p[2]) == rows * LANES for p in packed)
+    return {
+        "healthy": healthy,
+        "tflops": tflops,
+        "hbm_gbps": gbps if checksum_ok else None,
+        "ici_ok": None,
+        "chips": len(devices),
+        "timing": "device-profiler",
+        "phases": {
+            "trace_ms": round(trace_ms, 3),
+            "report_ms": round((time.perf_counter() - t1) * 1e3, 3),
+            "burnin_device_ms": round(max(burnin_ms.values()), 6),
+            "hbm_device_ms": round(max(hbm_ms.values()), 6),
+        },
+    }
+
+
+def _measure_node_health_wall(
+    devices: list,
+    size: int = 512,
+    depth: int = 8,
+    iters: int = 4,
+    on_tpu: bool = False,
+) -> dict:
+    """Wall-clock fallback probe (CPU meshes and profiler-less platforms):
+    best-of-iters host timing per chip. On transports where dispatch
+    latency dwarfs kernel time the rates are distorted — the health
+    labeler's plausibility guard (lm/health.py) keeps those off the node."""
+    t0 = time.perf_counter()
+    reports = [
+        measure_chip_health(size=size, depth=depth, iters=iters, device=d)
+        for d in devices
+    ]
+    burnin_ms = (time.perf_counter() - t0) * 1e3
+    hbm_gbps = None
+    hbm_ms = 0.0
+    if on_tpu:
+        from gpu_feature_discovery_tpu.ops.hbm import measure_hbm_bandwidth
+
+        t1 = time.perf_counter()
+        hbm = [
+            measure_hbm_bandwidth(total_mib=64, iters=2, device=d)
+            for d in devices
+        ]
+        hbm_ms = (time.perf_counter() - t1) * 1e3
+        if all(r["checksum_ok"] for r in hbm):
+            hbm_gbps = min(r["gbps"] for r in hbm)
+    return {
+        "healthy": all(r["healthy"] for r in reports),
+        "tflops": min(r["tflops"] for r in reports),
+        "hbm_gbps": hbm_gbps,
+        "ici_ok": None,
+        "chips": len(reports),
+        "timing": "wall-clock",
+        "phases": {
+            "burnin_ms": round(burnin_ms, 3),
+            "hbm_ms": round(hbm_ms, 3),
+        },
+    }
+
+
 def measure_node_health(
     size: int = 512,
     depth: int = 8,
@@ -141,47 +310,54 @@ def measure_node_health(
     health labeler acquires first so it can tell "cannot acquire" apart
     from "acquired but failing"); default is every local device.
 
-    On real TPUs the HBM streaming probe (ops/hbm.py) runs too; elsewhere
+    On real TPUs the rates come from ON-DEVICE profiler timing
+    (_measure_node_health_traced) and the HBM streaming probe (ops/hbm.py)
+    runs too; elsewhere timing falls back to host wall-clock and
     ``hbm_gbps`` is None — the interpreter would be slow and the number
     meaningless as bandwidth. ``ici`` (auto: multi-chip TPU nodes) rings
     the local chips with ppermute to verify every intra-host ICI link.
+    The report carries ``timing`` (which clock produced the rates) and a
+    ``phases`` cost breakdown (VERDICT r3 item 3).
     """
+    global _device_clock_unavailable
+    t_total = time.perf_counter()
     if devices is None:
         devices = jax.local_devices()
     on_tpu = all(d.platform == "tpu" for d in devices)
-    reports = [
-        measure_chip_health(size=size, depth=depth, iters=iters, device=d)
-        for d in devices
-    ]
-    hbm_gbps = None
-    if on_tpu:
-        from gpu_feature_discovery_tpu.ops.hbm import measure_hbm_bandwidth
-
-        hbm = [
-            measure_hbm_bandwidth(total_mib=64, iters=2, device=d)
-            for d in devices
-        ]
-        if all(r["checksum_ok"] for r in hbm):
-            hbm_gbps = min(r["gbps"] for r in hbm)
+    report = None
+    if on_tpu and not _device_clock_unavailable:
+        report = _measure_node_health_traced(
+            devices, size=size, depth=depth, iters=iters
+        )
+        if report is None:
+            # Remember for the process lifetime: without the memo every
+            # probing cycle would seize the chips TWICE (the discarded
+            # traced attempt plus the wall-clock rerun), and profiler
+            # availability does not change within a process.
+            _device_clock_unavailable = True
+            log.debug(
+                "no device-plane trace available; falling back to "
+                "wall-clock probe timing for this process"
+            )
+    if report is None:
+        report = _measure_node_health_wall(
+            devices, size=size, depth=depth, iters=iters, on_tpu=on_tpu
+        )
     if ici is None:
         ici = on_tpu and len(devices) > 1
     elif ici and len(devices) < 2:
         # An explicit request must fail loudly, not silently report
         # "not measured" — a single device has no ring to sweep.
         raise ValueError("ici sweep requested but only one local device")
-    ici_ok = None
     if ici:
         import numpy as np
 
+        t1 = time.perf_counter()
         sweep = ici_ring_sweep(Mesh(np.array(devices), ("ring",)))
-        ici_ok = sweep["links_ok"] and sweep["allreduce_ok"]
-    return {
-        "healthy": all(r["healthy"] for r in reports),
-        "tflops": min(r["tflops"] for r in reports),
-        "hbm_gbps": hbm_gbps,
-        "ici_ok": ici_ok,
-        "chips": len(reports),
-    }
+        report["ici_ok"] = sweep["links_ok"] and sweep["allreduce_ok"]
+        report["phases"]["ici_ms"] = round((time.perf_counter() - t1) * 1e3, 3)
+    report["phases"]["total_ms"] = round((time.perf_counter() - t_total) * 1e3, 3)
+    return report
 
 
 # ---------------------------------------------------------------------------
