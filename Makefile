@@ -57,10 +57,11 @@ helm-check:
 	helm lint deployments/helm/tpu-feature-discovery \
 	    --namespace node-feature-discovery
 	helm template tfd deployments/helm/tpu-feature-discovery \
-	    --namespace node-feature-discovery \
+	    --namespace node-feature-discovery --include-crds \
 	    | $(PYTHON) tests/helm-contract.py
 	helm template tfd deployments/helm/tpu-feature-discovery \
 	    --namespace node-feature-discovery --set nfd.deploy=false \
+	    --include-crds \
 	    | $(PYTHON) tests/helm-contract.py --no-nfd
 
 lint:

@@ -80,6 +80,15 @@ def check_nfd(docs, expected):
         assert not workers and not masters, (
             "nfd.deploy=false must render no NFD workloads"
         )
+        # Nor the NFD CRDs: on clusters where NFD pre-exists
+        # (nfd.deploy=false's use case), shipping them would overwrite the
+        # cluster's own schemas. Renders pass --include-crds so this is
+        # actually checked.
+        assert not any(
+            d.get("kind") == "CustomResourceDefinition"
+            and "nfd.k8s-sigs.io" in d.get("metadata", {}).get("name", "")
+            for d in docs
+        ), "nfd.deploy=false must not ship the NFD CRDs"
         return
     assert len(workers) == 1 and len(masters) == 1, (
         f"expected 1 NFD worker + 1 master, got {len(workers)}/{len(masters)}"
@@ -94,21 +103,64 @@ def check_nfd(docs, expected):
     assert any(
         "--extra-label-ns=google.com" in a for a in mctr.get("args", [])
     ), "nfd-master cannot publish the google.com label namespace"
-    # These manifests wire worker->master gRPC and ship no NodeFeature
-    # CRD; v0.14+ NFD images default to the CRD API, so gRPC must be
-    # re-enabled on BOTH binaries or no label ever lands.
+    # CRD-era contract (NFD >= v0.16, the only protocol current upstream
+    # speaks): no gRPC remnants — current nfd binaries FAIL on the removed
+    # -enable-nodefeature-api/--server flags, so their presence means the
+    # manifests only work against an old pinned image.
     for name, ctr in (("worker", wctr), ("master", mctr)):
-        assert "-enable-nodefeature-api=false" in ctr.get("args", []), (
-            f"nfd-{name} would default to the NodeFeature CRD API "
-            "(no CRD is installed): pass -enable-nodefeature-api=false"
-        )
-    # The worker must dial the rendered master service by name.
-    services = find(docs, "Service", "-master")
-    assert len(services) == 1
-    svc_name = services[0]["metadata"]["name"]
+        for arg in ctr.get("args", []):
+            assert "-enable-nodefeature-api" not in arg and not arg.startswith(
+                "--server="
+            ), (
+                f"nfd-{name} passes removed gRPC-era flag {arg!r}: current "
+                "NFD images (v0.16+) reject it"
+            )
+    # The worker publishes a NodeFeature object named after its node: it
+    # needs the node name, an identity, and create/update on the CRD.
+    wenv = {e["name"] for e in wctr.get("env", [])}
+    assert "NODE_NAME" in wenv, (
+        "nfd-worker has no NODE_NAME downward-API env: it cannot name "
+        "its NodeFeature object"
+    )
+    assert wspec.get("serviceAccountName"), (
+        "nfd-worker runs without a ServiceAccount: it cannot write its "
+        "NodeFeature object"
+    )
+    worker_rules = [
+        rule
+        for role in find(docs, "Role", "-worker")
+        for rule in role.get("rules", [])
+        if "nodefeatures" in rule.get("resources", [])
+    ]
     assert any(
-        a.startswith("--server=") and svc_name in a for a in wctr["args"]
-    ), "nfd-worker does not dial the rendered master service"
+        {"create", "update"} <= set(rule.get("verbs", [])) for rule in worker_rules
+    ), "no Role grants the worker create+update on nodefeatures"
+    master_rules = [
+        rule
+        for role in find(docs, "ClusterRole", "-master")
+        for rule in role.get("rules", [])
+    ]
+    assert any(
+        "nodefeatures" in rule.get("resources", [])
+        and {"list", "watch"} <= set(rule.get("verbs", []))
+        for rule in master_rules
+    ), "no ClusterRole lets the master watch nodefeatures"
+    assert any(
+        "nodes" in rule.get("resources", [])
+        and "patch" in rule.get("verbs", [])
+        for rule in master_rules
+    ), "no ClusterRole lets the master patch nodes"
+    # The NodeFeature CRD must ship with the deployment (helm renders
+    # crds/ only under --include-crds, which the Makefile/CI pass).
+    crds = {
+        d["metadata"]["name"]
+        for d in docs
+        if d.get("kind") == "CustomResourceDefinition"
+    }
+    assert "nodefeatures.nfd.k8s-sigs.io" in crds, (
+        "NodeFeature CRD missing from the render (forgot --include-crds, "
+        "or the chart dropped crds/)"
+    )
 
 
 def main():

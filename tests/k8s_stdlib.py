@@ -176,6 +176,15 @@ _KIND_ROUTES = {
         "clusterrolebindings",
         False,
     ),
+    "Role": ("/apis/rbac.authorization.k8s.io/v1", "roles", True),
+    "RoleBinding": ("/apis/rbac.authorization.k8s.io/v1", "rolebindings", True),
+    # CRD-era NFD (v0.16+): the example manifest ships the NodeFeature /
+    # NodeFeatureRule CRDs the worker and master speak through.
+    "CustomResourceDefinition": (
+        "/apis/apiextensions.k8s.io/v1",
+        "customresourcedefinitions",
+        False,
+    ),
 }
 
 

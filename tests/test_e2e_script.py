@@ -325,6 +325,14 @@ def test_e2e_script_against_fake_cluster(
                 "ClusterRoleBinding") in posted
         assert ("/apis/apps/v1/namespaces/node-feature-discovery/deployments",
                 "Deployment") in posted
+        # CRD-era NFD: the example manifest ships the nfd.k8s-sigs.io
+        # CRDs and a namespaced worker Role/RoleBinding (v0.16+ protocol).
+        assert ("/apis/apiextensions.k8s.io/v1/customresourcedefinitions",
+                "CustomResourceDefinition") in posted
+        assert ("/apis/rbac.authorization.k8s.io/v1/namespaces/"
+                "node-feature-discovery/roles", "Role") in posted
+        assert ("/apis/rbac.authorization.k8s.io/v1/namespaces/"
+                "node-feature-discovery/rolebindings", "RoleBinding") in posted
         # Everything in both manifests deployed. TFD arrives as a Job in
         # the oneshot scenario (batch API group), as a DaemonSet otherwise;
         # the NFD worker is always the other DaemonSet.

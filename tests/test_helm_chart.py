@@ -69,7 +69,12 @@ def test_helm_lint():
 @needs_helm
 def test_helm_template_defaults_render_tfd_and_nfd():
     mod = _contract()
-    docs = mod.load_docs(helm("template", "tfd", CHART, "-n", "node-feature-discovery"))
+    docs = mod.load_docs(
+        helm(
+            "template", "tfd", CHART, "-n", "node-feature-discovery",
+            "--include-crds",
+        )
+    )
     mod.check_tfd_daemonset(docs)
     mod.check_nfd(docs, expected=True)
 
