@@ -17,6 +17,8 @@ import sys
 import yaml
 
 HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, HERE)
+from slice_fixture import parse_hostenv  # noqa: E402
 STATIC = os.path.join(
     os.path.dirname(HERE),
     "deployments/static/tpu-feature-discovery-daemonset.yaml",
@@ -42,6 +44,38 @@ def prepare(image, backend="mock:v4-8", manifest_path=STATIC):
     return ds
 
 
+def prepare_slice_workers(image, backend, manifest_path, hostenv, nodes):
+    """One pinned DaemonSet per listed node, each a distinct worker of ONE
+    slice: shared TPU_* facts from ``hostenv`` plus its own TPU_WORKER_ID
+    (the slice-consistency e2e, SURVEY section 7 riskiest unknown (b)).
+
+    TFD_HERMETIC would blank the env-var provider, so these workloads use
+    TFD_NO_METADATA instead — host facts must REACH the daemon here, and
+    kind containers have no GKE env to leak (the metadata server is still
+    skipped; same split integration-tests.py --hostenv makes).
+    """
+    docs = []
+    for i, node in enumerate(nodes):
+        ds = prepare(image, backend, manifest_path)
+        ds["metadata"]["name"] += f"-w{i}"
+        # Distinct selectors: two DaemonSets with identical matchLabels
+        # would fight over each other's pods.
+        ds["spec"]["selector"]["matchLabels"]["tfd-slice-worker"] = str(i)
+        ds["spec"]["template"]["metadata"]["labels"]["tfd-slice-worker"] = str(i)
+        spec = ds["spec"]["template"]["spec"]
+        spec.setdefault("nodeSelector", {})["kubernetes.io/hostname"] = node
+        (container,) = spec["containers"]
+        env = container["env"]
+        env[:] = [e for e in env if e["name"] != "TFD_HERMETIC"]
+        env.append({"name": "TFD_NO_METADATA", "value": "1"})
+        env.append({"name": "TFD_MOCK_PCI", "value": "1"})
+        for key, value in parse_hostenv(hostenv):
+            env.append({"name": key, "value": value})
+        env.append({"name": "TPU_WORKER_ID", "value": str(i)})
+        docs.append(ds)
+    return docs
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("image")
@@ -53,13 +87,35 @@ def main():
         help="static DaemonSet to patch (e.g. the -with-topology-single "
         "variant for the strategy scenario)",
     )
+    parser.add_argument(
+        "--slice-worker-nodes",
+        help="comma-separated node names: emit one pinned DaemonSet per "
+        "node, each a distinct worker of one slice (needs --hostenv)",
+    )
+    parser.add_argument(
+        "--hostenv",
+        default="",
+        help='shared slice facts as "K=V;K=V" (TPU_WORKER_ID is added '
+        "per worker)",
+    )
     args = parser.parse_args()
-    ds = prepare(args.image, args.backend, args.manifest)
+    if args.slice_worker_nodes:
+        if not args.hostenv:
+            parser.error("--slice-worker-nodes requires --hostenv")
+        docs = prepare_slice_workers(
+            args.image,
+            args.backend,
+            args.manifest,
+            args.hostenv,
+            [n.strip() for n in args.slice_worker_nodes.split(",") if n.strip()],
+        )
+    else:
+        docs = [prepare(args.image, args.backend, args.manifest)]
     with open(args.out_path, "w") as f:
-        yaml.safe_dump(ds, f, sort_keys=False)
+        yaml.safe_dump_all(docs, f, sort_keys=False)
     print(
-        f"Wrote {args.out_path} (image={args.image}, backend={args.backend}, "
-        f"manifest={os.path.basename(args.manifest)})"
+        f"Wrote {args.out_path} ({len(docs)} doc(s), image={args.image}, "
+        f"backend={args.backend}, manifest={os.path.basename(args.manifest)})"
     )
     return 0
 

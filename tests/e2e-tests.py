@@ -16,6 +16,12 @@ Usage: python tests/e2e-tests.py TFD_YAML_PATH NFD_YAML_PATH [GOLDEN_PATH]
        python tests/e2e-tests.py --skip-deploy [GOLDEN_PATH]
 --skip-deploy watches and asserts only — for deployments made by another
 tool (the helm-install CI scenario).
+--slice-consistency N waits for N labeled nodes instead of one and
+additionally asserts the coordination-free multi-host invariant (SURVEY
+section 7 riskiest unknown (b)): every worker of one slice derives
+IDENTICAL slice-global labels (tpu.slice.*, tpu.topology.*, tpu.ici.*,
+tpu.multihost.* minus worker-id) from nothing but its own local env, with
+distinct worker-id labels.
 Env: KUBECONFIG selects the cluster; TFD_E2E_WATCH_TIMEOUT_S overrides
 the 180 s watch budget (tests use a short one).
 """
@@ -50,11 +56,79 @@ def check_labels(expected_regexs, labels):
     )
 
 
+# Label families every worker of one slice must agree on — they describe
+# the SLICE, not the worker, and are derived coordination-free from each
+# worker's own local facts. worker-id is the one deliberate exception.
+SLICE_GLOBAL_PREFIXES = (
+    "google.com/tpu.slice.",
+    "google.com/tpu.topology.",
+    "google.com/tpu.ici.",
+    "google.com/tpu.multihost.",
+)
+WORKER_LOCAL_LABELS = frozenset({"google.com/tpu.multihost.worker-id"})
+
+
+def slice_global_view(labels):
+    return {
+        k: v
+        for k, v in labels.items()
+        if k.startswith(SLICE_GLOBAL_PREFIXES) and k not in WORKER_LOCAL_LABELS
+    }
+
+
+def check_slice_consistency(node_labels):
+    """``node_labels``: {node_name: {label: value}} for every labeled node.
+    The design leans on workers agreeing WITHOUT coordinating; a
+    disagreement here means schedulers keying on slice labels would see
+    two different slices where there is one."""
+    ok = True
+    ids = {
+        n: ls.get("google.com/tpu.multihost.worker-id")
+        for n, ls in node_labels.items()
+    }
+    if None in ids.values() or len(set(ids.values())) != len(ids):
+        print(f"worker-id labels missing or not distinct: {ids}", file=sys.stderr)
+        ok = False
+    views = {n: slice_global_view(ls) for n, ls in node_labels.items()}
+    base_node = next(iter(views))
+    base = views[base_node]
+    if not base:
+        print("no slice-global labels present", file=sys.stderr)
+        ok = False
+    for n, view in views.items():
+        if view != base:
+            diff = sorted(set(base.items()) ^ set(view.items()))
+            print(
+                f"slice-global labels disagree ({base_node} vs {n}): {diff}",
+                file=sys.stderr,
+            )
+            ok = False
+    if ok:
+        print(
+            f"Slice consistency OK across {len(node_labels)} nodes "
+            f"({len(base)} slice-global labels, worker ids "
+            f"{sorted(ids.values())})"
+        )
+    return ok
+
+
 def main():
     argv = list(sys.argv[1:])
     skip_deploy = "--skip-deploy" in argv
     if skip_deploy:
         argv.remove("--skip-deploy")
+    expect_nodes = 1
+    if "--slice-consistency" in argv:
+        i = argv.index("--slice-consistency")
+        try:
+            expect_nodes = int(argv[i + 1])
+        except (IndexError, ValueError):
+            print("--slice-consistency needs a node count", file=sys.stderr)
+            return 1
+        del argv[i : i + 2]
+        if expect_nodes < 2:
+            print("--slice-consistency needs >= 2 nodes", file=sys.stderr)
+            return 1
     if (skip_deploy and len(argv) > 1) or (
         not skip_deploy and len(argv) not in (2, 3)
     ):
@@ -98,7 +172,7 @@ def main():
         deploy_yaml_file(client, argv[0])
 
     print("Watching node updates")
-    labeled_node = None
+    labeled_nodes = []  # distinct, in labeling order
     # In --skip-deploy mode the label may have landed BEFORE the watch
     # opens (deployment happened in an earlier step): check the list
     # snapshot first — a watch starting at "now" would never see it.
@@ -108,42 +182,55 @@ def main():
     if skip_deploy:
         for n in client.get("/api/v1/nodes").get("items", []):
             if TIMESTAMP_LABEL in (n["metadata"].get("labels") or {}):
-                labeled_node = n["metadata"]["name"]
+                labeled_nodes.append(n["metadata"]["name"])
                 print(
-                    f"Timestamp label already on {labeled_node}. Not watching"
+                    f"Timestamp label already on {labeled_nodes[-1]}. "
+                    "Not watching"
                 )
-                break
+                if len(labeled_nodes) >= expect_nodes:
+                    break
     # timeoutSeconds is server-side: the stream ends cleanly at expiry
     # instead of raising a client read timeout.
-    if labeled_node is None:
+    if len(labeled_nodes) < expect_nodes:
         for event in client.watch("/api/v1/nodes", timeout_s=WATCH_TIMEOUT_S):
             if event.get("type") == "MODIFIED":
                 labels = event["object"]["metadata"].get("labels") or {}
-                if TIMESTAMP_LABEL in labels:
-                    labeled_node = event["object"]["metadata"]["name"]
-                    print(
-                        f"Timestamp label found on {labeled_node}. "
-                        "Stop watching"
-                    )
-                    break
-    if labeled_node is None:
-        print("Timestamp label never appeared", file=sys.stderr)
+                name = event["object"]["metadata"]["name"]
+                if TIMESTAMP_LABEL in labels and name not in labeled_nodes:
+                    labeled_nodes.append(name)
+                    print(f"Timestamp label found on {name}. ", end="")
+                    if len(labeled_nodes) >= expect_nodes:
+                        print("Stop watching")
+                        break
+                    print(f"Waiting for {expect_nodes - len(labeled_nodes)} more")
+    if len(labeled_nodes) < expect_nodes:
+        print(
+            f"Timestamp label appeared on {len(labeled_nodes)}/{expect_nodes} "
+            "nodes",
+            file=sys.stderr,
+        )
         return 1
 
     print("Checking labels")
-    node = client.get(f"/api/v1/nodes/{labeled_node}")
     regexs = load_golden_regexs(golden)
-    for k, v in pre_labels.get(labeled_node, {}).items():
-        # Our own namespace is governed by the goldens; allowlisting stale
-        # google.com/* values would double-book label lines and make the
-        # test fail on any re-run against an already-labeled cluster.
-        if k.startswith("google.com/"):
-            continue
-        regexs.append(re.compile(re.escape(f"{k}={v}")))
-    labels = [
-        f"{k}={v}" for k, v in (node["metadata"].get("labels") or {}).items()
-    ]
-    if not check_labels(regexs, labels):
+    node_labels = {}
+    for labeled_node in labeled_nodes:
+        node = client.get(f"/api/v1/nodes/{labeled_node}")
+        node_labels[labeled_node] = dict(node["metadata"].get("labels") or {})
+        node_regexs = list(regexs)
+        for k, v in pre_labels.get(labeled_node, {}).items():
+            # Our own namespace is governed by the goldens; allowlisting
+            # stale google.com/* values would double-book label lines and
+            # make the test fail on any re-run against an already-labeled
+            # cluster.
+            if k.startswith("google.com/"):
+                continue
+            node_regexs.append(re.compile(re.escape(f"{k}={v}")))
+        labels = [f"{k}={v}" for k, v in node_labels[labeled_node].items()]
+        if not check_labels(node_regexs, labels):
+            print(f"E2E tests failed on {labeled_node}", file=sys.stderr)
+            return 1
+    if expect_nodes > 1 and not check_slice_consistency(node_labels):
         print("E2E tests failed", file=sys.stderr)
         return 1
     print("E2E tests done")

@@ -1,0 +1,35 @@
+"""The slice-consistency scenario's shared facts — ONE source of truth.
+
+Three places deploy "two workers of one v5p-64 slice" and must agree on
+its shape: the kind CI step (.github/workflows/ci.yml, parity-pinned
+against these constants by test_ci_workflow.py), the hermetic twin
+(test_e2e_script.py), and the manifest generator
+(ci-prepare-e2e-manifest.py). Hand-duplicating the env string let the
+twin silently drift from what CI deploys.
+"""
+
+SLICE_BACKEND = "mock-worker:v5p-64"
+
+# Shared slice facts every worker sees identically; TPU_WORKER_ID is
+# appended per worker by the consumers.
+SLICE_HOSTENV = (
+    "TPU_ACCELERATOR_TYPE=v5p-64;TPU_PROCESS_BOUNDS=2,2,2;"
+    "TPU_CHIPS_PER_PROCESS_BOUNDS=2,2,1;TPU_TOPOLOGY_WRAP=true,true,true;"
+    "TPU_WORKER_HOSTNAMES=w0,w1,w2,w3,w4,w5,w6,w7"
+)
+
+TOPOLOGY_SINGLE_MANIFEST = (
+    "deployments/static/"
+    "tpu-feature-discovery-daemonset-with-topology-single.yaml"
+)
+
+
+def parse_hostenv(hostenv):
+    """``"K=V;K=V"`` -> [(key, value), ...] — the --hostenv grammar shared
+    with integration-tests.py; blank segments are skipped."""
+    out = []
+    for pair in hostenv.split(";"):
+        key, _, value = pair.partition("=")
+        if key.strip():
+            out.append((key.strip(), value.strip()))
+    return out

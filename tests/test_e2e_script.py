@@ -20,6 +20,7 @@ runs the same script against a real cluster.
 """
 
 import http.server
+import importlib.util
 import json
 import os
 import shutil
@@ -41,11 +42,20 @@ from k8s_stdlib import KubeClient  # noqa: E402
 class FakeKubeApi:
     """Just enough kube-apiserver for e2e-tests.py: create objects, list
     and read nodes, and a watch stream that emits MODIFIED once the 'NFD'
-    side applied the features file to the node."""
+    side applied the features file to each node.
+
+    ``features_file``: a single path (one node, NODE_NAME) or a dict
+    {node_name: path} — the multi-node shape the slice-consistency e2e
+    uses (two workers of one slice on two nodes)."""
 
     def __init__(self, features_file, conflict_kinds=(), require_token=None):
-        self.features_file = features_file
-        self.node_labels = {"kubernetes.io/hostname": NODE_NAME}
+        if isinstance(features_file, dict):
+            self.node_files = {str(n): str(p) for n, p in features_file.items()}
+        else:
+            self.node_files = {NODE_NAME: str(features_file)}
+        self.node_labels = {
+            n: {"kubernetes.io/hostname": n} for n in self.node_files
+        }
         self.created = []  # (path, kind, name)
         self.namespaces = {"default", "kube-system"}
         self.conflict_kinds = set(conflict_kinds)  # respond 409 for these
@@ -76,12 +86,12 @@ class FakeKubeApi:
                 self.end_headers()
                 self.wfile.write(body)
 
-            def _node(self):
+            def _node(self, name):
                 with state.lock:
-                    labels = dict(state.node_labels)
+                    labels = dict(state.node_labels[name])
                 return {
                     "kind": "Node",
-                    "metadata": {"name": NODE_NAME, "labels": labels},
+                    "metadata": {"name": name, "labels": labels},
                 }
 
             def do_POST(self):
@@ -127,39 +137,46 @@ class FakeKubeApi:
                 if path == "/api/v1/nodes" and "watch=true" in query:
                     return self._watch()
                 if path == "/api/v1/nodes":
-                    return self._json({"items": [self._node()]})
-                if path == f"/api/v1/nodes/{NODE_NAME}":
-                    return self._json(self._node())
+                    return self._json(
+                        {"items": [self._node(n) for n in state.node_files]}
+                    )
+                if path.startswith("/api/v1/nodes/"):
+                    name = path.rsplit("/", 1)[1]
+                    if name in state.node_files:
+                        return self._json(self._node(name))
                 self._json({"error": "not found"}, code=404)
 
             def _watch(self):
                 self.send_response(200)
                 self.send_header("Content-Type", "application/json")
                 self.end_headers()
-                # The NFD simulation: when TFD's DaemonSet landed AND its
-                # label file exists, fold the file into the node labels
-                # and emit MODIFIED; otherwise expire cleanly like a real
-                # watch at timeoutSeconds.
-                applied = False
-                if state.tfd_deployed.wait(timeout=5) and os.path.exists(
-                    state.features_file
-                ):
-                    with open(state.features_file) as f:
-                        file_labels = dict(
-                            line.strip().split("=", 1)
-                            for line in f
-                            if "=" in line
-                        )
-                    with state.lock:
-                        state.node_labels.update(file_labels)
-                    applied = True
-                for event_type, send in (("ADDED", True), ("MODIFIED", applied)):
-                    if send:
-                        line = json.dumps(
-                            {"type": event_type, "object": self._node()}
-                        )
-                        self.wfile.write(line.encode() + b"\n")
-                        self.wfile.flush()
+                # The NFD simulation: when TFD's workload landed AND a
+                # node's label file exists, fold that file into the node's
+                # labels and emit MODIFIED for it; otherwise expire
+                # cleanly like a real watch at timeoutSeconds.
+                applied = []
+                if state.tfd_deployed.wait(timeout=5):
+                    for name, path in state.node_files.items():
+                        if not os.path.exists(path):
+                            continue
+                        with open(path) as f:
+                            file_labels = dict(
+                                line.strip().split("=", 1)
+                                for line in f
+                                if "=" in line
+                            )
+                        with state.lock:
+                            state.node_labels[name].update(file_labels)
+                        applied.append(name)
+                events = [("ADDED", n) for n in state.node_files] + [
+                    ("MODIFIED", n) for n in applied
+                ]
+                for event_type, name in events:
+                    line = json.dumps(
+                        {"type": event_type, "object": self._node(name)}
+                    )
+                    self.wfile.write(line.encode() + b"\n")
+                    self.wfile.flush()
 
         self.server = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
         self.thread = threading.Thread(
@@ -193,19 +210,40 @@ def write_kubeconfig(tmp_path, server_url):
     return str(path)
 
 
-def run_tfd_daemon_oneshot(features_file, strategy="none", backend="mock:v4-8"):
+def run_tfd_daemon_oneshot(
+    features_file,
+    strategy="none",
+    backend="mock:v4-8",
+    env_overrides=None,
+    clean_env=False,
+):
     """The real daemon, mock backend — the same payload the DaemonSet's
-    container produces into the features.d hostPath."""
-    env = dict(os.environ)
-    env.update(
-        {
-            "TFD_HERMETIC": "1",
-            "TFD_BACKEND": backend,
-            "PYTHONPATH": REPO_ROOT
-            + os.pathsep
-            + env.get("PYTHONPATH", ""),
+    container produces into the features.d hostPath.
+
+    ``clean_env`` strips the session's TPU_/TFD_ vars AND the axon site
+    hook (it rewrites TPU_* in every child python) — required whenever
+    ``env_overrides`` injects real host facts; the default hermetic mode
+    blanks the env provider instead (TFD_HERMETIC)."""
+    if clean_env:
+        env = {
+            k: v
+            for k, v in os.environ.items()
+            if not k.startswith(("TPU_", "TFD_", "PALLAS_"))
         }
-    )
+        env["PYTHONPATH"] = os.pathsep.join(
+            [
+                p
+                for p in env.get("PYTHONPATH", "").split(os.pathsep)
+                if p and "axon" not in p
+            ]
+            + [REPO_ROOT]
+        )
+    else:
+        env = dict(os.environ)
+        env["TFD_HERMETIC"] = "1"
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+    env["TFD_BACKEND"] = backend
+    env.update(env_overrides or {})
     subprocess.run(
         [
             sys.executable,
@@ -230,6 +268,7 @@ def run_e2e(
     watch_timeout="10",
     manifest="deployments/static/tpu-feature-discovery-daemonset.yaml",
     golden="expected-output.txt",
+    extra_args=(),
 ):
     env = dict(os.environ)
     env["KUBECONFIG"] = kubeconfig
@@ -241,6 +280,7 @@ def run_e2e(
             os.path.join(REPO_ROOT, manifest),
             os.path.join(HERE, "nfd.yaml"),
             os.path.join(HERE, golden),
+            *extra_args,
         ],
         capture_output=True,
         text=True,
@@ -389,7 +429,7 @@ def test_e2e_script_fails_when_label_never_lands(tmp_path):
             tmp_path, write_kubeconfig(tmp_path, api.url), watch_timeout="3"
         )
         assert result.returncode == 1
-        assert "Timestamp label never appeared" in result.stderr
+        assert "Timestamp label appeared on 0/1 nodes" in result.stderr
     finally:
         api.shutdown()
 
@@ -548,7 +588,7 @@ def test_e2e_script_sees_label_that_landed_before_watch(tmp_path):
     # Labels already applied; the watch will never fire (tfd_deployed
     # stays unset, so the fake's watch emits nothing and expires).
     with open(features_file) as f:
-        api.node_labels.update(
+        api.node_labels[NODE_NAME].update(
             dict(line.strip().split("=", 1) for line in f if "=" in line)
         )
     env = dict(os.environ)
@@ -657,3 +697,147 @@ def test_e2e_script_fails_loudly_on_stale_workload(tmp_path):
         assert "NOT deployed" in result.stderr
     finally:
         api.shutdown()
+
+
+# ---------------------------------------------------------------------------
+# Slice-consistency e2e (two workers of one slice on two nodes)
+# ---------------------------------------------------------------------------
+
+from slice_fixture import (  # noqa: E402
+    SLICE_BACKEND,
+    SLICE_HOSTENV,
+    TOPOLOGY_SINGLE_MANIFEST,
+    parse_hostenv,
+)
+
+
+def run_tfd_worker_oneshot(features_file, worker_id):
+    """The real daemon as worker ``worker_id`` of one v5p-64 slice: REAL
+    host-env injection (clean_env), the same env the pinned kind
+    DaemonSets carry (ci-prepare-e2e-manifest.py prepare_slice_workers)."""
+    overrides = {"TFD_NO_METADATA": "1", "TFD_MOCK_PCI": "1",
+                 "TPU_WORKER_ID": str(worker_id)}
+    overrides.update(parse_hostenv(SLICE_HOSTENV))
+    run_tfd_daemon_oneshot(
+        features_file,
+        strategy="single",
+        backend=SLICE_BACKEND,
+        env_overrides=overrides,
+        clean_env=True,
+    )
+
+
+def two_worker_manifest(tmp_path, nodes):
+    """Generate the two-pinned-DaemonSets manifest through the REAL CI
+    prep script — the same artifact the kind slice-consistency scenario
+    deploys."""
+    out = tmp_path / "two-worker.yaml"
+    subprocess.run(
+        [
+            sys.executable,
+            os.path.join(HERE, "ci-prepare-e2e-manifest.py"),
+            "tfd:test",
+            str(out),
+            "--backend",
+            SLICE_BACKEND,
+            "--manifest",
+            os.path.join(REPO_ROOT, TOPOLOGY_SINGLE_MANIFEST),
+            "--slice-worker-nodes",
+            ",".join(nodes),
+            "--hostenv",
+            SLICE_HOSTENV,
+        ],
+        check=True,
+        capture_output=True,
+        timeout=60,
+    )
+    return str(out)
+
+
+def _labeled_worker_files(tmp_path, worker_ids):
+    files = {}
+    for i, worker_id in enumerate(worker_ids):
+        node = f"fake-node-{i + 1}"
+        f = tmp_path / f"features-{i}" / "tfd"
+        f.parent.mkdir()
+        run_tfd_worker_oneshot(f, worker_id)
+        files[node] = str(f)
+    return files
+
+
+def test_e2e_slice_consistency_two_workers(tmp_path):
+    """SURVEY section 7 riskiest unknown (b): two workers of one slice,
+    labeling coordination-free on two nodes, agree on every slice-global
+    label and differ on worker-id."""
+    files = _labeled_worker_files(tmp_path, worker_ids=(0, 1))
+    manifest = two_worker_manifest(tmp_path, list(files))
+    api = FakeKubeApi(files)
+    try:
+        result = run_e2e(
+            tmp_path,
+            write_kubeconfig(tmp_path, api.url),
+            manifest=manifest,
+            golden="expected-output-v5p-64-two-worker.txt",
+            extra_args=("--slice-consistency", "2"),
+        )
+        assert result.returncode == 0, (
+            f"stdout:\n{result.stdout}\nstderr:\n{result.stderr}"
+        )
+        assert "Slice consistency OK across 2 nodes" in result.stdout
+        # Both pinned DaemonSets (plus NFD's worker) actually deployed.
+        ds_names = [n for _, k, n in api.created if k == "DaemonSet"]
+        assert "tpu-feature-discovery-w0" in ds_names
+        assert "tpu-feature-discovery-w1" in ds_names
+    finally:
+        api.shutdown()
+
+
+def test_e2e_slice_consistency_catches_duplicate_worker_id(tmp_path):
+    """Two nodes claiming the same worker id is a mis-deployment the
+    golden regexes cannot see ([0-9]+ matches both) — the consistency
+    check must."""
+    files = _labeled_worker_files(tmp_path, worker_ids=(0, 0))
+    manifest = two_worker_manifest(tmp_path, list(files))
+    api = FakeKubeApi(files)
+    try:
+        result = run_e2e(
+            tmp_path,
+            write_kubeconfig(tmp_path, api.url),
+            manifest=manifest,
+            golden="expected-output-v5p-64-two-worker.txt",
+            extra_args=("--slice-consistency", "2"),
+        )
+        assert result.returncode != 0
+        assert "not distinct" in result.stderr
+    finally:
+        api.shutdown()
+
+
+def _e2e_module():
+    spec = importlib.util.spec_from_file_location(
+        "e2e_tests", os.path.join(HERE, "e2e-tests.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_check_slice_consistency_logic():
+    mod = _e2e_module()
+    w0 = {
+        "google.com/tpu.multihost.worker-id": "0",
+        "google.com/tpu.slice.chips": "32",
+        "google.com/tfd.timestamp": "111",  # worker-local: may differ
+    }
+    w1 = dict(w0, **{"google.com/tpu.multihost.worker-id": "1",
+                     "google.com/tfd.timestamp": "222"})
+    assert mod.check_slice_consistency({"n1": w0, "n2": w1})
+    # Slice-global disagreement fails even with distinct ids.
+    w1_bad = dict(w1, **{"google.com/tpu.slice.chips": "64"})
+    assert not mod.check_slice_consistency({"n1": w0, "n2": w1_bad})
+    # Duplicate ids fail even with agreeing slice-global labels.
+    assert not mod.check_slice_consistency({"n1": w0, "n2": dict(w0)})
+    # A missing id is as bad as a duplicate one.
+    w1_noid = {k: v for k, v in w1.items()
+               if k != "google.com/tpu.multihost.worker-id"}
+    assert not mod.check_slice_consistency({"n1": w0, "n2": w1_noid})
