@@ -7,7 +7,7 @@ VERSION ?= 0.1.0
 
 COV_MIN ?= 75
 
-.PHONY: all native native-selftest test coverage integration bench check-yamls lint helm-check clean docker-build
+.PHONY: all native native-selftest test coverage integration bench check-yamls lint typecheck helm-check clean docker-build
 
 all: native test
 
@@ -67,6 +67,15 @@ helm-check:
 lint:
 	@command -v ruff >/dev/null && ruff check gpu_feature_discovery_tpu tests bench.py \
 	    || $(PYTHON) -m compileall -q gpu_feature_discovery_tpu tests bench.py
+
+# mypy config lives in pyproject.toml ([tool.mypy]); CI's lint job runs
+# this unconditionally, dev boxes without mypy skip with a notice.
+typecheck:
+	@if command -v mypy >/dev/null; then \
+	    mypy gpu_feature_discovery_tpu; \
+	else \
+	    echo "mypy unavailable; skipped (CI lint job runs it)"; \
+	fi
 
 clean:
 	$(MAKE) -C gpu_feature_discovery_tpu/native clean

@@ -150,6 +150,13 @@ def start(argv: Optional[list] = None) -> int:
 
             reset_burnin_schedule()
 
+            # New epoch, fresh once-per-epoch warnings: a reload must
+            # re-surface every still-true stable condition (missing DMI
+            # file, unacquirable chip) exactly once in the new epoch's log.
+            from gpu_feature_discovery_tpu.utils.logging import reset_warn_once
+
+            reset_warn_once()
+
             log.info("Start running")
             restart = run(manager, interconnect, config, sigs)
         except Exception as e:  # noqa: BLE001 - match reference error-to-exit

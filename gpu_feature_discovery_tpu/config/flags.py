@@ -146,7 +146,8 @@ FLAG_DEFS: List[FlagDef] = [
         default="",
         help='";"-separated key=value NamedValues passed to '
         "PJRT_Client_Create by the native-enumeration backend (some PJRT "
-        "plugins require named options; value types are inferred, or "
+        "plugins require named options; value types are inferred — "
+        "true/false Bool, integer Int64, decimal Float, else String — or "
         "forced with a s:/i:/f:/b: key prefix)",
         setter=lambda c, v: setattr(_f(c), "pjrt_create_options", v),
         getter=lambda c: _f(c).pjrt_create_options,

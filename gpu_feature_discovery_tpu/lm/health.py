@@ -28,6 +28,7 @@ from gpu_feature_discovery_tpu.config.spec import Config
 from gpu_feature_discovery_tpu.lm.labeler import Empty, Labeler
 from gpu_feature_discovery_tpu.lm.labels import Labels
 from gpu_feature_discovery_tpu.resource.types import Manager
+from gpu_feature_discovery_tpu.utils.logging import warn_once
 
 log = logging.getLogger("tfd.lm")
 
@@ -92,7 +93,15 @@ def _acquire_tpu_devices():
 
         devices = jax.local_devices()
     except Exception as e:  # noqa: BLE001 - backend init failures funnel here
-        log.warning("burn-in skipped: cannot acquire devices: %s", e)
+        # Stable condition (a broken PJRT init stays broken) and the
+        # caller's 'unacquirable' warning fires for this cycle too — once
+        # per epoch, or a wedged node logs two lines per sleep interval.
+        warn_once(
+            log,
+            "health:acquire-failed",
+            "burn-in skipped: cannot acquire devices: %s",
+            e,
+        )
         return None
     if not devices or any(getattr(d, "platform", "") != "tpu" for d in devices):
         return None
@@ -112,8 +121,9 @@ def new_health_labeler(manager: Manager, config: Config) -> Labeler:
         from gpu_feature_discovery_tpu.ops.healthcheck import measure_node_health
     except ImportError as e:
         # A missing/incompatible jax says nothing about chip health: skip
-        # the labels rather than mark a healthy node unhealthy.
-        log.warning("burn-in unavailable (no usable jax): %s", e)
+        # the labels rather than mark a healthy node unhealthy. Stable for
+        # the process lifetime — once per epoch.
+        warn_once(log, "health:no-jax", "burn-in unavailable (no usable jax): %s", e)
         return Empty()
     # Acquisition is checked EVERY cycle (it is cheap against the held
     # client) so cached health labels never outlive the chip being
@@ -121,9 +131,12 @@ def new_health_labeler(manager: Manager, config: Config) -> Labeler:
     sched = _schedule_for(manager)
     devices = _acquire_tpu_devices()
     if devices is None:
-        log.warning(
+        # Usually stable (a TPU-less node stays TPU-less): once per epoch.
+        warn_once(
+            log,
+            "health:unacquirable",
             "burn-in skipped: no local TPU devices acquirable (chip busy, "
-            "PJRT unusable, or CPU fallback); publishing no health labels"
+            "PJRT unusable, or CPU fallback); publishing no health labels",
         )
         # Stale health must not outlive acquirability: drop the cache so
         # the next cycles retry the acquisition instead of republishing.
@@ -188,7 +201,14 @@ def new_health_labeler(manager: Manager, config: Config) -> Labeler:
             # Sub-1 GiB/s is not a believable HBM reading on hardware that
             # just passed the checksum — a tunneled/virtualized device is
             # distorting timing; omit rather than publish a junk number.
-            log.warning("implausible HBM bandwidth %.3f GiB/s; omitting label", hbm)
+            # Stable per environment, so once per epoch (the number varies
+            # run to run; the condition does not).
+            warn_once(
+                log,
+                "health:implausible-hbm",
+                "implausible HBM bandwidth %.3f GiB/s; omitting label",
+                hbm,
+            )
     if report.get("ici_ok") is not None:
         labels[HEALTH_ICI] = str(report["ici_ok"]).lower()
     sched.consecutive_failures = 0

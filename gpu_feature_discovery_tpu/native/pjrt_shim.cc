@@ -223,6 +223,21 @@ bool text_is_int64(const char* s) {
   return true;
 }
 
+/* [-]digits[.digits] WITH a dot: unforced decimal text infers as Float
+ * (ADVICE r3 — "scale=1.5" silently became a String NamedValue, which a
+ * plugin expecting Float rejects). Dotless integers stay Int64; anything
+ * that must remain text is forced with s:. */
+bool text_is_inferred_float(const char* s) {
+  if (*s == '-') ++s;
+  bool digits = false, dot = false;
+  for (; *s != '\0'; ++s) {
+    if (*s >= '0' && *s <= '9') { digits = true; continue; }
+    if (*s == '.' && !dot) { dot = true; continue; }
+    return false;
+  }
+  return digits && dot;
+}
+
 /* Returns TFD_SUCCESS or TFD_ERROR_INVALID_ARGUMENT (malformed segment,
  * too many options, or spec longer than the buffer). */
 int parse_create_options(const char* spec, CreateOptions* o, char* err_msg,
@@ -298,7 +313,8 @@ int parse_create_options(const char* spec, CreateOptions* o, char* err_msg,
         /* -acc cannot overflow: acc <= LLONG_MAX, so -acc >= -LLONG_MAX >
          * LLONG_MIN (LLONG_MIN itself is rejected one digit early). */
         nv.v.int64_value = neg ? -acc : acc;
-      } else if (forced == 'f') {
+      } else if (forced == 'f' ||
+                 (forced == '\0' && text_is_inferred_float(value))) {
         /* Minimal decimal parser (no strtof: keep this file libc-light
          * and locale-independent). Accepts [-]digits[.digits]. */
         const char* d = value;

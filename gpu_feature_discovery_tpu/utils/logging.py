@@ -12,3 +12,25 @@ def setup(debug: bool = False) -> None:
         format="%(asctime)s %(name)s: %(message)s",
         datefmt="%Y/%m/%d %H:%M:%S",
     )
+
+
+# Conditions like a missing DMI file or an unacquirable chip are STABLE:
+# they repeat every labeling cycle, and a warning per cycle buries real
+# operator signal (10 cycles on a DMI-less host = 10 identical lines).
+# warn_once logs WARNING the first time a key is seen in a config epoch
+# and DEBUG thereafter; SIGHUP resets the epoch (cmd/main.py), so a
+# reload re-surfaces every still-true condition exactly once.
+_warned_keys: set = set()
+
+
+def warn_once(logger: logging.Logger, key: str, fmt: str, *args) -> None:
+    if key in _warned_keys:
+        logger.debug(fmt, *args)
+    else:
+        _warned_keys.add(key)
+        logger.warning(fmt, *args)
+
+
+def reset_warn_once() -> None:
+    """New config epoch: every stable condition may warn once again."""
+    _warned_keys.clear()

