@@ -3,11 +3,12 @@
 
 PYTHON ?= python
 IMAGE_NAME ?= ghcr.io/example/tpu-feature-discovery
-VERSION ?= 0.1.0
+
+include versions.mk
 
 COV_MIN ?= 75
 
-.PHONY: all native native-selftest test coverage integration bench check-yamls lint typecheck helm-check clean docker-build
+.PHONY: all native native-selftest test coverage integration bench check-yamls lint typecheck helm-check clean stamp wheel docker-build docker-build-multiarch docker-push
 
 all: native test
 
@@ -80,6 +81,37 @@ typecheck:
 clean:
 	$(MAKE) -C gpu_feature_discovery_tpu/native clean
 	find . -name __pycache__ -type d -prune -exec rm -rf {} +
+	# Generated build stamp + wheel artifacts: a leftover stamp would
+	# shadow the env fallback (tests assert an unstamped tree).
+	rm -f gpu_feature_discovery_tpu/info/_build_info.py
+	rm -rf dist build *.egg-info
+
+# Bake provenance into the package before any artifact is cut
+# (ldflags analog; see info/stamp.py).
+stamp:
+	$(PYTHON) -m gpu_feature_discovery_tpu.info.stamp \
+	    --version $(VERSION) --git-commit "$(GIT_COMMIT)"
+
+# --no-build-isolation: resolve the build backend from the environment
+# (constraints.txt world) instead of fetching one — matches
+# tests/test_packaging.py and keeps the build reproducible offline.
+wheel: native stamp
+	$(PYTHON) -m pip wheel --no-deps --no-build-isolation -w dist .
 
 docker-build:
-	docker build -t $(IMAGE_NAME):$(VERSION) -f deployments/container/Dockerfile .
+	docker build -t $(IMAGE_NAME):$(VERSION) -f deployments/container/Dockerfile \
+	    --build-arg TFD_VERSION=$(VERSION) \
+	    --build-arg TFD_GIT_COMMIT="$(GIT_COMMIT)" .
+
+# Reference: deployments/container/multi-arch.mk — buildx manifest for
+# every platform in versions.mk; pushes on build when PUSH_ON_BUILD=true
+# (a multi-arch manifest cannot --load into the local store).
+docker-build-multiarch:
+	docker buildx build --platform $(PLATFORMS) \
+	    --output=type=image,push=$(PUSH_ON_BUILD) \
+	    -t $(IMAGE_NAME):$(VERSION) -f deployments/container/Dockerfile \
+	    --build-arg TFD_VERSION=$(VERSION) \
+	    --build-arg TFD_GIT_COMMIT="$(GIT_COMMIT)" .
+
+docker-push:
+	docker push $(IMAGE_NAME):$(VERSION)

@@ -1,13 +1,25 @@
 """Build/version metadata.
 
-Reference: internal/info/version.go:22-43 (ldflags-injected version + gitCommit;
-here populated at build time via TFD_VERSION/TFD_GIT_COMMIT env or defaults).
+Reference: internal/info/version.go:22-43 — version + gitCommit injected
+at LINK time via ldflags (versions.mk). Python has no link step, so
+``make stamp`` (info/stamp.py) generates ``info/_build_info.py``
+(gitignored) before wheels and images are cut: a stamped artifact reports
+its provenance regardless of runtime env. Unstamped dev checkouts fall
+back to TFD_VERSION/TFD_GIT_COMMIT env vars, then defaults.
 """
 
 import os
 
-VERSION = os.environ.get("TFD_VERSION", "0.1.0")
-GIT_COMMIT = os.environ.get("TFD_GIT_COMMIT", "")
+DEFAULT_VERSION = "0.1.0"
+
+try:  # The build stamp wins: a released artifact's provenance is immutable.
+    from gpu_feature_discovery_tpu.info._build_info import (  # type: ignore
+        GIT_COMMIT,
+        VERSION,
+    )
+except ImportError:
+    VERSION = os.environ.get("TFD_VERSION", DEFAULT_VERSION)
+    GIT_COMMIT = os.environ.get("TFD_GIT_COMMIT", "")
 
 
 def get_version_string() -> str:

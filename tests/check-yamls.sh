@@ -21,6 +21,21 @@ for y in "$repo"/deployments/static/*.yaml "$repo"/deployments/static/*.template
   done < <(grep -E '^\s+- image:' "$y" | sed 's/[[:space:]]*$//')
 done
 
+# versions.mk feeds the stamped builds (make stamp/docker-build) and
+# pyproject.toml names the wheel; both must agree with the package
+# default or a release stamps/ships a different version than the code
+# reports unstamped.
+mk_version="$(grep -E '^VERSION \?=' "$repo/versions.mk" | awk '{print $3}')"
+if [ "$mk_version" != "$version" ]; then
+  echo "FAIL: versions.mk VERSION '$mk_version' != repo version '$version'"
+  fail=1
+fi
+wheel_version="$(grep -E '^version = ' "$repo/pyproject.toml" | head -1 | tr -d '"' | awk '{print $3}')"
+if [ "$wheel_version" != "$version" ]; then
+  echo "FAIL: pyproject.toml version '$wheel_version' != repo version '$version'"
+  fail=1
+fi
+
 chart="$repo/deployments/helm/tpu-feature-discovery/Chart.yaml"
 chart_app="$(grep '^appVersion:' "$chart" | tr -d '"' | awk '{print $2}')"
 if [ "$chart_app" != "$version" ]; then

@@ -1,0 +1,16 @@
+# Release version facts — the reference's versions.mk analog: one place
+# for the version, the git-describe provenance recipe, and the multi-arch
+# platform list; Makefile and the docker targets all include this.
+
+VERSION ?= 0.1.0
+
+# Full 40-char sha, -dirty on a modified tree, empty outside a checkout
+# (reference: versions.mk GIT_COMMIT).
+GIT_COMMIT ?= $(shell git describe --match="" --dirty --long --always --abbrev=40 2> /dev/null || echo "")
+
+# Multi-arch image targets (reference: deployments/container/multi-arch.mk).
+PLATFORMS ?= linux/amd64,linux/arm64
+
+# Multi-arch manifests cannot --load into the local docker store; they
+# either push on build or stay in the buildx cache.
+PUSH_ON_BUILD ?= false
