@@ -1,0 +1,80 @@
+#!/bin/sh
+# Provisioning-as-code for the tier-4 e2e (reference analog: the
+# aws-kube-ci terraform submodule + .gitlab-ci.yml:101-131, which create a
+# GPU node, run the e2e over ssh, and tear the instance down): create a
+# small GKE cluster with one v5e TPU node pool, run tests/ci-run-e2e.sh
+# against it, and delete the cluster — ALWAYS, an orphaned TPU node pool
+# bills by the chip-hour.
+#
+# Usage: ci-provision-gke.sh IMAGE_NAME VERSION [GOLDEN]
+# Env:
+#   GKE_PROJECT        (required) GCP project id
+#   GKE_ZONE           zone with v5e capacity   (default us-west4-a)
+#   CLUSTER_NAME       default tfd-e2e-$$ (unique per run)
+#   TPU_MACHINE_TYPE   default ct5lp-hightpu-4t (one v5e host, 4 chips)
+#   GCLOUD             the gcloud binary        (tests inject a stub)
+#   E2E_RUNNER         default ./ci-run-e2e.sh  (tests inject a stub)
+#   TFD_PROVISION_DRY_RUN=1  print every command instead of executing —
+#       the hermetic plan test (test_provision_script.py) pins the output.
+set -eu
+cd "$(dirname "$0")"
+
+if [ "$#" -lt 2 ]; then
+  echo "Usage: $0 IMAGE_NAME VERSION [GOLDEN]" && exit 1
+fi
+
+IMAGE_NAME=$1
+VERSION=$2
+GOLDEN=${3:-expected-output.txt}
+
+GKE_PROJECT=${GKE_PROJECT:?set GKE_PROJECT to the GCP project id}
+GKE_ZONE=${GKE_ZONE:-us-west4-a}
+CLUSTER_NAME=${CLUSTER_NAME:-tfd-e2e-$$}
+TPU_MACHINE_TYPE=${TPU_MACHINE_TYPE:-ct5lp-hightpu-4t}
+GCLOUD=${GCLOUD:-gcloud}
+E2E_RUNNER=${E2E_RUNNER:-./ci-run-e2e.sh}
+
+run() {
+  if [ "${TFD_PROVISION_DRY_RUN:-0}" = "1" ]; then
+    echo "DRY: $*"
+  else
+    "$@"
+  fi
+}
+
+teardown() {
+  # Runs on every exit path, pass or fail: the aws_kube_clean analog.
+  # || true — a failed delete must not mask the e2e verdict.
+  run "$GCLOUD" container clusters delete "$CLUSTER_NAME" \
+      --project "$GKE_PROJECT" --zone "$GKE_ZONE" --quiet || true
+  rm -f "$TFD_KUBECONFIG"
+}
+# INT/TERM too: POSIX sh does not run the EXIT trap on an untrapped fatal
+# signal, and a cancelled CI job must not orphan a billing TPU pool.
+trap teardown EXIT
+trap 'exit 130' INT
+trap 'exit 143' TERM
+
+# Ephemeral kubeconfig: get-credentials must not repoint the operator's
+# ~/.kube/config at a cluster that is about to be deleted.
+TFD_KUBECONFIG=$(mktemp)
+KUBECONFIG=$TFD_KUBECONFIG
+export KUBECONFIG
+
+# System pool: one small node for NFD's master + kube-system.
+run "$GCLOUD" container clusters create "$CLUSTER_NAME" \
+    --project "$GKE_PROJECT" --zone "$GKE_ZONE" \
+    --num-nodes 1 --machine-type e2-standard-4
+
+# TPU pool: GKE taints it google.com/tpu=present:NoSchedule and labels it
+# cloud.google.com/gke-tpu-accelerator natively — the exact affinity +
+# toleration the TFD daemonset ships with.
+run "$GCLOUD" container node-pools create tpu \
+    --project "$GKE_PROJECT" --zone "$GKE_ZONE" \
+    --cluster "$CLUSTER_NAME" \
+    --machine-type "$TPU_MACHINE_TYPE" --num-nodes 1
+
+run "$GCLOUD" container clusters get-credentials "$CLUSTER_NAME" \
+    --project "$GKE_PROJECT" --zone "$GKE_ZONE"
+
+run "$E2E_RUNNER" "$IMAGE_NAME" "$VERSION" "$GOLDEN"
