@@ -42,12 +42,19 @@ run() {
   fi
 }
 
+# Initialized before the trap: under set -u an early exit would
+# otherwise kill teardown itself on an unbound variable, masking the
+# real failure.
+TFD_KUBECONFIG=
+
 teardown() {
   # Runs on every exit path, pass or fail: the aws_kube_clean analog.
   # || true — a failed delete must not mask the e2e verdict.
   run "$GCLOUD" container clusters delete "$CLUSTER_NAME" \
       --project "$GKE_PROJECT" --zone "$GKE_ZONE" --quiet || true
-  rm -f "$TFD_KUBECONFIG"
+  if [ -n "$TFD_KUBECONFIG" ]; then
+    rm -f "$TFD_KUBECONFIG"
+  fi
 }
 # INT/TERM too: POSIX sh does not run the EXIT trap on an untrapped fatal
 # signal, and a cancelled CI job must not orphan a billing TPU pool.

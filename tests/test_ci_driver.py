@@ -1,0 +1,120 @@
+"""The act-style CI local driver (tests/ci-local-driver.py) — the tool
+that produced CI_EVIDENCE.md. Pinned here so the evidence generator
+itself cannot rot: expression evaluation, matrix expansion, tool-gated
+skips, fail-fast, and the evidence artifact."""
+
+import importlib.util
+import os
+
+import yaml
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def _driver():
+    spec = importlib.util.spec_from_file_location(
+        "ci_local_driver", os.path.join(HERE, "ci-local-driver.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_substitute_matrix_expressions():
+    d = _driver()
+    assert d.substitute("echo ${{ matrix.backend }}", {"backend": "mock:v4-8"}) == (
+        "echo mock:v4-8"
+    )
+    # Non-matrix expressions stay untouched (the driver must not guess).
+    assert d.substitute("${{ github.sha }}", {}) == "${{ github.sha }}"
+
+
+def test_if_condition_subset():
+    d = _driver()
+    assert d.if_condition_holds("", {})
+    assert d.if_condition_holds("matrix.scenario == 'helm'", {"scenario": "helm"})
+    assert not d.if_condition_holds("matrix.scenario == 'helm'", {"scenario": "base"})
+    assert d.if_condition_holds(
+        "matrix.scenario != 'helm' && matrix.scenario != 'slice-consistency'",
+        {"scenario": "base"},
+    )
+    assert not d.if_condition_holds("failure()", {})
+
+
+def test_real_workflow_parses_into_units():
+    d = _driver()
+    with open(os.path.join(HERE, "..", ".github", "workflows", "ci.yml")) as f:
+        wf = yaml.safe_load(f)
+    units = {name for name, _, _ in d.iter_units(wf)}
+    assert {"lint", "unit", "integration", "helm"} <= units
+    assert "docker-e2e (slice-consistency)" in units
+    # Every if: expression in the real workflow must be evaluable by the
+    # driver's subset — an unsupported expression means unproven steps.
+    for _, matrix, steps in d.iter_units(wf):
+        for step in steps:
+            d.if_condition_holds(step.get("if", ""), matrix)
+
+
+def test_synthetic_workflow_end_to_end(tmp_path, capsys):
+    d = _driver()
+    wf = tmp_path / "wf.yml"
+    wf.write_text(
+        """
+jobs:
+  demo:
+    steps:
+      - name: runs
+        run: echo ok-$((40 + 2))
+      - name: needs docker
+        run: docker build .
+      - name: gated off
+        if: matrix.scenario == 'other'
+        run: exit 1
+"""
+    )
+    out = tmp_path / "EVIDENCE.md"
+    rc = d.main(["--workflow", str(wf), "--out", str(out)])
+    assert rc == 0
+    text = out.read_text()
+    assert "| runs | PASS | ok-42 |" in text
+    assert "| needs docker | SKIP | docker unavailable |" in text
+    assert "NOT-SELECTED" in text
+
+
+def test_synthetic_workflow_failure_stops_job_and_exits_nonzero(tmp_path):
+    d = _driver()
+    wf = tmp_path / "wf.yml"
+    wf.write_text(
+        """
+jobs:
+  demo:
+    steps:
+      - name: boom
+        run: echo before; exit 3
+      - name: never
+        run: echo should-not-run > %s
+"""
+        % (tmp_path / "leak")
+    )
+    rc = d.main(["--workflow", str(wf)])
+    assert rc == 1
+    # Fail-fast within the job, like a real Actions job.
+    assert not (tmp_path / "leak").exists()
+
+
+def test_evidence_artifact_is_current():
+    """CI_EVIDENCE.md is committed proof; it must reference every job of
+    the CURRENT workflow (regenerate with
+    `python tests/ci-local-driver.py --out CI_EVIDENCE.md` after editing
+    ci.yml)."""
+    d = _driver()
+    evidence_path = os.path.join(HERE, "..", "CI_EVIDENCE.md")
+    assert os.path.exists(evidence_path), "run the CI local driver"
+    evidence = open(evidence_path).read()
+    with open(os.path.join(HERE, "..", ".github", "workflows", "ci.yml")) as f:
+        wf = yaml.safe_load(f)
+    for unit, _, _ in d.iter_units(wf):
+        assert f"## {unit}" in evidence, (
+            f"CI_EVIDENCE.md missing unit {unit!r} — regenerate it"
+        )
+    assert "FAIL" not in evidence, "committed evidence contains failures"
