@@ -17,7 +17,7 @@ import queue
 import signal
 import sys
 import time
-from typing import Optional, Tuple
+from typing import Optional
 
 from gpu_feature_discovery_tpu.config.flags import (
     CONFIG_FILE_ENV_VARS,

@@ -24,7 +24,6 @@ from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from gpu_feature_discovery_tpu.models import parse_accelerator_type
-from gpu_feature_discovery_tpu.models.accelerator_types import parse_topology
 
 _LINE_RE = re.compile(r"^\s*([A-Za-z0-9_.-]+)\s*:\s*(.*?)\s*$")
 

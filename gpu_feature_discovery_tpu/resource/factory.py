@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import logging
 import os
-from typing import Callable, Dict, Optional
+from typing import Optional
 
 from gpu_feature_discovery_tpu.config.spec import Config
 from gpu_feature_discovery_tpu.resource.fallback import FallbackToNullOnInitError

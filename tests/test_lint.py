@@ -1,0 +1,101 @@
+"""Mechanical F401 (unused import) sweep.
+
+This dev environment has no ruff, but the CI lint job runs `ruff check`
+over the same trees — an unused import merged here would fail CI's very
+first real run. This AST sweep approximates ruff's F401: `__all__`
+re-exports count as used (the __init__.py convention ruff honors), any
+`# noqa` on the import line exempts it, and string constants are parsed
+as type expressions so quoted annotations don't false-positive.
+"""
+
+import ast
+import glob
+import os
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+
+
+def _names_used(tree, source):
+    used = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Name):
+            used.add(node.id)
+        elif isinstance(node, ast.Attribute):
+            base = node
+            while isinstance(base, ast.Attribute):
+                base = base.value
+            if isinstance(base, ast.Name):
+                used.add(base.id)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            # Quoted annotations ("queue.Queue[int]") reference imports
+            # through strings; parse them as expressions when they look
+            # like one.
+            try:
+                sub = ast.parse(node.value, mode="eval")
+            except SyntaxError:
+                continue
+            for n in ast.walk(sub):
+                if isinstance(n, ast.Name):
+                    used.add(n.id)
+    # __all__ entries are deliberate re-exports.
+    for node in tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and any(
+                isinstance(t, ast.Name) and t.id == "__all__"
+                for t in node.targets
+            )
+            and isinstance(node.value, (ast.List, ast.Tuple))
+        ):
+            for elt in node.value.elts:
+                if isinstance(elt, ast.Constant) and isinstance(elt.value, str):
+                    used.add(elt.value)
+    return used
+
+
+def unused_imports(path):
+    source = open(path).read()
+    tree = ast.parse(source)
+    lines = source.splitlines()
+    imported = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Import, ast.ImportFrom)):
+            for alias in node.names:
+                if alias.name == "*":
+                    continue
+                name = (alias.asname or alias.name).split(".")[0]
+                # noqa anywhere in the import statement's span exempts it
+                # (multi-line from-imports put noqa on the first line).
+                span = " ".join(
+                    lines[node.lineno - 1 : (node.end_lineno or node.lineno)]
+                )
+                if "noqa" in span:
+                    continue
+                imported[name] = node.lineno
+    used = _names_used(tree, source)
+    return [
+        (name, lineno)
+        for name, lineno in imported.items()
+        if name not in used and name != "annotations"
+    ]
+
+
+def test_no_unused_imports():
+    offenders = []
+    files = (
+        glob.glob(os.path.join(REPO, "gpu_feature_discovery_tpu", "**", "*.py"),
+                  recursive=True)
+        + glob.glob(os.path.join(HERE, "*.py"))
+        + [os.path.join(REPO, "bench.py"), os.path.join(REPO, "__graft_entry__.py")]
+    )
+    for path in sorted(files):
+        if "__pycache__" in path:
+            continue
+        for name, lineno in unused_imports(path):
+            offenders.append(
+                f"{os.path.relpath(path, REPO)}:{lineno}: unused import {name}"
+            )
+    assert not offenders, (
+        "unused imports (would fail CI's ruff F401):\n" + "\n".join(offenders)
+    )

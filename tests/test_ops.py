@@ -11,7 +11,6 @@ import pytest
 from gpu_feature_discovery_tpu.ops.healthcheck import (
     build_mesh,
     burnin_flops,
-    burnin_step,
     ici_ring_sweep,
     make_burnin_step,
     make_slice_train_step,

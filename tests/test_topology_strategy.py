@@ -3,8 +3,6 @@ reference's largest unit suite (internal/lm/mig-strategy_test.go:148-360
 case matrix): every none/single/mixed edge including sharing replicas and
 all three INVALID reasons."""
 
-import pytest
-
 from gpu_feature_discovery_tpu.config import new_config
 from gpu_feature_discovery_tpu.config.spec import ReplicatedResource
 from gpu_feature_discovery_tpu.lm.topology_strategy import new_resource_labeler
