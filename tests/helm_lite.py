@@ -1,0 +1,556 @@
+"""helm-lite: a fail-loud renderer for THIS repo's helm charts.
+
+This sandbox has no helm binary, so after the CRD-era subchart rewrite
+the templates were only ever text-checked — a go-template slip would
+surface first in CI. This module implements exactly the template-language
+subset the charts use (actions, trim markers, if/else/with/range/define,
+variables, pipelines, and the sprig/builtin functions inventoried from
+the templates) and RAISES on anything else: an unsupported construct
+must fail the test, never silently mis-render.
+
+Where real helm exists (CI runners), test_helm_chart.py's parity test
+diffs this renderer's parsed output against `helm template`, which
+validates helm_lite itself. This is test infrastructure, not product
+code; helm remains the release-path authority.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+
+import yaml
+
+
+class RenderError(Exception):
+    pass
+
+
+class HelmFail(RenderError):
+    """A template called fail() — install-time validation fired."""
+
+
+# ---------------------------------------------------------------------------
+# Template parsing: text/action stream -> nested block AST
+# ---------------------------------------------------------------------------
+
+_TAG = re.compile(r"\{\{(-)?\s*(.*?)\s*(-)?\}\}", re.S)
+
+
+def _lex(source):
+    """Yield ('text', s) and ('action', body) with trim markers applied
+    (a '-' eats ALL adjacent whitespace, newlines included — go text/
+    template semantics)."""
+    parts = []
+    pos = 0
+    for m in _TAG.finditer(source):
+        text = source[pos : m.start()]
+        parts.append(["text", text])
+        parts.append(["action", m.group(2), m.group(1), m.group(3)])
+        pos = m.end()
+    parts.append(["text", source[pos:]])
+    # Apply trim markers to neighboring text nodes.
+    for i, part in enumerate(parts):
+        if part[0] != "action":
+            continue
+        if part[2] and i > 0:
+            parts[i - 1][1] = parts[i - 1][1].rstrip()
+        if part[3] and i + 1 < len(parts):
+            parts[i + 1][1] = parts[i + 1][1].lstrip()
+    for part in parts:
+        if part[0] == "text":
+            if part[1]:
+                yield ("text", part[1])
+        else:
+            body = part[1]
+            if body.startswith("/*"):  # comment
+                continue
+            yield ("action", body)
+
+
+def _parse(tokens):
+    """Nested node list; blocks: ('if', [(cond, body)...], else_body),
+    ('with', expr, body, else_body), ('range', expr, body),
+    ('define', name, body)."""
+    nodes = []
+    stack = [nodes]
+    frames = []  # ('if'|'with'|'range'|'define', data)
+    for kind, value in tokens:
+        if kind == "text":
+            stack[-1].append(("text", value))
+            continue
+        word = value.split(None, 1)[0] if value else ""
+        if word == "if":
+            body = []
+            frames.append(["if", [(value[2:].strip(), body)], None])
+            stack.append(body)
+        elif word == "else":
+            frame = frames[-1]
+            stack.pop()
+            rest = value[4:].strip()
+            body = []
+            if rest.startswith("if "):
+                frame[1].append((rest[3:].strip(), body))
+            elif frame[0] == "if":
+                frame[2] = body
+            elif frame[0] == "with":
+                frame[3] = body
+            else:
+                raise RenderError(f"helm-lite: else in {frame[0]} block")
+            stack.append(body)
+        elif word == "with":
+            body = []
+            frames.append(["with", value[4:].strip(), body, None])
+            stack.append(body)
+        elif word == "range":
+            body = []
+            frames.append(["range", value[5:].strip(), body])
+            stack.append(body)
+        elif word == "define":
+            name = value[6:].strip().strip('"')
+            body = []
+            frames.append(["define", name, body])
+            stack.append(body)
+        elif word == "end":
+            frame = frames.pop()
+            stack.pop()
+            if frame[0] == "if":
+                stack[-1].append(("if", frame[1], frame[2]))
+            elif frame[0] == "with":
+                stack[-1].append(("with", frame[1], frame[2], frame[3]))
+            elif frame[0] == "range":
+                stack[-1].append(("range", frame[1], frame[2]))
+            else:
+                stack[-1].append(("define", frame[1], frame[2]))
+        else:
+            stack[-1].append(("expr", value))
+    if frames:
+        raise RenderError(f"helm-lite: unclosed {frames[-1][0]} block")
+    return nodes
+
+
+# ---------------------------------------------------------------------------
+# Expression evaluation
+# ---------------------------------------------------------------------------
+
+_EXPR_TOKEN = re.compile(
+    r"""\s*(?:
+        (?P<str>"(?:[^"\\]|\\.)*")
+      | (?P<num>-?\d+(?:\.\d+)?)
+      | (?P<pipe>\|)
+      | (?P<lpar>\()
+      | (?P<rpar>\))
+      | (?P<assign>:=|=)
+      | (?P<var>\$[A-Za-z0-9_]*)
+      | (?P<dot>\.[A-Za-z0-9_.]*)
+      | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
+    )""",
+    re.X,
+)
+
+
+def _tokenize_expr(text):
+    out, pos = [], 0
+    while pos < len(text):
+        m = _EXPR_TOKEN.match(text, pos)
+        if not m or m.end() == pos:
+            if text[pos:].strip():
+                raise RenderError(f"helm-lite: cannot tokenize {text[pos:]!r}")
+            break
+        out.append((m.lastgroup, m.group(m.lastgroup)))
+        pos = m.end()
+    return out
+
+
+_NO_PIPE = object()  # piped nil must still reach the next stage's args
+
+
+def _truthy(v):
+    # go template truthiness: nil, false, 0, "", empty collection.
+    return not (v is None or v is False or v == 0 or v == "" or v == {} or v == [])
+
+
+class _Evaluator:
+    def __init__(self, renderer, dot, variables):
+        self.r = renderer
+        self.dot = dot
+        self.vars = variables
+
+    def pipeline(self, tokens):
+        """command ('|' command)* — each command's result is appended as
+        the LAST argument of the next (go template pipe semantics)."""
+        stages, current = [], []
+        depth = 0
+        for kind, val in tokens:
+            if kind == "pipe" and depth == 0:
+                stages.append(current)
+                current = []
+            else:
+                depth += kind == "lpar"
+                depth -= kind == "rpar"
+                current.append((kind, val))
+        stages.append(current)
+        value = self.command(stages[0], piped=_NO_PIPE)
+        for stage in stages[1:]:
+            value = self.command(stage, piped=value)
+        return value
+
+    _LITERALS = {"true": True, "false": False, "nil": None}
+
+    def command(self, tokens, piped):
+        if not tokens:
+            raise RenderError("helm-lite: empty pipeline stage")
+        if (
+            len(tokens) == 1
+            and tokens[0][0] == "ident"
+            and tokens[0][1] in self._LITERALS
+        ):
+            if piped is not _NO_PIPE:
+                raise RenderError("helm-lite: piped into a literal")
+            return self._LITERALS[tokens[0][1]]
+        operands, i = [], 0
+        while i < len(tokens):
+            kind, val = tokens[i]
+            if kind == "lpar":
+                depth, j = 1, i + 1
+                while depth:
+                    k = tokens[j][0]
+                    depth += k == "lpar"
+                    depth -= k == "rpar"
+                    j += 1
+                operands.append(self.pipeline(tokens[i + 1 : j - 1]))
+                i = j
+                continue
+            operands.append(self._atom(kind, val))
+            i += 1
+        head = tokens[0]
+        if head[0] == "ident":
+            args = operands[1:]
+            if piped is not _NO_PIPE:
+                args = args + [piped]  # pipe feeds the LAST argument
+            return self._call(head[1], args)
+        if len(operands) != 1:
+            raise RenderError(f"helm-lite: unexpected operands {tokens!r}")
+        if piped is not _NO_PIPE:
+            raise RenderError("helm-lite: piped into a non-function stage")
+        return operands[0]
+
+    def _atom(self, kind, val):
+        if kind == "str":
+            return val[1:-1].replace('\\"', '"').replace("\\n", "\n")
+        if kind == "num":
+            return float(val) if "." in val else int(val)
+        if kind == "var":
+            found, value = self.vars.lookup(val)
+            if not found:
+                raise RenderError(f"helm-lite: undefined variable {val}")
+            return value
+        if kind == "dot":
+            return self._resolve_dot(val)
+        if kind == "ident":
+            if val in self._LITERALS:
+                return self._LITERALS[val]
+            return ("__fn__", val)  # bare function name handled in command
+        raise RenderError(f"helm-lite: unexpected token {kind} {val!r}")
+
+    def _resolve_dot(self, path):
+        value = self.dot
+        for part in [p for p in path.split(".") if p]:
+            if isinstance(value, dict) and part in value:
+                value = value[part]
+            else:
+                return None  # missing key -> nil (falsy), like go template
+        return value
+
+    def _call(self, name, args):
+        fns = {
+            "default": lambda d, v: v if _truthy(v) else d,
+            "trunc": lambda n, s: str(s)[:n],
+            "trimSuffix": lambda suf, s: (
+                str(s)[: -len(suf)] if str(s).endswith(suf) else str(s)
+            ),
+            "printf": lambda fmt, *a: _go_printf(fmt, a),
+            "replace": lambda old, new, s: str(s).replace(old, new),
+            "contains": lambda sub, s: sub in str(s),
+            "quote": lambda v: '"%s"' % _to_text(v),
+            "toYaml": lambda v: yaml.safe_dump(
+                v, default_flow_style=False, sort_keys=False
+            ).rstrip("\n"),
+            "nindent": lambda n, s: "\n" + _indent(str(s), n),
+            "indent": lambda n, s: _indent(str(s), n),
+            "typeIs": _type_is,
+            "eq": lambda a, b: a == b,
+            "ne": lambda a, b: a != b,
+            "len": lambda v: len(v) if v is not None else 0,
+            "not": lambda v: not _truthy(v),
+            "and": lambda *a: next((x for x in a if not _truthy(x)), a[-1]),
+            "or": lambda *a: next((x for x in a if _truthy(x)), a[-1]),
+        }
+        if name == "include":
+            tpl_name, ctx = args
+            return self.r.render_define(tpl_name, ctx)
+        if name == "fail":
+            raise HelmFail(str(args[0]))
+        if name not in fns:
+            raise RenderError(f"helm-lite: unsupported function {name!r}")
+        try:
+            return fns[name](*args)
+        except HelmFail:
+            raise
+        except Exception as e:
+            raise RenderError(f"helm-lite: {name}{args!r}: {e}") from e
+
+
+def _go_printf(fmt, args):
+    # The charts use only %s and %d.
+    if re.search(r"%[^sd%]", fmt):
+        raise RenderError(f"helm-lite: unsupported printf verb in {fmt!r}")
+    converted = tuple(
+        a if isinstance(a, (int, float)) and not isinstance(a, bool)
+        else _to_text(a)
+        for a in args
+    )
+    return fmt % converted
+
+
+def _indent(s, n):
+    pad = " " * n
+    return "\n".join(pad + line if line else line for line in s.split("\n"))
+
+
+def _type_is(tname, v):
+    go = {
+        "bool": bool,
+        "string": str,
+        "int": int,
+        "float64": float,
+    }
+    if tname not in go:
+        raise RenderError(f"helm-lite: typeIs {tname!r} unsupported")
+    if tname == "int" and isinstance(v, bool):
+        return False
+    return isinstance(v, go[tname])
+
+
+def _to_text(v):
+    if v is None:
+        return ""
+    if v is True:
+        return "true"
+    if v is False:
+        return "false"
+    return str(v)
+
+
+# ---------------------------------------------------------------------------
+# Renderer
+# ---------------------------------------------------------------------------
+
+class _Scope(dict):
+    """go template variable scoping: := declares in the CURRENT block,
+    = assigns where the variable was declared; block-local declarations
+    end with the block."""
+
+    def __init__(self, parent=None):
+        super().__init__()
+        self.parent = parent
+
+    def lookup(self, name):
+        scope = self
+        while scope is not None:
+            if dict.__contains__(scope, name):
+                return True, dict.__getitem__(scope, name)
+            scope = scope.parent
+        return False, None
+
+    def declare(self, name, value):
+        dict.__setitem__(self, name, value)
+
+    def assign(self, name, value):
+        scope = self
+        while scope is not None:
+            if dict.__contains__(scope, name):
+                dict.__setitem__(scope, name, value)
+                return
+            scope = scope.parent
+        raise RenderError(f"helm-lite: assignment to undeclared {name}")
+
+
+class Renderer:
+    def __init__(self, defines):
+        self.defines = defines  # name -> node list
+
+    def render_define(self, name, dot):
+        if name not in self.defines:
+            raise RenderError(f"helm-lite: include of undefined template {name!r}")
+        return self.render_nodes(self.defines[name], dot, _Scope())
+
+    def render_nodes(self, nodes, dot, variables):
+        out = []
+        for node in nodes:
+            kind = node[0]
+            if kind == "text":
+                out.append(node[1])
+            elif kind == "expr":
+                out.append(self._exec_action(node[1], dot, variables))
+            elif kind == "if":
+                _, arms, else_body = node
+                for cond, body in arms:
+                    if _truthy(self._eval(cond, dot, variables)):
+                        out.append(self.render_nodes(body, dot, _Scope(variables)))
+                        break
+                else:
+                    if else_body is not None:
+                        out.append(
+                            self.render_nodes(else_body, dot, _Scope(variables))
+                        )
+            elif kind == "with":
+                _, expr, body, else_body = node
+                value = self._eval(expr, dot, variables)
+                if _truthy(value):
+                    out.append(self.render_nodes(body, value, _Scope(variables)))
+                elif else_body is not None:
+                    out.append(
+                        self.render_nodes(else_body, dot, _Scope(variables))
+                    )
+            elif kind == "range":
+                _, expr, body = node
+                value = self._eval(expr, dot, variables) or []
+                if isinstance(value, dict):
+                    # go templates iterate maps in sorted key order.
+                    items = [value[k] for k in sorted(value)]
+                else:
+                    items = value
+                for item in items:
+                    out.append(self.render_nodes(body, item, _Scope(variables)))
+            elif kind == "define":
+                self.defines[node[1]] = node[2]
+            else:  # pragma: no cover - parser produces only the above
+                raise RenderError(f"helm-lite: unknown node {kind}")
+        return "".join(out)
+
+    def _exec_action(self, body, dot, variables):
+        m = re.match(r"(\$[A-Za-z0-9_]*)\s*(:=|=)\s*(.*)", body, re.S)
+        if m:
+            var, op, expr = m.groups()
+            value = self._eval(expr, dot, variables)
+            if op == ":=":
+                variables.declare(var, value)
+            else:
+                variables.assign(var, value)
+            return ""
+        return _to_text(self._eval(body, dot, variables))
+
+    def _eval(self, expr, dot, variables):
+        return _Evaluator(self, dot, variables).pipeline(_tokenize_expr(expr))
+
+
+def _load_values(chart_dir, overrides=None):
+    with open(os.path.join(chart_dir, "values.yaml")) as f:
+        values = yaml.safe_load(f) or {}
+    for dotted, val in (overrides or {}).items():
+        node = values
+        parts = dotted.split(".")
+        for p in parts[:-1]:
+            node = node.setdefault(p, {})
+        node[parts[-1]] = val
+    return values
+
+
+def _deep_merge(base, over):
+    out = dict(base)
+    for k, v in over.items():
+        if isinstance(v, dict) and isinstance(out.get(k), dict):
+            out[k] = _deep_merge(out[k], v)
+        else:
+            out[k] = v
+    return out
+
+
+def render_chart(
+    chart_dir,
+    release_name="tfd",
+    namespace="node-feature-discovery",
+    values_overrides=None,
+    include_crds=True,
+):
+    """Render a chart directory (plus enabled subcharts in charts/) the
+    way `helm template --include-crds` would; returns parsed YAML docs."""
+    docs = []
+    values = _load_values(chart_dir, values_overrides)
+    docs += _render_one(chart_dir, values, release_name, namespace, include_crds)
+    charts_dir = os.path.join(chart_dir, "charts")
+    with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+        parent_meta = yaml.safe_load(f)
+    for dep in parent_meta.get("dependencies", []):
+        alias = dep.get("alias", dep["name"])
+        cond = dep.get("condition")
+        enabled = True
+        if cond:
+            node, resolved = values, True
+            for part in cond.split("."):
+                if isinstance(node, dict) and part in node:
+                    node = node[part]
+                else:
+                    resolved = False
+                    break
+            # helm: a condition path ABSENT from values enables the chart.
+            enabled = _truthy(node) if resolved else True
+        if not enabled:
+            continue
+        sub_dir = os.path.join(charts_dir, dep["name"])
+        sub_values = _deep_merge(
+            _load_values(sub_dir), values.get(alias, {}) or {}
+        )
+        docs += _render_one(sub_dir, sub_values, release_name, namespace, include_crds)
+    return [d for d in docs if d]
+
+
+def _render_one(chart_dir, values, release_name, namespace, include_crds):
+    with open(os.path.join(chart_dir, "Chart.yaml")) as f:
+        meta = yaml.safe_load(f)
+    chart_ctx = {
+        "Name": meta.get("name"),
+        "Version": str(meta.get("version", "")),
+        "AppVersion": str(meta.get("appVersion", "")),
+    }
+    release_ctx = {
+        "Name": release_name,
+        "Namespace": namespace,
+        "Service": "Helm",
+    }
+    dot = {"Values": values, "Chart": chart_ctx, "Release": release_ctx}
+
+    tpl_dir = os.path.join(chart_dir, "templates")
+    defines = {}
+    bodies = []
+    for fname in sorted(os.listdir(tpl_dir)):
+        if not fname.endswith((".yml", ".yaml", ".tpl")):
+            continue
+        with open(os.path.join(tpl_dir, fname)) as f:
+            bodies.append((fname, _parse(_lex(f.read()))))
+    renderer = Renderer(defines)
+    # First pass: collect defines from every file (helm parses all first).
+    for fname, nodes in bodies:
+        for node in nodes:
+            if node[0] == "define":
+                defines[node[1]] = node[2]
+    docs = []
+    for fname, nodes in bodies:
+        if fname.endswith(".tpl"):
+            continue
+        text = renderer.render_nodes(
+            [n for n in nodes if n[0] != "define"], dot, _Scope()
+        )
+        try:
+            docs += list(yaml.safe_load_all(text))
+        except yaml.YAMLError as e:
+            raise RenderError(
+                f"helm-lite: {fname} rendered to invalid YAML: {e}\n{text}"
+            ) from e
+    crds_dir = os.path.join(chart_dir, "crds")
+    if include_crds and os.path.isdir(crds_dir):
+        for fname in sorted(os.listdir(crds_dir)):
+            with open(os.path.join(crds_dir, fname)) as f:
+                docs += list(yaml.safe_load_all(f))
+    return docs

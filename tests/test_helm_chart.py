@@ -130,3 +130,44 @@ def test_helm_template_value_overrides_reach_env():
     env = {e["name"]: e["value"] for e in container["env"]}
     assert env["TFD_TPU_TOPOLOGY_STRATEGY"] == "single"
     assert env["TFD_WITH_BURNIN"] == "true"
+
+
+@needs_helm
+def test_helm_lite_matches_real_helm():
+    """helm-lite (tests/helm_lite.py) hermetically renders the chart on
+    helm-less boxes; where real helm exists the two renderers must agree
+    doc-for-doc (parsed YAML, order-insensitive) — this validates
+    helm-lite itself, keeping its hermetic contract checks trustworthy."""
+    import json
+
+    import yaml
+
+    from helm_lite import render_chart
+
+    out = helm(
+        "template", "tfd", CHART, "-n", "node-feature-discovery",
+        "--include-crds",
+    )
+    real = [d for d in yaml.safe_load_all(out) if d]
+    lite = render_chart(CHART)
+
+    assert len(real) == len(lite), (
+        f"doc count differs: helm={len(real)} helm-lite={len(lite)}"
+    )
+
+    def key(doc):
+        meta = doc.get("metadata", {})
+        return (
+            str(doc.get("kind")),
+            str(meta.get("namespace")),
+            str(meta.get("name")),
+        )
+
+    real_by_key = {key(d): d for d in real}
+    lite_by_key = {key(d): d for d in lite}
+    assert len(real_by_key) == len(real), "duplicate doc keys in helm render"
+    assert sorted(real_by_key) == sorted(lite_by_key)
+    for k in real_by_key:
+        assert json.dumps(real_by_key[k], sort_keys=True) == json.dumps(
+            lite_by_key[k], sort_keys=True
+        ), f"renderers disagree on {k}"

@@ -1,0 +1,198 @@
+"""Hermetic chart rendering via helm-lite (tests/helm_lite.py).
+
+This environment has no helm binary, so without this the templates are
+only ever text-checked and a go-template slip would surface first in CI.
+helm-lite renders the REAL chart (parent + vendored NFD subchart + crds)
+and the rendered docs run through the same tests/helm-contract.py checks
+the `helm template` pipeline uses. Where real helm exists,
+test_helm_chart.py::test_helm_lite_matches_real_helm diffs the two
+renderers' parsed outputs, validating helm-lite itself.
+"""
+
+import importlib.util
+import os
+
+import pytest
+
+from helm_lite import HelmFail, RenderError, render_chart
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPO = os.path.dirname(HERE)
+CHART = os.path.join(REPO, "deployments", "helm", "tpu-feature-discovery")
+
+
+def _contract():
+    spec = importlib.util.spec_from_file_location(
+        "helm_contract", os.path.join(HERE, "helm-contract.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_default_render_passes_the_full_contract():
+    docs = render_chart(CHART)
+    mod = _contract()
+    mod.check_tfd_daemonset(docs)
+    mod.check_nfd(docs, expected=True)
+
+
+def test_nfd_deploy_false_renders_tfd_only():
+    docs = render_chart(CHART, values_overrides={"nfd.deploy": False})
+    mod = _contract()
+    mod.check_tfd_daemonset(docs)
+    mod.check_nfd(docs, expected=False)
+
+
+def test_value_overrides_reach_env():
+    docs = render_chart(
+        CHART,
+        values_overrides={
+            "tpuTopologyStrategy": "single",
+            "withBurnin": True,
+        },
+    )
+    (ds,) = [
+        d
+        for d in docs
+        if d.get("kind") == "DaemonSet"
+        and "tpu-feature-discovery" in d["metadata"]["name"]
+    ]
+    env = {
+        e["name"]: e["value"]
+        for e in ds["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["TFD_TPU_TOPOLOGY_STRATEGY"] == "single"
+    assert env["TFD_WITH_BURNIN"] == "true"
+
+
+def test_extra_env_appends():
+    docs = render_chart(
+        CHART,
+        values_overrides={
+            "extraEnv": [{"name": "TFD_BACKEND", "value": "mock:v4-8"}]
+        },
+    )
+    (ds,) = [
+        d
+        for d in docs
+        if d.get("kind") == "DaemonSet"
+        and "tpu-feature-discovery" in d["metadata"]["name"]
+    ]
+    env = {
+        e["name"]: e["value"]
+        for e in ds["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["TFD_BACKEND"] == "mock:v4-8"
+    assert "TFD_TPU_TOPOLOGY_STRATEGY" in env
+
+
+def test_validation_rejects_default_namespace():
+    with pytest.raises(HelmFail, match="default"):
+        render_chart(CHART, namespace="default")
+    # And the documented bypass works.
+    docs = render_chart(
+        CHART,
+        namespace="default",
+        values_overrides={"allowDefaultNamespace": True},
+    )
+    assert docs
+
+
+def test_validation_rejects_explicit_namespace_value():
+    with pytest.raises(HelmFail, match="namespace"):
+        render_chart(CHART, values_overrides={"namespace": "mine"})
+
+
+def test_subchart_values_flow_through_the_alias():
+    docs = render_chart(CHART)
+    (master,) = [
+        d for d in docs if d.get("kind") == "Deployment"
+    ]
+    args = master["spec"]["template"]["spec"]["containers"][0]["args"]
+    assert "--extra-label-ns=google.com" in args
+    # master ServiceAccount name comes from the parent's override.
+    assert master["spec"]["template"]["spec"]["serviceAccountName"] == (
+        "node-feature-discovery"
+    )
+    (conf,) = [d for d in docs if d.get("kind") == "ConfigMap"]
+    assert "deviceClassWhitelist" in conf["data"]["nfd-worker.conf"]
+
+
+def test_unknown_construct_fails_loudly(tmp_path):
+    """The safety property: helm-lite must never silently mis-render a
+    construct it doesn't implement."""
+    chart = tmp_path / "c"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "Chart.yaml").write_text("name: c\nversion: 0.0.1\n")
+    (chart / "values.yaml").write_text("{}\n")
+    (chart / "templates" / "x.yml").write_text(
+        "a: {{ lookup \"v1\" \"Pod\" \"ns\" \"n\" }}\n"
+    )
+    with pytest.raises(RenderError, match="unsupported function"):
+        render_chart(str(chart))
+
+
+def _render_snippet(tmp_path, template, values="{}\n"):
+    chart = tmp_path / "c"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "Chart.yaml").write_text("name: c\nversion: 0.0.1\n")
+    (chart / "values.yaml").write_text(values)
+    (chart / "templates" / "x.yml").write_text(template)
+    return render_chart(str(chart))
+
+
+def test_block_scoped_variables(tmp_path):
+    """go template scoping: := inside a block ends with the block; = from
+    inside a block assigns where the variable was declared."""
+    (doc,) = _render_snippet(
+        tmp_path,
+        '{{- $x := "a" }}\n'
+        '{{- if true }}{{ $x := "b" }}{{ end }}\n'
+        '{{- if true }}{{ $x = "c" }}{{ end }}\n'
+        "v: {{ $x }}\n",
+    )
+    assert doc == {"v": "c"}
+
+
+def test_piped_nil_reaches_default(tmp_path):
+    (doc,) = _render_snippet(tmp_path, "v: {{ .Values.missing | default \"x\" }}\n")
+    assert doc == {"v": "x"}
+
+
+def test_printf_renders_go_bool_text(tmp_path):
+    (doc,) = _render_snippet(
+        tmp_path,
+        'v: {{ printf "%s" .Values.flag | quote }}\n',
+        values="flag: true\n",
+    )
+    assert doc == {"v": "true"}
+
+
+def test_range_over_map_is_key_sorted(tmp_path):
+    (doc,) = _render_snippet(
+        tmp_path,
+        "v:\n{{- range .Values.m }}\n  - {{ . }}\n{{- end }}\n",
+        values="m:\n  zz: 1\n  aa: 2\n",
+    )
+    assert doc == {"v": [2, 1]}  # sorted by key: aa then zz
+
+
+def test_absent_dependency_condition_enables_subchart(tmp_path):
+    """helm semantics: a condition path missing from values ENABLES the
+    dependency (conditions are opt-out)."""
+    chart = tmp_path / "c"
+    sub = chart / "charts" / "s"
+    (chart / "templates").mkdir(parents=True)
+    (sub / "templates").mkdir(parents=True)
+    (chart / "Chart.yaml").write_text(
+        "name: c\nversion: 0.0.1\n"
+        "dependencies:\n  - name: s\n    condition: s.enabled\n"
+    )
+    (chart / "values.yaml").write_text("{}\n")
+    (chart / "templates" / "x.yml").write_text("kind: Parent\n")
+    (sub / "Chart.yaml").write_text("name: s\nversion: 0.0.1\n")
+    (sub / "values.yaml").write_text("{}\n")
+    (sub / "templates" / "y.yml").write_text("kind: Child\n")
+    kinds = {d["kind"] for d in render_chart(str(chart))}
+    assert kinds == {"Parent", "Child"}
