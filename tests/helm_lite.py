@@ -226,6 +226,12 @@ class _Evaluator:
         head = tokens[0]
         if head[0] == "ident":
             args = operands[1:]
+            for a in args:
+                if isinstance(a, tuple) and len(a) == 2 and a[0] == "__fn__":
+                    raise RenderError(
+                        f"helm-lite: bare identifier {a[1]!r} in argument "
+                        "position (quote string literals)"
+                    )
             if piped is not _NO_PIPE:
                 args = args + [piped]  # pipe feeds the LAST argument
             return self._call(head[1], args)
@@ -319,17 +325,19 @@ def _indent(s, n):
 
 
 def _type_is(tname, v):
-    go = {
-        "bool": bool,
-        "string": str,
-        "int": int,
-        "float64": float,
-    }
-    if tname not in go:
+    if tname not in ("bool", "string", "int", "float64"):
         raise RenderError(f"helm-lite: typeIs {tname!r} unsupported")
-    if tname == "int" and isinstance(v, bool):
+    if tname == "bool":
+        return isinstance(v, bool)
+    if tname == "string":
+        return isinstance(v, str)
+    # helm parses values-file numbers as float64 (go YAML), so
+    # typeIs "int" is NEVER true for a values number — mirroring that
+    # keeps hermetic renders honest. (--set's int64 coercion is not
+    # modeled; pass strings the way the values files do.)
+    if tname == "int":
         return False
-    return isinstance(v, go[tname])
+    return isinstance(v, (int, float)) and not isinstance(v, bool)
 
 
 def _to_text(v):

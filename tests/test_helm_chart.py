@@ -133,7 +133,31 @@ def test_helm_template_value_overrides_reach_env():
 
 
 @needs_helm
-def test_helm_lite_matches_real_helm():
+@pytest.mark.parametrize(
+    "set_args,overrides",
+    [
+        # Default render plus the override paths the hermetic tests lean
+        # on — divergences that only appear under non-default values must
+        # fail THIS gate, not surface as false hermetic confidence.
+        ([], {}),
+        (["--set", "nfd.deploy=false"], {"nfd.deploy": False}),
+        (
+            [
+                "--set", "tpuTopologyStrategy=single",
+                "--set", "withBurnin=true",
+                "--set-string", "extraEnv[0].name=TFD_BACKEND",
+                "--set-string", "extraEnv[0].value=mock:v4-8",
+            ],
+            {
+                "tpuTopologyStrategy": "single",
+                "withBurnin": True,
+                "extraEnv": [{"name": "TFD_BACKEND", "value": "mock:v4-8"}],
+            },
+        ),
+    ],
+    ids=["defaults", "no-nfd", "typed-overrides"],
+)
+def test_helm_lite_matches_real_helm(set_args, overrides):
     """helm-lite (tests/helm_lite.py) hermetically renders the chart on
     helm-less boxes; where real helm exists the two renderers must agree
     doc-for-doc (parsed YAML, order-insensitive) — this validates
@@ -146,10 +170,10 @@ def test_helm_lite_matches_real_helm():
 
     out = helm(
         "template", "tfd", CHART, "-n", "node-feature-discovery",
-        "--include-crds",
+        "--include-crds", *set_args,
     )
     real = [d for d in yaml.safe_load_all(out) if d]
-    lite = render_chart(CHART)
+    lite = render_chart(CHART, values_overrides=overrides)
 
     assert len(real) == len(lite), (
         f"doc count differs: helm={len(real)} helm-lite={len(lite)}"

@@ -9,25 +9,16 @@ test_helm_chart.py::test_helm_lite_matches_real_helm diffs the two
 renderers' parsed outputs, validating helm-lite itself.
 """
 
-import importlib.util
 import os
 
 import pytest
 
 from helm_lite import HelmFail, RenderError, render_chart
+from test_helm_chart import _contract  # one loader, shared
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
 CHART = os.path.join(REPO, "deployments", "helm", "tpu-feature-discovery")
-
-
-def _contract():
-    spec = importlib.util.spec_from_file_location(
-        "helm_contract", os.path.join(HERE, "helm-contract.py")
-    )
-    mod = importlib.util.module_from_spec(spec)
-    spec.loader.exec_module(mod)
-    return mod
 
 
 def test_default_render_passes_the_full_contract():
@@ -196,3 +187,24 @@ def test_absent_dependency_condition_enables_subchart(tmp_path):
     (sub / "templates" / "y.yml").write_text("kind: Child\n")
     kinds = {d["kind"] for d in render_chart(str(chart))}
     assert kinds == {"Parent", "Child"}
+
+
+def test_values_file_number_is_not_int(tmp_path):
+    """helm parses values-file numbers as float64, so the daemonset's
+    `typeIs "int" .Values.sleepInterval` arm never fires for a YAML
+    number — helm-lite must agree or hermetic renders overstate the env."""
+    docs = render_chart(CHART, values_overrides={"sleepInterval": 60})
+    (ds,) = [
+        d for d in docs
+        if d.get("kind") == "DaemonSet"
+        and "tpu-feature-discovery" in d["metadata"]["name"]
+    ]
+    env = {
+        e["name"] for e in ds["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert "TFD_SLEEP_INTERVAL" not in env
+
+
+def test_bare_identifier_argument_fails_loudly(tmp_path):
+    with pytest.raises(RenderError, match="bare identifier"):
+        _render_snippet(tmp_path, "v: {{ eq .Values.x foo }}\n")
