@@ -11,10 +11,20 @@ jax-first: static shapes, lax.scan depth loops, bf16 matmuls sized for the
 slice-wide connectivity sweeps.
 """
 
-from gpu_feature_discovery_tpu.ops.healthcheck import (  # noqa: F401
+from gpu_feature_discovery_tpu.ops.healthcheck import (
     burnin_flops,
     ici_ring_sweep,
     make_burnin_step,
     make_slice_train_step,
     measure_chip_health,
+    measure_node_health,
 )
+
+__all__ = [
+    "burnin_flops",
+    "ici_ring_sweep",
+    "make_burnin_step",
+    "make_slice_train_step",
+    "measure_chip_health",
+    "measure_node_health",
+]
