@@ -26,10 +26,21 @@ import pytest
 
 @pytest.fixture(autouse=True)
 def _fresh_schedule():
-    """The burn-in schedule is process-global; isolate each test."""
+    """The burn-in schedule is process-global; isolate each test. The
+    first-probe join budget is pinned high so these tests stay
+    effectively synchronous on any machine speed (the real CPU probe in
+    test_enabled_emits_health_labels must never race the budget); the
+    async behavior itself is tested with an explicit tiny budget below."""
     health_mod.reset_burnin_schedule()
+    # reset_burnin_schedule deliberately leaves an in-flight first probe
+    # adoptable (the SIGHUP contract); tests need hard isolation.
+    health_mod._first_probe_inflight = None
+    original_wait = health_mod.FIRST_PROBE_WAIT_S
+    health_mod.FIRST_PROBE_WAIT_S = 300.0
     yield
+    health_mod.FIRST_PROBE_WAIT_S = original_wait
     health_mod.reset_burnin_schedule()
+    health_mod._first_probe_inflight = None
 
 
 def cfg(**cli):
@@ -231,3 +242,177 @@ def test_burnin_interval_config_validation():
         cfg(**{"burnin-interval": "abc"})
     assert cfg(**{"burnin-interval": "7"}).flags.tfd.burnin_interval == 7
     assert cfg().flags.tfd.burnin_interval == 10  # default
+
+
+def test_first_probe_runs_async_when_compile_is_slow(monkeypatch):
+    """The first probe pays XLA compile (tens of seconds on chips); base
+    labels must not wait on it. With a slow measure and a tiny join
+    budget, the first cycles publish nothing and the probe's result is
+    consumed once ready — with ITS duration as probe-ms."""
+    import threading
+    import time as _time
+
+    import gpu_feature_discovery_tpu.ops.healthcheck as hc
+
+    _pretend_devices_are_tpus(monkeypatch)
+    release = threading.Event()
+
+    def slow_measure(**kw):
+        assert release.wait(timeout=30), "test never released the probe"
+        return {"healthy": True, "tflops": 42.0, "hbm_gbps": 123.0, "ici_ok": None}
+
+    monkeypatch.setattr(hc, "measure_node_health", slow_measure)
+    monkeypatch.setattr(health_mod, "FIRST_PROBE_WAIT_S", 0.05)
+    manager = MockManager(chips=[MockChip()])
+    config = cfg(**{"with-burnin": "true", "burnin-interval": "5"})
+
+    # Probe still "compiling": no health labels, cycle after cycle.
+    assert new_health_labeler(manager, config).labels() == {}
+    assert new_health_labeler(manager, config).labels() == {}
+
+    release.set()
+    deadline = _time.monotonic() + 10
+    labels = {}
+    while _time.monotonic() < deadline and not labels:
+        labels = dict(new_health_labeler(manager, config).labels())
+        _time.sleep(0.01)
+    assert labels[HEALTH_OK] == "true"
+    assert labels[HEALTH_TFLOPS] == "42"
+    assert "google.com/tpu.health.probe-ms" in labels
+    # Steady state afterwards: cached republish, no extra probes pending.
+    cached = new_health_labeler(manager, config).labels()
+    assert cached[HEALTH_OK] == "true"
+
+
+def test_oneshot_first_probe_is_synchronous(monkeypatch):
+    """Oneshot has no later cycle to collect an async result: even with a
+    zero join budget it must wait for the probe and publish health."""
+    calls = _counting_measure(monkeypatch)
+    _pretend_devices_are_tpus(monkeypatch)
+    monkeypatch.setattr(health_mod, "FIRST_PROBE_WAIT_S", 0.0)
+    manager = MockManager(chips=[MockChip()])
+    config = cfg(**{"with-burnin": "true", "oneshot": "true"})
+    labels = new_health_labeler(manager, config).labels()
+    assert labels[HEALTH_OK] == "true"
+    assert calls["n"] == 1
+
+
+def test_async_first_probe_failure_keeps_failure_semantics(monkeypatch):
+    """A failure delivered through the async path follows the same
+    1st-uncached / 2nd-cached contract as the synchronous one."""
+    import gpu_feature_discovery_tpu.ops.healthcheck as hc
+
+    _pretend_devices_are_tpus(monkeypatch)
+    monkeypatch.setattr(
+        hc,
+        "measure_node_health",
+        lambda **kw: (_ for _ in ()).throw(RuntimeError("boom")),
+    )
+    manager = MockManager(chips=[MockChip()])
+    config = cfg(**{"with-burnin": "true", "burnin-interval": "5"})
+    # First failure arrives via the thread (generous budget): uncached.
+    assert new_health_labeler(manager, config).labels() == {HEALTH_OK: "false"}
+    sched = health_mod._schedule_for(manager)
+    assert sched.cached is None
+    # Second failure goes the synchronous re-probe path: cached.
+    assert new_health_labeler(manager, config).labels() == {HEALTH_OK: "false"}
+    assert sched.cached == {HEALTH_OK: "false"}
+
+
+def test_pending_probe_abandoned_across_unacquirable_gap(monkeypatch):
+    """A first probe in flight when the chip stops being acquirable must
+    be discarded: mid-gap it errors because the chip was TAKEN (busy, not
+    failed) or reports pre-gap health. After reacquisition, no second
+    probe starts while the orphan holds the chips; once it dies, a FRESH
+    probe runs and publishes."""
+    import threading
+
+    import gpu_feature_discovery_tpu.ops.healthcheck as hc
+
+    _pretend_devices_are_tpus(monkeypatch)
+    release = threading.Event()
+    calls = {"n": 0}
+
+    def measure(**kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            assert release.wait(timeout=30)
+            raise RuntimeError("chip seized by workload mid-probe")
+        return {"healthy": True, "tflops": 10.0, "hbm_gbps": None, "ici_ok": None}
+
+    monkeypatch.setattr(hc, "measure_node_health", measure)
+    monkeypatch.setattr(health_mod, "FIRST_PROBE_WAIT_S", 0.05)
+    manager = MockManager(chips=[MockChip()])
+    config = cfg(**{"with-burnin": "true", "burnin-interval": "5"})
+
+    assert new_health_labeler(manager, config).labels() == {}  # spawns
+    orphan = health_mod._first_probe_inflight
+    assert orphan is not None
+
+    acquired = {"ok": False}
+    monkeypatch.setattr(
+        health_mod,
+        "_acquire_tpu_devices",
+        lambda: jax.local_devices() if acquired["ok"] else None,
+    )
+    assert new_health_labeler(manager, config).labels() == {}  # gap
+    assert orphan.abandoned
+
+    acquired["ok"] = True
+    # Orphan still alive: no second seizure, no labels.
+    assert new_health_labeler(manager, config).labels() == {}
+    assert calls["n"] == 1
+
+    release.set()
+    orphan.join(timeout=10)
+    import time as _time
+
+    deadline = _time.monotonic() + 10
+    labels = {}
+    while _time.monotonic() < deadline and not labels:
+        labels = dict(new_health_labeler(manager, config).labels())
+        _time.sleep(0.01)
+    # The published result is the FRESH probe's, never the orphan's error.
+    assert labels[HEALTH_OK] == "true"
+    assert calls["n"] == 2
+
+
+def test_sighup_adopts_inflight_first_probe(monkeypatch):
+    """A reload mid-compile must not start a second probe: the new
+    epoch's schedule adopts the running one and consumes its result."""
+    import threading
+
+    import gpu_feature_discovery_tpu.ops.healthcheck as hc
+
+    _pretend_devices_are_tpus(monkeypatch)
+    release = threading.Event()
+    calls = {"n": 0}
+
+    def measure(**kw):
+        calls["n"] += 1
+        assert release.wait(timeout=30)
+        return {"healthy": True, "tflops": 10.0, "hbm_gbps": None, "ici_ok": None}
+
+    monkeypatch.setattr(hc, "measure_node_health", measure)
+    monkeypatch.setattr(health_mod, "FIRST_PROBE_WAIT_S", 0.05)
+    config = cfg(**{"with-burnin": "true", "burnin-interval": "5"})
+
+    old_manager = MockManager(chips=[MockChip()])
+    assert new_health_labeler(old_manager, config).labels() == {}
+
+    # SIGHUP: schedules reset, a NEW manager is built (cmd/main.py).
+    health_mod.reset_burnin_schedule()
+    new_manager = MockManager(chips=[MockChip()])
+    assert new_health_labeler(new_manager, config).labels() == {}
+    assert calls["n"] == 1  # adopted, not respawned
+
+    release.set()
+    import time as _time
+
+    deadline = _time.monotonic() + 10
+    labels = {}
+    while _time.monotonic() < deadline and not labels:
+        labels = dict(new_health_labeler(new_manager, config).labels())
+        _time.sleep(0.01)
+    assert labels[HEALTH_OK] == "true"
+    assert calls["n"] == 1
