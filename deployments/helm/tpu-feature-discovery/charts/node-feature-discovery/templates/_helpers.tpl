@@ -17,6 +17,10 @@
 {{- default (include "nfd.fullname" .) .Values.master.serviceAccount.name }}
 {{- end }}
 
+{{- define "nfd.gcServiceAccountName" -}}
+{{- default (printf "%s-gc" (include "nfd.fullname" .)) .Values.gc.serviceAccount.name }}
+{{- end }}
+
 {{- define "nfd.image" -}}
 {{- printf "%s:%s" .Values.image.repository (default .Chart.AppVersion .Values.image.tag) -}}
 {{- end }}

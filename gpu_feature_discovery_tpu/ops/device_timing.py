@@ -38,8 +38,9 @@ transient — see the return contract.
 Return contract: ``(result, durations)`` where ``durations`` is
 - a populated dict when device-plane events were captured,
 - ``{}`` when the trace ran but exported no ``/device:`` events (a
-  platform that has no device plane, e.g. CPU test meshes — PERMANENT for
-  the process, callers may stop trying),
+  platform with no device plane, e.g. CPU test meshes — or a glitch that
+  dropped every event; callers retry a bounded number of times before
+  treating it as permanent),
 - ``None`` when the trace never ran (``start_trace``/``stop_trace``
   raised: profiler busy with another in-process session, transient export
   glitch — TRANSIENT, callers should retry later rather than downgrade
@@ -204,8 +205,8 @@ def profile_device_durations(
     retry, and ``work`` was NOT run (its result would be discarded, so
     running it would seize the chips for nothing); ``(result, None)``
     when the trace started but stopping/parsing failed — also transient;
-    ``(result, {})`` when it ran but the platform exported no device
-    plane — permanent for this process. See the module return contract.
+    ``(result, {})`` when it ran but exported no device-plane events.
+    See the module return contract.
     """
     import jax
 

@@ -46,16 +46,17 @@ log = logging.getLogger("tfd.ops")
 # (device_timing.parse_trace_durations matches on it).
 BURNIN_KERNEL_NAME = "burnin_step"
 
-# Device-clock availability state. A platform that traced successfully but
-# exported no /device: plane never will (CPU meshes) — that memoizes
-# immediately. A trace that failed to run, or exported an incomplete
-# plane, may be a transient glitch (profiler busy with another in-process
-# session, one-off export race): those only memoize after
-# _TRACED_FAILURE_LIMIT consecutive failures, so a single hiccup does not
-# downgrade the node to wall-clock — and lose its rate labels — for the
-# whole process lifetime (ADVICE r4 #1). The cap still bounds the waste:
-# each failed traced attempt's work is discarded, so retrying forever
-# would keep double-probing the chips.
+# Device-clock availability state. Any traced-probe failure — trace did
+# not run, incomplete export, or an export with no /device: plane at all
+# — only memoizes unavailability after _TRACED_FAILURE_LIMIT consecutive
+# failures, so a single hiccup (profiler busy with another in-process
+# session, one-off export race) does not downgrade the node to
+# wall-clock — and lose its rate labels — for the whole process lifetime
+# (ADVICE r4 #1). Platforms that genuinely export no device plane (CPU
+# meshes) never reach this path (the on_tpu gate) or burn the same
+# bounded number of attempts. The cap still bounds the waste: each failed
+# traced attempt's work is discarded, so retrying forever would keep
+# double-probing the chips.
 _TRACED_FAILURE_LIMIT = 3
 _device_clock_unavailable = False
 _traced_probe_failures = 0
@@ -253,9 +254,10 @@ def _measure_node_health_traced(
 
     Rates are median-of-iters per chip, worst chip published. Returns
     ``(report, None)`` on success, else ``(None, reason)`` with reason
-    ``"no-device-plane"`` (platform never exports one — permanent) or
-    ``"transient"`` (trace didn't run / partial export — retry later);
-    the caller maps reasons onto the memoization policy (ADVICE r4 #1).
+    ``"no-device-plane"`` (export carried no device events at all) or
+    ``"transient"`` (trace didn't run / partial export); the caller
+    retries either a bounded number of consecutive times before
+    memoizing wall-clock fallback for the process (ADVICE r4 #1).
     """
     import numpy as np
 
@@ -443,16 +445,19 @@ def measure_node_health(
             devices, size=size, depth=depth, iters=iters
         )
         if report is None:
-            # Memoization policy (ADVICE r4 #1): a platform that traced
-            # but exported no device plane never will — stop immediately.
-            # A transient failure (profiler busy, partial export) retries,
-            # but only _TRACED_FAILURE_LIMIT times consecutively: each
-            # failed traced attempt's work is discarded, so unbounded
-            # retries would seize the chips twice per probing cycle.
+            # Memoization policy (ADVICE r4 #1): every traced failure —
+            # profiler busy, partial export, even a whole export with no
+            # device plane — gets _TRACED_FAILURE_LIMIT consecutive
+            # retries before the process downgrades to wall-clock for
+            # good. A single glitch that dropped ALL device events is
+            # indistinguishable from a platform that exports none, and
+            # the one-off must not cost the device clock forever; a
+            # genuinely plane-less platform just burns the same bounded
+            # number of attempts before memoizing. The cap matters
+            # because each failed traced attempt's work is discarded, so
+            # unbounded retries would seize the chips twice per cycle.
             _traced_probe_failures += 1
-            if fail == "no-device-plane" or (
-                _traced_probe_failures >= _TRACED_FAILURE_LIMIT
-            ):
+            if _traced_probe_failures >= _TRACED_FAILURE_LIMIT:
                 _device_clock_unavailable = True
                 log.debug(
                     "no device-plane trace available (%s, attempt %d); "

@@ -161,6 +161,52 @@ def check_nfd(docs, expected):
         "NodeFeature CRD missing from the render (forgot --include-crds, "
         "or the chart dropped crds/)"
     )
+    # Having chosen the CRD-only API, the stack owns its lifecycle:
+    # NodeFeature objects orphan when nodes are deleted, so the chart must
+    # deploy the collector (VERDICT r4 missing #2) with delete permission.
+    gcs = find(docs, "Deployment", "-gc")
+    assert len(gcs) == 1, f"expected 1 nfd-gc Deployment, got {len(gcs)}"
+    gspec = gcs[0]["spec"]["template"]["spec"]
+    (gctr,) = gspec["containers"]
+    assert gctr.get("command") == ["nfd-gc"], (
+        f"gc Deployment runs {gctr.get('command')}, not nfd-gc"
+    )
+    assert any(
+        a.startswith("-gc-interval=") for a in gctr.get("args", [])
+    ), "nfd-gc has no -gc-interval arg"
+    assert gspec.get("serviceAccountName"), (
+        "nfd-gc runs without a ServiceAccount: it cannot delete "
+        "NodeFeatures"
+    )
+    gc_rules = [
+        rule
+        for role in find(docs, "ClusterRole", "-gc")
+        for rule in role.get("rules", [])
+    ]
+    assert any(
+        "nodefeatures" in rule.get("resources", [])
+        and {"list", "delete"} <= set(rule.get("verbs", []))
+        for rule in gc_rules
+    ), "no ClusterRole grants the gc list+delete on nodefeatures"
+    assert any(
+        "nodes" in rule.get("resources", [])
+        and {"get", "list", "watch"} <= set(rule.get("verbs", []))
+        for rule in gc_rules
+    ), "no ClusterRole lets the gc watch nodes (its liveness source)"
+    # The gc's binding must point at the ServiceAccount the pod runs as —
+    # a rename in one place but not the other passes rendering and fails
+    # only at runtime with Forbidden.
+    gc_bindings = [
+        b
+        for b in docs
+        if b.get("kind") == "ClusterRoleBinding"
+        and b.get("roleRef", {}).get("name", "").endswith("-gc")
+    ]
+    assert any(
+        s.get("name") == gspec["serviceAccountName"]
+        for b in gc_bindings
+        for s in b.get("subjects", [])
+    ), "no ClusterRoleBinding grants the gc ServiceAccount its role"
 
 
 def main():

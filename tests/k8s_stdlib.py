@@ -163,6 +163,20 @@ class KubeClient:
                 f"POST {path}: HTTP {e.code}: {e.read()[:500]}"
             ) from e
 
+    def delete(self, path, tolerate_missing=True):
+        """Delete; a 404 NotFound is tolerated by default (delete is
+        idempotent from the caller's view — gone is gone, whoever got
+        there first)."""
+        try:
+            with self._request("DELETE", path) as resp:
+                return json.load(resp)
+        except urllib.error.HTTPError as e:
+            if tolerate_missing and e.code == 404:
+                return None
+            raise KubeError(
+                f"DELETE {path}: HTTP {e.code}: {e.read()[:500]}"
+            ) from e
+
     def watch(self, path, timeout_s):
         """Server-side-bounded watch: yields decoded events until the API
         server closes the stream at timeoutSeconds (the same clean-expiry
