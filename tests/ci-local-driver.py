@@ -49,19 +49,21 @@ def _importable(mod):
 
 
 TOOL_REQUIREMENTS = [
-    # Self-guarded target: helm-check falls back to the hermetic helm-lite
-    # renderer, which runs the SAME contract checks — executing it without
-    # helm is real evidence. Probe None = runnable, stop scanning. (make
-    # typecheck is also self-guarded but its fallback proves nothing, so
-    # it stays SKIPped below when mypy is absent.)
+    # Self-guarded targets (probe None = runnable, stop scanning):
+    # helm-check falls back to the hermetic helm-lite renderer running
+    # the SAME contract checks; lint/typecheck run the stdlib analyzer
+    # (tests/staticcheck.py — undefined names, unused locals, seam
+    # signatures) whether or not ruff/mypy exist, so executing them
+    # without those tools is real evidence, no longer a SKIP.
     (r"make helm-check", None, None),
+    (r"make lint|make typecheck", None, None),
     (r"\bpip install\b", lambda: False, "network install (zero-egress env)"),
     (r"\bdocker\b", _have("docker"), "docker unavailable"),
     (r"\bkind\b", _have("kind"), "kind unavailable"),
     (r"\bhelm\b", _have("helm"), "helm unavailable"),
     (r"\bkubectl\b", _have("kubectl"), "kubectl unavailable"),
     (r"\bruff\b", _have("ruff"), "ruff unavailable"),
-    (r"\bmypy\b|make typecheck", _have("mypy"), "mypy unavailable"),
+    (r"\bmypy\b", _have("mypy"), "mypy unavailable"),
     (r"make coverage", _importable("pytest_cov"), "pytest-cov unavailable"),
     # Steps that talk to the kind cluster or the built image: their tool
     # is python, but their PREREQUISITE (cluster/image from an earlier

@@ -99,3 +99,212 @@ def test_no_unused_imports():
     assert not offenders, (
         "unused imports (would fail CI's ruff F401):\n" + "\n".join(offenders)
     )
+
+
+# ---------------------------------------------------------------------------
+# staticcheck.py: the real analysis `make lint`/`make typecheck` run in
+# ruff/mypy-less environments (VERDICT r4 next-round #4). Two layers:
+# the repo must be clean, and each check must PROVE it detects its
+# defect class (a checker that never fires is indistinguishable from a
+# checker that works on a clean tree).
+# ---------------------------------------------------------------------------
+
+import staticcheck
+
+
+def _repo_files():
+    # The exact file set `make lint` checks — one source of truth, so the
+    # unit tier and the CLI can never diverge in coverage.
+    return sorted(
+        p
+        for p in staticcheck._python_files(staticcheck.DEFAULT_TARGETS)
+        if "__pycache__" not in p
+    )
+
+
+def test_no_undefined_names():
+    offenders = []
+    for path in _repo_files():
+        for lineno, msg in staticcheck.check_undefined_names(path):
+            offenders.append(f"{os.path.relpath(path, REPO)}:{lineno}: {msg}")
+    assert not offenders, (
+        "undefined names (would NameError at runtime):\n" + "\n".join(offenders)
+    )
+
+
+def test_no_unused_locals():
+    offenders = []
+    for path in _repo_files():
+        for lineno, msg in staticcheck.check_unused_locals(path):
+            offenders.append(f"{os.path.relpath(path, REPO)}:{lineno}: {msg}")
+    assert not offenders, (
+        "unused local variables:\n" + "\n".join(offenders)
+    )
+
+
+def test_seam_signatures_consistent():
+    findings = staticcheck.check_seam_signatures()
+    assert not findings, (
+        "resource/types.py seam signature drift:\n"
+        + "\n".join(f"{p}:{ln}: {m}" for p, ln, m in findings)
+    )
+
+
+def test_undefined_name_checker_detects():
+    found = staticcheck.check_undefined_names(
+        "<fixture>",
+        "def f():\n    return missing_name\n",
+    )
+    assert any("missing_name" in m for _, m in found)
+
+
+def test_undefined_name_checker_honors_scoping():
+    """The hard cases that make a naive checker unusable: class-scope
+    skip, comprehension scoping, walrus hoisting, nested closures."""
+    clean = """
+import os
+CONST = 1
+def outer():
+    x = CONST
+    def inner():
+        return x + os.sep.count("")
+    return inner()
+class C:
+    attr = CONST
+    def m(self):
+        return C.attr
+def comp():
+    return {k: v for k, v in zip("ab", range(2))}
+def walrus():
+    lst = [y := n for n in range(3)]
+    return y, lst
+"""
+    assert staticcheck.check_undefined_names("<fixture>", clean) == []
+    class_scope_leak = """
+class C:
+    attr = 1
+    def m(self):
+        return attr
+"""
+    found = staticcheck.check_undefined_names("<fixture>", class_scope_leak)
+    assert any("attr" in m for _, m in found), (
+        "class-scope names must be invisible to methods"
+    )
+
+
+def test_unused_local_checker_detects():
+    found = staticcheck.check_unused_locals(
+        "<fixture>",
+        "def f():\n    dead = compute()\n    return 1\ndef compute():\n    return 2\n",
+    )
+    assert any("'dead'" in m for _, m in found)
+
+
+def _seam_fixture(tmp_path, impl_src):
+    pkg = tmp_path / "pkg"
+    (pkg / "resource").mkdir(parents=True)
+    (pkg / "resource" / "types.py").write_text(
+        "from abc import ABC, abstractmethod\n"
+        "class Manager(ABC):\n"
+        "    @abstractmethod\n"
+        "    def init(self) -> None: ...\n"
+        "    @abstractmethod\n"
+        "    def get_chips(self, refresh): ...\n"
+    )
+    (pkg / "resource" / "impl.py").write_text(impl_src)
+    return str(pkg)
+
+
+def test_seam_checker_detects_missing_method(tmp_path):
+    pkg = _seam_fixture(
+        tmp_path,
+        "from .types import Manager\n"
+        "class M(Manager):\n"
+        "    def init(self):\n"
+        "        pass\n",
+    )
+    findings = staticcheck.check_seam_signatures(pkg)
+    assert any("defines no get_chips" in m for _, _, m in findings)
+
+
+def test_seam_checker_detects_signature_drift(tmp_path):
+    pkg = _seam_fixture(
+        tmp_path,
+        "from .types import Manager\n"
+        "class M(Manager):\n"
+        "    def init(self):\n"
+        "        pass\n"
+        "    def get_chips(self, reload):\n"  # param renamed
+        "        return []\n",
+    )
+    findings = staticcheck.check_seam_signatures(pkg)
+    assert any("get_chips" in m and "reload" in m for _, _, m in findings)
+
+
+def test_seam_checker_allows_extra_defaulted_params(tmp_path):
+    pkg = _seam_fixture(
+        tmp_path,
+        "from .types import Manager\n"
+        "class M(Manager):\n"
+        "    def init(self, eager=True):\n"
+        "        pass\n"
+        "    def get_chips(self, refresh, deep=False):\n"
+        "        return []\n",
+    )
+    assert staticcheck.check_seam_signatures(pkg) == []
+
+
+def test_seam_checker_resolves_inherited_implementations(tmp_path):
+    pkg = _seam_fixture(
+        tmp_path,
+        "from .types import Manager\n"
+        "class Base(Manager):\n"
+        "    def init(self):\n"
+        "        pass\n"
+        "    def get_chips(self, refresh):\n"
+        "        return []\n"
+        "class Child(Base):\n"
+        "    pass\n",
+    )
+    assert staticcheck.check_seam_signatures(pkg) == []
+
+
+def test_undefined_name_checker_handles_global_lazy_init():
+    """`global G` in one function creates the module name other functions
+    read — the lazy-init pattern must not false-positive."""
+    src = "def f():\n    global G\n    G = 1\ndef g():\n    return G\n"
+    assert staticcheck.check_undefined_names("<fixture>", src) == []
+
+
+def test_undefined_name_checker_handles_pep695_type_alias():
+    src = "type Pair = tuple[int, int]\ndef f(p: Pair) -> Pair:\n    return p\n"
+    assert staticcheck.check_undefined_names("<fixture>", src) == []
+
+
+def test_seam_checker_detects_added_required_kwonly(tmp_path):
+    """An implementation adding a required keyword-only param passes a
+    positional-only comparison but TypeErrors every ABC-shaped call."""
+    pkg = _seam_fixture(
+        tmp_path,
+        "from .types import Manager\n"
+        "class M(Manager):\n"
+        "    def init(self):\n"
+        "        pass\n"
+        "    def get_chips(self, refresh, *, deep):\n"
+        "        return []\n",
+    )
+    findings = staticcheck.check_seam_signatures(pkg)
+    assert any("keyword-only" in m and "deep" in m for _, _, m in findings)
+
+
+def test_seam_checker_allows_defaulted_kwonly(tmp_path):
+    pkg = _seam_fixture(
+        tmp_path,
+        "from .types import Manager\n"
+        "class M(Manager):\n"
+        "    def init(self):\n"
+        "        pass\n"
+        "    def get_chips(self, refresh, *, deep=False):\n"
+        "        return []\n",
+    )
+    assert staticcheck.check_seam_signatures(pkg) == []
