@@ -420,14 +420,16 @@ def check_seam_signatures(package_dir=None):
         if abstract:
             abcs[cls.name] = abstract
 
-    # Registry of every class in the package, keyed by name.
-    registry = {}  # class name -> (path, ClassDef)
+    # Registry of every class in the package, keyed by name — a name may
+    # map to SEVERAL classes (a backend variant and a test double sharing
+    # a name): every candidate is checked, none silently skipped.
+    registry = {}  # class name -> [(path, ClassDef), ...]
     for path in sorted(
         glob.glob(os.path.join(package_dir, "**", "*.py"), recursive=True)
     ):
         tree = ast.parse(open(path).read())
         for name, cls in _classes(tree).items():
-            registry.setdefault(name, (path, cls))
+            registry.setdefault(name, []).append((path, cls))
 
     def base_names(cls):
         out = []
@@ -438,13 +440,12 @@ def check_seam_signatures(package_dir=None):
                 out.append(b.attr)
         return out
 
-    def find_method(cls_name, method, seen=()):
+    def find_method(cls, method, seen=()):
         """CONCRETE def node for method on cls or its repo-defined bases
         (MRO-ish depth-first, left to right). Abstract stubs are not
-        implementations — inheriting one leaves the class abstract."""
-        if cls_name not in registry or cls_name in seen:
-            return None
-        _, cls = registry[cls_name]
+        implementations — inheriting one leaves the class abstract. Base
+        names resolving to several classes accept any candidate that
+        provides the method (conservative: ambiguity never flags)."""
         for n in cls.body:
             if (
                 isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
@@ -453,61 +454,73 @@ def check_seam_signatures(package_dir=None):
             ):
                 return n
         for base in base_names(cls):
-            found = find_method(base, method, (*seen, cls_name))
-            if found is not None:
-                return found
+            if base in seen:
+                continue
+            for _, base_cls in registry.get(base, []):
+                found = find_method(base_cls, method, (*seen, base))
+                if found is not None:
+                    return found
         return None
 
-    def inherits_abc(cls_name, abc_name, seen=()):
-        if cls_name == abc_name:
-            return True
-        if cls_name not in registry or cls_name in seen:
-            return False
-        _, cls = registry[cls_name]
-        return any(
-            inherits_abc(b, abc_name, (*seen, cls_name)) for b in base_names(cls)
-        )
+    def inherits_abc(cls, abc_name, seen=()):
+        for base in base_names(cls):
+            if base == abc_name:
+                return True
+            if base in seen:
+                continue
+            if any(
+                inherits_abc(base_cls, abc_name, (*seen, base))
+                for _, base_cls in registry.get(base, [])
+            ):
+                return True
+        return False
 
     findings = []
-    for cls_name, (path, cls) in sorted(registry.items()):
-        # A class declaring abstract methods of its own is an ABC, not an
-        # implementation — only concrete classes owe the full surface.
-        if any(
-            isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)) and _is_abstract(n)
-            for n in cls.body
-        ):
-            continue
-        for abc_name, methods in abcs.items():
-            if cls_name == abc_name or not inherits_abc(cls_name, abc_name):
+    for cls_name, candidates in sorted(registry.items()):
+        for path, cls in candidates:
+            # A class declaring abstract methods of its own is an ABC, not
+            # an implementation — only concrete classes owe the surface.
+            if any(
+                isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+                and _is_abstract(n)
+                for n in cls.body
+            ):
                 continue
-            for method, (abc_required, abc_kwonly, _) in sorted(methods.items()):
-                impl = find_method(cls_name, method)
-                rel = os.path.relpath(path, REPO)
-                if impl is None:
-                    findings.append(
-                        (rel, cls.lineno,
-                         f"{cls_name} implements {abc_name} but defines no "
-                         f"{method}()")
-                    )
+            for abc_name, methods in abcs.items():
+                if cls_name == abc_name or not inherits_abc(cls, abc_name):
                     continue
-                required, required_kwonly, has_var = _method_params(impl)
-                if has_var:
-                    continue  # *args/**kwargs accepts anything
-                if required != abc_required:
-                    findings.append(
-                        (rel, impl.lineno,
-                         f"{cls_name}.{method} required params {required} != "
-                         f"{abc_name}.{method} {abc_required} (extra params "
-                         "need defaults; names and order must match)")
-                    )
-                if required_kwonly - abc_kwonly:
-                    findings.append(
-                        (rel, impl.lineno,
-                         f"{cls_name}.{method} adds required keyword-only "
-                         f"params {sorted(required_kwonly - abc_kwonly)} "
-                         f"absent from {abc_name}.{method} — ABC-shaped "
-                         "call sites would TypeError")
-                    )
+                for method, (abc_required, abc_kwonly, _) in sorted(
+                    methods.items()
+                ):
+                    impl = find_method(cls, method)
+                    rel = os.path.relpath(path, REPO)
+                    if impl is None:
+                        findings.append(
+                            (rel, cls.lineno,
+                             f"{cls_name} implements {abc_name} but defines "
+                             f"no {method}()")
+                        )
+                        continue
+                    required, required_kwonly, has_var = _method_params(impl)
+                    if has_var:
+                        continue  # *args/**kwargs accepts anything
+                    if required != abc_required:
+                        findings.append(
+                            (rel, impl.lineno,
+                             f"{cls_name}.{method} required params "
+                             f"{required} != {abc_name}.{method} "
+                             f"{abc_required} (extra params need defaults; "
+                             "names and order must match)")
+                        )
+                    if required_kwonly - abc_kwonly:
+                        findings.append(
+                            (rel, impl.lineno,
+                             f"{cls_name}.{method} adds required "
+                             f"keyword-only params "
+                             f"{sorted(required_kwonly - abc_kwonly)} absent "
+                             f"from {abc_name}.{method} — ABC-shaped call "
+                             "sites would TypeError")
+                        )
     return findings
 
 

@@ -308,3 +308,31 @@ def test_seam_checker_allows_defaulted_kwonly(tmp_path):
         "        return []\n",
     )
     assert staticcheck.check_seam_signatures(pkg) == []
+
+
+def test_seam_checker_checks_all_duplicate_named_classes(tmp_path):
+    """Two classes sharing a name must BOTH be checked — first-wins
+    registration would let a drifted duplicate hide behind a clean one."""
+    pkg = tmp_path / "pkg"
+    (pkg / "resource").mkdir(parents=True)
+    (pkg / "resource" / "types.py").write_text(
+        "from abc import ABC, abstractmethod\n"
+        "class Manager(ABC):\n"
+        "    @abstractmethod\n"
+        "    def init(self) -> None: ...\n"
+    )
+    # a_impl.py sorts before b_impl.py: the clean class registers first.
+    (pkg / "resource" / "a_impl.py").write_text(
+        "from .types import Manager\n"
+        "class M(Manager):\n"
+        "    def init(self):\n"
+        "        pass\n"
+    )
+    (pkg / "resource" / "b_impl.py").write_text(
+        "from .types import Manager\n"
+        "class M(Manager):\n"
+        "    def init(self, eager):\n"  # drifted: extra required param
+        "        pass\n"
+    )
+    findings = staticcheck.check_seam_signatures(str(pkg))
+    assert any("b_impl.py" in p and "eager" in m for p, _, m in findings)
