@@ -228,6 +228,24 @@ bool text_is_int64(const char* s) {
  * plugin expecting Float rejects). Dotless integers stay Int64; anything
  * that must remain text is forced with s:. */
 bool text_is_inferred_float(const char* s) {
+  /* Exactly the documented [-]digits.digits grammar: digits required on
+   * BOTH sides of the dot. Edge forms like "1." and ".5" stay String
+   * (ADVICE r4 #3) — inference must never be looser than the docs, and a
+   * plugin wanting them as floats forces f: (whose parser accepts them). */
+  if (*s == '-') ++s;
+  bool pre = false, post = false, dot = false;
+  for (; *s != '\0'; ++s) {
+    if (*s >= '0' && *s <= '9') { (dot ? post : pre) = true; continue; }
+    if (*s == '.' && !dot) { dot = true; continue; }
+    return false;
+  }
+  return pre && dot && post;
+}
+
+/* The f: parser's acceptance grammar ([-]digits[.digits] with at least
+ * one digit somewhere): the ONE definition both the parser's validation
+ * and tfd_classify_create_option consult, so they cannot drift. */
+bool text_is_forced_float(const char* s) {
   if (*s == '-') ++s;
   bool digits = false, dot = false;
   for (; *s != '\0'; ++s) {
@@ -235,7 +253,34 @@ bool text_is_inferred_float(const char* s) {
     if (*s == '.' && !dot) { dot = true; continue; }
     return false;
   }
-  return digits && dot;
+  return digits;
+}
+
+/* 1 = "true", 0 = "false", -1 = neither — shared by parser + classifier. */
+int bool_literal(const char* v) {
+  const char* t = "true";
+  const char* f = "false";
+  size_t ti = 0, fi = 0;
+  while (t[ti] != '\0' && v[ti] == t[ti]) ++ti;
+  if (t[ti] == '\0' && v[ti] == '\0') return 1;
+  while (f[fi] != '\0' && v[fi] == f[fi]) ++fi;
+  if (f[fi] == '\0' && v[fi] == '\0') return 0;
+  return -1;
+}
+
+/* NamedValue type a ([forced], value) pair gets, applying the SAME
+ * validation the parser enforces: 'b'/'i'/'f'/'s', or 0 when the parser
+ * would reject the segment (forced type whose value fails its grammar). */
+int classify_value(char forced, const char* value) {
+  int lit = bool_literal(value);
+  if (forced == 'b') return lit >= 0 ? 'b' : 0;
+  if (forced == 'i') return text_is_int64(value) ? 'i' : 0;
+  if (forced == 'f') return text_is_forced_float(value) ? 'f' : 0;
+  if (forced == 's') return 's';
+  if (lit >= 0) return 'b';
+  if (text_is_int64(value)) return 'i';
+  if (text_is_inferred_float(value)) return 'f';
+  return 's';
 }
 
 /* Returns TFD_SUCCESS or TFD_ERROR_INVALID_ARGUMENT (malformed segment,
@@ -284,20 +329,11 @@ int parse_create_options(const char* spec, CreateOptions* o, char* err_msg,
       nv.name = p;
       nv.name_size = static_cast<size_t>(eq - p);
       nv.value_size = 1;
-      bool is_true = false, is_false = false;
-      {
-        const char* t = "true";
-        const char* f = "false";
-        size_t ti = 0, fi = 0;
-        while (t[ti] != '\0' && value[ti] == t[ti]) ++ti;
-        is_true = t[ti] == '\0' && value[ti] == '\0';
-        while (f[fi] != '\0' && value[fi] == f[fi]) ++fi;
-        is_false = f[fi] == '\0' && value[fi] == '\0';
-      }
-      if (forced == 'b' || (forced == '\0' && (is_true || is_false))) {
-        if (!is_true && !is_false) return fail("b: value must be true|false");
+      int lit = bool_literal(value);
+      if (forced == 'b' || (forced == '\0' && lit >= 0)) {
+        if (lit < 0) return fail("b: value must be true|false");
         nv.type = kPjrtNamedValueBool;
-        nv.v.bool_value = is_true;
+        nv.v.bool_value = lit == 1;
       } else if (forced == 'i' ||
                  (forced == '\0' && text_is_int64(value))) {
         if (!text_is_int64(value)) return fail("i: value is not an integer");
@@ -316,11 +352,14 @@ int parse_create_options(const char* spec, CreateOptions* o, char* err_msg,
       } else if (forced == 'f' ||
                  (forced == '\0' && text_is_inferred_float(value))) {
         /* Minimal decimal parser (no strtof: keep this file libc-light
-         * and locale-independent). Accepts [-]digits[.digits]. */
+         * and locale-independent). Acceptance grammar lives in
+         * text_is_forced_float — the classifier consults the same one. */
+        if (!text_is_forced_float(value)) {
+          return fail("f: value is not a number");
+        }
         const char* d = value;
         bool neg = *d == '-';
         if (neg) ++d;
-        if (*d == '\0') return fail("f: value is not a number");
         float acc = 0.0f;
         for (; *d >= '0' && *d <= '9'; ++d) acc = acc * 10.0f + (*d - '0');
         if (*d == '.') {
@@ -350,6 +389,27 @@ int parse_create_options(const char* spec, CreateOptions* o, char* err_msg,
 typedef void* (*PjrtErrorFn)(void*);  /* generic PJRT_Error* f(Args*) */
 
 }  // namespace
+
+extern "C" int tfd_classify_create_option(const char* segment) {
+  /* The SAME predicates parse_create_options applies (classify_value —
+   * shared helpers, not a mirror), exposed so the Python loader can
+   * debug-log each option's would-be NamedValue type; a plugin rejecting
+   * a create option is otherwise undiagnosable (ADVICE r4 #3). Returns 0
+   * for any segment the parser would reject, including a forced type
+   * whose value fails that type's grammar. */
+  if (segment == nullptr) return 0;
+  const char* p = segment;
+  char forced = '\0';
+  if ((p[0] == 's' || p[0] == 'i' || p[0] == 'f' || p[0] == 'b') &&
+      p[1] == ':') {
+    forced = p[0];
+    p += 2;
+  }
+  const char* eq = p;
+  while (*eq != '\0' && *eq != '=') ++eq;
+  if (*eq != '=' || eq == p) return 0;
+  return classify_value(forced, eq + 1);
+}
 
 #ifdef TFD_TESTING
 /* Sanitizer self-test hook (native/selftest.cc): drives the option

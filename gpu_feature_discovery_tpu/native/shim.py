@@ -147,7 +147,7 @@ def probe_libtpu(explicit_path: Optional[str] = None) -> ProbeResult:
 # Must equal TFD_NATIVE_ABI_VERSION in tfd_native.h. A stale prebuilt .so
 # with a different struct layout would otherwise parse device records at
 # the wrong stride — silently corrupting every record after the first.
-NATIVE_ABI_VERSION = 3
+NATIVE_ABI_VERSION = 4
 
 
 class NativeShim:
@@ -191,6 +191,16 @@ class NativeShim:
             ctypes.c_size_t,
         ]
         lib.tfd_enumerate.restype = ctypes.c_int
+        lib.tfd_classify_create_option.argtypes = [ctypes.c_char_p]
+        lib.tfd_classify_create_option.restype = ctypes.c_int
+
+    def classify_create_option(self, segment: str) -> Optional[str]:
+        """NamedValue type one `[force:]key=value` segment would get from
+        the C parser's own inference/force rules — 'b'/'i'/'f'/'s', or
+        None for a malformed segment. Same code path as the parse, so the
+        answer cannot drift from what PJRT_Client_Create receives."""
+        code = self._lib.tfd_classify_create_option(segment.encode())
+        return chr(code) if code else None
 
     def probe(self, libtpu_path: str):
         """dlopen + GetPjrtApi probe; returns (ok, api_major, api_minor)."""
@@ -220,6 +230,21 @@ class NativeShim:
 
         Returns (platform, [EnumeratedDevice, ...]) or None on failure.
         """
+        if create_options and log.isEnabledFor(logging.DEBUG):
+            # A plugin rejecting a create option is undiagnosable without
+            # knowing the TYPE each value was sent as (ADVICE r4 #3) —
+            # classification comes from the C parser itself, not a
+            # Python mirror that could drift.
+            type_names = {"b": "Bool", "i": "Int64", "f": "Float", "s": "String"}
+            for seg in create_options.split(";"):
+                if not seg:
+                    continue
+                kind = self.classify_create_option(seg)
+                log.debug(
+                    "create option %r -> %s NamedValue",
+                    seg,
+                    type_names.get(kind, "MALFORMED"),
+                )
         out = (_CDeviceInfo * max_devices)()
         n = ctypes.c_size_t(0)
         platform = ctypes.create_string_buffer(64)

@@ -55,8 +55,14 @@ typedef struct {
  * refuses to load a .so whose tfd_abi_version() disagrees, so a stale
  * prebuilt library degrades to the pure-Python fallback instead of
  * parsing device records with the wrong stride. */
-#define TFD_NATIVE_ABI_VERSION 3
+#define TFD_NATIVE_ABI_VERSION 4
 int tfd_abi_version(void);
+
+/* NamedValue type one `[force:]key=value` create-option segment would
+ * get from the parser's inference/force rules: 'b', 'i', 'f', or 's'
+ * (as an int), or 0 for a malformed segment. Lets callers log/diagnose
+ * the typed create contract without re-implementing the rules. */
+int tfd_classify_create_option(const char* segment);
 
 /* dlopen(path) + GetPjrtApi() probe; writes the PJRT C API version into
  * *api_major / *api_minor on success. Never creates a PJRT client — the

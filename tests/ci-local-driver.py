@@ -310,6 +310,10 @@ def run_unit(unit, matrix, steps, unproven):
                 capture_output=True,
                 text=True,
                 timeout=1800,
+                # Same self-reference cut as _run_twin: a verbatim test
+                # step (fully-tooled host) would otherwise assert the very
+                # CI_EVIDENCE.md this run is regenerating.
+                env={**os.environ, "TFD_CI_DRIVER_ACTIVE": "1"},
             )
         except subprocess.TimeoutExpired:
             # A hung step must become recorded evidence, not a driver

@@ -440,27 +440,31 @@ def check_seam_signatures(package_dir=None):
                 out.append(b.attr)
         return out
 
-    def find_method(cls, method, seen=()):
-        """CONCRETE def node for method on cls or its repo-defined bases
-        (MRO-ish depth-first, left to right). Abstract stubs are not
-        implementations — inheriting one leaves the class abstract. Base
-        names resolving to several classes accept any candidate that
-        provides the method (conservative: ambiguity never flags)."""
-        for n in cls.body:
-            if (
-                isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
-                and n.name == method
-                and not _is_abstract(n)
-            ):
-                return n
+    def find_methods(cls, method, seen=()):
+        """ALL candidate concrete def nodes for method: the class's own
+        def shadows every base (Python MRO), else every def reachable
+        through repo-defined bases — a base NAME resolving to several
+        classes contributes all of them, and the caller passes if ANY
+        candidate is signature-compatible (conservative: name ambiguity
+        must neither hide a drifted class nor false-positive against the
+        wrong same-named one). Abstract stubs are not implementations —
+        inheriting one leaves the class abstract."""
+        own = [
+            n
+            for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+            and n.name == method
+            and not _is_abstract(n)
+        ]
+        if own:
+            return own
+        found = []
         for base in base_names(cls):
             if base in seen:
                 continue
             for _, base_cls in registry.get(base, []):
-                found = find_method(base_cls, method, (*seen, base))
-                if found is not None:
-                    return found
-        return None
+                found.extend(find_methods(base_cls, method, (*seen, base)))
+        return found
 
     def inherits_abc(cls, abc_name, seen=()):
         for base in base_names(cls):
@@ -492,18 +496,27 @@ def check_seam_signatures(package_dir=None):
                 for method, (abc_required, abc_kwonly, _) in sorted(
                     methods.items()
                 ):
-                    impl = find_method(cls, method)
+                    impls = find_methods(cls, method)
                     rel = os.path.relpath(path, REPO)
-                    if impl is None:
+                    if not impls:
                         findings.append(
                             (rel, cls.lineno,
                              f"{cls_name} implements {abc_name} but defines "
                              f"no {method}()")
                         )
                         continue
+
+                    def compatible(impl):
+                        required, req_kwonly, has_var = _method_params(impl)
+                        return has_var or (
+                            required == abc_required
+                            and not (req_kwonly - abc_kwonly)
+                        )
+
+                    if any(compatible(i) for i in impls):
+                        continue
+                    impl = impls[0]
                     required, required_kwonly, has_var = _method_params(impl)
-                    if has_var:
-                        continue  # *args/**kwargs accepts anything
                     if required != abc_required:
                         findings.append(
                             (rel, impl.lineno,

@@ -336,3 +336,31 @@ def test_seam_checker_checks_all_duplicate_named_classes(tmp_path):
     )
     findings = staticcheck.check_seam_signatures(str(pkg))
     assert any("b_impl.py" in p and "eager" in m for p, _, m in findings)
+
+
+def test_seam_checker_ambiguous_base_accepts_any_compatible(tmp_path):
+    """A base NAME resolving to two classes (a drifted fake sorting first,
+    the real compatible base after) must not false-positive: any
+    compatible candidate passes."""
+    pkg = tmp_path / "pkg"
+    (pkg / "resource").mkdir(parents=True)
+    (pkg / "resource" / "types.py").write_text(
+        "from abc import ABC, abstractmethod\n"
+        "class Manager(ABC):\n"
+        "    @abstractmethod\n"
+        "    def init(self) -> None: ...\n"
+    )
+    (pkg / "resource" / "a_fake.py").write_text(
+        "class Base:\n"
+        "    def init(self, eager):\n"  # drifted double, sorts first
+        "        pass\n"
+    )
+    (pkg / "resource" / "b_real.py").write_text(
+        "from .types import Manager\n"
+        "class Base(Manager):\n"
+        "    def init(self):\n"  # the real, compatible base
+        "        pass\n"
+        "class Child(Base):\n"
+        "    pass\n"
+    )
+    assert staticcheck.check_seam_signatures(str(pkg)) == []

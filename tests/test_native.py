@@ -673,3 +673,43 @@ def test_enumerate_probe_only_plugin_fails_cleanly(native, fake_libtpu):
 
 def test_enumerate_missing_lib(native):
     assert native.enumerate("/nonexistent/libtpu.so") is None
+
+
+def test_classify_create_option_matches_parser_rules(native):
+    """ADVICE r4 #3: the classification entry point (what shim.py debug-
+    logs per option) must speak the parser's own rules — including the
+    tightened float grammar where `1.` / `.5` stay String."""
+    cases = {
+        "flag=true": "b",
+        "flag=false": "b",
+        "rank=42": "i",
+        "rank=-7": "i",
+        "scale=1.5": "f",
+        "scale=-0.25": "f",
+        "rev=1.": "s",      # edge form: NOT inferred Float
+        "rev=.5": "s",      # edge form: NOT inferred Float
+        "rev=1.2.3": "s",
+        "name=hello": "s",
+        "s:build=true": "s",   # forced wins
+        "i:sid=123": "i",
+        "f:rev=2.0": "f",
+        "b:on=true": "b",
+        "s:session_id=12345": "s",
+    }
+    for seg, want in cases.items():
+        got = native.classify_create_option(seg)
+        assert got == want, f"{seg!r}: classified {got!r}, want {want!r}"
+    # Malformed segments classify as None (the parser would reject them).
+    assert native.classify_create_option("novalue") is None
+    assert native.classify_create_option("=x") is None
+
+
+def test_classify_rejects_invalid_forced_values(native):
+    """A forced type whose value fails its grammar is a segment the
+    parser REJECTS — the classifier must say 0/None, never report a type
+    for an option that will never reach PJRT_Client_Create."""
+    for seg in ("b:on=yes", "i:sid=abc", "f:x=abc", "f:x=.", "i:x=1.5"):
+        assert native.classify_create_option(seg) is None, seg
+    # Forced values that DO satisfy their grammar classify as forced.
+    assert native.classify_create_option("f:x=1.") == "f"
+    assert native.classify_create_option("f:x=.5") == "f"
