@@ -436,6 +436,13 @@ def measure_node_health(
     """
     global _device_clock_unavailable, _traced_probe_failures
     t_total = time.perf_counter()
+    # Standalone callers (bench, tests) reach the probe without going
+    # through JaxManager.init — same cache, same idempotent enable.
+    from gpu_feature_discovery_tpu.utils.jaxenv import (
+        enable_persistent_compilation_cache,
+    )
+
+    enable_persistent_compilation_cache()
     if devices is None:
         devices = jax.local_devices()
     on_tpu = all(d.platform == "tpu" for d in devices)

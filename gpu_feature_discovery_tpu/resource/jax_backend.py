@@ -119,6 +119,14 @@ class JaxManager(Manager):
     def init(self) -> None:
         if self._devices is not None:
             return
+        # Before anything compiles: with --with-burnin the probe kernels'
+        # one-time XLA compile dominates daemon start; a persistent cache
+        # ($TFD_COMPILATION_CACHE_DIR) survives restarts (jaxenv docs).
+        from gpu_feature_discovery_tpu.utils.jaxenv import (
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache()
         try:
             devices, all_devices = _enumerate_tpu_devices()
         except Exception as e:  # noqa: BLE001 - backend init failures funnel

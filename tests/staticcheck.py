@@ -441,14 +441,17 @@ def check_seam_signatures(package_dir=None):
         return out
 
     def find_methods(cls, method, seen=()):
-        """ALL candidate concrete def nodes for method: the class's own
-        def shadows every base (Python MRO), else every def reachable
-        through repo-defined bases — a base NAME resolving to several
-        classes contributes all of them, and the caller passes if ANY
-        candidate is signature-compatible (conservative: name ambiguity
-        must neither hide a drifted class nor false-positive against the
-        wrong same-named one). Abstract stubs are not implementations —
-        inheriting one leaves the class abstract."""
+        """The candidate concrete def nodes Python's resolution would
+        dispatch to: the class's LAST own def (later defs shadow earlier
+        in one body), else the FIRST base — depth-first, left to right,
+        the MRO approximation — whose chain defines it. Only when that
+        base's NAME resolves to several registry classes does the result
+        hold several candidates; the caller then passes if ANY is
+        signature-compatible (name ambiguity must neither hide a drifted
+        class nor false-positive against the wrong same-named one). Later
+        bases never vouch for an earlier base's drifted def — Python
+        would dispatch to the earlier one. Abstract stubs are not
+        implementations — inheriting one leaves the class abstract."""
         own = [
             n
             for n in cls.body
@@ -457,14 +460,16 @@ def check_seam_signatures(package_dir=None):
             and not _is_abstract(n)
         ]
         if own:
-            return own
-        found = []
+            return [own[-1]]
         for base in base_names(cls):
             if base in seen:
                 continue
+            found = []
             for _, base_cls in registry.get(base, []):
                 found.extend(find_methods(base_cls, method, (*seen, base)))
-        return found
+            if found:
+                return found
+        return []
 
     def inherits_abc(cls, abc_name, seen=()):
         for base in base_names(cls):

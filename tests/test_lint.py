@@ -364,3 +364,32 @@ def test_seam_checker_ambiguous_base_accepts_any_compatible(tmp_path):
         "    pass\n"
     )
     assert staticcheck.check_seam_signatures(str(pkg)) == []
+
+
+def test_seam_checker_flags_mro_winning_drifted_base(tmp_path):
+    """class Child(A, B) where A.init drifted and B.init matches: Python
+    dispatches to A.init (MRO left-to-right), so B must NOT vouch for it
+    — the drift is real and must flag."""
+    pkg = tmp_path / "pkg"
+    (pkg / "resource").mkdir(parents=True)
+    (pkg / "resource" / "types.py").write_text(
+        "from abc import ABC, abstractmethod\n"
+        "class Manager(ABC):\n"
+        "    @abstractmethod\n"
+        "    def init(self) -> None: ...\n"
+    )
+    (pkg / "resource" / "impl.py").write_text(
+        "from .types import Manager\n"
+        "class A:\n"
+        "    def init(self, eager):\n"  # drifted, wins the MRO
+        "        pass\n"
+        "class B(Manager):\n"
+        "    def init(self):\n"  # compatible, but never dispatched
+        "        pass\n"
+        "class Child(A, B):\n"
+        "    pass\n"
+    )
+    findings = staticcheck.check_seam_signatures(str(pkg))
+    assert any(
+        "Child.init" in m and "eager" in m for _, _, m in findings
+    ), findings

@@ -86,3 +86,50 @@ def test_graft_entry_contract():
     out = jax.jit(fn)(*args)
     assert all(jnp.isfinite(o) for o in out)
     ge.dryrun_multichip(8)
+
+
+# ---------------------------------------------------------------------------
+# Persistent compilation cache gate (VERDICT r4 next-round #6)
+# ---------------------------------------------------------------------------
+
+def test_compilation_cache_enables_from_env(tmp_path, monkeypatch):
+    from gpu_feature_discovery_tpu.utils import jaxenv
+
+    jaxenv.reset_compilation_cache_state()
+    cache_dir = tmp_path / "xla-cache"
+    monkeypatch.setenv("TFD_COMPILATION_CACHE_DIR", str(cache_dir))
+    try:
+        assert jaxenv.enable_persistent_compilation_cache() is True
+        assert cache_dir.is_dir()
+        import jax
+
+        assert jax.config.jax_compilation_cache_dir == str(cache_dir)
+        # Idempotent: a second call does not re-configure.
+        assert jaxenv.enable_persistent_compilation_cache() is True
+    finally:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", None)
+        jaxenv.reset_compilation_cache_state()
+
+
+def test_compilation_cache_noop_without_env(monkeypatch):
+    from gpu_feature_discovery_tpu.utils import jaxenv
+
+    jaxenv.reset_compilation_cache_state()
+    monkeypatch.delenv("TFD_COMPILATION_CACHE_DIR", raising=False)
+    assert jaxenv.enable_persistent_compilation_cache() is False
+
+
+def test_compilation_cache_failure_is_nonfatal(tmp_path, monkeypatch):
+    """An unwritable cache path must degrade to no-cache, never raise —
+    the cache is an optimization, not a labeling dependency."""
+    from gpu_feature_discovery_tpu.utils import jaxenv
+
+    jaxenv.reset_compilation_cache_state()
+    blocker = tmp_path / "not-a-dir"
+    blocker.write_text("file, not dir")
+    monkeypatch.setenv(
+        "TFD_COMPILATION_CACHE_DIR", str(blocker / "sub")
+    )
+    assert jaxenv.enable_persistent_compilation_cache() is False
