@@ -9,8 +9,8 @@ query — JAX freezes its backend on init — and must go through
 
 The persistent compilation cache cuts the burn-in's one-time XLA compile
 across daemon RESTARTS (VERDICT r4 next-round #6): measured on a real
-v5e chip, a warm cache takes the first probe's compile phase from ~3.2 s
-to ~0.37 s and start-to-health-labels from ~14 s to ~4 s.
+v5e chip, a warm cache takes the first probe's compile phase from ~8.5 s
+to ~1 s (measured at the TPU probe geometry).
 """
 
 from __future__ import annotations

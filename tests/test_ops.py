@@ -133,3 +133,26 @@ def test_compilation_cache_failure_is_nonfatal(tmp_path, monkeypatch):
         "TFD_COMPILATION_CACHE_DIR", str(blocker / "sub")
     )
     assert jaxenv.enable_persistent_compilation_cache() is False
+
+
+def test_probe_workspace_commits_to_target_device():
+    """Multi-chip correctness pin: the probe workspace must be COMMITTED
+    to its device — a jit output under jax.default_device is uncommitted,
+    and all-uncommitted inputs make JAX run the kernels on the DEFAULT
+    device, so chips 1..n of a multi-chip host would never be probed and
+    worst-chip-wins would silently report chip 0's rates."""
+    import jax
+    import jax.numpy as jnp
+
+    from gpu_feature_discovery_tpu.ops import healthcheck as hc
+
+    for d in jax.local_devices()[:2]:
+        x, ws = hc._burnin_workspace(d, 128, 2, jnp.bfloat16)
+        assert x.committed and ws.committed
+        assert x.devices() == {d} and ws.devices() == {d}
+        buf = hc._stream_workspace(d, 512)
+        assert buf.committed and buf.devices() == {d}
+        # And the kernels actually execute there: committed inputs pin
+        # the computation's device placement.
+        out, _ = hc._jitted_burnin()(x, ws)
+        assert out.devices() == {d}
