@@ -142,9 +142,14 @@ def measure_hbm_bandwidth(
     if interpret is None:
         interpret = not _on_tpu(device)
     rows = probe_rows(total_mib)
-    buf = jnp.ones((rows, LANES), jnp.float32)
     if device is not None:
-        buf = jax.device_put(buf, device)
+        # Create on the target device (committed): materializing the
+        # buffer host-side and device_put-ing it would stream total_mib
+        # over the transport for a buffer whose contents are constant.
+        with jax.default_device(device):
+            buf = jax.device_put(jnp.ones((rows, LANES), jnp.float32), device)
+    else:
+        buf = jnp.ones((rows, LANES), jnp.float32)
     fn = _jitted_stream_sum(interpret)
     total = jax.block_until_ready(fn(buf))  # compile + warm
     best = float("inf")

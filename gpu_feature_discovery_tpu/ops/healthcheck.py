@@ -100,14 +100,12 @@ def make_burnin_step(
 
     ``size`` defaults to a multiple of 256 so bf16 tiles (16x128 min) pack
     the MXU exactly. Returns the *unjitted* fn — callers jit it (the driver
-    compile-checks ``jax.jit(fn)(*args)``).
+    compile-checks ``jax.jit(fn)(*args)``). The example args come from the
+    same construction the daemon's on-device generator jits
+    (_burnin_input_arrays), so what the driver checks is what the probe
+    runs.
     """
-    key = jax.random.PRNGKey(0)
-    kx, kw = jax.random.split(key)
-    x = jax.random.normal(kx, (size, size), dtype=jnp.float32).astype(dtype)
-    ws = jax.random.normal(kw, (depth, size, size), dtype=jnp.float32).astype(dtype)
-    ws = ws / jnp.sqrt(jnp.float32(size)).astype(dtype)
-    return burnin_step, (x, ws)
+    return burnin_step, _burnin_input_arrays(size, depth, dtype)
 
 
 def burnin_flops(size: int, depth: int) -> float:
@@ -138,20 +136,24 @@ def _jitted_burnin() -> callable:
     return jax.jit(burnin_step)
 
 
+def _burnin_input_arrays(size: int, depth: int, dtype):
+    """THE probe input construction — the single definition both the
+    driver compile-check path (make_burnin_step) and the daemon's
+    on-device generator (_jitted_input_gen) build from, so the checked
+    inputs can never drift from the probed ones."""
+    key = jax.random.PRNGKey(0)
+    kx, kw = jax.random.split(key)
+    x = jax.random.normal(kx, (size, size), jnp.float32).astype(dtype)
+    ws = jax.random.normal(kw, (depth, size, size), jnp.float32).astype(dtype)
+    return x, ws / jnp.sqrt(jnp.float32(size)).astype(dtype)
+
+
 @functools.lru_cache(maxsize=None)
 def _jitted_input_gen(size: int, depth: int, dtype) -> callable:
     """Jitted ON-DEVICE input generator: the probe inputs are synthesized
     where they will be consumed — nothing streams over the transport
     (at the TPU geometry the weights alone are ~32 MiB)."""
-
-    def burnin_inputs():
-        key = jax.random.PRNGKey(0)
-        kx, kw = jax.random.split(key)
-        x = jax.random.normal(kx, (size, size), jnp.float32).astype(dtype)
-        ws = jax.random.normal(kw, (depth, size, size), jnp.float32).astype(dtype)
-        return x, ws / jnp.sqrt(jnp.float32(size)).astype(dtype)
-
-    return jax.jit(burnin_inputs)
+    return jax.jit(functools.partial(_burnin_input_arrays, size, depth, dtype))
 
 
 @functools.lru_cache(maxsize=None)
