@@ -119,6 +119,21 @@ def _jitted_stream_sum(interpret: bool):
     return jax.jit(hbm_probe)
 
 
+@functools.lru_cache(maxsize=None)
+def stream_workspace(device, rows: int) -> jax.Array:
+    """Per-device HBM stream buffer, created ON the device once per
+    process, held resident, and COMMITTED there (same-device device_put
+    pins placement; an uncommitted jit output would let downstream
+    kernels hop to the default device). Residency rationale in
+    healthcheck._burnin_workspace: fresh per-cycle allocation costs
+    ~30 ms of transport overhead, and TPU chips are single-tenant so the
+    buffer contends with nobody. Shared by the traced probe and the
+    wall-clock fallback."""
+    with jax.default_device(device):
+        buf = jnp.ones((rows, LANES), jnp.float32)
+    return jax.device_put(buf, device)
+
+
 def probe_rows(total_mib: int) -> int:
     """Row count of the probe buffer covering ``total_mib`` (rounded down
     to whole chunks, minimum one chunk). The single source of truth for
@@ -143,11 +158,12 @@ def measure_hbm_bandwidth(
         interpret = not _on_tpu(device)
     rows = probe_rows(total_mib)
     if device is not None:
-        # Create on the target device (committed): materializing the
-        # buffer host-side and device_put-ing it would stream total_mib
-        # over the transport for a buffer whose contents are constant.
-        with jax.default_device(device):
-            buf = jax.device_put(jnp.ones((rows, LANES), jnp.float32), device)
+        # Resident committed on-device buffer (stream_workspace):
+        # materializing host-side and device_put-ing would stream
+        # total_mib over the transport per probe for constant contents,
+        # and re-allocating per cycle pays the overhead the residency
+        # design exists to avoid.
+        buf = stream_workspace(device, rows)
     else:
         buf = jnp.ones((rows, LANES), jnp.float32)
     fn = _jitted_stream_sum(interpret)

@@ -150,7 +150,8 @@ def test_probe_workspace_commits_to_target_device():
         x, ws = hc._burnin_workspace(d, 128, 2, jnp.bfloat16)
         assert x.committed and ws.committed
         assert x.devices() == {d} and ws.devices() == {d}
-        buf = hc._stream_workspace(d, 512)
+        from gpu_feature_discovery_tpu.ops.hbm import stream_workspace
+        buf = stream_workspace(d, 512)
         assert buf.committed and buf.devices() == {d}
         # And the kernels actually execute there: committed inputs pin
         # the computation's device placement.
