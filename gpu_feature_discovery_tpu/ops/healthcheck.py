@@ -125,6 +125,13 @@ TPU_PROBE_SIZE = 2048
 TPU_PROBE_DEPTH = 4
 DEFAULT_PROBE_SIZE = 512
 DEFAULT_PROBE_DEPTH = 8
+# One HBM probe geometry for BOTH timing paths (ADVICE r4 #2): the traced
+# probe and the wall-clock fallback must request the same buffer so they
+# share one resident stream_workspace cache entry per device — different
+# sizes would pin a dead 256 MiB entry per chip after a wall-clock
+# downgrade, and their published rates would not be comparable.
+PROBE_HBM_MIB = 256
+PROBE_HBM_ITERS = 3
 
 
 @functools.lru_cache(maxsize=None)
@@ -288,8 +295,8 @@ def _measure_node_health_traced(
     depth: int = 8,
     iters: int = 4,
     dtype=jnp.bfloat16,
-    hbm_mib: int = 256,
-    hbm_iters: int = 3,
+    hbm_mib: int = PROBE_HBM_MIB,
+    hbm_iters: int = PROBE_HBM_ITERS,
 ) -> Tuple[Optional[dict], Optional[str]]:
     """Probe every device with ON-DEVICE timing: dispatch the burn-in and
     HBM kernels under a profiler trace and read the kernels' execution
@@ -446,7 +453,9 @@ def _measure_node_health_wall(
 
         t1 = time.perf_counter()
         hbm = [
-            measure_hbm_bandwidth(total_mib=64, iters=2, device=d)
+            measure_hbm_bandwidth(
+                total_mib=PROBE_HBM_MIB, iters=PROBE_HBM_ITERS, device=d
+            )
             for d in devices
         ]
         hbm_ms = (time.perf_counter() - t1) * 1e3

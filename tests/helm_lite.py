@@ -141,7 +141,7 @@ _EXPR_TOKEN = re.compile(
       | (?P<lpar>\()
       | (?P<rpar>\))
       | (?P<assign>:=|=)
-      | (?P<var>\$[A-Za-z0-9_]*)
+      | (?P<var>\$[A-Za-z0-9_]*(?:\.[A-Za-z0-9_.]+)?)
       | (?P<dot>\.[A-Za-z0-9_.]*)
       | (?P<ident>[A-Za-z_][A-Za-z0-9_]*)
     )""",
@@ -163,6 +163,27 @@ def _tokenize_expr(text):
 
 
 _NO_PIPE = object()  # piped nil must still reach the next stage's args
+
+def _walk_fields(value, path):
+    """Field-path walk: missing dict key -> nil (go map semantics), but a
+    field on a NON-map value is a loud error — real helm fails with
+    "can't evaluate field X in type ..." there, and silently rendering
+    empty text would let the hermetic sandbox pass what `helm template`
+    rejects (the module's fail-loud contract). Fields on nil itself stay
+    nil: chart idioms lean on `.Values.a.b | default` chains.
+    """
+    for part in [p for p in path.split(".") if p]:
+        if isinstance(value, dict):
+            value = value.get(part)  # missing key -> nil (falsy)
+        elif value is None:
+            return None
+        else:
+            raise RenderError(
+                f"helm-lite: can't evaluate field {part!r} in "
+                f"{type(value).__name__} value {value!r}"
+            )
+    return value
+
 
 
 def _truthy(v):
@@ -247,9 +268,15 @@ class _Evaluator:
         if kind == "num":
             return float(val) if "." in val else int(val)
         if kind == "var":
-            found, value = self.vars.lookup(val)
+            # $name[.field.path]: go templates predeclare $ as the root
+            # context of the template invocation, and any variable can be
+            # followed by a field path ($.Values.x, $item.name).
+            name, dot_sep, rest = val.partition(".")
+            found, value = self.vars.lookup(name)
             if not found:
-                raise RenderError(f"helm-lite: undefined variable {val}")
+                raise RenderError(f"helm-lite: undefined variable {name}")
+            if dot_sep:
+                value = _walk_fields(value, rest)
             return value
         if kind == "dot":
             return self._resolve_dot(val)
@@ -260,13 +287,7 @@ class _Evaluator:
         raise RenderError(f"helm-lite: unexpected token {kind} {val!r}")
 
     def _resolve_dot(self, path):
-        value = self.dot
-        for part in [p for p in path.split(".") if p]:
-            if isinstance(value, dict) and part in value:
-                value = value[part]
-            else:
-                return None  # missing key -> nil (falsy), like go template
-        return value
+        return _walk_fields(self.dot, path)
 
     def _call(self, name, args):
         fns = {
@@ -388,10 +409,18 @@ class Renderer:
     def __init__(self, defines):
         self.defines = defines  # name -> node list
 
+    @staticmethod
+    def root_scope(dot):
+        """Fresh top-level variable scope with go's predeclared $ bound to
+        the invocation's root context (rebinds per include, as upstream)."""
+        scope = _Scope()
+        scope.declare("$", dot)
+        return scope
+
     def render_define(self, name, dot):
         if name not in self.defines:
             raise RenderError(f"helm-lite: include of undefined template {name!r}")
-        return self.render_nodes(self.defines[name], dot, _Scope())
+        return self.render_nodes(self.defines[name], dot, self.root_scope(dot))
 
     def render_nodes(self, nodes, dot, variables):
         out = []
@@ -548,7 +577,7 @@ def _render_one(chart_dir, values, release_name, namespace, include_crds):
         if fname.endswith(".tpl"):
             continue
         text = renderer.render_nodes(
-            [n for n in nodes if n[0] != "define"], dot, _Scope()
+            [n for n in nodes if n[0] != "define"], dot, renderer.root_scope(dot)
         )
         try:
             docs += list(yaml.safe_load_all(text))

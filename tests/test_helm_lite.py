@@ -235,3 +235,46 @@ def test_values_file_number_is_not_int(tmp_path):
 def test_bare_identifier_argument_fails_loudly(tmp_path):
     with pytest.raises(RenderError, match="bare identifier"):
         _render_snippet(tmp_path, "v: {{ eq .Values.x foo }}\n")
+
+
+def test_dollar_root_inside_with_and_range(tmp_path):
+    """Go templates predeclare $ as the invocation's root context: inside
+    `with`/`range` (which rebind .), $.Values still reaches the top —
+    the single most common rescoping idiom in real charts."""
+    out = _render_snippet(
+        tmp_path,
+        "{{ with .Values.m }}v: {{ .x }}-{{ $.Values.a }}{{ end }}\n"
+        "{{ range .Values.lst }}r{{ . }}: {{ $.Values.a }}\n{{ end }}",
+        values="a: top\nm:\n  x: inner\nlst: [1, 2]\n",
+    )
+    assert out == [{"v": "inner-top", "r1": "top", "r2": "top"}]
+
+
+def test_variable_field_paths(tmp_path):
+    """$var.field walks the variable's value like a dot path, with nil
+    for missing keys (go template semantics)."""
+    out = _render_snippet(
+        tmp_path,
+        "{{ $m := .Values.m }}v: {{ $m.x }}\n"
+        "miss: {{ $m.nope | default \"fallback\" }}\n",
+        values="m:\n  x: deep\n",
+    )
+    assert out == [{"v": "deep", "miss": "fallback"}]
+
+
+def test_dollar_rebinds_per_include(tmp_path):
+    """Within an include, $ is the include's ctx argument, not the outer
+    file's root — matching upstream's per-invocation predeclaration."""
+    chart = tmp_path / "c"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "Chart.yaml").write_text("name: c\nversion: 0.0.1\n")
+    (chart / "values.yaml").write_text("m:\n  x: inner\n")
+    (chart / "templates" / "_h.tpl").write_text(
+        '{{- define "h" -}}{{ $.x }}{{- end -}}'
+    )
+    (chart / "templates" / "x.yml").write_text(
+        'v: {{ include "h" .Values.m }}\n'
+    )
+    from helm_lite import render_chart
+
+    assert render_chart(str(chart)) == [{"v": "inner"}]
