@@ -203,8 +203,10 @@ def measure_chip_health(
     """Run the burn-in on one chip and report health + achieved TFLOP/s.
 
     ``healthy`` is "every output finite"; ``tflops`` is the
-    best-of-``iters`` sustained matmul rate, which on a healthy TPU should
-    sit near the chip's bf16 peak.
+    median-of-``iters`` sustained matmul rate (the same aggregation the
+    traced path applies to its device durations, so the two paths'
+    numbers are comparable — ADVICE r4 #2), which on a healthy TPU
+    should sit near the chip's bf16 peak.
     """
     step = _jitted_burnin()
     if device is not None:
@@ -215,16 +217,17 @@ def measure_chip_health(
     else:
         x, ws = _jitted_input_gen(size, depth, dtype)()
     checksum, rms = jax.block_until_ready(step(x, ws))  # compile + warm
-    best = float("inf")
+    samples = []
     for _ in range(iters):
         t0 = time.perf_counter()
         jax.block_until_ready(step(x, ws))
-        best = min(best, time.perf_counter() - t0)
+        samples.append(time.perf_counter() - t0)
+    sec = statistics.median(samples)
     healthy = bool(jnp.isfinite(checksum)) and bool(jnp.isfinite(rms))
     return {
         "healthy": healthy,
-        "tflops": burnin_flops(size, depth) / best / 1e12,
-        "seconds": best,
+        "tflops": burnin_flops(size, depth) / sec / 1e12,
+        "seconds": sec,
     }
 
 
@@ -437,7 +440,7 @@ def _measure_node_health_wall(
     on_tpu: bool = False,
 ) -> dict:
     """Wall-clock fallback probe (CPU meshes and profiler-less platforms):
-    best-of-iters host timing per chip. On transports where dispatch
+    median-of-iters host timing per chip. On transports where dispatch
     latency dwarfs kernel time the rates are distorted — the health
     labeler's plausibility guard (lm/health.py) keeps those off the node."""
     t0 = time.perf_counter()
