@@ -85,15 +85,28 @@ def _parse(tokens):
             frames.append(["if", [(value[2:].strip(), body)], None])
             stack.append(body)
         elif word == "else":
+            if not frames:
+                raise RenderError("helm-lite: else outside any block")
             frame = frames[-1]
             stack.pop()
             rest = value[4:].strip()
             body = []
             if rest.startswith("if "):
+                if frame[0] != "if":
+                    raise RenderError(
+                        f"helm-lite: else if in {frame[0]} block"
+                    )
+                if frame[2] is not None:
+                    # go/template rejects any branch after the final else.
+                    raise RenderError("helm-lite: else if after else")
                 frame[1].append((rest[3:].strip(), body))
             elif frame[0] == "if":
+                if frame[2] is not None:
+                    raise RenderError("helm-lite: duplicate else in if block")
                 frame[2] = body
             elif frame[0] == "with":
+                if frame[3] is not None:
+                    raise RenderError("helm-lite: duplicate else in with block")
                 frame[3] = body
             else:
                 raise RenderError(f"helm-lite: else in {frame[0]} block")
@@ -112,6 +125,8 @@ def _parse(tokens):
             frames.append(["define", name, body])
             stack.append(body)
         elif word == "end":
+            if not frames:
+                raise RenderError("helm-lite: end outside any block")
             frame = frames.pop()
             stack.pop()
             if frame[0] == "if":

@@ -207,3 +207,58 @@ def test_labels_file_round_trip(tmp_path_factory, kv):
         k, _, v = line.partition("=")
         written[k] = v
     assert written == {k: str(v) for k, v in kv.items()}
+
+
+# ---------------------------------------------------------------------------
+# helm-lite renderer: templates must fail CONTROLLED, never crash
+# ---------------------------------------------------------------------------
+#
+# The hermetic chart pipeline trusts helm_lite.py's fail-loud contract:
+# anything it cannot faithfully render must raise RenderError (or the
+# chart's own HelmFail), never an arbitrary exception and never a hang.
+# Fuzz template bodies built from go-template fragments: most are
+# malformed (controlled RenderError expected); the well-formed minority
+# must produce parseable YAML or a controlled failure.
+
+_TPL_FRAGMENTS = [
+    "{{ .Values.a }}", "{{ .Values.missing }}", "{{- if .Values.a }}",
+    "{{- end }}", "{{ else }}", "{{ range .Values.lst }}", "{{ with .Values.m }}",
+    "{{ $x := 1 }}", "{{ $x }}", "{{ $.Values.a }}", "{{ $x.y }}", "{{ $y }}",
+    "{{ .Values.a | quote }}", "{{ .Values.a | default \"d\" }}",
+    "{{ include \"nope\" . }}", "{{ toYaml .Values.m | nindent 2 }}",
+    "{{ printf \"%s\" .Values.a }}", "k: v\n", "  indented: x\n", ": bad\n",
+    "{{ unknownfn 1 }}", "{{", "}}", "{{ .Values.a.b.c }}", "{{ $ }}",
+    # The shapes that actually crashed (stray else/end, else-if in a
+    # non-if block) before the parser grew its controlled failures.
+    "{{ define \"t\" }}", "{{ else if .Values.a }}", "{{ .Values.lst }}",
+    "{{ end }}{{ end }}", "{{ range $i, $v := .Values.lst }}",
+]
+
+
+@given(
+    st.lists(st.sampled_from(_TPL_FRAGMENTS), min_size=0, max_size=8),
+    st.sampled_from(["a: 1\n", "a: s\nm:\n  x: 2\nlst: [1]\n", "{}\n"]),
+)
+@settings(max_examples=150, deadline=None)
+def test_helm_lite_fails_controlled_on_arbitrary_templates(
+    tmp_path_factory, fragments, values
+):
+    # Fixture params come FIRST: hypothesis binds its strategies to the
+    # trailing parameters.
+    import helm_lite
+
+    chart = tmp_path_factory.mktemp("tfd-fuzz-chart")
+    (chart / "templates").mkdir()
+    (chart / "Chart.yaml").write_text("name: c\nversion: 0.0.1\n")
+    (chart / "values.yaml").write_text(values)
+    (chart / "templates" / "x.yml").write_text("".join(fragments))
+    try:
+        docs = helm_lite.render_chart(str(chart))
+    except helm_lite.RenderError:
+        return  # controlled refusal — the contract
+    except Exception as e:  # noqa: BLE001 - the property under test
+        raise AssertionError(
+            f"helm-lite raised uncontrolled {type(e).__name__} for "
+            f"template {''.join(fragments)!r}: {e}"
+        ) from e
+    assert isinstance(docs, list)

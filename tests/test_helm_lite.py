@@ -278,3 +278,21 @@ def test_dollar_rebinds_per_include(tmp_path):
     from helm_lite import render_chart
 
     assert render_chart(str(chart)) == [{"v": "inner"}]
+
+
+def test_duplicate_else_fails_loudly(tmp_path):
+    """go/template rejects any branch after the final else; rendering on
+    (dropping a body) would pass hermetically what `helm template`
+    refuses — the exact divergence the fail-loud contract exists for."""
+    with pytest.raises(RenderError, match="duplicate else"):
+        _render_snippet(
+            tmp_path,
+            "{{ if .Values.a }}A{{ else }}B{{ else }}C{{ end }}\n",
+            values="a: 1\n",
+        )
+    with pytest.raises(RenderError, match="else if after else"):
+        _render_snippet(
+            tmp_path,
+            "{{ if .Values.a }}A{{ else }}B{{ else if .Values.a }}C{{ end }}\n",
+            values="a: 1\n",
+        )
