@@ -29,15 +29,17 @@ from gpu_feature_discovery_tpu.config.flags import (
 from gpu_feature_discovery_tpu.config.spec import Config, ConfigError
 from gpu_feature_discovery_tpu.hostinfo.provider import ChainedProvider
 from gpu_feature_discovery_tpu.info.version import get_version_string
+from gpu_feature_discovery_tpu.lm.engine import new_label_engine
 from gpu_feature_discovery_tpu.lm.interconnect import InterconnectLabeler
-from gpu_feature_discovery_tpu.lm.labeler import Labeler, Merge
-from gpu_feature_discovery_tpu.lm.labelers import new_labelers
+from gpu_feature_discovery_tpu.lm.labeler import Labeler
+from gpu_feature_discovery_tpu.lm.labelers import new_label_sources
 from gpu_feature_discovery_tpu.lm.labels import remove_output_file
 from gpu_feature_discovery_tpu.lm.timestamp import new_timestamp_labeler
 from gpu_feature_discovery_tpu.pci.pciutil import SysfsGooglePCI
 from gpu_feature_discovery_tpu.resource import factory
 from gpu_feature_discovery_tpu.resource.types import Manager
 from gpu_feature_discovery_tpu.utils import logging as tfd_logging
+from gpu_feature_discovery_tpu.utils import timing
 from gpu_feature_discovery_tpu.utils.timing import timed
 
 log = logging.getLogger("tfd")
@@ -220,15 +222,32 @@ def run(
     (SIGHUP), False for clean exit."""
     output_file = config.flags.tfd.output_file
     oneshot = config.flags.tfd.oneshot
+    # One engine per config epoch: its last-good cache and straggler
+    # futures must not survive a SIGHUP reload (same staleness contract as
+    # reset_burnin_schedule), and the reload rebuilds run() anyway.
+    engine = new_label_engine(config)
     try:
         timestamp_labeler = new_timestamp_labeler(config)
         while True:
+            # Per-cycle spans only: without the reset, a cached-health
+            # cycle would re-report the last probe's cost as current.
+            timing.reset_cycle()
             with timed("labelgen.total"):
-                loop_labelers = new_labelers(manager, interconnect, config)
-                labels = Merge(timestamp_labeler, loop_labelers).labels()
+                # init() happens inside new_label_sources; its errors
+                # propagate before shutdown is owed (eager-path parity).
+                sources = new_label_sources(
+                    manager, interconnect, config, timestamp=timestamp_labeler
+                )
+                try:
+                    labels = engine.generate(sources)
+                finally:
+                    with timed("tpu.shutdown"):
+                        manager.shutdown()
 
             if len(labels) <= 1:
                 log.warning("no labels generated from any source")
+            log.info("Cycle timings: %s", timing.cycle_summary())
+            timing.write_timings_file(config.flags.tfd.timings_file or "")
 
             log.info("Writing labels to output file %s", output_file or "<stdout>")
             labels.write_to_file(output_file)
@@ -252,6 +271,7 @@ def run(
                 log.info("Received signal %s, shutting down.", signum)
                 return False
     finally:
+        engine.close()
         # Deferred cleanup (main.go:149-156): a daemon exit removes the
         # label file so stale labels don't outlive the pod; oneshot leaves
         # the file for NFD.

@@ -24,6 +24,8 @@ from gpu_feature_discovery_tpu.config.spec import (
     parse_positive_int as _parse_positive_int,
 )
 
+from gpu_feature_discovery_tpu.lm.engine import DEFAULT_LABELER_TIMEOUT
+
 DEFAULT_OUTPUT_FILE = "/etc/kubernetes/node-feature-discovery/features.d/tfd"
 DEFAULT_MACHINE_TYPE_FILE = "/sys/class/dmi/id/product_name"
 DEFAULT_SLEEP_INTERVAL = 60.0
@@ -216,6 +218,39 @@ FLAG_DEFS: List[FlagDef] = [
         help="path to a file containing the DMI (SMBIOS) machine type of the node",
         setter=lambda c, v: setattr(_f(c).tfd, "machine_type_file", v),
         getter=lambda c: _f(c).tfd.machine_type_file,
+    ),
+    FlagDef(
+        name="parallel-labelers",
+        env_vars=("TFD_PARALLEL_LABELERS",),
+        parse=_parse_bool,
+        default=True,
+        help="run the top-level labelers concurrently with per-labeler "
+        "deadlines (lm/engine.py); false reproduces the strictly "
+        "sequential merge of the reference",
+        setter=lambda c, v: setattr(_f(c).tfd, "parallel_labelers", v),
+        getter=lambda c: _f(c).tfd.parallel_labelers,
+    ),
+    FlagDef(
+        name="labeler-timeout",
+        env_vars=("TFD_LABELER_TIMEOUT",),
+        parse=parse_duration,
+        default=DEFAULT_LABELER_TIMEOUT,
+        help="with --parallel-labelers, per-cycle deadline for each "
+        "labeler (Go duration, e.g. 2s); a labeler exceeding it is served "
+        "from its last-good cache and named in the "
+        "google.com/tpu.tfd.stale-sources label until it catches up",
+        setter=lambda c, v: setattr(_f(c).tfd, "labeler_timeout", v),
+        getter=lambda c: _f(c).tfd.labeler_timeout,
+    ),
+    FlagDef(
+        name="timings-file",
+        env_vars=("TFD_TIMINGS_FILE",),
+        parse=str,
+        default="",
+        help="path to write a JSON per-labeler timing summary after every "
+        "labeling cycle, for scraping (empty = disabled)",
+        setter=lambda c, v: setattr(_f(c).tfd, "timings_file", v),
+        getter=lambda c: _f(c).tfd.timings_file,
     ),
 ]
 

@@ -104,6 +104,12 @@ class TfdFlags:
     machine_type_file: Optional[str] = None
     with_burnin: Optional[bool] = None  # TPU extension: on-chip health labels
     burnin_interval: Optional[int] = None  # probe every Nth cycle (cache between)
+    # Label-engine knobs (lm/engine.py): run the top-level labelers
+    # concurrently, each bounded by a per-cycle deadline (seconds) past
+    # which its last-good cached labels are served instead.
+    parallel_labelers: Optional[bool] = None
+    labeler_timeout: Optional[float] = None  # seconds
+    timings_file: Optional[str] = None  # per-cycle JSON timing dump ("" = off)
 
 
 @dataclass
@@ -147,6 +153,9 @@ class Config:
                     "machineTypeFile": self.flags.tfd.machine_type_file,
                     "withBurnin": self.flags.tfd.with_burnin,
                     "burninInterval": self.flags.tfd.burnin_interval,
+                    "parallelLabelers": self.flags.tfd.parallel_labelers,
+                    "labelerTimeout": self.flags.tfd.labeler_timeout,
+                    "timingsFile": self.flags.tfd.timings_file,
                 },
             },
             "sharing": {
@@ -229,6 +238,12 @@ def parse_config_file(path: str) -> Config:
     config.flags.tfd.with_burnin = _opt_bool(tfd.get("withBurnin"))
     if tfd.get("burninInterval") is not None:
         config.flags.tfd.burnin_interval = parse_positive_int(tfd["burninInterval"])
+    config.flags.tfd.parallel_labelers = _opt_bool(tfd.get("parallelLabelers"))
+    if tfd.get("labelerTimeout") is not None:
+        from gpu_feature_discovery_tpu.config.flags import parse_duration
+
+        config.flags.tfd.labeler_timeout = parse_duration(tfd["labelerTimeout"])
+    config.flags.tfd.timings_file = _opt_str(tfd.get("timingsFile"))
 
     config.resources = raw.get("resources", {}) or {}
     config.sharing = Sharing.from_dict(raw.get("sharing", {}) or {})

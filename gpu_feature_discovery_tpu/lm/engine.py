@@ -1,0 +1,345 @@
+"""Concurrent label-generation engine with per-labeler deadlines.
+
+The reference composes its labelers with a strictly sequential Merge
+(internal/lm/list.go:33-46), so one slow source — a metadata-server fetch,
+a sysfs PCI scan, a burn-in probe — stalls the whole cycle and delays
+every other label reaching NFD (BENCH_r05: steady-state p50 0.635 ms, but
+a burn-in cycle ~136 ms and a first probe >10 s; the tail IS the slowest
+single source). This engine replaces that merge in the daemon loop:
+
+- Each top-level labeler (timestamp, machine-type, device, health,
+  interconnect — lm/labelers.new_label_sources) becomes a named
+  ``LabelSource``. Sources that can block (file/metadata/chip I/O) run on
+  a small shared thread pool; sources declared pure-local run on the main
+  thread overlapping the workers (see LabelSource.offload).
+- Every source gets the same absolute per-cycle deadline
+  (``--labeler-timeout``, measured from cycle start — the sources run
+  concurrently, so one budget bounds them all individually AND the cycle).
+- A source that exceeds its budget is NOT awaited: the engine serves that
+  source's last-good cached labels, marks the degradation via the
+  ``google.com/tpu.tfd.stale-sources`` label, and leaves the straggler
+  running. Its result is harvested into the cache when a later cycle
+  finds it finished — the straggler is never resubmitted while in flight,
+  so a wedged source occupies exactly one pool thread, not one per cycle.
+- Merging stays ordered: results land in source-list order whatever order
+  the futures finish in, so the last-writer-wins override semantics (and
+  the golden output files) are byte-identical to the sequential merge.
+
+``--parallel-labelers=false`` bypasses all of it — sources run inline, in
+order, with no pool, no cache, and no staleness: exactly the reference's
+sequential merge, reproducing today's goldens byte for byte.
+
+The cache is engine-scoped and the daemon builds one engine per config
+epoch, so a SIGHUP reload drops every cached label — the same staleness
+contract the burn-in schedule follows (lm/health.reset_burnin_schedule).
+
+Labeler errors propagate in both modes (awaited in source order), matching
+the sequential merge's fail-the-cycle contract; only a DEADLINE miss is
+degraded to cache + staleness. A harvested straggler that failed instead
+of finishing re-raises on harvest — a slow-then-broken source must surface
+as broken, not stay silently stale forever.
+"""
+
+from __future__ import annotations
+
+import concurrent.futures
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional, Set, Tuple
+
+from gpu_feature_discovery_tpu.lm.labeler import Labeler
+from gpu_feature_discovery_tpu.lm.labels import Labels, label_safe_value
+from gpu_feature_discovery_tpu.utils import timing
+
+log = logging.getLogger("tfd.lm")
+
+# Which sources missed their deadline this cycle and are being served from
+# the last-good cache. Absent when every source was fresh, so default runs
+# (and the golden files) never see it.
+STALE_SOURCES_LABEL = "google.com/tpu.tfd.stale-sources"
+
+# Per-labeler deadline default: generous against every in-tree source's
+# worst case (the health labeler's bounded first-probe wait is 2 s, a
+# metadata-server timeout ~1 s) so staleness marks genuine degradation,
+# not routine variance. Operators bounding tails harder tune it down.
+DEFAULT_LABELER_TIMEOUT = 10.0
+
+# Label-source names joined with "_" (names themselves use "-"), because a
+# k8s label value cannot carry a comma.
+_STALE_JOIN = "_"
+
+
+@dataclass(frozen=True)
+class LabelSource:
+    """One named top-level labeler: ``produce()`` builds/probes it and the
+    engine calls ``.labels()`` on the result (accepting either a Labeler
+    or a ready Labels map — both carry .labels()).
+
+    ``offload`` declares whether the source can BLOCK (file/sysfs reads,
+    metadata HTTP, chip probes): offloaded sources run on the pool under
+    the deadline. Pure-local sources (in-memory dict math, a clock read)
+    set offload=False and run on the MAIN thread, overlapping the
+    workers: they physically cannot stall the cycle, and keeping them off
+    the pool saves a cross-thread handoff apiece — which would otherwise
+    more than double the all-fast cycle's p50 (~0.13 ms per handoff
+    against a ~0.5 ms cycle). Default True: an unknown source gets full
+    deadline protection, never silent inline trust."""
+
+    name: str
+    produce: Callable[[], Labeler]
+    offload: bool = True
+
+    def run(self) -> Labels:
+        return self.produce().labels()
+
+
+@dataclass
+class _SourceState:
+    """Engine-side bookkeeping for one source name."""
+
+    last_good: Optional[Labels] = None
+    inflight: Optional[concurrent.futures.Future] = None
+
+
+class _DaemonPool:
+    """Minimal fixed-size thread pool with DAEMON workers.
+
+    Not concurrent.futures.ThreadPoolExecutor: its workers are non-daemon
+    and its atexit hook joins them, so one wedged labeler (the exact
+    pathology the deadline exists for) would hang daemon shutdown
+    forever. These workers die with the process; an abandoned straggler
+    costs one idle thread, never a hung exit.
+
+    Capacity never starves: the engine holds at most one task per source
+    name (a straggling source is waited on, not resubmitted), so demand
+    is bounded by the source count, well under ``max_workers``.
+
+    Workers spawn ON DEMAND — a new thread only when every existing one
+    may be occupied — because the daemon builds a fresh engine (and thus
+    pool) per config epoch: a SIGHUP storm would otherwise pay a full
+    complement of thread spawns per reload, and the steady-state daemon
+    only ever needs one or two workers (offloaded sources, not all
+    sources, land here).
+    """
+
+    def __init__(self, max_workers: int, name_prefix: str = "tfd-labeler"):
+        self._q: "queue.SimpleQueue[Optional[Tuple]]" = queue.SimpleQueue()
+        self._max = max_workers
+        self._prefix = name_prefix
+        self._lock = threading.Lock()
+        self._threads: List[threading.Thread] = []
+        # Tasks submitted and not yet finished. Incremented under the
+        # lock at submit, decremented when the worker completes the task
+        # — never earlier, so the spawn check can only OVER-estimate
+        # demand (spurious spawn, capped and benign), never under-spawn
+        # and leave a queued task waiting behind a busy worker.
+        self._outstanding = 0
+
+    def submit(self, fn: Callable[[], Labels]) -> concurrent.futures.Future:
+        fut: concurrent.futures.Future = concurrent.futures.Future()
+        with self._lock:
+            self._outstanding += 1
+            if (
+                len(self._threads) < self._max
+                and self._outstanding > len(self._threads)
+            ):
+                t = threading.Thread(
+                    target=self._work,
+                    name=f"{self._prefix}-{len(self._threads)}",
+                    daemon=True,
+                )
+                t.start()
+                self._threads.append(t)
+        self._q.put((fut, fn))
+        return fut
+
+    def _work(self) -> None:
+        while True:
+            item = self._q.get()
+            if item is None:
+                return
+            fut, fn = item
+            try:
+                if not fut.set_running_or_notify_cancel():
+                    continue
+                try:
+                    fut.set_result(fn())
+                except BaseException as e:  # noqa: BLE001 - via the future
+                    fut.set_exception(e)
+            finally:
+                with self._lock:
+                    self._outstanding -= 1
+
+    def shutdown(self) -> None:
+        """Idle workers exit now; busy ones after their current task (or
+        never, if wedged — they are daemons, the process won't wait)."""
+        with self._lock:
+            threads = list(self._threads)
+        for _ in threads:
+            self._q.put(None)
+
+
+class LabelEngine:
+    """Per-config-epoch label generator. ``generate(sources)`` is the one
+    entry point; the caller rebuilds the source list every cycle (labeler
+    trees are per-cycle, as in the reference) while the engine carries the
+    cross-cycle state: pool, last-good cache, in-flight stragglers."""
+
+    def __init__(
+        self,
+        parallel: bool = True,
+        timeout_s: float = DEFAULT_LABELER_TIMEOUT,
+        max_workers: int = 8,
+    ):
+        self._parallel = parallel
+        self._timeout_s = timeout_s
+        self._max_workers = max_workers
+        self._pool: Optional[_DaemonPool] = None
+        self._state: Dict[str, _SourceState] = {}
+        self._stale_prev: Set[str] = set()
+        self._lock = threading.Lock()  # pool creation (embedder threads)
+
+    # -- public -----------------------------------------------------------
+
+    def generate(self, sources: List[LabelSource]) -> Labels:
+        if not self._parallel:
+            return self._generate_sequential(sources)
+        return self._generate_parallel(sources)
+
+    def close(self) -> None:
+        """Retire the pool at epoch end. Workers are daemon threads, so a
+        SIGHUP reload proceeds immediately while an orphaned straggler
+        finishes (or wedges) in the background without blocking exit."""
+        if self._pool is not None:
+            self._pool.shutdown()
+            self._pool = None
+
+    # -- sequential (reference parity) ------------------------------------
+
+    def _generate_sequential(self, sources: List[LabelSource]) -> Labels:
+        merged = Labels()
+        for src in sources:
+            with timing.timed(f"labeler.{src.name}"):
+                merged.update(src.run())
+        return merged
+
+    # -- parallel ----------------------------------------------------------
+
+    def _generate_parallel(self, sources: List[LabelSource]) -> Labels:
+        start = time.monotonic()
+        offloaded = [src for src in sources if src.offload]
+        futures: Dict[str, concurrent.futures.Future] = {}
+        if offloaded:
+            pool = self._ensure_pool()
+        for src in offloaded:
+            state = self._state.setdefault(src.name, _SourceState())
+            if state.inflight is not None:
+                if not state.inflight.done():
+                    # Straggler from an earlier cycle still running: wait
+                    # on IT (it may land inside this cycle's budget) and
+                    # never stack a second probe behind it.
+                    futures[src.name] = state.inflight
+                    continue
+                self._harvest(src.name, state)
+            fut = pool.submit(lambda src=src: self._run_source(src))
+            # Marked in flight from submission, not first timeout: if an
+            # earlier source's error aborts this cycle mid-collection, the
+            # next cycle must wait on THIS future, not stack a second
+            # probe behind a still-running one.
+            state.inflight = fut
+            futures[src.name] = fut
+
+        if futures:
+            # Hand the GIL to the freshly-woken workers before starting
+            # the inline work: a CPU-bound main thread would otherwise
+            # hold it for up to the 5 ms switch interval, serializing the
+            # overlap this engine exists for (measured ~0.13 ms off the
+            # steady-state cycle).
+            time.sleep(0)
+
+        # Inline sources run on the main thread while the workers churn —
+        # they declared themselves non-blocking, so they can neither
+        # stall the cycle nor go stale.
+        results: Dict[str, Labels] = {}
+        for src in sources:
+            if not src.offload:
+                with timing.timed(f"labeler.{src.name}"):
+                    results[src.name] = src.run()
+
+        stale: List[str] = []
+        for src in offloaded:
+            fut = futures[src.name]
+            state = self._state[src.name]
+            remaining = self._timeout_s - (time.monotonic() - start)
+            try:
+                labels = fut.result(timeout=max(0.0, remaining))
+            except concurrent.futures.TimeoutError:
+                stale.append(src.name)
+                labels = state.last_good if state.last_good is not None else Labels()
+            except BaseException:
+                state.inflight = None  # consumed: surfacing it this cycle
+                raise
+            else:
+                state.inflight = None
+                state.last_good = labels
+            results[src.name] = labels
+
+        merged = Labels()
+        for src in sources:
+            merged.update(results[src.name])
+        self._log_stale_transitions(stale)
+        if stale:
+            merged[STALE_SOURCES_LABEL] = label_safe_value(_STALE_JOIN.join(stale))
+        return merged
+
+    def _run_source(self, src: LabelSource) -> Labels:
+        t0 = time.perf_counter()
+        try:
+            return src.run()
+        finally:
+            timing.record(f"labeler.{src.name}", time.perf_counter() - t0)
+
+    def _harvest(self, name: str, state: _SourceState) -> None:
+        """Fold a finished straggler's result into the cache. Its error —
+        if it failed rather than finished — surfaces now: the alternative
+        is a source that is served stale forever with nobody told why."""
+        fut, state.inflight = state.inflight, None
+        state.last_good = fut.result()
+        log.info("labeler %r caught up; straggler result cached", name)
+
+    def _log_stale_transitions(self, stale: List[str]) -> None:
+        now = set(stale)
+        for name in sorted(now - self._stale_prev):
+            log.warning(
+                "labeler %r exceeded its %.3fs deadline; serving last-good "
+                "cached labels and marking %s",
+                name,
+                self._timeout_s,
+                STALE_SOURCES_LABEL,
+            )
+        for name in sorted(self._stale_prev - now):
+            log.info("labeler %r fresh again", name)
+        self._stale_prev = now
+
+    def _ensure_pool(self) -> _DaemonPool:
+        with self._lock:
+            if self._pool is None:
+                self._pool = _DaemonPool(self._max_workers)
+            return self._pool
+
+
+def new_label_engine(config) -> LabelEngine:
+    """Engine from the daemon config (--parallel-labelers,
+    --labeler-timeout). One per config epoch — build it where the manager
+    is built, close it when the epoch ends."""
+    tfd = config.flags.tfd
+    parallel = tfd.parallel_labelers if tfd.parallel_labelers is not None else True
+    timeout = (
+        tfd.labeler_timeout
+        if tfd.labeler_timeout is not None
+        else DEFAULT_LABELER_TIMEOUT
+    )
+    return LabelEngine(parallel=parallel, timeout_s=timeout)

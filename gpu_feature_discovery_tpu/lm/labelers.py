@@ -6,15 +6,25 @@ host-interconnect labeler (the vGPU analog: multi-host slice metadata from
 the TPU VM environment — SURVEY.md section 5 "distributed communication
 backend" row). The timestamp labeler is merged in by the daemon loop, as in
 run() (main.go:158-168).
+
+Two composition surfaces over the same parts:
+
+- ``new_labelers`` — the reference's eager Merge (tests, embedders, the
+  sequential semantics).
+- ``new_label_sources`` — the same labelers as an ORDERED list of named
+  sources for the label engine (lm/engine.py), which runs them
+  concurrently with per-labeler deadlines in the daemon loop. List order
+  is merge order, so both surfaces produce identical label maps.
 """
 
 from __future__ import annotations
 
-from typing import Optional
+from typing import List, Optional
 
 from gpu_feature_discovery_tpu.config.spec import Config
+from gpu_feature_discovery_tpu.lm.engine import LabelSource
 from gpu_feature_discovery_tpu.lm.labeler import Empty, Labeler, Merge
-from gpu_feature_discovery_tpu.lm.tpu import new_tpu_labeler
+from gpu_feature_discovery_tpu.lm.tpu import new_tpu_labeler, tpu_label_sources
 from gpu_feature_discovery_tpu.resource.types import Manager
 
 
@@ -23,3 +33,34 @@ def new_labelers(
 ) -> Labeler:
     tpu_labeler = new_tpu_labeler(manager, config)
     return Merge(tpu_labeler, interconnect if interconnect is not None else Empty())
+
+
+def new_label_sources(
+    manager: Manager,
+    interconnect: Optional[Labeler],
+    config: Config,
+    timestamp: Optional[Labeler] = None,
+) -> List[LabelSource]:
+    """Every top-level labeler as a named engine source, in merge order:
+    timestamp, then the device-backed sources (machine-type, device,
+    health — chip-gated), then interconnect, which deliberately merges
+    last so its host-metadata facts override e.g. the DMI machine type
+    (lm/machine_type.py rationale).
+
+    Calls ``manager.init()`` (errors propagate exactly as the eager
+    path's); the caller owns ``manager.shutdown()`` after the sources
+    have run — in the daemon loop that is after ``engine.generate``.
+    """
+    from gpu_feature_discovery_tpu.utils.timing import timed
+
+    sources: List[LabelSource] = []
+    if timestamp is not None:
+        ts = timestamp
+        # A clock read: nothing to block on, so inline (engine rationale).
+        sources.append(LabelSource("timestamp", lambda: ts, offload=False))
+    with timed("tpu.init"):
+        manager.init()
+    sources.extend(tpu_label_sources(manager, config))
+    ic = interconnect if interconnect is not None else Empty()
+    sources.append(LabelSource("interconnect", lambda: ic))
+    return sources

@@ -57,13 +57,36 @@ class Labels(dict):
 
     def write_to_file(self, path: str) -> None:
         """Write labels to ``path`` atomically; empty path → stdout
-        (labels.go:37-52)."""
+        (labels.go:37-52).
+
+        Churn-free: when the serialized content is byte-identical to the
+        current output file, the write is skipped entirely — no staging
+        file, no rename, mtime untouched. NFD's "local" source re-parses
+        the feature file on every change event; the reference renames
+        unconditionally every cycle, waking NFD each sleep interval for
+        labels that did not change. Returns are indistinguishable to the
+        caller: the file's contents are the requested labels either way.
+        """
         if not path:
             self.write_to(sys.stdout)
             return
         buf = io.StringIO()
         self.write_to(buf)
-        _write_file_atomically(path, buf.getvalue().encode(), OUTPUT_MODE)
+        contents = buf.getvalue().encode()
+        if _file_contents_equal(path, contents):
+            return
+        _write_file_atomically(path, contents, OUTPUT_MODE)
+
+
+def _file_contents_equal(path: str, contents: bytes) -> bool:
+    """True when ``path`` already holds exactly ``contents``. Any read
+    failure (missing file, permission change, race with a concurrent
+    writer) reports False — the safe answer is always "write it"."""
+    try:
+        with open(path, "rb") as f:
+            return f.read() == contents
+    except OSError:
+        return False
 
 
 def _write_file_atomically(path: str, contents: bytes, perm: int) -> None:

@@ -153,14 +153,25 @@ def _stop_trace_durations(tmp: str) -> DeviceDurations:
     export, no chrome-trace conversion. The session internals are private
     jax API, so ANY failure before the session is stopped falls back to
     the public ``stop_trace`` + on-disk parse — behavior-identical, just
-    slower. A failure AFTER the in-memory stop succeeded (xspace parse
-    error) propagates to the caller, which treats the probe as transient.
+    slower. The ENTIRE in-memory path is therefore verified up front —
+    including ``jax.profiler.ProfileData.from_serialized_xspace``, which
+    is only needed AFTER the stop: on a jax build whose private stop
+    works but lacks ProfileData, discovering that post-stop would raise
+    every probing cycle and burn the caller's bounded transient-failure
+    budget down to a permanent wall-clock downgrade (ADVICE r5 #1). A
+    failure AFTER the in-memory stop succeeded (xspace parse error)
+    propagates to the caller, which treats the probe as transient.
     """
     import jax
 
     try:
         from jax._src import profiler as _prof
 
+        profile_data = getattr(jax.profiler, "ProfileData", None)
+        if getattr(profile_data, "from_serialized_xspace", None) is None:
+            raise RuntimeError(
+                "jax.profiler.ProfileData.from_serialized_xspace unavailable"
+            )
         state = _prof._profile_state
         with state.lock:
             sess = state.profile_session
@@ -174,7 +185,7 @@ def _stop_trace_durations(tmp: str) -> DeviceDurations:
         jax.profiler.stop_trace()
         return parse_trace_durations(tmp)
     return parse_profile_data_durations(
-        jax.profiler.ProfileData.from_serialized_xspace(data)
+        profile_data.from_serialized_xspace(data)
     )
 
 

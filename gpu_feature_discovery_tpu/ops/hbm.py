@@ -37,6 +37,19 @@ LANES = 128          # last dim is always 128 on TPU
 CHUNK_ROWS = 512     # (512, 128) f32 = 256 KiB per slot
 N_BUFFERS = 4        # 4 slots = 1 MiB VMEM; depth hides DMA latency
 
+# Stream-buffer fill pattern: every element of chunk c holds
+# ``1 + c % _PATTERN_PERIOD``, so the checksum detects a DMA slot being
+# read early/late/twice in the 4-deep pipeline — an all-ones buffer sums
+# identically whichever chunk a slot actually carried, validating byte
+# COUNT but not ordering (ADVICE r5 #2). The period is coprime with
+# N_BUFFERS so any slot slip smaller than the period (including the
+# realistic ±N_BUFFERS aliasing cases) lands on a different value, and
+# small enough that every partial sum stays an exact f32 integer: chunk
+# sums are k*CHUNK_ROWS*LANES = k*2^16 with k <= 7, and the running total
+# is m*2^16 with m <= 7*num_chunks — far below the 2^24 mantissa bound at
+# any probe size this module builds (256 MiB = 1024 chunks -> m <= 7168).
+_PATTERN_PERIOD = 7
+
 
 def _bandwidth_kernel(hbm_ref, out_ref):
     """Stream hbm_ref (rows, LANES) through VMEM in CHUNK_ROWS chunks
@@ -120,6 +133,26 @@ def _jitted_stream_sum(interpret: bool):
     return jax.jit(hbm_probe)
 
 
+def stream_pattern(rows: int) -> jax.Array:
+    """The (rows, LANES) per-chunk-distinct probe buffer: iota-derived
+    chunk index mod _PATTERN_PERIOD, plus one. THE single construction
+    both the resident workspace and ad-hoc buffers use, so the checksum
+    gate (expected_stream_sum) can never disagree with the contents."""
+    chunk = jax.lax.broadcasted_iota(jnp.int32, (rows, LANES), 0) // CHUNK_ROWS
+    return (1 + chunk % _PATTERN_PERIOD).astype(jnp.float32)
+
+
+def expected_stream_sum(rows: int) -> float:
+    """Exact f32 sum of stream_pattern(rows) — integer math on the host,
+    exactly representable on the device (pattern-period rationale above).
+    The checksum gate for BOTH timing paths (ops/healthcheck.py and
+    measure_hbm_bandwidth below)."""
+    num_chunks = rows // CHUNK_ROWS
+    return float(
+        sum(1 + c % _PATTERN_PERIOD for c in range(num_chunks)) * CHUNK_ROWS * LANES
+    )
+
+
 @functools.lru_cache(maxsize=None)
 def stream_workspace(device, rows: int) -> jax.Array:
     """Per-device HBM stream buffer, created ON the device once per
@@ -129,9 +162,11 @@ def stream_workspace(device, rows: int) -> jax.Array:
     healthcheck._burnin_workspace: fresh per-cycle allocation costs
     ~30 ms of transport overhead, and TPU chips are single-tenant so the
     buffer contends with nobody. Shared by the traced probe and the
-    wall-clock fallback."""
+    wall-clock fallback. Lifetime is tied to the held PJRT client:
+    healthcheck.reset_probe_workspaces clears this cache when a backend
+    genuinely releases its client (JaxManager.release)."""
     with jax.default_device(device):
-        buf = jnp.ones((rows, LANES), jnp.float32)
+        buf = stream_pattern(rows)
     return jax.device_put(buf, device)
 
 
@@ -166,7 +201,7 @@ def measure_hbm_bandwidth(
         # design exists to avoid.
         buf = stream_workspace(device, rows)
     else:
-        buf = jnp.ones((rows, LANES), jnp.float32)
+        buf = stream_pattern(rows)
     fn = _jitted_stream_sum(interpret)
     total = jax.block_until_ready(fn(buf))  # compile + warm
     samples = []
@@ -181,6 +216,6 @@ def measure_hbm_bandwidth(
         "gbps": buf.nbytes / sec / 2**30,
         "seconds": sec,
         "bytes": buf.nbytes,
-        "checksum_ok": bool(total[0, 0] == rows * LANES),
+        "checksum_ok": bool(total[0, 0] == expected_stream_sum(rows)),
         "interpreted": interpret,
     }

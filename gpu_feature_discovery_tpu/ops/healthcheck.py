@@ -255,6 +255,27 @@ def _jitted_health_pack():
 _warmed_probe_keys: set = set()
 
 
+def reset_probe_workspaces() -> None:
+    """Drop every per-device probe cache: the resident burn-in inputs,
+    the HBM stream buffers (ops/hbm.stream_workspace), and the warmed-
+    kernel memo keyed on device objects.
+
+    These caches are keyed by jax Device objects and hold ~300 MiB of
+    device arrays per chip; their validity rests on the owning PJRT
+    client staying alive (JaxManager holds it for the process lifetime,
+    so the steady state never calls this). A backend that genuinely
+    RELEASES its client must call this first — entries referencing arrays
+    on a destroyed client would leak and poison any future client whose
+    Device objects happen to compare equal (ADVICE r5 #3). Invoked from
+    JaxManager.release, mirroring reset_device_clock_state's lifecycle.
+    """
+    from gpu_feature_discovery_tpu.ops.hbm import stream_workspace
+
+    stream_workspace.cache_clear()
+    _burnin_workspace.cache_clear()
+    _warmed_probe_keys.clear()
+
+
 def _warm_probe_kernels(
     devices: tuple, size: int, depth: int, dtype, hbm_mib: int
 ) -> float:
@@ -330,6 +351,7 @@ def _measure_node_health_traced(
         HBM_KERNEL_NAME,
         LANES,
         _jitted_stream_sum,
+        expected_stream_sum,
         probe_rows,
         stream_workspace,
     )
@@ -409,9 +431,11 @@ def _measure_node_health_traced(
     healthy = all(
         bool(np.isfinite(p[0])) and bool(np.isfinite(p[1])) for p in packed
     )
-    # Sum-of-ones checksum: exact in f32 because each chunk adds 65536
-    # (CHUNK_ROWS*LANES), a multiple of the float spacing up to 2^32.
-    checksum_ok = all(float(p[2]) == rows * LANES for p in packed)
+    # Per-chunk-distinct checksum (hbm.stream_pattern): exact in f32 —
+    # every partial sum is an integer multiple of 2^16 below the mantissa
+    # bound — and sensitive to a DMA slot read early/late/twice, which a
+    # sum-of-ones buffer could never see (ADVICE r5 #2).
+    checksum_ok = all(float(p[2]) == expected_stream_sum(rows) for p in packed)
     return {
         "healthy": healthy,
         "tflops": tflops,

@@ -144,8 +144,37 @@ class JaxManager(Manager):
     def shutdown(self) -> None:
         # Deliberate no-op: dropping the PJRT client mid-run would release
         # and re-seize the TPU every cycle (nvml.Shutdown analog does not
-        # apply; see module docstring).
+        # apply; see module docstring). INVARIANT: the probe workspace
+        # caches (ops/healthcheck.py — keyed by this client's Device
+        # objects, ~300 MiB of device arrays per chip) rely on the client
+        # outliving them; any future lifecycle that actually drops the
+        # client must go through release() below, which clears them first.
         pass
+
+    def release(self) -> None:
+        """Genuinely relinquish the backend: clear the per-device probe
+        caches keyed on this client's Device objects, then drop the held
+        device handles so the PJRT client can be garbage-collected.
+
+        NOT called by the daemon loop (shutdown above stays a no-op by
+        design); this is the hook for embedders and future multi-backend
+        lifecycles that recreate clients — without it, cache entries
+        referencing arrays on a destroyed client would leak for the
+        process lifetime (ADVICE r5 #3; mirrors reset_device_clock_state).
+        """
+        import sys
+
+        # Only touch the caches when the probe module was ever imported —
+        # importing jax machinery just to clear empty caches is waste.
+        healthcheck = sys.modules.get(
+            "gpu_feature_discovery_tpu.ops.healthcheck"
+        )
+        if healthcheck is not None:
+            healthcheck.reset_probe_workspaces()
+        self._devices = None
+        self._all_devices = []
+        self._slice_topology = ""
+        self._driver_version = None
 
     def _resolve_slice_topology(self) -> str:
         """Topology of the slice the local chips are provisioned into;

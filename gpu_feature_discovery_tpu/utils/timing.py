@@ -3,10 +3,24 @@
 The reference has no tracing at all (SURVEY.md section 5); we add a light
 per-stage timer to prove the <100ms label-generation p50 target from
 BASELINE.json, logged at debug level and queryable by bench.py.
+
+Stages are recorded into one flat ``last_durations`` map (most recent
+duration per named span). The daemon loop clears it at cycle start
+(``reset_cycle``) and reads it back two ways after each cycle:
+``cycle_summary()`` renders one log line for operators tailing the pod,
+and ``write_timings_file()`` dumps the same spans as JSON for scrapers
+(gated by ``--timings-file``). Writers are the labeling path only — the
+engine's worker threads and the sequential merge — and a plain dict
+assignment/clear is a single atomic C-level operation under the GIL, so
+no lock; READERS must snapshot via ``dict(last_durations)`` (also one
+C-level op) before iterating — a straggling labeler can finish and
+insert its span at any moment, and a Python-level iteration would die
+with "dictionary changed size during iteration".
 """
 
 from __future__ import annotations
 
+import json
 import logging
 import time
 from contextlib import contextmanager
@@ -18,12 +32,56 @@ log = logging.getLogger("tfd.timing")
 last_durations: Dict[str, float] = {}
 
 
+def record(stage: str, elapsed: float) -> None:
+    """Record a named span's duration (seconds). The engine's parallel
+    path measures futures directly and records here; the sequential path
+    goes through ``timed``. Same map either way, so the cycle summary and
+    timings file are mode-agnostic."""
+    last_durations[stage] = elapsed
+    log.debug("stage %s took %.3f ms", stage, elapsed * 1e3)
+
+
 @contextmanager
 def timed(stage: str) -> Iterator[None]:
     start = time.perf_counter()
     try:
         yield
     finally:
-        elapsed = time.perf_counter() - start
-        last_durations[stage] = elapsed
-        log.debug("stage %s took %.3f ms", stage, elapsed * 1e3)
+        record(stage, time.perf_counter() - start)
+
+
+def reset_cycle() -> None:
+    """Forget every recorded span. The daemon calls this at cycle start
+    so the summary and timings file report only spans that actually ran
+    since — a cached-health cycle must not re-report the last probe's
+    cost as if it were fresh, and a deadline-missed labeler contributes
+    no span until it actually finishes."""
+    last_durations.clear()
+
+
+def cycle_summary() -> str:
+    """One-line ``stage=N.NNNms`` rendering of every recorded span, the
+    total first — the per-cycle observability line the daemon logs
+    (docs/operations.md)."""
+    snapshot = dict(last_durations)  # module-docstring reader contract
+    items = sorted(
+        snapshot.items(), key=lambda kv: (kv[0] != "labelgen.total", kv[0])
+    )
+    return " ".join(f"{k}={v * 1e3:.3f}ms" for k, v in items)
+
+
+def write_timings_file(path: str) -> None:
+    """Dump the recorded spans as ``{"stages_ms": {stage: ms}}`` JSON for
+    scraping (--timings-file). Atomic rename via the same staging scheme
+    as the label file, so a scraper never reads a torn document; failures
+    are logged, never fatal — timings are observability, not labels."""
+    if not path:
+        return
+    from gpu_feature_discovery_tpu.lm.labels import _write_file_atomically
+
+    snapshot = dict(last_durations)  # module-docstring reader contract
+    doc = {"stages_ms": {k: round(v * 1e3, 3) for k, v in snapshot.items()}}
+    try:
+        _write_file_atomically(path, json.dumps(doc, sort_keys=True).encode(), 0o644)
+    except OSError as e:
+        log.warning("cannot write timings file %s: %s", path, e)

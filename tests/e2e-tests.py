@@ -128,12 +128,14 @@ def gc_sweep(client):
     cleans them up). Mirrors upstream nfd-gc's sweep with the same RBAC
     surface the chart grants it (charts/node-feature-discovery/
     templates/gc.yml): list nodes, list nodefeatures across namespaces,
-    delete the orphans. The owning node comes from the
+    delete the orphans. The owning node comes SOLELY from the
     ``nfd.node-feature-discovery/node-name`` label — the NFD API's
     binding, which third-party feature publishers use with arbitrary
-    object names — with the object name as fallback (the convention the
-    default worker follows). Returns the (namespace, name) pairs
-    collected."""
+    object names. An object without the label is kept, never collected:
+    upstream nfd-gc keys liveness off the label alone, and falling back
+    to the object name would delete a third-party NodeFeature whose
+    arbitrary name matches no node (ADVICE r5 #4). Returns the
+    (namespace, name) pairs collected."""
     live = {
         n["metadata"]["name"]
         for n in client.get("/api/v1/nodes").get("items", [])
@@ -145,8 +147,8 @@ def gc_sweep(client):
     for nf in features:
         meta = nf.get("metadata", {})
         name, ns = meta.get("name"), meta.get("namespace", "default")
-        node = (meta.get("labels") or {}).get(NODE_NAME_LABEL, name)
-        if node in live:
+        node = (meta.get("labels") or {}).get(NODE_NAME_LABEL)
+        if node is None or node in live:
             continue
         client.delete(
             f"/apis/nfd.k8s-sigs.io/v1alpha1/namespaces/{ns}"

@@ -21,7 +21,12 @@ from gpu_feature_discovery_tpu.ops.device_timing import (
     parse_trace_durations,
     profile_device_durations,
 )
-from gpu_feature_discovery_tpu.ops.hbm import CHUNK_ROWS, LANES, probe_rows
+from gpu_feature_discovery_tpu.ops.hbm import (
+    CHUNK_ROWS,
+    LANES,
+    expected_stream_sum,
+    probe_rows,
+)
 
 
 def _write_trace(tmp_path, events):
@@ -127,7 +132,7 @@ def test_traced_rates_are_bytes_and_flops_over_median(monkeypatch):
     bytes/median(device durs), median across iters, worst chip wins."""
     hbm_mib = 1
     rows = probe_rows(hbm_mib)
-    good = np.array([1.0, 1.0, float(rows * LANES)], np.float32)
+    good = np.array([1.0, 1.0, expected_stream_sum(rows)], np.float32)
     durs = {
         # Two "chips": chip 1 is 2x slower on both axes -> it governs.
         "burnin_step": {
@@ -159,7 +164,7 @@ def test_traced_rates_are_bytes_and_flops_over_median(monkeypatch):
 def test_traced_checksum_mismatch_suppresses_hbm(monkeypatch):
     hbm_mib = 1
     rows = probe_rows(hbm_mib)
-    bad = np.array([1.0, 1.0, float(rows * LANES - 1)], np.float32)
+    bad = np.array([1.0, 1.0, expected_stream_sum(rows) - 1.0], np.float32)
     durs = {
         "burnin_step": {"/device:TPU:0": [10e-6]},
         "hbm_probe": {"/device:TPU:0": [100e-6]},
@@ -179,7 +184,7 @@ def test_traced_checksum_mismatch_suppresses_hbm(monkeypatch):
 def test_traced_nonfinite_checksum_is_unhealthy(monkeypatch):
     hbm_mib = 1
     rows = probe_rows(hbm_mib)
-    naned = np.array([np.nan, 1.0, float(rows * LANES)], np.float32)
+    naned = np.array([np.nan, 1.0, expected_stream_sum(rows)], np.float32)
     durs = {
         "burnin_step": {"/device:TPU:0": [10e-6]},
         "hbm_probe": {"/device:TPU:0": [100e-6]},
@@ -224,7 +229,7 @@ def test_traced_missing_iterations_is_transient(monkeypatch):
     # median would be biased toward whichever iters survived -> refuse.
     hbm_mib = 1
     rows = probe_rows(hbm_mib)
-    good = np.array([1.0, 1.0, float(rows * LANES)], np.float32)
+    good = np.array([1.0, 1.0, expected_stream_sum(rows)], np.float32)
     durs = {
         "burnin_step": {"/device:TPU:0": [10e-6]},  # 1 event, 3 dispatched
         "hbm_probe": {"/device:TPU:0": [100e-6, 100e-6]},
@@ -355,7 +360,7 @@ def test_traced_partial_plane_coverage_falls_back(monkeypatch):
     # must refuse (worst-chip-wins contract) and let wall-clock time all.
     hbm_mib = 1
     rows = probe_rows(hbm_mib)
-    good = np.array([1.0, 1.0, float(rows * LANES)], np.float32)
+    good = np.array([1.0, 1.0, expected_stream_sum(rows)], np.float32)
     durs = {
         "burnin_step": {"/device:TPU:0": [10e-6]},
         "hbm_probe": {"/device:TPU:0": [100e-6]},
@@ -371,7 +376,8 @@ def test_traced_partial_plane_coverage_falls_back(monkeypatch):
 
 
 def test_probe_rows_geometry():
-    # The checksum gate compares against rows*LANES: whole chunks only,
+    # The checksum gate compares against expected_stream_sum(rows):
+    # whole chunks only,
     # never exceeding the requested size (above the one-chunk minimum).
     for mib in (1, 64, 256):
         rows = probe_rows(mib)
@@ -386,7 +392,7 @@ def test_one_kernel_wholly_missing_is_transient_not_permanent(monkeypatch):
     a single race must not cost the process its device clock forever."""
     hbm_mib = 1
     rows = probe_rows(hbm_mib)
-    good = np.array([1.0, 1.0, float(rows * LANES)], np.float32)
+    good = np.array([1.0, 1.0, expected_stream_sum(rows)], np.float32)
     durs = {"burnin_step": {"/device:TPU:0": [10e-6]}}  # hbm_probe dropped
     monkeypatch.setattr(
         device_timing, "profile_device_durations", _fake_profile([good], durs)
@@ -460,4 +466,45 @@ def test_stop_falls_back_to_export_when_in_memory_unavailable(tmp_path, monkeypa
     ]
     durs = device_timing._stop_trace_durations(_write_trace(tmp_path, events))
     assert stopped == [1]
+    assert durs == {"burnin_step": {"/device:TPU:0": [30e-6]}}
+
+
+def test_stop_falls_back_pre_stop_when_profile_data_missing(tmp_path, monkeypatch):
+    """ADVICE r5 #1: on a jax build whose private session stop WORKS but
+    which lacks jax.profiler.ProfileData, the public fallback must be
+    taken BEFORE the session is stopped — discovering the missing parser
+    post-stop would raise every probing cycle and burn the bounded
+    transient budget into a permanent wall-clock downgrade, even though
+    the export path works fine."""
+    import threading
+
+    import jax.profiler as jprof
+
+    private_stops = []
+
+    class _Session:
+        def stop(self):
+            private_stops.append(1)
+            return b"xspace"
+
+    class _State:
+        profile_session = _Session()
+        lock = threading.Lock()
+
+        def reset(self):
+            pass
+
+    from jax._src import profiler as _prof
+
+    monkeypatch.setattr(_prof, "_profile_state", _State())
+    monkeypatch.delattr(jprof, "ProfileData", raising=False)
+    public_stops = []
+    monkeypatch.setattr(jprof, "stop_trace", lambda: public_stops.append(1))
+    events = [
+        {"ph": "M", "pid": 3, "name": "process_name", "args": {"name": "/device:TPU:0"}},
+        {"ph": "X", "pid": 3, "name": "jit_burnin_step(1)", "dur": 30},
+    ]
+    durs = device_timing._stop_trace_durations(_write_trace(tmp_path, events))
+    assert private_stops == [], "private stop must not run without ProfileData"
+    assert public_stops == [1]
     assert durs == {"burnin_step": {"/device:TPU:0": [30e-6]}}

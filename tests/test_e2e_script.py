@@ -915,26 +915,36 @@ def test_gc_sweep_collects_orphaned_nodefeatures(tmp_path):
     list/delete nodefeatures)."""
     ns = "node-feature-discovery"
     api = FakeKubeApi({"fake-node-1": "/dev/null", "fake-node-2": "/dev/null"})
-    # The default worker names its object after the node (no label);
-    # a third-party publisher uses an arbitrary name + the node-name
-    # label. Both bindings must be honored (real nfd-gc matches by
-    # label): "extra-features" belongs to the LIVE node despite its
-    # non-node name, "departed-extras" to the one about to be deleted.
-    api.nodefeatures[(ns, "fake-node-1")] = _nodefeature(ns, "fake-node-1")
-    api.nodefeatures[(ns, "fake-node-2")] = _nodefeature(ns, "fake-node-2")
+    # Liveness is keyed SOLELY off the node-name label (upstream nfd-gc
+    # semantics, ADVICE r5 #4): the worker labels its per-node objects,
+    # a third-party publisher uses an arbitrary name + the label
+    # ("extra-features" belongs to the LIVE node despite its non-node
+    # name, "departed-extras" to the one about to be deleted), and an
+    # object WITHOUT the label ("vendor-telemetry") is out of gc's
+    # jurisdiction entirely — kept through every sweep, never treated as
+    # orphaned just because its name matches no node.
+    api.nodefeatures[(ns, "fake-node-1")] = _nodefeature(
+        ns, "fake-node-1", node="fake-node-1"
+    )
+    api.nodefeatures[(ns, "fake-node-2")] = _nodefeature(
+        ns, "fake-node-2", node="fake-node-2"
+    )
     api.nodefeatures[(ns, "extra-features")] = _nodefeature(
         ns, "extra-features", node="fake-node-1"
     )
     api.nodefeatures[(ns, "departed-extras")] = _nodefeature(
         ns, "departed-extras", node="fake-node-2"
     )
+    api.nodefeatures[(ns, "vendor-telemetry")] = _nodefeature(
+        ns, "vendor-telemetry"
+    )
     kubeconfig = write_kubeconfig(tmp_path, api.url)
     try:
         # Steady state: both nodes live, nothing to collect.
         result = _run_gc_sweep(tmp_path, kubeconfig)
         assert result.returncode == 0, result.stderr
-        assert "0 collected, 4 kept, 2 live nodes" in result.stdout
-        assert len(api.nodefeatures) == 4
+        assert "0 collected, 5 kept, 2 live nodes" in result.stdout
+        assert len(api.nodefeatures) == 5
 
         # Node churn: fake-node-2 is deleted (autoscaler scale-down).
         from k8s_stdlib import KubeClient
@@ -948,15 +958,16 @@ def test_gc_sweep_collects_orphaned_nodefeatures(tmp_path):
             f"Collected orphaned NodeFeature {ns}/fake-node-2"
             in result.stdout
         )
-        assert "2 collected, 2 kept, 1 live nodes" in result.stdout
+        assert "2 collected, 3 kept, 1 live nodes" in result.stdout
         assert set(api.nodefeatures) == {
             (ns, "fake-node-1"),
             (ns, "extra-features"),
-        }, "the live node's NodeFeatures must survive the sweep"
+            (ns, "vendor-telemetry"),
+        }, "live-node and label-less NodeFeatures must survive the sweep"
 
         # Idempotence: a second sweep finds nothing.
         result = _run_gc_sweep(tmp_path, kubeconfig)
         assert result.returncode == 0, result.stderr
-        assert "0 collected, 2 kept, 1 live nodes" in result.stdout
+        assert "0 collected, 3 kept, 1 live nodes" in result.stdout
     finally:
         api.shutdown()

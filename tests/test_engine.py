@@ -1,0 +1,246 @@
+"""Label-engine contract: per-labeler deadlines, stale-label caching,
+straggler harvesting, sequential-bypass parity, and the churn-free write
+path (the ISSUE 1 tentpole acceptance)."""
+
+import os
+import threading
+import time
+
+import pytest
+
+from gpu_feature_discovery_tpu.lm.engine import (
+    DEFAULT_LABELER_TIMEOUT,
+    STALE_SOURCES_LABEL,
+    LabelEngine,
+    LabelSource,
+    new_label_engine,
+)
+from gpu_feature_discovery_tpu.lm.labels import Labels
+
+
+def src(name, fn):
+    return LabelSource(name, lambda: Labels(fn()) if callable(fn) else Labels(fn))
+
+
+class GatedLabeler:
+    """Labeler blocked on an event, with a call counter."""
+
+    def __init__(self, labels):
+        self._labels = labels
+        self.release = threading.Event()
+        self.calls = 0
+
+    def labels(self):
+        self.calls += 1
+        assert self.release.wait(10), "test gate never released"
+        return Labels(self._labels)
+
+
+def wait_done(engine, name, timeout=5.0):
+    """Wait until the engine's straggler future for ``name`` completed."""
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        state = engine._state.get(name)
+        if state is not None and state.inflight is not None and state.inflight.done():
+            return
+        time.sleep(0.01)
+    raise AssertionError(f"straggler {name!r} never finished")
+
+
+# ---------------------------------------------------------------------------
+# ordering + parity
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("parallel", [False, True])
+def test_merge_order_is_source_order(parallel):
+    """Later sources override earlier keys — identical to lm.labeler.Merge
+    — and key insertion order (the serialized line order) matches the
+    sequential merge in BOTH modes."""
+    engine = LabelEngine(parallel=parallel, timeout_s=5.0)
+    sources = [
+        src("a", {"k": "a", "a.only": "1"}),
+        src("b", {"k": "b", "b.only": "1"}),
+    ]
+    merged = engine.generate(sources)
+    engine.close()
+    assert merged == {"k": "b", "a.only": "1", "b.only": "1"}
+    assert list(merged) == ["k", "a.only", "b.only"]
+
+
+@pytest.mark.parametrize("parallel", [False, True])
+def test_labeler_errors_propagate(parallel):
+    engine = LabelEngine(parallel=parallel, timeout_s=5.0)
+
+    def boom():
+        raise RuntimeError("probe died")
+
+    with pytest.raises(RuntimeError, match="probe died"):
+        engine.generate([src("ok", {}), LabelSource("bad", boom)])
+    engine.close()
+
+
+def test_no_stale_label_when_all_fresh():
+    engine = LabelEngine(parallel=True, timeout_s=5.0)
+    merged = engine.generate([src("a", {"x": "1"})])
+    engine.close()
+    assert STALE_SOURCES_LABEL not in merged
+
+
+# ---------------------------------------------------------------------------
+# deadlines + stale cache + harvesting
+# ---------------------------------------------------------------------------
+
+def test_deadline_serves_cache_and_marks_stale():
+    engine = LabelEngine(parallel=True, timeout_s=0.1)
+    slow = GatedLabeler({"slow.k": "v1"})
+    fast = {"fast.k": "1"}
+    try:
+        # Cycle 1: seed the cache (gate open -> fresh).
+        slow.release.set()
+        merged = engine.generate(
+            [src("fast", fast), LabelSource("slow", lambda: slow)]
+        )
+        assert merged == {"fast.k": "1", "slow.k": "v1"}
+
+        # Cycle 2: the source wedges -> its LAST-GOOD labels are served,
+        # the stale marker names it, and the fast source stays live.
+        slow.release.clear()
+        t0 = time.monotonic()
+        merged = engine.generate(
+            [src("fast", fast), LabelSource("slow", lambda: slow)]
+        )
+        elapsed = time.monotonic() - t0
+        assert merged["slow.k"] == "v1", "cached labels must be served"
+        assert merged["fast.k"] == "1"
+        assert merged[STALE_SOURCES_LABEL] == "slow"
+        assert elapsed < 2.0, "cycle must be bounded near the deadline"
+    finally:
+        slow.release.set()
+        engine.close()
+
+
+def test_straggler_not_resubmitted_and_harvested_next_cycle():
+    engine = LabelEngine(parallel=True, timeout_s=0.1)
+    slow = GatedLabeler({"slow.k": "fresh"})
+    try:
+        # Cycle 1: no cache yet -> the source contributes nothing, is
+        # marked stale, and its probe keeps running.
+        merged = engine.generate([LabelSource("slow", lambda: slow)])
+        assert "slow.k" not in merged
+        assert merged[STALE_SOURCES_LABEL] == "slow"
+        assert slow.calls == 1
+
+        # Cycles 2..3 while still wedged: served from (empty) cache, and
+        # the in-flight probe is NEVER stacked with a second one.
+        for _ in range(2):
+            merged = engine.generate([LabelSource("slow", lambda: slow)])
+            assert merged[STALE_SOURCES_LABEL] == "slow"
+        assert slow.calls == 1, "straggler must not be resubmitted while running"
+
+        # The straggler finishes between cycles; the next cycle harvests
+        # its result into the cache and probes fresh again.
+        slow.release.set()
+        wait_done(engine, "slow")
+        merged = engine.generate([LabelSource("slow", lambda: slow)])
+        assert merged["slow.k"] == "fresh"
+        assert STALE_SOURCES_LABEL not in merged
+        assert slow.calls == 2, "post-harvest cycle probes fresh"
+    finally:
+        slow.release.set()
+        engine.close()
+
+
+def test_multiple_stale_sources_join_with_underscore():
+    engine = LabelEngine(parallel=True, timeout_s=0.05)
+    a, b = GatedLabeler({}), GatedLabeler({})
+    try:
+        merged = engine.generate(
+            [LabelSource("health", lambda: a), LabelSource("interconnect", lambda: b)]
+        )
+        assert merged[STALE_SOURCES_LABEL] == "health_interconnect"
+    finally:
+        a.release.set()
+        b.release.set()
+        engine.close()
+
+
+def test_inline_sources_run_on_main_thread_and_never_stale():
+    """offload=False declares a pure-local source: it executes on the
+    calling thread (no pool handoff), overlapping the workers, and is
+    exempt from deadlines — it cannot block by contract."""
+    engine = LabelEngine(parallel=True, timeout_s=0.05)
+    seen_threads = []
+
+    def local():
+        seen_threads.append(threading.current_thread())
+        return Labels({"local.k": "1"})
+
+    slow = GatedLabeler({})
+    try:
+        merged = engine.generate(
+            [
+                LabelSource("local", local, offload=False),
+                LabelSource("slow", lambda: slow),
+            ]
+        )
+        assert merged["local.k"] == "1"
+        assert merged[STALE_SOURCES_LABEL] == "slow"  # only the offloaded one
+        assert seen_threads == [threading.main_thread()]
+    finally:
+        slow.release.set()
+        engine.close()
+
+
+def test_sequential_mode_never_marks_stale():
+    """parallel=false is the reference semantics: no pool, no deadline,
+    the cycle simply waits (and the goldens stay byte-identical)."""
+    engine = LabelEngine(parallel=False, timeout_s=0.01)
+    slow = GatedLabeler({"slow.k": "v"})
+    slow.release.set()
+    merged = engine.generate([LabelSource("slow", lambda: slow)])
+    engine.close()
+    assert merged == {"slow.k": "v"}
+    assert STALE_SOURCES_LABEL not in merged
+
+
+def test_new_label_engine_reads_config():
+    from gpu_feature_discovery_tpu.config.flags import new_config
+
+    engine = new_label_engine(new_config())
+    assert engine._parallel is True
+    assert engine._timeout_s == DEFAULT_LABELER_TIMEOUT
+    engine.close()
+
+    engine = new_label_engine(
+        new_config(
+            cli_values={"parallel-labelers": "false", "labeler-timeout": "250ms"}
+        )
+    )
+    assert engine._parallel is False
+    assert engine._timeout_s == pytest.approx(0.25)
+    engine.close()
+
+
+# ---------------------------------------------------------------------------
+# churn-free writes
+# ---------------------------------------------------------------------------
+
+def test_write_to_file_skips_unchanged_content(tmp_path):
+    out = tmp_path / "tfd"
+    Labels({"k": "v"}).write_to_file(str(out))
+    first = os.stat(out).st_mtime_ns
+    time.sleep(0.01)  # ensure a rewrite would move mtime_ns
+    Labels({"k": "v"}).write_to_file(str(out))
+    assert os.stat(out).st_mtime_ns == first, "unchanged content must not rewrite"
+    Labels({"k": "v2"}).write_to_file(str(out))
+    assert os.stat(out).st_mtime_ns != first
+    assert out.read_text() == "k=v2\n"
+
+
+def test_write_to_file_still_writes_when_file_missing(tmp_path):
+    out = tmp_path / "tfd"
+    labels = Labels({"k": "v"})
+    labels.write_to_file(str(out))
+    os.remove(out)
+    labels.write_to_file(str(out))
+    assert out.read_text() == "k=v\n"

@@ -28,6 +28,9 @@ FILE_KEYS = {
     "with-burnin": ("tfd", "withBurnin"),
     "burnin-interval": ("tfd", "burninInterval"),
     "machine-type-file": ("tfd", "machineTypeFile"),
+    "parallel-labelers": ("tfd", "parallelLabelers"),
+    "labeler-timeout": ("tfd", "labelerTimeout"),
+    "timings-file": ("tfd", "timingsFile"),
 }
 
 # Two distinct valid raw values per flag (a wins the dominance checks).
@@ -35,6 +38,7 @@ VALUE_PAIRS = {
     "tpu-topology-strategy": ("single", "mixed"),
     "sleep-interval": ("30s", "45s"),
     "burnin-interval": ("3", "7"),
+    "labeler-timeout": ("2s", "5s"),
 }
 
 

@@ -47,3 +47,48 @@ def test_node_health_skips_hbm_off_tpu():
     report = measure_node_health(size=128, depth=2, iters=1)
     assert report["hbm_gbps"] is None
     assert report["chips"] >= 1
+
+
+def test_stream_pattern_checksum_detects_slot_misreads():
+    """ADVICE r5 #2: the workspace carries a per-chunk-distinct
+    (iota-derived) pattern, so the checksum catches a DMA slot read
+    early/late/twice in the pipeline; an all-ones buffer would sum
+    identically whichever chunk a slot actually delivered."""
+    from gpu_feature_discovery_tpu.ops.hbm import (
+        N_BUFFERS,
+        expected_stream_sum,
+        stream_pattern,
+    )
+
+    rows = 8 * CHUNK_ROWS
+    buf = stream_pattern(rows)
+    # The kernel over the true pattern reproduces the expected sum EXACTLY
+    # (every partial sum is an integer multiple of 2^16 in f32 range).
+    out = hbm_stream_sum(buf, interpret=True)
+    assert float(out[0, 0]) == expected_stream_sum(rows)
+
+    # Slot-aliasing bug twin: chunk 0's slot still holds chunk N_BUFFERS'
+    # data (read-after-write slip of one pipeline depth). Under the old
+    # all-ones fill this summed identically; the pattern must catch it.
+    aliased = buf.at[0:CHUNK_ROWS].set(
+        buf[N_BUFFERS * CHUNK_ROWS:(N_BUFFERS + 1) * CHUNK_ROWS]
+    )
+    out = hbm_stream_sum(aliased, interpret=True)
+    assert float(out[0, 0]) != expected_stream_sum(rows)
+
+    # A chunk read twice / another skipped (ordering bug) also shifts the
+    # sum, because adjacent chunks carry distinct values.
+    doubled = buf.at[CHUNK_ROWS:2 * CHUNK_ROWS].set(buf[0:CHUNK_ROWS])
+    out = hbm_stream_sum(doubled, interpret=True)
+    assert float(out[0, 0]) != expected_stream_sum(rows)
+
+
+def test_expected_stream_sum_matches_dense_sum():
+    from gpu_feature_discovery_tpu.ops.hbm import (
+        expected_stream_sum,
+        stream_pattern,
+    )
+
+    for chunks in (1, 4, 9):
+        rows = chunks * CHUNK_ROWS
+        assert float(jnp.sum(stream_pattern(rows))) == expected_stream_sum(rows)

@@ -216,20 +216,26 @@ def test_absent_dependency_condition_enables_subchart(tmp_path):
     assert kinds == {"Parent", "Child"}
 
 
-def test_values_file_number_is_not_int(tmp_path):
-    """helm parses values-file numbers as float64, so the daemonset's
-    `typeIs "int" .Values.sleepInterval` arm never fires for a YAML
-    number — helm-lite must agree or hermetic renders overstate the env."""
-    docs = render_chart(CHART, values_overrides={"sleepInterval": 60})
+def test_values_file_number_renders_via_float64_arm(tmp_path):
+    """helm parses values-file numbers as float64 (never int), so the
+    daemonset guards carry an explicit `typeIs "float64"` arm — without
+    it a numeric sleepInterval/labelerTimeout silently rendered NO env
+    var and the daemon default won unnoticed. A numeric value must now
+    reach the env, and helm-lite must agree with helm on the typing."""
+    docs = render_chart(
+        CHART, values_overrides={"sleepInterval": 60, "labelerTimeout": 30}
+    )
     (ds,) = [
         d for d in docs
         if d.get("kind") == "DaemonSet"
         and "tpu-feature-discovery" in d["metadata"]["name"]
     ]
     env = {
-        e["name"] for e in ds["spec"]["template"]["spec"]["containers"][0]["env"]
+        e["name"]: e["value"]
+        for e in ds["spec"]["template"]["spec"]["containers"][0]["env"]
     }
-    assert "TFD_SLEEP_INTERVAL" not in env
+    assert env["TFD_SLEEP_INTERVAL"] == "60"
+    assert env["TFD_LABELER_TIMEOUT"] == "30"
 
 
 def test_bare_identifier_argument_fails_loudly(tmp_path):

@@ -157,3 +157,34 @@ def test_probe_workspace_commits_to_target_device():
         # the computation's device placement.
         out, _ = hc._jitted_burnin()(x, ws)
         assert out.devices() == {d}
+
+
+def test_jax_manager_release_clears_probe_workspaces():
+    """ADVICE r5 #3: the per-device probe caches are keyed on the held
+    PJRT client's Device objects; a backend that genuinely releases its
+    client (JaxManager.release — NOT the per-cycle no-op shutdown) must
+    invalidate them, or entries referencing arrays on a destroyed client
+    leak for the process lifetime."""
+    import jax
+    import jax.numpy as jnp
+
+    from gpu_feature_discovery_tpu.config.flags import new_config
+    from gpu_feature_discovery_tpu.ops import healthcheck as hc
+    from gpu_feature_discovery_tpu.ops.hbm import stream_workspace
+    from gpu_feature_discovery_tpu.resource.jax_backend import JaxManager
+
+    d = jax.local_devices()[0]
+    hc._burnin_workspace(d, 128, 2, jnp.bfloat16)
+    stream_workspace(d, 512)
+    hc._warmed_probe_keys.add("sentinel")
+    assert hc._burnin_workspace.cache_info().currsize > 0
+    assert stream_workspace.cache_info().currsize > 0
+
+    manager = JaxManager(new_config())
+    manager.shutdown()  # the per-cycle no-op must NOT clear the caches
+    assert hc._burnin_workspace.cache_info().currsize > 0
+
+    manager.release()
+    assert hc._burnin_workspace.cache_info().currsize == 0
+    assert stream_workspace.cache_info().currsize == 0
+    assert not hc._warmed_probe_keys
