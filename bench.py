@@ -266,6 +266,83 @@ def main() -> int:
         file=sys.stderr,
     )
 
+    # Recovery scenario (supervisor acceptance, ISSUE 2): inject 2
+    # backend-init failures through the real factory path and count
+    # supervised cycles until the label file holds the FULL label set
+    # again. Cycles 1-2 run degraded (non-device labels + the
+    # tfd.degraded marker — the file is never absent), cycle 3 converges:
+    # the metric is the recovery latency in cycles, not wall-clock, so it
+    # is deadline-free and CI-stable.
+    from gpu_feature_discovery_tpu.cmd.supervisor import DEGRADED_LABEL, Supervisor
+    from gpu_feature_discovery_tpu.lm.labelers import degraded_label_sources
+    from gpu_feature_discovery_tpu.resource import factory as resource_factory
+    from gpu_feature_discovery_tpu.utils import faults
+
+    recovery_out = os.path.join(out_dir, "tfd-recovery")
+    recovery_config = new_config(
+        cli_values={
+            "output-file": recovery_out,
+            "init-retries": "10",
+            # Tiny backoff cap: the bench measures cycles-to-recovery,
+            # not the production retry pacing.
+            "init-backoff-max": "0.001s",
+        },
+        environ={},
+        config_file=None,
+    )
+    injected_init_failures = 2
+    saved_backend = os.environ.get("TFD_BACKEND")
+    os.environ["TFD_BACKEND"] = "mock:v4-8"
+    faults.load_fault_spec(f"pjrt_init:fail:{injected_init_failures}")
+    recovery_engine = new_label_engine(recovery_config)
+    recovery_supervisor = Supervisor(recovery_config)
+
+    def build_backend():
+        m = resource_factory.new_manager(recovery_config, wrap_fallback=False)
+        m.init()
+        return m
+
+    recovery_cycles = None
+    degraded_cycles = 0
+    try:
+        for cycle in range(1, 21):
+            backend_mgr = recovery_supervisor.acquire_manager(build_backend)
+            if backend_mgr is None:
+                cycle_labels = recovery_engine.generate(
+                    degraded_label_sources(
+                        interconnect, recovery_config, timestamp=timestamp
+                    )
+                )
+                cycle_labels[DEGRADED_LABEL] = "true"
+            else:
+                cycle_labels = recovery_engine.generate(
+                    new_label_sources(
+                        backend_mgr, interconnect, recovery_config,
+                        timestamp=timestamp,
+                    )
+                )
+                backend_mgr.shutdown()
+            cycle_labels.write_to_file(recovery_out)
+            assert os.path.exists(recovery_out), "label file went absent"
+            if "google.com/tpu.count" in cycle_labels:
+                recovery_cycles = cycle
+                break
+            degraded_cycles += 1
+            time.sleep(0.002)  # let the (1ms-capped) backoff window reopen
+    finally:
+        recovery_engine.close()
+        faults.reset()
+        if saved_backend is None:
+            os.environ.pop("TFD_BACKEND", None)
+        else:
+            os.environ["TFD_BACKEND"] = saved_backend
+    print(
+        f"bench: recovery scenario injected_init_failures="
+        f"{injected_init_failures} degraded_cycles={degraded_cycles} "
+        f"recovery_cycles_to_labels={recovery_cycles}",
+        file=sys.stderr,
+    )
+
     n_labels = len(labels)
     p50 = statistics.median(samples_ms)
     p95 = sorted(samples_ms)[
@@ -292,6 +369,12 @@ def main() -> int:
                 "p95_slow_source_ms": round(p95_slow, 3),
                 "slow_source_deadline_ms": round(slow_deadline_s * 1e3, 3),
                 "slow_source_stale_cycles": stale_cycles,
+                # Supervisor acceptance: cycles from first (faulted) cycle
+                # to the label file holding full labels again, with 2
+                # injected backend-init failures (degraded labels served
+                # in between) — None would mean it never recovered.
+                "recovery_cycles_to_labels": recovery_cycles,
+                "recovery_injected_init_failures": injected_init_failures,
                 **(
                     {"burnin_cycle_p50_ms": round(burnin_p50, 3)}
                     if burnin_p50 is not None

@@ -10,6 +10,7 @@ oneshot mode (main.go:148-232).
 from __future__ import annotations
 
 import argparse
+import faulthandler
 import json
 import logging
 import os
@@ -17,7 +18,7 @@ import queue
 import signal
 import sys
 import time
-from typing import Optional
+from typing import Callable, Optional, Union
 
 from gpu_feature_discovery_tpu.config.flags import (
     CONFIG_FILE_ENV_VARS,
@@ -26,13 +27,22 @@ from gpu_feature_discovery_tpu.config.flags import (
     env_flag as _env_flag,
     new_config,
 )
+from gpu_feature_discovery_tpu.cmd.supervisor import (
+    DEGRADED_LABEL,
+    InitRetriesExhausted,
+    Supervisor,
+    TooManyConsecutiveFailures,
+)
 from gpu_feature_discovery_tpu.config.spec import Config, ConfigError
 from gpu_feature_discovery_tpu.hostinfo.provider import ChainedProvider
 from gpu_feature_discovery_tpu.info.version import get_version_string
 from gpu_feature_discovery_tpu.lm.engine import new_label_engine
 from gpu_feature_discovery_tpu.lm.interconnect import InterconnectLabeler
 from gpu_feature_discovery_tpu.lm.labeler import Labeler
-from gpu_feature_discovery_tpu.lm.labelers import new_label_sources
+from gpu_feature_discovery_tpu.lm.labelers import (
+    degraded_label_sources,
+    new_label_sources,
+)
 from gpu_feature_discovery_tpu.lm.labels import remove_output_file
 from gpu_feature_discovery_tpu.lm.timestamp import new_timestamp_labeler
 from gpu_feature_discovery_tpu.pci.pciutil import SysfsGooglePCI
@@ -105,6 +115,10 @@ def start(argv: Optional[list] = None) -> int:
     parser = build_arg_parser()
     ns = vars(parser.parse_args(argv))
     tfd_logging.setup(debug=ns.pop("debug", False))
+    # A native crash in libtpu/PJRT (SIGSEGV inside a C extension) would
+    # otherwise kill the pod with no Python-side evidence at all; the
+    # faulthandler dump in the pod log is the only postmortem there is.
+    faulthandler.enable()
 
     cli_values = {k: v for k, v in ns.items() if v is not None and k != "config-file"}
     config_file = ns.get("config-file") or next(
@@ -141,7 +155,6 @@ def start(argv: Optional[list] = None) -> int:
 
             reset_metadata_provider_cache()
 
-            manager = factory.new_manager(config)
             interconnect = new_interconnect_labeler(config)
 
             # A reload may change --with-burnin/--burnin-interval: drop the
@@ -160,12 +173,45 @@ def start(argv: Optional[list] = None) -> int:
             reset_warn_once()
 
             log.info("Start running")
-            restart = run(manager, interconnect, config, sigs)
+            if config.flags.tfd.oneshot:
+                # Oneshot keeps the reference's eager factory + strict
+                # error-to-exit parity: a one-off labeling Job should
+                # fail loudly, not linger degraded.
+                manager = factory.new_manager(config)
+                restart = run(manager, interconnect, config, sigs)
+            else:
+                # Daemon mode is supervised: the manager is built (and
+                # rebuilt after faults) INSIDE the cycle loop, so init
+                # failures degrade the labels instead of the process.
+                restart = run(
+                    lambda: _build_manager(config),
+                    interconnect,
+                    config,
+                    sigs,
+                    supervisor=Supervisor(config),
+                )
         except Exception as e:  # noqa: BLE001 - match reference error-to-exit
             log.error("Error: %s", e)
+            # The reference's one-line parity log discards the stack; keep
+            # the line for log-scrapers and put the traceback at debug —
+            # "--debug and reproduce" beats "attach a debugger to a pod".
+            log.debug("Traceback:", exc_info=True)
             return 1
         if not restart:
             return 0
+
+
+def _build_manager(config: Config) -> Manager:
+    """The supervised acquisition unit: factory + eager init as ONE
+    retryable step (cmd/supervisor.py backoff wraps exactly this).
+    ``wrap_fallback=False``: the supervisor needs raw init errors — its
+    degraded mode (non-device labels + the tfd.degraded marker) replaces
+    the fallback wrapper's silent swap-to-null. init() is idempotent, so
+    the per-cycle init() inside new_label_sources stays a cheap
+    re-check."""
+    manager = factory.new_manager(config, wrap_fallback=False)
+    manager.init()
+    return manager
 
 
 def new_interconnect_labeler(config: Config) -> Labeler:
@@ -212,63 +258,202 @@ class _TolerantPCI:
             return []
 
 
+def _check_signal(
+    sigs: "queue.Queue[int]", timeout: Optional[float] = None
+) -> Optional[str]:
+    """One signal-queue read: "restart" (SIGHUP), "shutdown", or None.
+    ``timeout=None`` polls without blocking — the phase-boundary check."""
+    try:
+        if timeout is None:
+            signum = sigs.get_nowait()
+        else:
+            signum = sigs.get(timeout=timeout)
+    except queue.Empty:
+        return None
+    if signum == signal.SIGHUP:
+        log.info("Received SIGHUP, restarting.")
+        return "restart"
+    log.info("Received signal %s, shutting down.", signum)
+    return "shutdown"
+
+
+def _wait_for_signal(sigs: "queue.Queue[int]", duration: float) -> Optional[str]:
+    """Sleep up to ``duration`` seconds, waking for signals. Returns the
+    first decision, or None when the wait ran out (rerun)."""
+    deadline = time.monotonic() + duration
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return None
+        decision = _check_signal(sigs, timeout=remaining)
+        if decision is not None:
+            return decision
+
+
 def run(
-    manager: Manager,
+    manager: Union[Manager, Callable[[], Manager]],
     interconnect: Labeler,
     config: Config,
     sigs: "queue.Queue[int]",
+    supervisor: Optional[Supervisor] = None,
 ) -> bool:
     """run() (main.go:148-210). Returns True to request a config reload
-    (SIGHUP), False for clean exit."""
+    (SIGHUP), False for clean exit.
+
+    ``manager`` is either a ready Manager (reference parity: tests,
+    embedders, the oneshot path) or a zero-arg factory callable — the
+    supervised daemon path, where the backend is (re)built inside the
+    cycle loop so init failures turn into degraded cycles, not exits.
+
+    Daemon mode (non-oneshot) runs SUPERVISED (cmd/supervisor.py): a
+    failing cycle re-serves last-good labels with the unhealthy-cycles
+    counter and retries after a capped backoff; a down backend publishes
+    degraded labels; only InitRetriesExhausted / TooManyConsecutive-
+    Failures escape to start()'s error-to-exit. Oneshot keeps the
+    reference's strict parity — the first error propagates.
+    """
     output_file = config.flags.tfd.output_file
     oneshot = config.flags.tfd.oneshot
+    sleep_interval = config.flags.tfd.sleep_interval
+    make_manager = manager if callable(manager) else None
+    current: Optional[Manager] = None if make_manager is not None else manager
+    supervised = not oneshot
+    if supervised and supervisor is None:
+        supervisor = Supervisor(config)
     # One engine per config epoch: its last-good cache and straggler
     # futures must not survive a SIGHUP reload (same staleness contract as
     # reset_burnin_schedule), and the reload rebuilds run() anyway.
     engine = new_label_engine(config)
+    # Whether THIS epoch has written the output file yet: a failure before
+    # the first write must not clobber a previous epoch's still-valid
+    # file, but once this epoch owns the file its markers must stay
+    # current (a reserve may overwrite an earlier reserve).
+    wrote_this_epoch = False
     try:
         timestamp_labeler = new_timestamp_labeler(config)
         while True:
             # Per-cycle spans only: without the reset, a cached-health
             # cycle would re-report the last probe's cost as current.
             timing.reset_cycle()
-            with timed("labelgen.total"):
-                # init() happens inside new_label_sources; its errors
-                # propagate before shutdown is owed (eager-path parity).
-                sources = new_label_sources(
-                    manager, interconnect, config, timestamp=timestamp_labeler
+            try:
+                with timed("labelgen.total"):
+                    if current is None and make_manager is not None:
+                        if supervised:
+                            current = supervisor.acquire_manager(make_manager)
+                        else:
+                            current = make_manager()
+                    if current is None and make_manager is not None:
+                        # Backend down: publish the non-device facts plus
+                        # the degraded marker instead of publishing
+                        # nothing (a label-less TPU node is
+                        # indistinguishable from a non-TPU node).
+                        labels = engine.generate(
+                            degraded_label_sources(
+                                interconnect, config, timestamp=timestamp_labeler
+                            )
+                        )
+                        labels[DEGRADED_LABEL] = "true"
+                    else:
+                        # init() happens inside new_label_sources; its
+                        # errors propagate before shutdown is owed
+                        # (eager-path parity).
+                        sources = new_label_sources(
+                            current, interconnect, config, timestamp=timestamp_labeler
+                        )
+                        try:
+                            labels = engine.generate(sources)
+                        finally:
+                            with timed("tpu.shutdown"):
+                                current.shutdown()
+
+                if len(labels) <= 1:
+                    log.warning("no labels generated from any source")
+                log.info("Cycle timings: %s", timing.cycle_summary())
+                timing.write_timings_file(config.flags.tfd.timings_file or "")
+
+                log.info(
+                    "Writing labels to output file %s", output_file or "<stdout>"
                 )
-                try:
-                    labels = engine.generate(sources)
-                finally:
-                    with timed("tpu.shutdown"):
-                        manager.shutdown()
-
-            if len(labels) <= 1:
-                log.warning("no labels generated from any source")
-            log.info("Cycle timings: %s", timing.cycle_summary())
-            timing.write_timings_file(config.flags.tfd.timings_file or "")
-
-            log.info("Writing labels to output file %s", output_file or "<stdout>")
-            labels.write_to_file(output_file)
+                labels.write_to_file(output_file)
+                wrote_this_epoch = True
+            except (InitRetriesExhausted, TooManyConsecutiveFailures):
+                raise  # supervision verdicts, not containable faults
+            except Exception as e:  # noqa: BLE001 - supervision boundary
+                if not supervised:
+                    raise
+                delay = supervisor.cycle_failed(e)  # raises at the bound
+                if make_manager is not None:
+                    # The backend may be the broken part; next cycle goes
+                    # back through acquisition (and degraded mode). Release
+                    # it first — an abandoned initialized client would hold
+                    # the exclusive libtpu device and make every re-init
+                    # fail, turning one bad cycle into a permanent outage.
+                    # (shutdown() is idempotent: the generate path already
+                    # ran it in its finally; source-building failures
+                    # after init() have not.)
+                    if current is not None:
+                        try:
+                            current.shutdown()
+                        except Exception:  # noqa: BLE001 - already failed
+                            log.debug("shutdown of failed backend:", exc_info=True)
+                    current = None
+                if (
+                    not supervisor.has_last_good
+                    and not wrote_this_epoch
+                    and output_file
+                    and os.path.exists(output_file)
+                ):
+                    # No write has happened THIS epoch, but a previous
+                    # epoch/process left a label file: leave it alone —
+                    # full labels from minutes ago beat a counter-only
+                    # file now. The loop is alive, so heartbeat anyway.
+                    log.info(
+                        "cycle failed before this epoch's first write; "
+                        "keeping the existing label file untouched"
+                    )
+                    supervisor.touch_heartbeat()
+                else:
+                    reserve = supervisor.reserve_labels()
+                    try:
+                        reserve.write_to_file(output_file)
+                    except Exception as we:  # noqa: BLE001 - already degraded
+                        log.warning("could not re-serve last-good labels: %s", we)
+                    else:
+                        wrote_this_epoch = True
+                        log.info(
+                            "re-served last-good labels (unhealthy-cycles=%d)",
+                            supervisor.consecutive_failures,
+                        )
+                        supervisor.touch_heartbeat()
+                # The backoff delay replaces the sleep interval for a
+                # failed cycle: sooner than a long interval (retry, don't
+                # idle out 60s on a transient), slower than a short one
+                # once failures streak (back off, don't hot-loop).
+                log.info("retrying failed cycle in %.3fs", delay)
+                decision = _wait_for_signal(sigs, delay)
+                if decision == "restart":
+                    return True
+                if decision == "shutdown":
+                    return False
+                continue
+            else:
+                if supervised:
+                    supervisor.cycle_succeeded(labels)
+                    supervisor.touch_heartbeat()
 
             if oneshot:
                 return False
 
-            log.info("Sleeping for %ss", config.flags.tfd.sleep_interval)
-            deadline = time.monotonic() + config.flags.tfd.sleep_interval
-            while True:
-                remaining = deadline - time.monotonic()
-                if remaining <= 0:
-                    break  # rerun
-                try:
-                    signum = sigs.get(timeout=remaining)
-                except queue.Empty:
-                    break  # rerun
-                if signum == signal.SIGHUP:
-                    log.info("Received SIGHUP, restarting.")
-                    return True
-                log.info("Received signal %s, shutting down.", signum)
+            # Phase boundary: a signal that arrived DURING a long cycle
+            # (burn-in probe, straggling labeler) is honored now instead
+            # of waiting out the full sleep interval on top.
+            decision = _check_signal(sigs)
+            if decision is None:
+                log.info("Sleeping for %ss", sleep_interval)
+                decision = _wait_for_signal(sigs, sleep_interval)
+            if decision == "restart":
+                return True
+            if decision == "shutdown":
                 return False
     finally:
         engine.close()

@@ -1,0 +1,251 @@
+"""Fault-tolerant daemon supervisor.
+
+The reference exits on ANY error (main.go:148-232 error-to-exit parity),
+so on a TPU node every transient fault — libtpu held by a terminating pod
+at boot, a flaky metadata server, a wedged PJRT init, a read-only
+features.d mount — becomes a CrashLoopBackOff that strips the node of ALL
+labels until kubelet restarts the pod. This supervisor makes the unhealthy
+paths survivable, per-cycle, without hiding genuine brokenness:
+
+1. **Backend init retry** (``acquire_manager``): one init attempt per
+   labeling cycle, spaced by jittered exponential backoff
+   (``--init-backoff-max`` caps it). While the backend is down the daemon
+   publishes DEGRADED labels — everything the non-device sources can
+   produce (lm/labelers.degraded_label_sources) plus the
+   ``google.com/tpu.tfd.degraded=true`` marker — instead of publishing
+   nothing. After ``--init-retries`` consecutive failed attempts:
+   ``--fail-on-init-error=true`` escalates to a real exit (fail-fast stays
+   reachable); ``false`` stays degraded and keeps retrying at the capped
+   cadence, mirroring the flag the reference's sibling device-plugin has.
+
+2. **Per-cycle crash containment** (``cycle_failed``): an exception
+   escaping ``engine.generate()`` or ``labels.write_to_file()`` marks the
+   cycle failed instead of killing the process; the run loop re-serves the
+   last-good labels with the ``google.com/tpu.tfd.unhealthy-cycles=<n>``
+   counter and retries after a capped backoff. ``--max-consecutive-
+   failures`` bounds containment — a persistently broken cycle still exits
+   nonzero, so kubelet's restart remains the backstop, just no longer the
+   FIRST response.
+
+3. **Heartbeat** (``touch_heartbeat``): ``--heartbeat-file`` has its mtime
+   touched after every COMPLETED cycle (full, degraded, or re-served).
+   Wired as an exec livenessProbe it restarts a truly wedged pod — and
+   ONLY a wedged one: degraded cycles heartbeat too, so probe-driven
+   restarts never race the supervisor's own recovery.
+
+Oneshot mode bypasses all of it: ``--oneshot`` keeps the reference's
+strict error-to-exit parity (tests and one-off Jobs want loud failures).
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Callable, Optional
+
+from gpu_feature_discovery_tpu.config.flags import (
+    DEFAULT_INIT_BACKOFF_MAX,
+    DEFAULT_INIT_RETRIES,
+    DEFAULT_MAX_CONSECUTIVE_FAILURES,
+)
+from gpu_feature_discovery_tpu.config.spec import Config
+from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.resource.types import Manager
+from gpu_feature_discovery_tpu.utils.retry import BackoffPolicy
+
+log = logging.getLogger("tfd.supervisor")
+
+# Published while the device backend cannot init: the labels in the file
+# are the non-device subset, honest but incomplete. Cleared (by absence)
+# the first cycle the backend recovers.
+DEGRADED_LABEL = "google.com/tpu.tfd.degraded"
+
+# Published while cycles are failing and last-good labels are re-served;
+# the value counts CONSECUTIVE failed cycles. Cleared (by absence) the
+# first cycle that completes normally.
+UNHEALTHY_CYCLES_LABEL = "google.com/tpu.tfd.unhealthy-cycles"
+
+# Backoff base for both init re-attempts and failed-cycle retries; the
+# cap comes from --init-backoff-max.
+BACKOFF_BASE_S = 1.0
+
+
+class InitRetriesExhausted(RuntimeError):
+    """--init-retries consecutive init failures under
+    --fail-on-init-error=true; ``__cause__`` carries the last error."""
+
+
+class TooManyConsecutiveFailures(RuntimeError):
+    """--max-consecutive-failures cycles failed in a row; the supervisor
+    stops containing and lets the process exit nonzero."""
+
+
+class Supervisor:
+    """Cross-cycle supervision state for one config epoch. The run loop
+    (cmd/main.run) drives it; it never sleeps or touches the signal
+    queue itself — waits stay in the loop where SIGTERM is serviced."""
+
+    def __init__(
+        self,
+        config: Config,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        tfd = config.flags.tfd
+        self._init_retries = (
+            tfd.init_retries if tfd.init_retries is not None else DEFAULT_INIT_RETRIES
+        )
+        backoff_cap = (
+            tfd.init_backoff_max
+            if tfd.init_backoff_max is not None
+            else DEFAULT_INIT_BACKOFF_MAX
+        )
+        self._max_failures = (
+            tfd.max_consecutive_failures
+            if tfd.max_consecutive_failures is not None
+            else DEFAULT_MAX_CONSECUTIVE_FAILURES
+        )
+        self._fail_on_init_error = bool(config.flags.fail_on_init_error)
+        self._heartbeat_file = tfd.heartbeat_file or ""
+        # Base must stay under the cap or delay() would exceed it on
+        # attempt 0 (tests set caps of tens of milliseconds).
+        self._policy = BackoffPolicy(
+            base=min(BACKOFF_BASE_S, backoff_cap), cap=backoff_cap
+        )
+        self._clock = clock
+        self._init_failures = 0
+        self._next_init_attempt = 0.0
+        self._consecutive_failures = 0
+        self._last_good: Optional[Labels] = None
+        self._heartbeat_warned = False
+
+    # -- backend init -----------------------------------------------------
+
+    def acquire_manager(self, build: Callable[[], Manager]) -> Optional[Manager]:
+        """One bounded init attempt. Returns the manager on success, None
+        while the backoff window is still closed or the attempt failed
+        (the cycle then runs degraded), and raises InitRetriesExhausted
+        when the attempt budget is spent under --fail-on-init-error."""
+        now = self._clock()
+        if now < self._next_init_attempt:
+            return None
+        try:
+            manager = build()
+        except Exception as e:  # noqa: BLE001 - supervision boundary
+            self._init_failures += 1
+            log.warning(
+                "backend init attempt %d/%s failed: %s",
+                self._init_failures,
+                self._init_retries if self._fail_on_init_error else "inf",
+                e,
+            )
+            log.debug("backend init traceback:", exc_info=True)
+            if self._fail_on_init_error and self._init_failures >= self._init_retries:
+                raise InitRetriesExhausted(
+                    f"backend init failed {self._init_failures} consecutive "
+                    f"times (--init-retries={self._init_retries}); last: {e}"
+                ) from e
+            # Exhausted but not failing fast: keep retrying at the capped
+            # cadence forever — attempt index pins to the cap.
+            attempt = min(self._init_failures - 1, 63)
+            delay = self._policy.delay(attempt)
+            self._next_init_attempt = now + delay
+            log.info(
+                "staying degraded; next backend init attempt in %.3fs", delay
+            )
+            return None
+        if self._init_failures:
+            log.info(
+                "backend init recovered after %d failed attempts",
+                self._init_failures,
+            )
+        self._init_failures = 0
+        self._next_init_attempt = 0.0
+        return manager
+
+    @property
+    def degraded(self) -> bool:
+        """True while the backend has failed init and not yet recovered."""
+        return self._init_failures > 0
+
+    # -- per-cycle containment --------------------------------------------
+
+    def cycle_succeeded(self, labels: Labels) -> None:
+        """A cycle generated AND wrote labels: reset the failure streak
+        and remember the output for future re-serves. EVERY status
+        marker (unhealthy counter, degraded flag, engine staleness) is
+        stripped from the remembered copy: markers describe the cycle
+        that published them, so a re-serve must re-apply only what is
+        true at re-serve time — a tfd.degraded captured while the
+        backend was down must not resurface after it recovered."""
+        from gpu_feature_discovery_tpu.lm.engine import STALE_SOURCES_LABEL
+
+        self._consecutive_failures = 0
+        remembered = Labels(labels)
+        remembered.pop(UNHEALTHY_CYCLES_LABEL, None)
+        remembered.pop(DEGRADED_LABEL, None)
+        remembered.pop(STALE_SOURCES_LABEL, None)
+        self._last_good = remembered
+
+    def cycle_failed(self, error: BaseException) -> float:
+        """Contain one cycle failure. Returns the capped backoff delay
+        the loop should wait before retrying; raises
+        TooManyConsecutiveFailures once the streak hits the bound."""
+        self._consecutive_failures += 1
+        n = self._consecutive_failures
+        log.error(
+            "labeling cycle failed (%d consecutive, bound %d): %s",
+            n,
+            self._max_failures,
+            error,
+        )
+        log.debug("cycle failure traceback:", exc_info=True)
+        if n >= self._max_failures:
+            raise TooManyConsecutiveFailures(
+                f"{n} consecutive labeling cycles failed "
+                f"(--max-consecutive-failures={self._max_failures}); last: {error}"
+            ) from error
+        return self._policy.delay(n - 1)
+
+    @property
+    def consecutive_failures(self) -> int:
+        return self._consecutive_failures
+
+    @property
+    def has_last_good(self) -> bool:
+        """True once any cycle in this epoch completed. Before that, a
+        re-serve has nothing real to say — and must not overwrite a
+        still-valid label file left by the previous epoch/process."""
+        return self._last_good is not None
+
+    def reserve_labels(self) -> Labels:
+        """What a failed cycle publishes instead of nothing: the last
+        good label set (if any cycle ever succeeded this epoch) plus the
+        unhealthy-cycles counter — and the degraded marker only when the
+        backend is CURRENTLY failing init. Before any success there is
+        nothing cached, so the counter alone goes out — the file still
+        exists and still converges (chaos contract: full or degraded,
+        never absent)."""
+        labels = Labels(self._last_good) if self._last_good is not None else Labels()
+        labels[UNHEALTHY_CYCLES_LABEL] = str(self._consecutive_failures)
+        if self.degraded:
+            labels[DEGRADED_LABEL] = "true"
+        return labels
+
+    # -- liveness ----------------------------------------------------------
+
+    def touch_heartbeat(self) -> None:
+        """Bump the heartbeat file's mtime (creating it on first touch).
+        Failures are logged once and never fail a cycle — liveness
+        reporting must not be able to kill the thing it reports on."""
+        path = self._heartbeat_file
+        if not path:
+            return
+        try:
+            with open(path, "ab"):
+                pass
+            os.utime(path, None)
+        except OSError as e:
+            if not self._heartbeat_warned:
+                self._heartbeat_warned = True
+                log.warning("cannot touch heartbeat file %s: %s", path, e)

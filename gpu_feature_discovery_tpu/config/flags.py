@@ -24,11 +24,24 @@ from gpu_feature_discovery_tpu.config.spec import (
     parse_positive_int as _parse_positive_int,
 )
 
-from gpu_feature_discovery_tpu.lm.engine import DEFAULT_LABELER_TIMEOUT
-
 DEFAULT_OUTPUT_FILE = "/etc/kubernetes/node-feature-discovery/features.d/tfd"
 DEFAULT_MACHINE_TYPE_FILE = "/sys/class/dmi/id/product_name"
 DEFAULT_SLEEP_INTERVAL = 60.0
+# Supervisor defaults (cmd/supervisor.py): 5 init attempts with backoff
+# capped at 30s rides out a ~1-2 min boot race (libtpu held by a
+# terminating pod, metadata not yet routable) before fail-fast; 5
+# contained cycle failures before escalation bounds how long a
+# persistently broken cycle re-serves stale labels.
+DEFAULT_INIT_RETRIES = 5
+DEFAULT_INIT_BACKOFF_MAX = 30.0
+DEFAULT_MAX_CONSECUTIVE_FAILURES = 5
+# Per-labeler deadline default (lm/engine.py consumes it; the constant
+# lives here so the config layer never imports the lm layer — config is
+# a leaf below lm in the repo's layer map): generous against every
+# in-tree source's worst case (the health labeler's bounded first-probe
+# wait is 2 s, a metadata-server timeout ~1 s) so staleness marks
+# genuine degradation, not routine variance.
+DEFAULT_LABELER_TIMEOUT = 10.0
 
 _DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
 _DURATION_UNITS = {
@@ -251,6 +264,55 @@ FLAG_DEFS: List[FlagDef] = [
         "labeling cycle, for scraping (empty = disabled)",
         setter=lambda c, v: setattr(_f(c).tfd, "timings_file", v),
         getter=lambda c: _f(c).tfd.timings_file,
+    ),
+    FlagDef(
+        name="init-retries",
+        env_vars=("TFD_INIT_RETRIES",),
+        parse=_parse_positive_int,
+        default=DEFAULT_INIT_RETRIES,
+        help="daemon mode: consecutive backend-init attempts (one per "
+        "labeling cycle, spaced by exponential backoff) tolerated before "
+        "the supervisor escalates; while the backend is down, degraded "
+        "labels are published with google.com/tpu.tfd.degraded=true; with "
+        "--fail-on-init-error=false the daemon stays degraded and keeps "
+        "retrying at the capped cadence instead of exiting",
+        setter=lambda c, v: setattr(_f(c).tfd, "init_retries", v),
+        getter=lambda c: _f(c).tfd.init_retries,
+    ),
+    FlagDef(
+        name="init-backoff-max",
+        env_vars=("TFD_INIT_BACKOFF_MAX",),
+        parse=parse_duration,
+        default=DEFAULT_INIT_BACKOFF_MAX,
+        help="cap (Go duration, e.g. 30s) on the exponential backoff "
+        "between backend-init re-attempts and between failed-cycle "
+        "retries (jittered; base 1s, doubling)",
+        setter=lambda c, v: setattr(_f(c).tfd, "init_backoff_max", v),
+        getter=lambda c: _f(c).tfd.init_backoff_max,
+    ),
+    FlagDef(
+        name="max-consecutive-failures",
+        env_vars=("TFD_MAX_CONSECUTIVE_FAILURES",),
+        parse=_parse_positive_int,
+        default=DEFAULT_MAX_CONSECUTIVE_FAILURES,
+        help="daemon mode: labeling cycles may fail this many times in a "
+        "row (each contained: last-good labels re-served with the "
+        "google.com/tpu.tfd.unhealthy-cycles counter) before the "
+        "supervisor escalates to a real nonzero exit",
+        setter=lambda c, v: setattr(_f(c).tfd, "max_consecutive_failures", v),
+        getter=lambda c: _f(c).tfd.max_consecutive_failures,
+    ),
+    FlagDef(
+        name="heartbeat-file",
+        env_vars=("TFD_HEARTBEAT_FILE",),
+        parse=str,
+        default="",
+        help="path whose mtime the daemon touches after every COMPLETED "
+        "labeling cycle (full, degraded, or re-served) — wire it as an "
+        "exec livenessProbe so Kubernetes restarts a truly wedged pod "
+        "but never a merely degraded one (empty = disabled)",
+        setter=lambda c, v: setattr(_f(c).tfd, "heartbeat_file", v),
+        getter=lambda c: _f(c).tfd.heartbeat_file,
     ),
 ]
 

@@ -110,6 +110,14 @@ class TfdFlags:
     parallel_labelers: Optional[bool] = None
     labeler_timeout: Optional[float] = None  # seconds
     timings_file: Optional[str] = None  # per-cycle JSON timing dump ("" = off)
+    # Supervisor knobs (cmd/supervisor.py): bounded backend-init retry
+    # with backoff-capped re-attempts (degraded labels published in
+    # between), per-cycle crash containment with an escalation bound, and
+    # the per-completed-cycle heartbeat file for the liveness probe.
+    init_retries: Optional[int] = None
+    init_backoff_max: Optional[float] = None  # seconds
+    max_consecutive_failures: Optional[int] = None
+    heartbeat_file: Optional[str] = None  # "" = disabled
 
 
 @dataclass
@@ -156,6 +164,10 @@ class Config:
                     "parallelLabelers": self.flags.tfd.parallel_labelers,
                     "labelerTimeout": self.flags.tfd.labeler_timeout,
                     "timingsFile": self.flags.tfd.timings_file,
+                    "initRetries": self.flags.tfd.init_retries,
+                    "initBackoffMax": self.flags.tfd.init_backoff_max,
+                    "maxConsecutiveFailures": self.flags.tfd.max_consecutive_failures,
+                    "heartbeatFile": self.flags.tfd.heartbeat_file,
                 },
             },
             "sharing": {
@@ -244,6 +256,17 @@ def parse_config_file(path: str) -> Config:
 
         config.flags.tfd.labeler_timeout = parse_duration(tfd["labelerTimeout"])
     config.flags.tfd.timings_file = _opt_str(tfd.get("timingsFile"))
+    if tfd.get("initRetries") is not None:
+        config.flags.tfd.init_retries = parse_positive_int(tfd["initRetries"])
+    if tfd.get("initBackoffMax") is not None:
+        from gpu_feature_discovery_tpu.config.flags import parse_duration
+
+        config.flags.tfd.init_backoff_max = parse_duration(tfd["initBackoffMax"])
+    if tfd.get("maxConsecutiveFailures") is not None:
+        config.flags.tfd.max_consecutive_failures = parse_positive_int(
+            tfd["maxConsecutiveFailures"]
+        )
+    config.flags.tfd.heartbeat_file = _opt_str(tfd.get("heartbeatFile"))
 
     config.resources = raw.get("resources", {}) or {}
     config.sharing = Sharing.from_dict(raw.get("sharing", {}) or {})

@@ -50,6 +50,11 @@ import time
 from dataclasses import dataclass
 from typing import Callable, Dict, List, Optional, Set, Tuple
 
+# DEFAULT_LABELER_TIMEOUT is re-exported here for engine consumers; it is
+# defined beside the other flag defaults (config/flags.py) so the config
+# layer never has to import the lm layer for it. Operators bounding
+# tails harder tune --labeler-timeout down.
+from gpu_feature_discovery_tpu.config.flags import DEFAULT_LABELER_TIMEOUT
 from gpu_feature_discovery_tpu.lm.labeler import Labeler
 from gpu_feature_discovery_tpu.lm.labels import Labels, label_safe_value
 from gpu_feature_discovery_tpu.utils import timing
@@ -61,11 +66,6 @@ log = logging.getLogger("tfd.lm")
 # (and the golden files) never see it.
 STALE_SOURCES_LABEL = "google.com/tpu.tfd.stale-sources"
 
-# Per-labeler deadline default: generous against every in-tree source's
-# worst case (the health labeler's bounded first-probe wait is 2 s, a
-# metadata-server timeout ~1 s) so staleness marks genuine degradation,
-# not routine variance. Operators bounding tails harder tune it down.
-DEFAULT_LABELER_TIMEOUT = 10.0
 
 # Label-source names joined with "_" (names themselves use "-"), because a
 # k8s label value cannot carry a comma.
@@ -93,6 +93,9 @@ class LabelSource:
     offload: bool = True
 
     def run(self) -> Labels:
+        from gpu_feature_discovery_tpu.utils.faults import maybe_inject
+
+        maybe_inject(f"labeler.{self.name}")
         return self.produce().labels()
 
 
@@ -205,6 +208,9 @@ class LabelEngine:
     # -- public -----------------------------------------------------------
 
     def generate(self, sources: List[LabelSource]) -> Labels:
+        from gpu_feature_discovery_tpu.utils.faults import maybe_inject
+
+        maybe_inject("generate")
         if not self._parallel:
             return self._generate_sequential(sources)
         return self._generate_parallel(sources)

@@ -64,3 +64,39 @@ def new_label_sources(
     ic = interconnect if interconnect is not None else Empty()
     sources.append(LabelSource("interconnect", lambda: ic))
     return sources
+
+
+def degraded_label_sources(
+    interconnect: Optional[Labeler],
+    config: Config,
+    timestamp: Optional[Labeler] = None,
+) -> List[LabelSource]:
+    """The non-device subset of ``new_label_sources`` — what the daemon
+    can still honestly publish while the backend won't init
+    (cmd/supervisor.py degraded mode): timestamp, the DMI machine type,
+    and the host-metadata interconnect facts (slice topology included).
+    No manager is touched. Source NAMES and merge order match the full
+    list's, so the engine's per-source last-good cache carries across a
+    healthy→degraded→healthy transition instead of starting cold.
+
+    Machine type normally rides inside the chip-gated device sources
+    (lm/tpu.tpu_label_sources) — a wedged PJRT says nothing about the
+    DMI file, so degraded mode lifts it out and keeps publishing it.
+    """
+    from gpu_feature_discovery_tpu.lm.machine_type import new_machine_type_labeler
+
+    machine_type_file = config.flags.tfd.machine_type_file
+    sources: List[LabelSource] = []
+    if timestamp is not None:
+        ts = timestamp
+        sources.append(LabelSource("timestamp", lambda: ts, offload=False))
+    sources.append(
+        LabelSource(
+            "machine-type",
+            lambda: new_machine_type_labeler(machine_type_file),
+            offload=False,
+        )
+    )
+    ic = interconnect if interconnect is not None else Empty()
+    sources.append(LabelSource("interconnect", lambda: ic))
+    return sources

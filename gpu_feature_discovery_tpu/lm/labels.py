@@ -67,6 +67,9 @@ class Labels(dict):
         labels that did not change. Returns are indistinguishable to the
         caller: the file's contents are the requested labels either way.
         """
+        from gpu_feature_discovery_tpu.utils.faults import maybe_inject
+
+        maybe_inject("write")
         if not path:
             self.write_to(sys.stdout)
             return
@@ -92,15 +95,27 @@ def _file_contents_equal(path: str, contents: bytes) -> bool:
 def _write_file_atomically(path: str, contents: bytes, perm: int) -> None:
     """Stage into ``<dir>/tfd-tmp`` then rename over the target
     (labels.go:68-114). The staging dir lives on the same filesystem as the
-    target so the rename is atomic."""
+    target so the rename is atomic.
+
+    Durability matters as much as atomicity here: rename() orders nothing
+    against data writeback, so without the fsyncs a node crash shortly
+    after the rename can leave the TARGET name pointing at a
+    truncated/empty inode — which NFD would faithfully parse as "this
+    node has no TPU labels". fsync the temp file BEFORE the rename (data
+    on disk before the name moves) and the containing directory AFTER
+    (the rename itself on disk).
+    """
     abs_path = os.path.abspath(path)
-    tmp_dir = os.path.join(os.path.dirname(abs_path), TMP_SUBDIR)
+    out_dir = os.path.dirname(abs_path)
+    tmp_dir = os.path.join(out_dir, TMP_SUBDIR)
     os.makedirs(tmp_dir, exist_ok=True)
 
     fd, tmp_name = tempfile.mkstemp(prefix=TMP_PREFIX, dir=tmp_dir)
     try:
         with os.fdopen(fd, "wb") as f:
             f.write(contents)
+            f.flush()
+            os.fsync(f.fileno())
         os.replace(tmp_name, abs_path)
     except BaseException:
         try:
@@ -109,6 +124,24 @@ def _write_file_atomically(path: str, contents: bytes, perm: int) -> None:
             pass
         raise
     os.chmod(abs_path, perm)
+    _fsync_dir(out_dir)
+
+
+def _fsync_dir(dir_path: str) -> None:
+    """Persist a just-completed rename. Best-effort: some filesystems
+    (and sandboxes) refuse O_RDONLY dir fsync — the write already
+    succeeded, so degrade to the pre-fsync durability rather than fail a
+    labeling cycle over it."""
+    try:
+        dir_fd = os.open(dir_path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(dir_fd)
+    except OSError:
+        pass
+    finally:
+        os.close(dir_fd)
 
 
 def remove_output_file(path: str) -> None:

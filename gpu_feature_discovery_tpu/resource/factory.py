@@ -31,9 +31,24 @@ log = logging.getLogger("tfd.resource")
 BACKEND_ENV = "TFD_BACKEND"
 
 
-def new_manager(config: Config) -> Manager:
-    """NewManager (factory.go:27-30)."""
-    return with_config(_get_manager(config), config)
+def new_manager(config: Config, wrap_fallback: bool = True) -> Manager:
+    """NewManager (factory.go:27-30).
+
+    ``wrap_fallback=False`` skips the fallback-to-null decorator
+    regardless of --fail-on-init-error: the daemon supervisor
+    (cmd/supervisor.py) needs RAW init errors — it owns a richer
+    degradation policy (backoff-retried re-init + degraded-mode labels)
+    than silently swapping in Null, and the flag then decides whether
+    exhausted retries escalate to an exit or stay degraded. Oneshot and
+    embedder paths keep the reference's wrapper semantics.
+    """
+    from gpu_feature_discovery_tpu.utils.faults import maybe_inject
+
+    maybe_inject("pjrt_init")
+    manager = _get_manager(config)
+    if not wrap_fallback:
+        return manager
+    return with_config(manager, config)
 
 
 def with_config(manager: Manager, config: Config) -> Manager:

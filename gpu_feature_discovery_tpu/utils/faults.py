@@ -1,0 +1,197 @@
+"""Deterministic fault-injection registry (``TFD_FAULT_SPEC``).
+
+Every recovery path the daemon supervisor adds — backend-init backoff,
+degraded-mode labeling, per-cycle crash containment, write-failure
+re-serves — is unreachable on a healthy CPU-only CI machine: the mock
+backends never fail. This registry makes the unhealthy paths
+deterministically reachable WITHOUT touching the production code paths'
+structure: the instrumented sites call ``maybe_inject(site)``, which is a
+no-op unless a fault spec armed that site.
+
+Spec grammar (comma-separated entries)::
+
+    TFD_FAULT_SPEC=pjrt_init:fail:3,write:raise:OSError,generate:raise:RuntimeError:2
+
+    <site>:fail:<n>            raise FaultInjected on the first n calls
+    <site>:raise:<exc>[:<n>]   raise <exc>("injected fault ...") on the
+                               first n calls (default 1)
+
+``<exc>`` comes from a fixed allowlist (below) — the spec is an operator/
+CI surface, not an eval. Counts are finite by design: every chaos
+scenario must CONVERGE (the label file ends full or degraded, never
+absent), so a fault that never clears is expressed as a large count, not
+an infinity.
+
+Instrumented sites:
+
+    pjrt_init          resource.factory.new_manager (backend construction)
+    generate           lm.engine.LabelEngine.generate (cycle entry)
+    labeler.<name>     lm.engine.LabelSource.run (one named labeler)
+    write              lm.labels.Labels.write_to_file
+
+The registry is process-global and loaded lazily from the environment on
+first use; tests install specs directly with ``load_fault_spec`` and MUST
+``reset()`` when done (the chaos suite does both in try/finally).
+Counting is lock-protected — labeler sites fire from engine worker
+threads.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import Dict, Optional, Tuple, Type
+
+from gpu_feature_discovery_tpu.config.spec import ConfigError
+
+log = logging.getLogger("tfd.faults")
+
+FAULT_SPEC_ENV = "TFD_FAULT_SPEC"
+
+# The spec names exception TYPES, not code: only these resolve. OSError /
+# TimeoutError cover the I/O shapes (write, metadata fetch); Runtime /
+# Value cover generic labeler bugs; ResourceError is the backend seam's
+# own probe-failure type (resource/types.py).
+_EXCEPTION_ALLOWLIST: Dict[str, Type[BaseException]] = {
+    "OSError": OSError,
+    "RuntimeError": RuntimeError,
+    "ValueError": ValueError,
+    "TimeoutError": TimeoutError,
+}
+
+
+def _allowed_exceptions() -> Dict[str, Type[BaseException]]:
+    from gpu_feature_discovery_tpu.resource.types import ResourceError
+
+    return {**_EXCEPTION_ALLOWLIST, "ResourceError": ResourceError}
+
+
+class FaultInjected(RuntimeError):
+    """The ``fail`` mode's error type — unambiguous in logs/tracebacks."""
+
+
+class _Fault:
+    def __init__(self, site: str, exc_type: Type[BaseException], remaining: int):
+        self.site = site
+        self.exc_type = exc_type
+        self.remaining = remaining
+
+
+class FaultRegistry:
+    """Armed faults by site, with thread-safe countdown."""
+
+    def __init__(self, faults: Dict[str, _Fault]):
+        self._faults = faults
+        self._lock = threading.Lock()
+
+    @property
+    def sites(self) -> Tuple[str, ...]:
+        return tuple(self._faults)
+
+    def fire(self, site: str) -> None:
+        fault = self._faults.get(site)
+        if fault is None:
+            return
+        with self._lock:
+            if fault.remaining <= 0:
+                return
+            fault.remaining -= 1
+            remaining = fault.remaining
+        log.warning(
+            "fault injection: raising %s at site %r (%d left)",
+            fault.exc_type.__name__,
+            site,
+            remaining,
+        )
+        raise fault.exc_type(f"injected fault at {site!r} ({FAULT_SPEC_ENV})")
+
+
+def parse_fault_spec(spec: str) -> FaultRegistry:
+    """Parse the grammar above; malformed entries are a hard ConfigError
+    (a typo'd chaos matrix must fail the job, not silently test nothing)."""
+    faults: Dict[str, _Fault] = {}
+    exceptions = _allowed_exceptions()
+    for entry in spec.split(","):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parts = entry.split(":")
+        if len(parts) < 2:
+            raise ConfigError(f"fault entry {entry!r}: want <site>:<mode>[:...]")
+        site, mode = parts[0], parts[1]
+        if not site:
+            raise ConfigError(f"fault entry {entry!r}: empty site")
+        if site in faults:
+            raise ConfigError(f"fault entry {entry!r}: duplicate site {site!r}")
+        if mode == "fail":
+            if len(parts) != 3:
+                raise ConfigError(f"fault entry {entry!r}: want {site}:fail:<n>")
+            exc_type: Type[BaseException] = FaultInjected
+            count_raw = parts[2]
+        elif mode == "raise":
+            if len(parts) not in (3, 4):
+                raise ConfigError(
+                    f"fault entry {entry!r}: want {site}:raise:<exc>[:<n>]"
+                )
+            if parts[2] not in exceptions:
+                raise ConfigError(
+                    f"fault entry {entry!r}: unknown exception {parts[2]!r} "
+                    f"(allowed: {sorted(exceptions)})"
+                )
+            exc_type = exceptions[parts[2]]
+            count_raw = parts[3] if len(parts) == 4 else "1"
+        else:
+            raise ConfigError(
+                f"fault entry {entry!r}: unknown mode {mode!r} (fail | raise)"
+            )
+        try:
+            count = int(count_raw)
+        except ValueError as e:
+            raise ConfigError(f"fault entry {entry!r}: bad count {count_raw!r}") from e
+        if count < 1:
+            raise ConfigError(f"fault entry {entry!r}: count must be >= 1")
+        faults[site] = _Fault(site, exc_type, count)
+    return FaultRegistry(faults)
+
+
+# None = not yet loaded (read the env on first use); a loaded registry —
+# even an empty one — stays until reset(). Plain attribute reads/writes
+# are atomic under the GIL; the per-fault countdown has its own lock.
+_registry: Optional[FaultRegistry] = None
+_loaded = False
+
+
+def load_fault_spec(spec: str) -> FaultRegistry:
+    """Install a spec programmatically (tests, bench). Returns the
+    registry so callers can introspect ``sites``."""
+    global _registry, _loaded
+    _registry = parse_fault_spec(spec)
+    _loaded = True
+    if _registry.sites:
+        log.warning(
+            "FAULT INJECTION ACTIVE (%s): %s — never set in production",
+            FAULT_SPEC_ENV,
+            ",".join(_registry.sites),
+        )
+    return _registry
+
+
+def reset() -> None:
+    """Disarm everything and re-read the environment on next use."""
+    global _registry, _loaded
+    _registry = None
+    _loaded = False
+
+
+def maybe_inject(site: str) -> None:
+    """The instrumented-site hook: no-op unless a spec armed ``site``."""
+    global _loaded
+    if not _loaded:
+        _loaded = True
+        spec = os.environ.get(FAULT_SPEC_ENV, "")
+        if spec:
+            load_fault_spec(spec)
+    reg = _registry
+    if reg is not None:
+        reg.fire(site)

@@ -1,0 +1,55 @@
+"""Bounded exponential backoff with jitter.
+
+The reference exits on the first error of any kind (main.go:148-232
+error-to-exit parity); on a TPU node that turns every *transient* fault —
+libtpu still held by a terminating workload at boot, a metadata server
+that is not yet routable, a wedged PJRT init — into a CrashLoopBackOff
+that strips the node of ALL labels until kubelet restarts the pod. The
+daemon supervisor (cmd/supervisor.py) instead spaces its re-attempts with
+this policy: exponential growth bounds the retry pressure on a genuinely
+broken dependency, the cap keeps recovery latency bounded once the
+dependency heals, and jitter keeps a rack of daemonset pods that all
+failed at the same instant (node boot) from re-probing the same metadata
+server in lockstep.
+
+Deliberately dependency-free and deterministic under test: jitter comes
+from an injectable ``random.Random`` so tests pin exact delays with
+``jitter=0`` or a seeded generator.
+"""
+
+from __future__ import annotations
+
+import random
+from dataclasses import dataclass, field
+
+DEFAULT_BASE_S = 1.0
+DEFAULT_FACTOR = 2.0
+DEFAULT_CAP_S = 30.0
+DEFAULT_JITTER = 0.1
+
+
+@dataclass
+class BackoffPolicy:
+    """Delay schedule: ``min(cap, base * factor**attempt)`` spread by
+    ``±jitter`` (a fraction of the delay). ``attempt`` is 0-based — the
+    delay *after* the first failure is ``delay(0)``."""
+
+    base: float = DEFAULT_BASE_S
+    factor: float = DEFAULT_FACTOR
+    cap: float = DEFAULT_CAP_S
+    jitter: float = DEFAULT_JITTER
+    rng: random.Random = field(default_factory=random.Random)
+
+    def delay(self, attempt: int) -> float:
+        """Delay in seconds before retry number ``attempt + 1``."""
+        if attempt < 0:
+            raise ValueError(f"attempt must be >= 0, got {attempt}")
+        # Cap the exponent too: factor**attempt overflows to inf after
+        # ~1000 doublings, and min() on inf still works but the
+        # intermediate is garbage for the jitter math.
+        raw = self.base * (self.factor ** min(attempt, 64))
+        bounded = min(self.cap, raw)
+        if not self.jitter:
+            return bounded
+        spread = self.jitter * bounded
+        return max(0.0, bounded + self.rng.uniform(-spread, spread))

@@ -1,0 +1,56 @@
+"""Hermetic twin of the CI chaos matrix: every TFD_FAULT_SPEC row the
+workflow runs through tests/chaos-run.py also executes here, in-process,
+so the chaos contract (label file converges to full or degraded labels,
+never absent; the daemon never exits on its own) gates every plain
+pytest run — not only the dedicated CI job."""
+
+import importlib.util
+import os
+
+import pytest
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+# The CI chaos matrix (ci.yml `chaos` job). test_ci_matrix_matches_rows
+# pins the workflow to this list so the twin cannot silently drift.
+CHAOS_SPECS = [
+    "pjrt_init:fail:2",
+    "generate:raise:RuntimeError",
+    "write:raise:OSError:2",
+    "labeler.interconnect:raise:RuntimeError:2",
+    "pjrt_init:fail:1,write:raise:OSError,generate:raise:RuntimeError",
+]
+
+
+def _driver():
+    spec = importlib.util.spec_from_file_location(
+        "chaos_run", os.path.join(HERE, "chaos-run.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+@pytest.mark.parametrize("fault_spec", CHAOS_SPECS)
+def test_daemon_converges_under_faults(fault_spec, tmp_path):
+    result = _driver().run_chaos(fault_spec, str(tmp_path))
+    assert result["converged_s"] < 8.0
+
+
+def test_ci_matrix_matches_rows():
+    """The workflow's chaos matrix and CHAOS_SPECS are the same set —
+    a spec added to one place only fails here."""
+    import yaml
+
+    wf_path = os.path.join(
+        os.path.dirname(HERE), ".github", "workflows", "ci.yml"
+    )
+    with open(wf_path) as f:
+        wf = yaml.safe_load(f)
+    rows = wf["jobs"]["chaos"]["strategy"]["matrix"]["include"]
+    assert {r["fault_spec"] for r in rows} == set(CHAOS_SPECS), (
+        "ci.yml chaos matrix drifted from tests/test_chaos.py CHAOS_SPECS"
+    )
+    assert len({r["scenario"] for r in rows}) == len(rows), (
+        "chaos matrix scenarios must be unique (driver unit naming)"
+    )

@@ -244,3 +244,19 @@ def test_write_to_file_still_writes_when_file_missing(tmp_path):
     os.remove(out)
     labels.write_to_file(str(out))
     assert out.read_text() == "k=v\n"
+
+
+def test_engine_close_is_idempotent():
+    """start()'s reload loop closes the epoch engine in run()'s finally;
+    a double close (e.g. defensive embedder cleanup) must be a no-op."""
+    engine = LabelEngine(parallel=True, timeout_s=1.0)
+    labels = engine.generate([LabelSource("x", lambda: Labels({"a": "b"}))])
+    assert labels == {"a": "b"}
+    engine.close()
+    engine.close()
+    # A fresh generate after close builds a new pool rather than dying
+    # on a retired one (the epoch contract: one engine per epoch, but
+    # close must fail safe, not booby-trap).
+    assert engine.generate([LabelSource("x", lambda: Labels({"a": "c"}))]) == {
+        "a": "c"
+    }

@@ -31,6 +31,10 @@ FILE_KEYS = {
     "parallel-labelers": ("tfd", "parallelLabelers"),
     "labeler-timeout": ("tfd", "labelerTimeout"),
     "timings-file": ("tfd", "timingsFile"),
+    "init-retries": ("tfd", "initRetries"),
+    "init-backoff-max": ("tfd", "initBackoffMax"),
+    "max-consecutive-failures": ("tfd", "maxConsecutiveFailures"),
+    "heartbeat-file": ("tfd", "heartbeatFile"),
 }
 
 # Two distinct valid raw values per flag (a wins the dominance checks).
@@ -39,6 +43,9 @@ VALUE_PAIRS = {
     "sleep-interval": ("30s", "45s"),
     "burnin-interval": ("3", "7"),
     "labeler-timeout": ("2s", "5s"),
+    "init-retries": ("3", "7"),
+    "init-backoff-max": ("2s", "5s"),
+    "max-consecutive-failures": ("2", "4"),
 }
 
 

@@ -1,0 +1,88 @@
+"""SIGHUP reload choreography (previously untested): a config reload must
+rebuild the label engine (and close the old one), and re-run every
+per-epoch reset — metadata-provider cache, burn-in schedule, warn-once
+keys — exactly once per epoch. These wrap the REAL functions with
+counters, drive start() through two epochs (SIGHUP then SIGTERM), and
+assert the choreography; a regression that drops one reset from start()
+fails here instead of resurfacing as a stale-cache field bug."""
+
+import queue
+import signal
+
+import gpu_feature_discovery_tpu.cmd.main as cmd_main
+from gpu_feature_discovery_tpu.hostinfo import provider as hostinfo_provider
+from gpu_feature_discovery_tpu.lm import health as lm_health
+from gpu_feature_discovery_tpu.utils import logging as tfd_logging
+
+
+def _counted(calls, key, fn):
+    def wrapper(*args, **kwargs):
+        calls[key] += 1
+        return fn(*args, **kwargs)
+
+    return wrapper
+
+
+def test_sighup_rebuilds_engine_and_reruns_epoch_resets(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    calls = {"engine": 0, "burnin": 0, "warn": 0, "metadata": 0}
+    engine_closes = []
+
+    real_new_engine = cmd_main.new_label_engine
+
+    def counting_engine(config):
+        calls["engine"] += 1
+        engine = real_new_engine(config)
+        real_close = engine.close
+        closes = []
+        engine_closes.append(closes)
+
+        def close():
+            closes.append(True)
+            real_close()
+
+        engine.close = close
+        return engine
+
+    monkeypatch.setattr(cmd_main, "new_label_engine", counting_engine)
+    # start() from-imports these INSIDE the reload loop, so the module
+    # attribute is re-read every epoch — patching the source modules
+    # counts real calls.
+    monkeypatch.setattr(
+        lm_health,
+        "reset_burnin_schedule",
+        _counted(calls, "burnin", lm_health.reset_burnin_schedule),
+    )
+    monkeypatch.setattr(
+        tfd_logging,
+        "reset_warn_once",
+        _counted(calls, "warn", tfd_logging.reset_warn_once),
+    )
+    monkeypatch.setattr(
+        hostinfo_provider,
+        "reset_metadata_provider_cache",
+        _counted(calls, "metadata", hostinfo_provider.reset_metadata_provider_cache),
+    )
+
+    sigs = queue.Queue()
+    sigs.put(signal.SIGHUP)   # epoch 1: reload at the first phase boundary
+    sigs.put(signal.SIGTERM)  # epoch 2: clean exit
+    monkeypatch.setattr(cmd_main, "new_os_watcher", lambda: sigs)
+
+    machine = tmp_path / "machine-type"
+    machine.write_text("Google Compute Engine\n")
+    rc = cmd_main.start(
+        [
+            "--output-file", str(tmp_path / "tfd"),
+            "--machine-type-file", str(machine),
+            "--sleep-interval", "30s",  # never served: signals preempt it
+        ]
+    )
+    assert rc == 0
+    assert calls["engine"] == 2, "SIGHUP must rebuild the engine per epoch"
+    assert [len(c) for c in engine_closes] == [1, 1], (
+        "each epoch's engine must be closed exactly once on epoch end"
+    )
+    assert calls["burnin"] == 2, "burn-in schedule reset skipped on reload"
+    assert calls["warn"] == 2, "warn-once reset skipped on reload"
+    assert calls["metadata"] == 2, "metadata cache reset skipped on reload"

@@ -1,0 +1,100 @@
+"""Unit tests for the supervision primitives: the backoff policy
+(utils/retry.py) and the fault-injection registry (utils/faults.py).
+The daemon-level recovery behaviors they enable are covered by
+test_supervisor.py and test_chaos.py; these pin the primitives' own
+contracts — deterministic delays, strict spec parsing, finite countdowns."""
+
+import pytest
+
+from gpu_feature_discovery_tpu.config.spec import ConfigError
+from gpu_feature_discovery_tpu.resource.types import ResourceError
+from gpu_feature_discovery_tpu.utils import faults
+from gpu_feature_discovery_tpu.utils.retry import BackoffPolicy
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# BackoffPolicy
+# ---------------------------------------------------------------------------
+
+def test_backoff_grows_exponentially_and_caps():
+    p = BackoffPolicy(base=1.0, factor=2.0, cap=10.0, jitter=0.0)
+    assert [p.delay(a) for a in range(5)] == [1.0, 2.0, 4.0, 8.0, 10.0]
+    assert p.delay(1000) == 10.0  # huge attempt indexes must not overflow
+
+
+def test_backoff_jitter_stays_within_fraction():
+    p = BackoffPolicy(base=4.0, factor=1.0, cap=4.0, jitter=0.25)
+    for a in range(50):
+        d = p.delay(a)
+        assert 3.0 <= d <= 5.0
+
+
+def test_backoff_rejects_negative_attempt():
+    with pytest.raises(ValueError):
+        BackoffPolicy().delay(-1)
+
+
+# ---------------------------------------------------------------------------
+# fault spec parsing
+# ---------------------------------------------------------------------------
+
+def test_parse_fail_and_raise_entries():
+    reg = faults.parse_fault_spec(
+        "pjrt_init:fail:3,write:raise:OSError,generate:raise:RuntimeError:2"
+    )
+    assert set(reg.sites) == {"pjrt_init", "write", "generate"}
+
+
+@pytest.mark.parametrize(
+    "bad",
+    [
+        "pjrt_init",                      # no mode
+        "pjrt_init:explode:1",            # unknown mode
+        "pjrt_init:fail",                 # fail without count
+        "pjrt_init:fail:zero",            # non-integer count
+        "pjrt_init:fail:0",               # count must be >= 1
+        "write:raise:SystemExit",         # exception not in the allowlist
+        ":fail:1",                        # empty site
+        "a:fail:1,a:fail:2",              # duplicate site
+    ],
+)
+def test_malformed_specs_fail_loudly(bad):
+    with pytest.raises(ConfigError):
+        faults.parse_fault_spec(bad)
+
+
+def test_fail_mode_counts_down_then_disarms():
+    faults.load_fault_spec("s:fail:2")
+    for _ in range(2):
+        with pytest.raises(faults.FaultInjected):
+            faults.maybe_inject("s")
+    faults.maybe_inject("s")  # third call: drained, no-op
+    faults.maybe_inject("other-site")  # unarmed site: always a no-op
+
+
+def test_raise_mode_uses_named_exception_type():
+    faults.load_fault_spec("w:raise:OSError,r:raise:ResourceError")
+    with pytest.raises(OSError):
+        faults.maybe_inject("w")
+    with pytest.raises(ResourceError):
+        faults.maybe_inject("r")
+    faults.maybe_inject("w")  # default count is 1
+    faults.maybe_inject("r")
+
+
+def test_registry_loads_lazily_from_environment(monkeypatch):
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV, "envsite:fail:1")
+    faults.reset()
+    with pytest.raises(faults.FaultInjected):
+        faults.maybe_inject("envsite")
+    faults.maybe_inject("envsite")
+    faults.reset()
+    monkeypatch.delenv(faults.FAULT_SPEC_ENV)
+    faults.maybe_inject("envsite")  # env cleared + reset: disarmed

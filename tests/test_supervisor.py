@@ -1,0 +1,526 @@
+"""Supervisor acceptance tests (ISSUE 2): backend-init retry with
+degraded-mode labeling, per-cycle crash containment with last-good
+re-serves, escalation bounds, and the heartbeat. Everything is
+deterministic — faults come from the injection registry (utils/faults.py),
+waits are bounded polls over sub-second cycle intervals, and no test
+sleeps longer than 1s at a stretch."""
+
+import os
+import queue
+import signal
+import threading
+import time
+
+import pytest
+
+import gpu_feature_discovery_tpu.cmd.main as cmd_main
+from gpu_feature_discovery_tpu.cmd.main import run
+from gpu_feature_discovery_tpu.cmd.supervisor import (
+    DEGRADED_LABEL,
+    InitRetriesExhausted,
+    Supervisor,
+    TooManyConsecutiveFailures,
+    UNHEALTHY_CYCLES_LABEL,
+)
+from gpu_feature_discovery_tpu.config import new_config
+from gpu_feature_discovery_tpu.lm.labeler import Empty
+from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.resource.testing import new_single_host_manager
+from gpu_feature_discovery_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def cfg(tmp_path, **cli):
+    machine = tmp_path / "machine-type"
+    machine.write_text("Google Compute Engine\n")
+    values = {
+        "oneshot": False,
+        "machine-type-file": str(machine),
+        "output-file": str(tmp_path / "tfd"),
+        "sleep-interval": "0.01s",
+        "init-backoff-max": "0.02s",
+    }
+    values.update(cli)
+    return new_config(cli_values=values, environ={})
+
+
+def labels_at(path):
+    """Parse the label file; {} when absent (a write may be in flight)."""
+    try:
+        with open(path) as f:
+            return dict(
+                line.strip().split("=", 1) for line in f if "=" in line
+            )
+    except OSError:
+        return {}
+
+
+def wait_until(pred, timeout=8.0, interval=0.002):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def start_daemon(config, interconnect=None):
+    """run() on a thread with the supervised factory path (what start()
+    wires for daemon mode). Returns (thread, sigs, result)."""
+    sigs = queue.Queue()
+    result = {}
+
+    def target():
+        try:
+            result["restart"] = run(
+                lambda: cmd_main._build_manager(config),
+                interconnect if interconnect is not None else Empty(),
+                config,
+                sigs,
+                supervisor=Supervisor(config),
+            )
+        except BaseException as e:  # noqa: BLE001 - surfaced by the test
+            result["error"] = e
+
+    t = threading.Thread(target=target)
+    t.start()
+    return t, sigs, result
+
+
+def stop_daemon(t, sigs, result):
+    sigs.put(signal.SIGTERM)
+    t.join(timeout=5)
+    assert not t.is_alive()
+    return result
+
+
+# ---------------------------------------------------------------------------
+# tentpole 1: init retry + degraded mode
+# ---------------------------------------------------------------------------
+
+def test_init_faults_degrade_then_recover(tmp_path, monkeypatch):
+    """The headline acceptance scenario: 3 consecutive PJRT init failures
+    then success. The daemon never exits, publishes degraded labels
+    (tfd.degraded=true, no device labels, machine-type still present)
+    within the first cycle, and converges to full labels afterwards."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    config = cfg(tmp_path, **{"init-retries": "10"})
+    out = config.flags.tfd.output_file
+    faults.load_fault_spec("pjrt_init:fail:3")
+
+    t, sigs, result = start_daemon(config)
+    try:
+        assert wait_until(lambda: labels_at(out).get(DEGRADED_LABEL) == "true"), (
+            f"no degraded labels published; file: {labels_at(out)}"
+        )
+        degraded = labels_at(out)
+        assert "google.com/tpu.count" not in degraded, (
+            "degraded cycle must not fabricate device labels"
+        )
+        assert "google.com/tpu.machine" in degraded, (
+            "machine type is a non-device fact; degraded mode keeps it"
+        )
+
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+            and DEGRADED_LABEL not in labels_at(out)
+        ), f"did not converge to full labels; file: {labels_at(out)}"
+        assert t.is_alive(), "daemon exited during init faults"
+        assert "error" not in result, result.get("error")
+    finally:
+        stop_daemon(t, sigs, result)
+    assert result["restart"] is False
+
+
+def test_init_retries_exhausted_escalates_under_fail_fast(tmp_path, monkeypatch):
+    """fail-on-init-error=true (the default) keeps fail-fast reachable:
+    the attempt budget spends, then the supervisor raises (start() maps
+    that to exit 1). Degraded labels were still served in between."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    config = cfg(tmp_path, **{"init-retries": "2"})
+    out = config.flags.tfd.output_file
+    faults.load_fault_spec("pjrt_init:fail:99")
+
+    with pytest.raises(InitRetriesExhausted):
+        run(
+            lambda: cmd_main._build_manager(config),
+            Empty(),
+            config,
+            queue.Queue(),
+            supervisor=Supervisor(config),
+        )
+    # run()'s deferred cleanup removed the file on exit; the degraded
+    # write DID happen first (the staging dir only appears on a write).
+    assert not os.path.exists(out)
+
+
+def test_fail_on_init_error_false_stays_degraded(tmp_path, monkeypatch):
+    """--fail-on-init-error=false: the attempt budget never escalates —
+    the daemon stays alive and degraded past init-retries attempts,
+    still honoring SIGTERM."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    config = cfg(
+        tmp_path, **{"fail-on-init-error": False, "init-retries": "2"}
+    )
+    out = config.flags.tfd.output_file
+    faults.load_fault_spec("pjrt_init:fail:99")
+
+    t, sigs, result = start_daemon(config)
+    try:
+        assert wait_until(lambda: labels_at(out).get(DEGRADED_LABEL) == "true")
+        # Ride well past 2 attempts' worth of backoff (capped at 20ms).
+        time.sleep(0.3)
+        assert t.is_alive(), f"daemon exited: {result.get('error')}"
+        assert labels_at(out).get(DEGRADED_LABEL) == "true"
+    finally:
+        stop_daemon(t, sigs, result)
+    assert result["restart"] is False
+    assert not os.path.exists(out), "daemon exit must remove the output file"
+
+
+# ---------------------------------------------------------------------------
+# tentpole 2: per-cycle crash containment
+# ---------------------------------------------------------------------------
+
+class FlakyLabeler:
+    """Interconnect stand-in that raises on the given cycle numbers."""
+
+    def __init__(self, fail_cycles=()):
+        self.fail_cycles = set(fail_cycles)
+        self.cycles = 0
+
+    def labels(self):
+        self.cycles += 1
+        if self.cycles in self.fail_cycles:
+            raise RuntimeError(f"injected labeler failure on cycle {self.cycles}")
+        return Labels()
+
+
+def test_mid_cycle_failure_reserves_last_good_with_counter(tmp_path):
+    """One failing cycle re-serves the last-good labels (device labels
+    included) with tfd.unhealthy-cycles=1; the next clean cycle clears
+    the counter. init-backoff-max=0.3s keeps the re-served file
+    observable for a deterministic window."""
+    config = cfg(tmp_path, **{"init-backoff-max": "0.3s"})
+    out = config.flags.tfd.output_file
+    flaky = FlakyLabeler(fail_cycles=(2,))
+    manager = new_single_host_manager("v4-8")
+    sigs = queue.Queue()
+    result = {}
+
+    def target():
+        try:
+            result["restart"] = run(manager, flaky, config, sigs)
+        except BaseException as e:  # noqa: BLE001
+            result["error"] = e
+
+    t = threading.Thread(target=target)
+    t.start()
+    try:
+        assert wait_until(
+            lambda: labels_at(out).get(UNHEALTHY_CYCLES_LABEL) == "1"
+        ), f"no re-served labels; file: {labels_at(out)}"
+        reserved = labels_at(out)
+        assert reserved.get("google.com/tpu.count") == "4", (
+            "re-serve must carry the last-good device labels, not go empty"
+        )
+
+        assert wait_until(
+            lambda: UNHEALTHY_CYCLES_LABEL not in labels_at(out)
+            and labels_at(out).get("google.com/tpu.count") == "4"
+        ), f"did not converge after recovery; file: {labels_at(out)}"
+        assert t.is_alive()
+        assert "error" not in result, result.get("error")
+    finally:
+        sigs.put(signal.SIGTERM)
+        t.join(timeout=5)
+    assert result["restart"] is False
+
+
+def test_max_consecutive_failures_escalates(tmp_path):
+    """Containment is bounded: with --max-consecutive-failures=2, the
+    second straight failed cycle raises instead of containing."""
+    config = cfg(tmp_path, **{"max-consecutive-failures": "2"})
+    always_broken = FlakyLabeler(fail_cycles=range(1, 100))
+    with pytest.raises(TooManyConsecutiveFailures):
+        run(new_single_host_manager("v4-8"), always_broken, config, queue.Queue())
+    assert always_broken.cycles == 2
+
+
+def test_escalation_produces_nonzero_exit_through_start(tmp_path, monkeypatch):
+    """End to end through start(): persistent mid-cycle faults exhaust
+    --max-consecutive-failures and the process exit code is nonzero."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    monkeypatch.setattr(cmd_main, "new_os_watcher", lambda: queue.Queue())
+    machine = tmp_path / "machine-type"
+    machine.write_text("Google Compute Engine\n")
+    faults.load_fault_spec("generate:raise:RuntimeError:99")
+    rc = cmd_main.start(
+        [
+            "--output-file", str(tmp_path / "tfd"),
+            "--machine-type-file", str(machine),
+            "--sleep-interval", "0.01s",
+            "--init-backoff-max", "0.01s",
+            "--max-consecutive-failures", "2",
+        ]
+    )
+    assert rc == 1
+
+
+def test_write_failure_is_contained_and_recovers(tmp_path):
+    """A failing label-file write (read-only features.d, ENOSPC) is a
+    contained cycle failure, not an exit; the file converges once the
+    fault clears."""
+    config = cfg(tmp_path)
+    out = config.flags.tfd.output_file
+    faults.load_fault_spec("write:raise:OSError:2")
+    manager = new_single_host_manager("v4-8")
+    sigs = queue.Queue()
+    result = {}
+
+    def target():
+        try:
+            result["restart"] = run(manager, Empty(), config, sigs)
+        except BaseException as e:  # noqa: BLE001
+            result["error"] = e
+
+    t = threading.Thread(target=target)
+    t.start()
+    try:
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+            and UNHEALTHY_CYCLES_LABEL not in labels_at(out)
+        ), f"file: {labels_at(out)}"
+        assert t.is_alive()
+        assert "error" not in result, result.get("error")
+    finally:
+        sigs.put(signal.SIGTERM)
+        t.join(timeout=5)
+
+
+# ---------------------------------------------------------------------------
+# tentpole 3: heartbeat
+# ---------------------------------------------------------------------------
+
+def test_heartbeat_touched_every_completed_cycle(tmp_path):
+    """The heartbeat mtime advances with cycles even when the label file
+    itself is churn-free (unchanged content skips the rename, so label
+    mtime is NOT a liveness signal — the heartbeat is)."""
+    hb = tmp_path / "heartbeat"
+    config = cfg(tmp_path, **{"heartbeat-file": str(hb), "sleep-interval": "0.02s"})
+    counter = FlakyLabeler()
+    manager = new_single_host_manager("v4-8")
+    sigs = queue.Queue()
+    result = {}
+
+    def target():
+        result["restart"] = run(manager, counter, config, sigs)
+
+    t = threading.Thread(target=target)
+    t.start()
+    try:
+        assert wait_until(hb.exists)
+        first = hb.stat().st_mtime_ns
+        cycles_then = counter.cycles
+        assert wait_until(
+            lambda: counter.cycles >= cycles_then + 2
+            and hb.stat().st_mtime_ns > first
+        ), "heartbeat mtime did not advance across cycles"
+        out = config.flags.tfd.output_file
+        assert labels_at(out).get("google.com/tpu.count") == "4"
+    finally:
+        sigs.put(signal.SIGTERM)
+        t.join(timeout=5)
+
+
+def test_heartbeat_failure_never_kills_a_cycle(tmp_path):
+    """An untouchable heartbeat path logs once and labeling proceeds."""
+    config = cfg(
+        tmp_path,
+        **{"heartbeat-file": str(tmp_path / "no-such-dir" / "hb"), "oneshot": False},
+    )
+    out = config.flags.tfd.output_file
+    sigs = queue.Queue()
+    result = {}
+    manager = new_single_host_manager("v4-8")
+
+    def target():
+        result["restart"] = run(manager, Empty(), config, sigs)
+
+    t = threading.Thread(target=target)
+    t.start()
+    try:
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+        )
+        assert t.is_alive()
+    finally:
+        sigs.put(signal.SIGTERM)
+        t.join(timeout=5)
+    assert result["restart"] is False
+
+
+# ---------------------------------------------------------------------------
+# satellite: SIGTERM honored at the phase boundary, not a full cycle later
+# ---------------------------------------------------------------------------
+
+def test_signal_during_cycle_honored_at_phase_boundary(tmp_path):
+    """A signal that lands while the cycle is generating is consumed at
+    the generation→sleep boundary: the daemon must exit without serving
+    the sleep interval at all."""
+    config = cfg(tmp_path, **{"sleep-interval": "30s"})
+    sigs = queue.Queue()
+    gate = threading.Event()
+
+    class SignalDuringCycle:
+        def labels(self):
+            # Runs INSIDE the cycle: the signal is queued mid-generation.
+            sigs.put(signal.SIGTERM)
+            gate.set()
+            return Labels()
+
+    result = {}
+
+    def target():
+        result["restart"] = run(
+            new_single_host_manager("v4-8"), SignalDuringCycle(), config, sigs
+        )
+
+    t = threading.Thread(target=target)
+    t.start()
+    assert gate.wait(timeout=5)
+    # Well under the 30s sleep interval: the phase-boundary check fired.
+    t.join(timeout=5)
+    assert not t.is_alive(), "SIGTERM waited out the sleep interval"
+    assert result["restart"] is False
+
+
+# ---------------------------------------------------------------------------
+# marker hygiene: status labels describe the CURRENT cycle, never a past one
+# ---------------------------------------------------------------------------
+
+def test_reserve_never_resurrects_stale_markers(tmp_path):
+    """A last-good set captured during a degraded (or stale-marked) cycle
+    must shed those markers when re-served after the backend recovered:
+    markers state current facts, not history."""
+    from gpu_feature_discovery_tpu.lm.engine import STALE_SOURCES_LABEL
+
+    sup = Supervisor(cfg(tmp_path))
+    sup.cycle_succeeded(
+        Labels(
+            {
+                "google.com/tpu.machine": "gce",
+                DEGRADED_LABEL: "true",
+                STALE_SOURCES_LABEL: "health",
+            }
+        )
+    )
+    sup.cycle_failed(RuntimeError("transient write error"))
+    reserve = sup.reserve_labels()
+    assert reserve[UNHEALTHY_CYCLES_LABEL] == "1"
+    assert reserve["google.com/tpu.machine"] == "gce"
+    assert DEGRADED_LABEL not in reserve, "degraded marker resurrected"
+    assert STALE_SOURCES_LABEL not in reserve, "stale marker resurrected"
+
+
+def test_reserve_marks_degraded_when_backend_currently_down(tmp_path):
+    """...but when the backend IS currently failing init, the re-serve
+    carries the degraded marker alongside the counter."""
+    sup = Supervisor(cfg(tmp_path, **{"init-retries": "10"}))
+
+    def broken():
+        raise RuntimeError("backend down")
+
+    assert sup.acquire_manager(broken) is None
+    sup.cycle_failed(RuntimeError("and the degraded cycle write failed too"))
+    reserve = sup.reserve_labels()
+    assert reserve[DEGRADED_LABEL] == "true"
+    assert reserve[UNHEALTHY_CYCLES_LABEL] == "1"
+
+
+def test_failure_before_first_success_keeps_previous_epoch_file(tmp_path):
+    """A fresh epoch (SIGHUP reload / pod restart) whose FIRST cycle fails
+    has no last-good set — it must leave the previous epoch's still-valid
+    label file untouched rather than clobber it with a counter-only file."""
+    config = cfg(tmp_path, **{"init-backoff-max": "0.3s"})
+    out = config.flags.tfd.output_file
+    previous_epoch = "google.com/tpu.count=4\ngoogle.com/tpu.machine=gce\n"
+    with open(out, "w") as f:
+        f.write(previous_epoch)
+    flaky = FlakyLabeler(fail_cycles=(1,))
+    manager = new_single_host_manager("v4-8")
+    sigs = queue.Queue()
+    result = {}
+
+    def target():
+        result["restart"] = run(manager, flaky, config, sigs)
+
+    t = threading.Thread(target=target)
+    t.start()
+    try:
+        # Cycle 1 fails; during its 0.3s backoff the old file must survive.
+        assert wait_until(lambda: flaky.cycles >= 1)
+        content = open(out).read()
+        assert content == previous_epoch, (
+            f"previous epoch's labels clobbered: {content!r}"
+        )
+        assert wait_until(
+            lambda: flaky.cycles >= 2
+            and labels_at(out).get("google.com/tpu.count") == "4"
+            and UNHEALTHY_CYCLES_LABEL not in labels_at(out)
+        )
+    finally:
+        sigs.put(signal.SIGTERM)
+        t.join(timeout=5)
+    assert result["restart"] is False
+
+
+def test_failed_source_build_releases_backend(tmp_path):
+    """An exception AFTER init() but before generate's shutdown-finally
+    (e.g. the chip probe) must not leak the initialized client: the
+    failure handler shuts it down before dropping it, or every re-init
+    would find the device held."""
+    config = cfg(tmp_path, **{"init-backoff-max": "0.3s"})
+    out = config.flags.tfd.output_file
+    manager = new_single_host_manager("v4-8")
+    real_get_chips = manager.get_chips
+    state = {"probes": 0}
+
+    def chips_broken_once():
+        state["probes"] += 1
+        if state["probes"] == 1:
+            raise RuntimeError("chip probe blew up after init")
+        return real_get_chips()
+
+    manager.get_chips = chips_broken_once
+    sigs = queue.Queue()
+    result = {}
+
+    def target():
+        result["restart"] = run(
+            lambda: manager, Empty(), config, sigs,
+            supervisor=Supervisor(config),
+        )
+
+    t = threading.Thread(target=target)
+    t.start()
+    try:
+        # During the post-failure backoff window: exactly one probe ran,
+        # generate never did — the shutdown MUST have come from the
+        # failure handler, not generate's finally.
+        assert wait_until(
+            lambda: state["probes"] == 1 and manager.calls["shutdown"] >= 1
+        ), f"backend leaked: probes={state['probes']} calls={dict(manager.calls)}"
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+        ), "daemon did not recover after releasing the backend"
+    finally:
+        sigs.put(signal.SIGTERM)
+        t.join(timeout=5)
+    assert result["restart"] is False
