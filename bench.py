@@ -127,6 +127,96 @@ def main() -> int:
             samples_ms.append(dt_ms)
     engine.close()
 
+    # Observability overhead (ISSUE 3 acceptance): what enabling the
+    # introspection server costs the cycle, asserted < 5% in CI.
+    # Methodology: ALTERNATING paired blocks — a block of cycles with the
+    # server idle, then a block with a live /metrics scraper (100 ms
+    # cadence, already ~300x production's 30 s), repeated; the metric is
+    # the MEDIAN of the per-pair p50 ratios. Adjacent-in-time pairs
+    # cancel machine drift (a single off-then-on pass measured CPU
+    # weather, not the server: medians of identical back-to-back runs
+    # vary tens of percent on shared runners), and the median across
+    # pairs discards outlier blocks. Registry RECORDING runs in both
+    # conditions (it is unconditional by design); what this isolates is
+    # serving — render lock shares, handler threads, socket accepts.
+    import threading
+    import urllib.request
+
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+    from gpu_feature_discovery_tpu.obs.server import (
+        IntrospectionServer,
+        IntrospectionState,
+    )
+
+    obs_state = IntrospectionState(60.0)
+    obs_server = IntrospectionServer(
+        obs_metrics.REGISTRY, obs_state, addr="127.0.0.1", port=0
+    )
+    obs_server.start()
+    scrape_stop = threading.Event()
+    scrape_on = threading.Event()
+    scrape_count = [0]
+
+    def _scraper():
+        url = f"http://127.0.0.1:{obs_server.port}/metrics"
+        while not scrape_stop.is_set():
+            scrape_on.wait()
+            if scrape_stop.is_set():
+                return
+            try:
+                with urllib.request.urlopen(url, timeout=2) as resp:
+                    resp.read()
+                scrape_count[0] += 1
+            except OSError:  # pragma: no cover - server racing shutdown
+                pass
+            scrape_stop.wait(0.1)
+
+    scraper = threading.Thread(target=_scraper, daemon=True)
+    scraper.start()
+    overhead_engine = new_label_engine(config)
+    block_cycles = max(
+        10, int(os.environ.get("TFD_BENCH_OVERHEAD_BLOCK", "50"))
+    )
+    overhead_pairs = max(
+        3, int(os.environ.get("TFD_BENCH_OVERHEAD_PAIRS", "10"))
+    )
+
+    def _block_p50():
+        block_ms = []
+        for _ in range(block_cycles):
+            t0 = time.perf_counter()
+            cycle_labels = overhead_engine.generate(
+                new_label_sources(
+                    manager, interconnect, config, timestamp=timestamp
+                )
+            )
+            manager.shutdown()
+            cycle_labels.write_to_file(out_file)
+            block_ms.append((time.perf_counter() - t0) * 1e3)
+        return statistics.median(block_ms)
+
+    _block_p50()  # warm the pool/caches outside the comparison
+    pair_ratios = []
+    for _ in range(overhead_pairs):
+        scrape_on.clear()
+        p50_off = _block_p50()
+        scrape_on.set()
+        p50_on = _block_p50()
+        pair_ratios.append((p50_on - p50_off) / p50_off * 100.0)
+    overhead_engine.close()
+    scrape_stop.set()
+    scrape_on.set()
+    scraper.join(timeout=5)
+    obs_server.close()
+    metrics_overhead_pct = round(statistics.median(pair_ratios), 2)
+    print(
+        f"bench: metrics overhead median={metrics_overhead_pct}% over "
+        f"{overhead_pairs} paired blocks of {block_cycles} cycles "
+        f"({scrape_count[0]} concurrent scrapes served); pair ratios "
+        f"{[round(r, 1) for r in sorted(pair_ratios)]}",
+        file=sys.stderr,
+    )
+
     # Burn-in cycle cost (VERDICT r2 next-round #7): on the real chip,
     # measure what a --with-burnin labeling cycle costs next to the plain
     # cycle, proving the --burnin-interval amortization claim with a
@@ -369,6 +459,11 @@ def main() -> int:
                 "p95_slow_source_ms": round(p95_slow, 3),
                 "slow_source_deadline_ms": round(slow_deadline_s * 1e3, 3),
                 "slow_source_stale_cycles": stale_cycles,
+                # Observability acceptance: cycle p50 with the
+                # introspection server live (and a concurrent /metrics
+                # scraper) vs off — CI asserts < 5%. Negative = noise
+                # (the two runs are statistically identical).
+                "metrics_overhead_pct": metrics_overhead_pct,
                 # Supervisor acceptance: cycles from first (faulted) cycle
                 # to the label file holding full labels again, with 2
                 # injected backend-init failures (degraded labels served

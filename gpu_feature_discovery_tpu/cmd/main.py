@@ -45,6 +45,7 @@ from gpu_feature_discovery_tpu.lm.labelers import (
 )
 from gpu_feature_discovery_tpu.lm.labels import remove_output_file
 from gpu_feature_discovery_tpu.lm.timestamp import new_timestamp_labeler
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
 from gpu_feature_discovery_tpu.pci.pciutil import SysfsGooglePCI
 from gpu_feature_discovery_tpu.resource import factory
 from gpu_feature_discovery_tpu.resource.types import Manager
@@ -201,6 +202,54 @@ def start(argv: Optional[list] = None) -> int:
             return 0
 
 
+def start_introspection_server(config: Config, quiet: bool = False):
+    """Bind the obs introspection server for a daemon epoch; returns
+    ``(server, state)`` or ``(None, None)``. Oneshot NEVER serves (a
+    one-off labeling Job has no probe/scrape consumer and must not open
+    sockets) and ``--metrics-port 0`` disables. A bind failure degrades
+    to no-server with a warning rather than killing the daemon — the
+    run loop RETRIES the bind each cycle (``quiet=True`` suppresses the
+    repeat warnings), so a boot-time port race (sidecar, TIME_WAIT from
+    a SIGHUP storm) self-heals instead of leaving the httpGet
+    livenessProbe failing for the pod's lifetime.
+
+    Fields are read straight off the config — the flag layer
+    (config/flags.py) already resolved CLI > env > file > default, and
+    re-stating defaults here would be a second copy that can drift."""
+    tfd = config.flags.tfd
+    if tfd.oneshot or not tfd.metrics_port:
+        return None, None
+    from gpu_feature_discovery_tpu.obs.server import (
+        IntrospectionServer,
+        IntrospectionState,
+    )
+
+    state = IntrospectionState(tfd.sleep_interval)
+    try:
+        server = IntrospectionServer(
+            obs_metrics.REGISTRY,
+            state,
+            addr=tfd.metrics_addr,
+            port=tfd.metrics_port,
+            debug_endpoints=bool(tfd.debug_endpoints),
+        )
+    except OSError as e:
+        if not quiet:
+            log.warning(
+                "cannot bind introspection server on %s:%s: %s "
+                "(will keep retrying each cycle)",
+                tfd.metrics_addr,
+                tfd.metrics_port,
+                e,
+            )
+        return None, None
+    server.start()
+    log.info(
+        "Introspection server listening on %s:%d", tfd.metrics_addr, server.port
+    )
+    return server, state
+
+
 def _build_manager(config: Config) -> Manager:
     """The supervised acquisition unit: factory + eager init as ONE
     retryable step (cmd/supervisor.py backoff wraps exactly this).
@@ -324,6 +373,9 @@ def run(
     # futures must not survive a SIGHUP reload (same staleness contract as
     # reset_burnin_schedule), and the reload rebuilds run() anyway.
     engine = new_label_engine(config)
+    # Introspection server (obs/): daemon epochs only, rebound per epoch
+    # so a SIGHUP reload picks up new --metrics-* flags.
+    obs_server, obs_state = start_introspection_server(config)
     # Whether THIS epoch has written the output file yet: a failure before
     # the first write must not clobber a previous epoch's still-valid
     # file, but once this epoch owns the file its markers must stay
@@ -335,6 +387,16 @@ def run(
             # Per-cycle spans only: without the reset, a cached-health
             # cycle would re-report the last probe's cost as current.
             timing.reset_cycle()
+            if obs_server is None:
+                # A bind that failed at epoch start (port race) is
+                # retried once per cycle: the static manifests point the
+                # livenessProbe at this server, so staying serverless
+                # for the epoch would turn one transient EADDRINUSE into
+                # a kubelet restart loop.
+                obs_server, obs_state = start_introspection_server(
+                    config, quiet=True
+                )
+            cycle_mode = "full"
             try:
                 with timed("labelgen.total"):
                     if current is None and make_manager is not None:
@@ -343,6 +405,7 @@ def run(
                         else:
                             current = make_manager()
                     if current is None and make_manager is not None:
+                        cycle_mode = "degraded"
                         # Backend down: publish the non-device facts plus
                         # the degraded marker instead of publishing
                         # nothing (a label-less TPU node is
@@ -376,6 +439,11 @@ def run(
                 )
                 labels.write_to_file(output_file)
                 wrote_this_epoch = True
+                obs_metrics.CYCLES_TOTAL.labels(outcome=cycle_mode).inc()
+                if obs_state is not None:
+                    obs_state.labels_written(
+                        labels, engine.last_provenance, mode=cycle_mode
+                    )
             except (InitRetriesExhausted, TooManyConsecutiveFailures):
                 raise  # supervision verdicts, not containable faults
             except Exception as e:  # noqa: BLE001 - supervision boundary
@@ -412,6 +480,8 @@ def run(
                         "keeping the existing label file untouched"
                     )
                     supervisor.touch_heartbeat()
+                    if obs_state is not None:
+                        obs_state.cycle_completed()
                 else:
                     reserve = supervisor.reserve_labels()
                     try:
@@ -425,6 +495,12 @@ def run(
                             supervisor.consecutive_failures,
                         )
                         supervisor.touch_heartbeat()
+                        obs_metrics.RESERVES_TOTAL.inc()
+                        if obs_state is not None:
+                            obs_state.labels_written(
+                                reserve, {}, mode="reserved"
+                            )
+                            obs_state.cycle_completed()
                 # The backoff delay replaces the sleep interval for a
                 # failed cycle: sooner than a long interval (retry, don't
                 # idle out 60s on a transient), slower than a short one
@@ -440,6 +516,8 @@ def run(
                 if supervised:
                     supervisor.cycle_succeeded(labels)
                     supervisor.touch_heartbeat()
+                if obs_state is not None:
+                    obs_state.cycle_completed()
 
             if oneshot:
                 return False
@@ -457,6 +535,10 @@ def run(
                 return False
     finally:
         engine.close()
+        if obs_server is not None:
+            # Synchronous close releases the port before a SIGHUP reload
+            # rebinds it.
+            obs_server.close()
         # Deferred cleanup (main.go:149-156): a daemon exit removes the
         # label file so stale labels don't outlive the pod; oneshot leaves
         # the file for NFD.

@@ -51,6 +51,7 @@ from gpu_feature_discovery_tpu.config.flags import (
 )
 from gpu_feature_discovery_tpu.config.spec import Config
 from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
 from gpu_feature_discovery_tpu.resource.types import Manager
 from gpu_feature_discovery_tpu.utils.retry import BackoffPolicy
 
@@ -118,6 +119,12 @@ class Supervisor:
         self._consecutive_failures = 0
         self._last_good: Optional[Labels] = None
         self._heartbeat_warned = False
+        # The degraded/streak gauges reflect THIS epoch from its very
+        # first scrape — an armed-but-healthy supervisor must read 0,
+        # not "series absent".
+        obs_metrics.DEGRADED.set(0)
+        obs_metrics.CONSECUTIVE_CYCLE_FAILURES.set(0)
+        obs_metrics.BACKEND_INIT_BACKOFF.set(0)
 
     # -- backend init -----------------------------------------------------
 
@@ -133,6 +140,8 @@ class Supervisor:
             manager = build()
         except Exception as e:  # noqa: BLE001 - supervision boundary
             self._init_failures += 1
+            obs_metrics.BACKEND_INIT_FAILURES.inc()
+            obs_metrics.DEGRADED.set(1)
             log.warning(
                 "backend init attempt %d/%s failed: %s",
                 self._init_failures,
@@ -150,17 +159,21 @@ class Supervisor:
             attempt = min(self._init_failures - 1, 63)
             delay = self._policy.delay(attempt)
             self._next_init_attempt = now + delay
+            obs_metrics.BACKEND_INIT_BACKOFF.set(delay)
             log.info(
                 "staying degraded; next backend init attempt in %.3fs", delay
             )
             return None
         if self._init_failures:
+            obs_metrics.BACKEND_INIT_RECOVERIES.inc()
             log.info(
                 "backend init recovered after %d failed attempts",
                 self._init_failures,
             )
         self._init_failures = 0
         self._next_init_attempt = 0.0
+        obs_metrics.DEGRADED.set(0)
+        obs_metrics.BACKEND_INIT_BACKOFF.set(0)
         return manager
 
     @property
@@ -181,6 +194,7 @@ class Supervisor:
         from gpu_feature_discovery_tpu.lm.engine import STALE_SOURCES_LABEL
 
         self._consecutive_failures = 0
+        obs_metrics.CONSECUTIVE_CYCLE_FAILURES.set(0)
         remembered = Labels(labels)
         remembered.pop(UNHEALTHY_CYCLES_LABEL, None)
         remembered.pop(DEGRADED_LABEL, None)
@@ -193,6 +207,8 @@ class Supervisor:
         TooManyConsecutiveFailures once the streak hits the bound."""
         self._consecutive_failures += 1
         n = self._consecutive_failures
+        obs_metrics.CYCLES_TOTAL.labels(outcome="failed").inc()
+        obs_metrics.CONSECUTIVE_CYCLE_FAILURES.set(n)
         log.error(
             "labeling cycle failed (%d consecutive, bound %d): %s",
             n,

@@ -21,6 +21,7 @@ from gpu_feature_discovery_tpu.config.spec import (
     TOPOLOGY_STRATEGY_NONE,
     parse_bool as _parse_bool,
     parse_config_file,
+    parse_nonneg_int as _parse_nonneg_int,
     parse_positive_int as _parse_positive_int,
 )
 
@@ -35,6 +36,12 @@ DEFAULT_SLEEP_INTERVAL = 60.0
 DEFAULT_INIT_RETRIES = 5
 DEFAULT_INIT_BACKOFF_MAX = 30.0
 DEFAULT_MAX_CONSECUTIVE_FAILURES = 5
+# Introspection server defaults (obs/server.py; cmd/main.py gates it to
+# daemon mode — oneshot never opens a socket). 0.0.0.0 because the
+# Prometheus scraper reaches the pod over the pod network, not localhost;
+# the port is in the free range next to the node-exporter block.
+DEFAULT_METRICS_ADDR = "0.0.0.0"
+DEFAULT_METRICS_PORT = 9101
 # Per-labeler deadline default (lm/engine.py consumes it; the constant
 # lives here so the config layer never imports the lm layer — config is
 # a leaf below lm in the repo's layer map): generous against every
@@ -301,6 +308,38 @@ FLAG_DEFS: List[FlagDef] = [
         "supervisor escalates to a real nonzero exit",
         setter=lambda c, v: setattr(_f(c).tfd, "max_consecutive_failures", v),
         getter=lambda c: _f(c).tfd.max_consecutive_failures,
+    ),
+    FlagDef(
+        name="metrics-addr",
+        env_vars=("TFD_METRICS_ADDR",),
+        parse=str,
+        default=DEFAULT_METRICS_ADDR,
+        help="bind address for the HTTP introspection server "
+        "(/metrics, /healthz, /readyz, /debug/labels)",
+        setter=lambda c, v: setattr(_f(c).tfd, "metrics_addr", v),
+        getter=lambda c: _f(c).tfd.metrics_addr,
+    ),
+    FlagDef(
+        name="metrics-port",
+        env_vars=("TFD_METRICS_PORT",),
+        parse=_parse_nonneg_int,
+        default=DEFAULT_METRICS_PORT,
+        help="port for the HTTP introspection server; 0 disables it "
+        "entirely (no socket). Served in daemon mode only — oneshot "
+        "never opens a socket regardless of this flag",
+        setter=lambda c, v: setattr(_f(c).tfd, "metrics_port", v),
+        getter=lambda c: _f(c).tfd.metrics_port,
+    ),
+    FlagDef(
+        name="debug-endpoints",
+        env_vars=("TFD_DEBUG_ENDPOINTS",),
+        parse=_parse_bool,
+        default=True,
+        help="serve /debug/labels (last-written labels with per-source "
+        "provenance as JSON) on the introspection server; false leaves "
+        "only /metrics and the probe endpoints",
+        setter=lambda c, v: setattr(_f(c).tfd, "debug_endpoints", v),
+        getter=lambda c: _f(c).tfd.debug_endpoints,
     ),
     FlagDef(
         name="heartbeat-file",

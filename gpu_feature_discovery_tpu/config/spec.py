@@ -118,6 +118,12 @@ class TfdFlags:
     init_backoff_max: Optional[float] = None  # seconds
     max_consecutive_failures: Optional[int] = None
     heartbeat_file: Optional[str] = None  # "" = disabled
+    # Observability subsystem (obs/): the HTTP introspection server's
+    # bind address/port (0 = disabled; served in daemon mode only —
+    # oneshot never opens a socket) and the /debug/labels gate.
+    metrics_addr: Optional[str] = None
+    metrics_port: Optional[int] = None  # 0 = disabled
+    debug_endpoints: Optional[bool] = None
 
 
 @dataclass
@@ -168,6 +174,9 @@ class Config:
                     "initBackoffMax": self.flags.tfd.init_backoff_max,
                     "maxConsecutiveFailures": self.flags.tfd.max_consecutive_failures,
                     "heartbeatFile": self.flags.tfd.heartbeat_file,
+                    "metricsAddr": self.flags.tfd.metrics_addr,
+                    "metricsPort": self.flags.tfd.metrics_port,
+                    "debugEndpoints": self.flags.tfd.debug_endpoints,
                 },
             },
             "sharing": {
@@ -208,6 +217,18 @@ def parse_positive_int(value: Any) -> int:
         raise ConfigError(f"invalid integer: {value!r}") from e
     if n < 1:
         raise ConfigError(f"value must be >= 1: {value!r}")
+    return n
+
+
+def parse_nonneg_int(value: Any) -> int:
+    """Strict non-negative-integer parsing: 0 is a meaningful value
+    (--metrics-port 0 = introspection server disabled)."""
+    try:
+        n = int(str(value).strip())
+    except ValueError as e:
+        raise ConfigError(f"invalid integer: {value!r}") from e
+    if n < 0:
+        raise ConfigError(f"value must be >= 0: {value!r}")
     return n
 
 
@@ -267,6 +288,10 @@ def parse_config_file(path: str) -> Config:
             tfd["maxConsecutiveFailures"]
         )
     config.flags.tfd.heartbeat_file = _opt_str(tfd.get("heartbeatFile"))
+    config.flags.tfd.metrics_addr = _opt_str(tfd.get("metricsAddr"))
+    if tfd.get("metricsPort") is not None:
+        config.flags.tfd.metrics_port = parse_nonneg_int(tfd["metricsPort"])
+    config.flags.tfd.debug_endpoints = _opt_bool(tfd.get("debugEndpoints"))
 
     config.resources = raw.get("resources", {}) or {}
     config.sharing = Sharing.from_dict(raw.get("sharing", {}) or {})

@@ -57,6 +57,7 @@ from typing import Callable, Dict, List, Optional, Set, Tuple
 from gpu_feature_discovery_tpu.config.flags import DEFAULT_LABELER_TIMEOUT
 from gpu_feature_discovery_tpu.lm.labeler import Labeler
 from gpu_feature_discovery_tpu.lm.labels import Labels, label_safe_value
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
 from gpu_feature_discovery_tpu.utils import timing
 
 log = logging.getLogger("tfd.lm")
@@ -204,6 +205,10 @@ class LabelEngine:
         self._state: Dict[str, _SourceState] = {}
         self._stale_prev: Set[str] = set()
         self._lock = threading.Lock()  # pool creation (embedder threads)
+        # Per-source provenance of the most recent generate() — the
+        # /debug/labels payload (obs/server.py): status fresh|stale plus
+        # the measured duration where the source actually finished.
+        self.last_provenance: Dict[str, Dict[str, object]] = {}
 
     # -- public -----------------------------------------------------------
 
@@ -230,6 +235,7 @@ class LabelEngine:
         for src in sources:
             with timing.timed(f"labeler.{src.name}"):
                 merged.update(src.run())
+        self.last_provenance = self._provenance(sources, stale=[])
         return merged
 
     # -- parallel ----------------------------------------------------------
@@ -297,9 +303,30 @@ class LabelEngine:
         for src in sources:
             merged.update(results[src.name])
         self._log_stale_transitions(stale)
+        obs_metrics.STALE_SOURCES.set(len(stale))
+        for name in stale:
+            obs_metrics.LABELER_DEADLINE_MISSES.labels(labeler=name).inc()
+        self.last_provenance = self._provenance(sources, stale=stale)
         if stale:
             merged[STALE_SOURCES_LABEL] = label_safe_value(_STALE_JOIN.join(stale))
         return merged
+
+    def _provenance(
+        self, sources: List[LabelSource], stale: List[str]
+    ) -> Dict[str, Dict[str, object]]:
+        """status + duration per source for /debug/labels. Durations come
+        from the cycle stage store, so a straggler that has not finished
+        reports null — it genuinely has no duration yet."""
+        stages = obs_metrics.cycle_stages()
+        stale_set = set(stale)
+        out: Dict[str, Dict[str, object]] = {}
+        for src in sources:
+            elapsed = stages.get(f"labeler.{src.name}")
+            out[src.name] = {
+                "status": "stale" if src.name in stale_set else "fresh",
+                "duration_ms": round(elapsed * 1e3, 3) if elapsed is not None else None,
+            }
+        return out
 
     def _run_source(self, src: LabelSource) -> Labels:
         t0 = time.perf_counter()
@@ -314,6 +341,7 @@ class LabelEngine:
         is a source that is served stale forever with nobody told why."""
         fut, state.inflight = state.inflight, None
         state.last_good = fut.result()
+        obs_metrics.STRAGGLERS_HARVESTED.labels(labeler=name).inc()
         log.info("labeler %r caught up; straggler result cached", name)
 
     def _log_stale_transitions(self, stale: List[str]) -> None:

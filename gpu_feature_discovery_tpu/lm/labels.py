@@ -14,7 +14,10 @@ import os
 import shutil
 import sys
 import tempfile
+import time
 from typing import TextIO
+
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
 
 TMP_SUBDIR = "tfd-tmp"
 TMP_PREFIX = "tfd-"
@@ -72,13 +75,19 @@ class Labels(dict):
         maybe_inject("write")
         if not path:
             self.write_to(sys.stdout)
+            obs_metrics.LABEL_WRITES.inc()
+            obs_metrics.LABELS_PUBLISHED.set(len(self))
             return
         buf = io.StringIO()
         self.write_to(buf)
         contents = buf.getvalue().encode()
         if _file_contents_equal(path, contents):
+            obs_metrics.LABEL_WRITE_SKIPS.inc()
             return
         _write_file_atomically(path, contents, OUTPUT_MODE)
+        obs_metrics.LABEL_WRITES.inc()
+        obs_metrics.LABEL_FILE_BYTES.set(len(contents))
+        obs_metrics.LABELS_PUBLISHED.set(len(self))
 
 
 def _file_contents_equal(path: str, contents: bytes) -> bool:
@@ -115,7 +124,11 @@ def _write_file_atomically(path: str, contents: bytes, perm: int) -> None:
         with os.fdopen(fd, "wb") as f:
             f.write(contents)
             f.flush()
+            fsync_start = time.perf_counter()
             os.fsync(f.fileno())
+            obs_metrics.FSYNC_DURATION.observe(
+                time.perf_counter() - fsync_start
+            )
         os.replace(tmp_name, abs_path)
     except BaseException:
         try:

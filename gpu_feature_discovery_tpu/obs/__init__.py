@@ -1,0 +1,13 @@
+"""Observability subsystem: metrics registry + HTTP introspection server.
+
+``registry`` is the dependency-free Prometheus primitives layer
+(Counter/Gauge/Histogram + text exposition 0.0.4); ``metrics`` defines
+every ``tfd_*`` series the daemon publishes and is the single source of
+truth the per-cycle timing plumbing (utils/timing.py) renders from;
+``server`` is the stdlib HTTP daemon serving ``/metrics``, ``/healthz``,
+``/readyz``, and ``/debug/labels``.
+
+Layering: this package imports nothing from cmd/lm/resource/config — it
+is a leaf the instrumented layers call into, so instrumentation can never
+introduce an import cycle.
+"""

@@ -1,0 +1,180 @@
+"""The daemon's metric families (every ``tfd_*`` series) + the per-cycle
+stage store.
+
+This module is the single source of truth for cycle observability:
+``utils/timing.py``'s cycle summary and ``--timings-file`` JSON are VIEWS
+over the stage store here (``observe_stage``/``cycle_stages``), and the
+HTTP server renders ``REGISTRY``. Instrumented layers import the metric
+objects directly; nothing here imports back into cmd/lm/resource/config,
+so instrumentation can never create a cycle.
+
+Every metric name, type, and label below is documented in
+``docs/observability.md`` — tests/test_obs.py pins the two in sync.
+Recording is unconditional and costs nanoseconds; whether a scraper can
+SEE the registry is what ``--metrics-port`` gates (cmd/main.py), so
+enabling the server mid-fleet needs no behavior change in the hot path.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Dict
+
+from gpu_feature_discovery_tpu.obs.registry import Registry
+
+REGISTRY = Registry()
+
+# -- cycle outcomes (cmd/main.py + cmd/supervisor.py) -----------------------
+
+CYCLES_TOTAL = REGISTRY.counter(
+    "tfd_cycles_total",
+    "Labeling cycle attempts by outcome: full (all sources, file written), "
+    "degraded (backend down, non-device labels written), failed (exception "
+    "contained by the supervisor).",
+    labelnames=("outcome",),
+)
+RESERVES_TOTAL = REGISTRY.counter(
+    "tfd_reserves_total",
+    "Failed cycles whose last-good labels were re-served to the output "
+    "file (with the tfd.unhealthy-cycles marker).",
+)
+CONSECUTIVE_CYCLE_FAILURES = REGISTRY.gauge(
+    "tfd_consecutive_cycle_failures",
+    "Current streak of failed labeling cycles (the tfd.unhealthy-cycles "
+    "label value); 0 after any clean cycle.",
+)
+CYCLE_DURATION = REGISTRY.histogram(
+    "tfd_cycle_duration_seconds",
+    "End-to-end label generation time per cycle (the labelgen.total span).",
+)
+LAST_CYCLE_COMPLETED = REGISTRY.gauge(
+    "tfd_last_cycle_completed_timestamp_seconds",
+    "Wall-clock time of the last COMPLETED cycle (full, degraded, or "
+    "re-served) — the same event that touches the heartbeat file.",
+)
+
+# -- backend init / degraded mode (resource/factory.py, cmd/supervisor.py) --
+
+BACKEND_INIT_ATTEMPTS = REGISTRY.counter(
+    "tfd_backend_init_attempts_total",
+    "Backend factory invocations (construction attempts), healthy or not.",
+)
+BACKEND_INIT_FAILURES = REGISTRY.counter(
+    "tfd_backend_init_failures_total",
+    "Supervised backend construction+init attempts that raised (one per "
+    "degraded acquisition attempt).",
+)
+BACKEND_INIT_RECOVERIES = REGISTRY.counter(
+    "tfd_backend_init_recoveries_total",
+    "Times the backend came back after one or more failed init attempts.",
+)
+BACKEND_INIT_BACKOFF = REGISTRY.gauge(
+    "tfd_backend_init_backoff_seconds",
+    "Backoff delay before the next backend init attempt; 0 while healthy.",
+)
+DEGRADED = REGISTRY.gauge(
+    "tfd_degraded",
+    "1 while the device backend is failing init and degraded labels are "
+    "being published (the tfd.degraded marker), else 0.",
+)
+
+# -- label engine (lm/engine.py) --------------------------------------------
+
+LABELER_DURATION = REGISTRY.histogram(
+    "tfd_labeler_duration_seconds",
+    "Per-labeler wall time, recorded when the labeler finishes (a "
+    "deadline-missed straggler contributes no sample until it completes).",
+    labelnames=("labeler",),
+)
+LABELER_DEADLINE_MISSES = REGISTRY.counter(
+    "tfd_labeler_deadline_misses_total",
+    "Cycles in which the named labeler exceeded --labeler-timeout and was "
+    "served from its last-good cache.",
+    labelnames=("labeler",),
+)
+STRAGGLERS_HARVESTED = REGISTRY.counter(
+    "tfd_labeler_stragglers_harvested_total",
+    "Deadline-missed labelers whose late result a subsequent cycle folded "
+    "back into the cache.",
+    labelnames=("labeler",),
+)
+STALE_SOURCES = REGISTRY.gauge(
+    "tfd_stale_sources",
+    "Sources served from the last-good cache in the most recent parallel "
+    "cycle (the tfd.stale-sources label names them).",
+)
+
+# -- label file output (lm/labels.py) ---------------------------------------
+
+LABEL_WRITES = REGISTRY.counter(
+    "tfd_label_file_writes_total",
+    "Label serializations that reached the output (atomic rename, or "
+    "stdout when no output file is configured).",
+)
+LABEL_WRITE_SKIPS = REGISTRY.counter(
+    "tfd_label_file_write_skips_total",
+    "Churn-free skips: cycles whose serialized labels were byte-identical "
+    "to the file on disk, so no rename happened and NFD saw no event.",
+)
+LABEL_FILE_BYTES = REGISTRY.gauge(
+    "tfd_label_file_bytes",
+    "Serialized size of the last label set written.",
+)
+LABELS_PUBLISHED = REGISTRY.gauge(
+    "tfd_labels_published",
+    "Number of labels in the last written set.",
+)
+FSYNC_DURATION = REGISTRY.histogram(
+    "tfd_file_fsync_duration_seconds",
+    "fsync cost of the staged file before its atomic rename (label and "
+    "timings files both stage through the same writer).",
+)
+
+# -- per-cycle stage store (the utils/timing.py backing) --------------------
+
+# Most recent duration per named span, cleared at cycle start. Writers are
+# the labeling path (engine workers + sequential merge); readers snapshot
+# under the same lock, so the "dict changed size during iteration" hazard
+# the old timing-module contract documented is structurally gone.
+_stage_lock = threading.Lock()
+_cycle_stages: Dict[str, float] = {}
+
+STAGE_DURATION = REGISTRY.gauge(
+    "tfd_stage_duration_seconds",
+    "Most recent duration of each named span (the Cycle timings log line "
+    "and --timings-file render from the same store).",
+    labelnames=("stage",),
+)
+
+
+def observe_stage(stage: str, elapsed: float) -> None:
+    """One named span finished: feed the per-cycle store, the last-value
+    gauge, and — for labeler/cycle spans — the duration histograms. The
+    single entry point both engine modes and the daemon loop record
+    through, so every timing view agrees by construction."""
+    with _stage_lock:
+        _cycle_stages[stage] = elapsed
+    STAGE_DURATION.labels(stage=stage).set(elapsed)
+    if stage.startswith("labeler."):
+        LABELER_DURATION.observe(elapsed, labeler=stage[len("labeler."):])
+    elif stage == "labelgen.total":
+        CYCLE_DURATION.observe(elapsed)
+
+
+def reset_cycle_stages() -> None:
+    with _stage_lock:
+        _cycle_stages.clear()
+
+
+def cycle_stages() -> Dict[str, float]:
+    """Snapshot of the spans recorded since the last reset."""
+    with _stage_lock:
+        return dict(_cycle_stages)
+
+
+def reset_for_tests() -> None:
+    """Zero every series and forget the cycle stages, so a test can
+    assert exact counter values (the chaos scrape acceptance pins
+    tfd_backend_init_failures_total == injected failures)."""
+    REGISTRY.reset_values()
+    reset_cycle_stages()

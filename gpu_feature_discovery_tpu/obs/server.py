@@ -1,0 +1,205 @@
+"""HTTP introspection server: /metrics, /healthz, /readyz, /debug/labels.
+
+The standard Kubernetes observability contract the sibling node agents
+ship (dcgm-exporter, the NFD worker): a Prometheus exposition endpoint
+plus health/readiness probes, served by a stdlib ``ThreadingHTTPServer``
+on daemon threads — a wedged scrape can never hold up daemon shutdown,
+exactly the property the label engine's pool already has.
+
+Endpoint semantics:
+
+- ``/metrics`` — the registry rendered as text exposition 0.0.4.
+- ``/healthz`` — 200 while the loop is LIVE: the last completed cycle
+  (full, degraded, or re-served — the heartbeat-touch event) is within
+  3x the sleep interval; 503 once the loop has silently stopped
+  completing cycles. Degraded is healthy: the supervisor owns recovery,
+  and restarting a degraded pod would race it (same contract as the
+  heartbeat exec probe this replaces).
+- ``/readyz`` — 200 once this epoch has written a label file at all;
+  stays ready while degraded (a degraded file is still a served file).
+- ``/debug/labels`` — JSON: the last written labels with per-source
+  provenance (fresh/stale this cycle, duration, write mode, generation
+  counter). Gated by ``--debug-endpoints``.
+
+The server is bound by cmd/main.run for daemon epochs only (oneshot
+never serves; ``--metrics-port 0`` disables) and closed at epoch end, so
+a SIGHUP reload rebinds cleanly.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Callable, Dict, Optional
+from urllib.parse import urlsplit
+
+from gpu_feature_discovery_tpu.obs import metrics
+from gpu_feature_discovery_tpu.obs.registry import CONTENT_TYPE, Registry
+
+log = logging.getLogger("tfd.obs")
+
+# A loop is stale once no cycle completed for this many sleep intervals:
+# one interval is the normal cadence, a second absorbs a slow cycle, the
+# third is genuine wedge territory (matches the heartbeat probe's
+# staleSeconds guidance of comfortably above interval + backoff cap).
+HEALTHZ_INTERVALS = 3.0
+
+
+class IntrospectionState:
+    """What the daemon loop tells the endpoints. Updated from the run
+    loop (cycle completions, label writes), read from server threads —
+    every access takes the lock; values are tiny."""
+
+    def __init__(
+        self,
+        sleep_interval_s: float,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._sleep_interval = max(float(sleep_interval_s), 0.0)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._started = clock()
+        self._last_cycle: Optional[float] = None
+        self._cycles_completed = 0
+        self._ready = False
+        self._debug: Dict[str, Any] = {
+            "generation": 0,
+            "mode": None,
+            "degraded": False,
+            "labels": {},
+            "sources": {},
+        }
+
+    # -- writers (run loop) ------------------------------------------------
+
+    def cycle_completed(self) -> None:
+        """A cycle COMPLETED — full, degraded, or re-served: the same
+        event that touches the heartbeat file feeds /healthz."""
+        with self._lock:
+            self._last_cycle = self._clock()
+            self._cycles_completed += 1
+        metrics.LAST_CYCLE_COMPLETED.set(time.time())
+
+    def labels_written(
+        self,
+        labels: Dict[str, str],
+        sources: Optional[Dict[str, Dict[str, Any]]] = None,
+        mode: str = "full",
+    ) -> None:
+        """A label file landed this epoch: flips /readyz and refreshes
+        the /debug/labels snapshot. ``mode`` is full | degraded |
+        reserved; ``sources`` is the engine's per-source provenance."""
+        with self._lock:
+            self._ready = True
+            self._debug = {
+                "generation": self._debug["generation"] + 1,
+                "mode": mode,
+                "degraded": mode != "full",
+                "labels": dict(labels),
+                "sources": dict(sources or {}),
+            }
+
+    # -- readers (server threads) ------------------------------------------
+
+    def healthy(self) -> "tuple[bool, str]":
+        with self._lock:
+            last = self._last_cycle if self._last_cycle is not None else self._started
+            since = self._clock() - last
+            threshold = HEALTHZ_INTERVALS * self._sleep_interval
+            if self._sleep_interval and since > threshold:
+                return False, (
+                    f"no completed cycle for {since:.1f}s "
+                    f"(threshold {threshold:.1f}s)"
+                )
+            return True, f"ok: {self._cycles_completed} cycles completed"
+
+    def ready(self) -> "tuple[bool, str]":
+        with self._lock:
+            if self._ready:
+                return True, "ok: label file written this epoch"
+            return False, "no label file written yet this epoch"
+
+    def debug_snapshot(self) -> Dict[str, Any]:
+        with self._lock:
+            return json.loads(json.dumps(self._debug))
+
+
+def _make_handler(
+    registry: Registry, state: IntrospectionState, debug_endpoints: bool
+):
+    class _Handler(BaseHTTPRequestHandler):
+        # Content-Length is always sent, so keep-alive is safe.
+        protocol_version = "HTTP/1.1"
+
+        def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
+            path = urlsplit(self.path).path
+            if path == "/metrics":
+                self._reply(200, registry.render().encode(), CONTENT_TYPE)
+            elif path == "/healthz":
+                ok, detail = state.healthy()
+                self._reply(200 if ok else 503, (detail + "\n").encode())
+            elif path == "/readyz":
+                ok, detail = state.ready()
+                self._reply(200 if ok else 503, (detail + "\n").encode())
+            elif path == "/debug/labels" and debug_endpoints:
+                body = json.dumps(
+                    state.debug_snapshot(), indent=2, sort_keys=True
+                ).encode()
+                self._reply(200, body + b"\n", "application/json")
+            else:
+                self._reply(404, b"not found\n")
+
+        def _reply(self, code: int, body: bytes, ctype: str = "text/plain"):
+            self.send_response(code)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def log_message(self, format, *args):  # noqa: A002 - stdlib name
+            log.debug("introspection: %s", format % args)
+
+    return _Handler
+
+
+class IntrospectionServer:
+    """Daemon-threaded HTTP server over a registry + state pair. ``port``
+    0 binds an ephemeral port (tests); the FLAG-level port 0 means
+    "disabled" and is resolved by the caller before this is built."""
+
+    def __init__(
+        self,
+        registry: Registry,
+        state: IntrospectionState,
+        addr: str = "0.0.0.0",
+        port: int = 0,
+        debug_endpoints: bool = True,
+    ):
+        self._httpd = ThreadingHTTPServer(
+            (addr, port), _make_handler(registry, state, debug_endpoints)
+        )
+        self._httpd.daemon_threads = True
+        self.addr = self._httpd.server_address[0]
+        self.port = self._httpd.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> None:
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever,
+            kwargs={"poll_interval": 0.1},
+            name="tfd-introspection",
+            daemon=True,
+        )
+        self._thread.start()
+
+    def close(self) -> None:
+        """Stop serving and release the port (synchronous, so a SIGHUP
+        reload can rebind the same address immediately)."""
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -42,8 +42,10 @@ def new_manager(config: Config, wrap_fallback: bool = True) -> Manager:
     exhausted retries escalate to an exit or stay degraded. Oneshot and
     embedder paths keep the reference's wrapper semantics.
     """
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
     from gpu_feature_discovery_tpu.utils.faults import maybe_inject
 
+    obs_metrics.BACKEND_INIT_ATTEMPTS.inc()
     maybe_inject("pjrt_init")
     manager = _get_manager(config)
     if not wrap_fallback:

@@ -1,22 +1,20 @@
-"""Per-labeler duration tracing.
+"""Per-labeler duration tracing — a VIEW over the obs registry.
 
-The reference has no tracing at all (SURVEY.md section 5); we add a light
-per-stage timer to prove the <100ms label-generation p50 target from
-BASELINE.json, logged at debug level and queryable by bench.py.
+The reference has no tracing at all (SURVEY.md section 5); PR 1 added a
+module-local span map here, and the observability subsystem (obs/) then
+became the second holder of the same durations. This module now keeps
+only the rendering: spans are STORED in ``obs.metrics`` (the per-cycle
+stage store plus the ``tfd_stage_duration_seconds`` gauge and the
+``tfd_labeler_duration_seconds`` / ``tfd_cycle_duration_seconds``
+histograms), and the two human-facing outputs — the per-cycle
+``cycle_summary()`` log line and the ``--timings-file`` JSON — render
+from a registry snapshot. One store, every view agrees by construction,
+and the old "readers must snapshot the dict" footgun is gone (the store
+snapshots under its own lock).
 
-Stages are recorded into one flat ``last_durations`` map (most recent
-duration per named span). The daemon loop clears it at cycle start
-(``reset_cycle``) and reads it back two ways after each cycle:
-``cycle_summary()`` renders one log line for operators tailing the pod,
-and ``write_timings_file()`` dumps the same spans as JSON for scrapers
-(gated by ``--timings-file``). Writers are the labeling path only — the
-engine's worker threads and the sequential merge — and a plain dict
-assignment/clear is a single atomic C-level operation under the GIL, so
-no lock; READERS must snapshot via ``dict(last_durations)`` (also one
-C-level op) before iterating — a straggling labeler can finish and
-insert its span at any moment, and a Python-level iteration would die
-with "dictionary changed size during iteration".
-"""
+The ``--timings-file`` document schema (``{"stages_ms": {stage: ms}}``,
+ms rounded to 3 decimals, sorted keys) is a PR 1 contract consumed by
+scrapers; tests/test_obs.py pins it against a golden."""
 
 from __future__ import annotations
 
@@ -24,20 +22,19 @@ import json
 import logging
 import time
 from contextlib import contextmanager
-from typing import Dict, Iterator
+from typing import Iterator
+
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
 
 log = logging.getLogger("tfd.timing")
-
-# Most recent duration (seconds) per stage name; overwritten on every pass.
-last_durations: Dict[str, float] = {}
 
 
 def record(stage: str, elapsed: float) -> None:
     """Record a named span's duration (seconds). The engine's parallel
     path measures futures directly and records here; the sequential path
-    goes through ``timed``. Same map either way, so the cycle summary and
-    timings file are mode-agnostic."""
-    last_durations[stage] = elapsed
+    goes through ``timed``. Same store either way, so the cycle summary,
+    timings file, and Prometheus series are mode-agnostic."""
+    obs_metrics.observe_stage(stage, elapsed)
     log.debug("stage %s took %.3f ms", stage, elapsed * 1e3)
 
 
@@ -55,15 +52,16 @@ def reset_cycle() -> None:
     so the summary and timings file report only spans that actually ran
     since — a cached-health cycle must not re-report the last probe's
     cost as if it were fresh, and a deadline-missed labeler contributes
-    no span until it actually finishes."""
-    last_durations.clear()
+    no span until it actually finishes. (The Prometheus histograms are
+    cumulative by design and are NOT reset.)"""
+    obs_metrics.reset_cycle_stages()
 
 
 def cycle_summary() -> str:
     """One-line ``stage=N.NNNms`` rendering of every recorded span, the
     total first — the per-cycle observability line the daemon logs
     (docs/operations.md)."""
-    snapshot = dict(last_durations)  # module-docstring reader contract
+    snapshot = obs_metrics.cycle_stages()
     items = sorted(
         snapshot.items(), key=lambda kv: (kv[0] != "labelgen.total", kv[0])
     )
@@ -79,7 +77,7 @@ def write_timings_file(path: str) -> None:
         return
     from gpu_feature_discovery_tpu.lm.labels import _write_file_atomically
 
-    snapshot = dict(last_durations)  # module-docstring reader contract
+    snapshot = obs_metrics.cycle_stages()
     doc = {"stages_ms": {k: round(v * 1e3, 3) for k, v in snapshot.items()}}
     try:
         _write_file_atomically(path, json.dumps(doc, sort_keys=True).encode(), 0o644)

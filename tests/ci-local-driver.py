@@ -114,6 +114,13 @@ TWIN_MAP = {
         "the identical e2e script against the fake apiserver, all "
         "backend/strategy/manifest scenarios",
     ),
+    "Scrape /metrics and /healthz from the TFD pod": (
+        "python -m pytest -q "
+        "tests/test_obs.py::test_live_scrape_during_chaos_cycle",
+        "a live HTTP scrape of the REAL daemon loop's introspection "
+        "server (under injected faults, so the degraded series appear); "
+        "the kubectl-exec transport is what the networked run adds",
+    ),
     "Tier-4 slice-consistency e2e (two workers, two nodes)": (
         "python -m pytest -q "
         "tests/test_e2e_script.py::test_e2e_slice_consistency_two_workers",

@@ -1,0 +1,187 @@
+"""promtool-lite: a hermetic validator for Prometheus text exposition
+format 0.0.4 (the `promtool check metrics` analog, no network, no
+binary).
+
+The CI scrape step can only grep for a series name; this validates the
+GRAMMAR of a live scrape — malformed HELP/TYPE lines, invalid metric or
+label names, unescaped label values, broken histograms (non-cumulative
+buckets, missing +Inf, _count disagreeing with the +Inf bucket), samples
+typed under no family, duplicate series — so an exposition bug fails
+hermetically on every unit run instead of on the first real Prometheus
+scrape. Fail-loud like helm_lite: anything outside the implemented
+grammar subset raises, never passes silently.
+
+Usage: ``validate_exposition(text)`` returns {family: type} or raises
+``ExpositionError`` naming the first offending line.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Dict, List, Tuple
+
+METRIC_NAME = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+LABEL_NAME = re.compile(r"^[a-zA-Z_][a-zA-Z0-9_]*$")
+TYPES = {"counter", "gauge", "histogram", "summary", "untyped"}
+
+_SAMPLE = re.compile(
+    r"^(?P<name>[a-zA-Z_:][a-zA-Z0-9_:]*)"
+    r"(?:\{(?P<labels>.*)\})?"
+    r" (?P<value>[^ ]+)"
+    r"(?: (?P<ts>-?\d+))?$"
+)
+_LABEL_PAIR = re.compile(
+    r'\s*(?P<name>[a-zA-Z_][a-zA-Z0-9_]*)="(?P<value>(?:[^"\\]|\\.)*)"\s*(?:,|$)'
+)
+_VALUE = re.compile(r"^(?:[+-]?Inf|NaN|-?\d+(?:\.\d+)?(?:[eE][+-]?\d+)?)$")
+
+
+class ExpositionError(ValueError):
+    pass
+
+
+def _fail(lineno: int, line: str, why: str) -> None:
+    raise ExpositionError(f"line {lineno}: {why}: {line!r}")
+
+
+def _parse_labels(raw: str, lineno: int, line: str) -> Tuple[Tuple[str, str], ...]:
+    pairs: List[Tuple[str, str]] = []
+    pos = 0
+    while pos < len(raw):
+        m = _LABEL_PAIR.match(raw, pos)
+        if not m or m.end() == pos:
+            _fail(lineno, line, f"malformed label pairs at {raw[pos:]!r}")
+        name = m.group("name")
+        if name.startswith("__"):
+            _fail(lineno, line, f"reserved label name {name!r}")
+        pairs.append((name, m.group("value")))
+        pos = m.end()
+    seen = [n for n, _ in pairs]
+    if len(seen) != len(set(seen)):
+        _fail(lineno, line, "duplicate label name")
+    return tuple(pairs)
+
+
+def _base_family(name: str, families: Dict[str, str]) -> str:
+    """The family a sample belongs to: histogram/summary samples carry
+    the _bucket/_sum/_count suffix of their declared base family."""
+    for suffix in ("_bucket", "_sum", "_count"):
+        if name.endswith(suffix):
+            base = name[: -len(suffix)]
+            if families.get(base) in ("histogram", "summary"):
+                return base
+    return name
+
+
+def validate_exposition(text: str) -> Dict[str, str]:
+    """Validate one scrape payload; returns {family_name: type}."""
+    if not text.endswith("\n"):
+        raise ExpositionError("exposition must end with a newline")
+    families: Dict[str, str] = {}
+    helps: Dict[str, str] = {}
+    # family -> list of (sample_name, labelset) for duplicate detection
+    seen_series: Dict[Tuple[str, Tuple[Tuple[str, str], ...]], int] = {}
+    # histogram family -> {labelset-without-le: [(le, cumulative_count)]}
+    hist_buckets: Dict[str, Dict[Tuple, List[Tuple[float, float]]]] = {}
+    hist_counts: Dict[str, Dict[Tuple, float]] = {}
+    hist_sums: Dict[str, Dict[Tuple, float]] = {}
+    last_family = None
+
+    for lineno, line in enumerate(text.splitlines(), 1):
+        if not line.strip():
+            continue
+        if line.startswith("# HELP "):
+            rest = line[len("# HELP "):]
+            name, _, _help = rest.partition(" ")
+            if not METRIC_NAME.match(name):
+                _fail(lineno, line, f"invalid metric name {name!r}")
+            if name in helps:
+                _fail(lineno, line, "second HELP for family")
+            helps[name] = _help
+            continue
+        if line.startswith("# TYPE "):
+            rest = line[len("# TYPE "):]
+            parts = rest.split(" ")
+            if len(parts) != 2:
+                _fail(lineno, line, "TYPE wants '<name> <type>'")
+            name, mtype = parts
+            if not METRIC_NAME.match(name):
+                _fail(lineno, line, f"invalid metric name {name!r}")
+            if mtype not in TYPES:
+                _fail(lineno, line, f"unknown type {mtype!r}")
+            if name in families:
+                _fail(lineno, line, "second TYPE for family")
+            families[name] = mtype
+            last_family = name
+            continue
+        if line.startswith("#"):
+            continue  # free comment
+        m = _SAMPLE.match(line)
+        if not m:
+            _fail(lineno, line, "unparseable sample")
+        name = m.group("name")
+        if not _VALUE.match(m.group("value")):
+            _fail(lineno, line, f"unparseable value {m.group('value')!r}")
+        value = float(m.group("value").replace("Inf", "inf"))
+        labels = _parse_labels(m.group("labels") or "", lineno, line)
+        family = _base_family(name, families)
+        if family not in families:
+            _fail(lineno, line, f"sample {name!r} has no TYPE declaration")
+        if family != last_family:
+            _fail(
+                lineno, line,
+                f"sample of family {family!r} outside its TYPE block "
+                f"(current {last_family!r})",
+            )
+        key = (name, labels)
+        if key in seen_series:
+            _fail(lineno, line, "duplicate series (same name + labelset)")
+        seen_series[key] = lineno
+        mtype = families[family]
+        if mtype == "counter" and name == family and value < 0:
+            _fail(lineno, line, "negative counter")
+        if mtype == "histogram":
+            without_le = tuple(p for p in labels if p[0] != "le")
+            if name == f"{family}_bucket":
+                le_raw = dict(labels).get("le")
+                if le_raw is None:
+                    _fail(lineno, line, "histogram bucket without le label")
+                le = float(le_raw.replace("Inf", "inf"))
+                hist_buckets.setdefault(family, {}).setdefault(
+                    without_le, []
+                ).append((le, value))
+            elif name == f"{family}_count":
+                hist_counts.setdefault(family, {})[without_le] = value
+            elif name == f"{family}_sum":
+                hist_sums.setdefault(family, {})[without_le] = value
+            elif name == family:
+                _fail(lineno, line, "bare sample under a histogram family")
+
+    for family, per_series in hist_buckets.items():
+        for labelset, buckets in per_series.items():
+            les = [le for le, _ in buckets]
+            counts = [c for _, c in buckets]
+            if les != sorted(les):
+                raise ExpositionError(
+                    f"{family}{labelset}: bucket le values not sorted: {les}"
+                )
+            if not les or les[-1] != float("inf"):
+                raise ExpositionError(f"{family}{labelset}: no +Inf bucket")
+            if counts != sorted(counts):
+                raise ExpositionError(
+                    f"{family}{labelset}: bucket counts not cumulative: {counts}"
+                )
+            count = hist_counts.get(family, {}).get(labelset)
+            if count is None:
+                raise ExpositionError(f"{family}{labelset}: missing _count")
+            if labelset not in hist_sums.get(family, {}):
+                raise ExpositionError(f"{family}{labelset}: missing _sum")
+            if count != counts[-1]:
+                raise ExpositionError(
+                    f"{family}{labelset}: _count {count} != +Inf bucket "
+                    f"{counts[-1]}"
+                )
+    for family in families:
+        if family not in helps:
+            raise ExpositionError(f"family {family!r} has TYPE but no HELP")
+    return families

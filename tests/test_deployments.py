@@ -94,6 +94,26 @@ def test_strategy_variants_differ_only_in_strategy():
         assert variant == base
 
 
+def test_static_daemonsets_expose_metrics_and_http_probes():
+    """The observability contract (docs/observability.md): every static
+    daemonset serves the introspection port and probes through it —
+    /healthz for liveness (wedged loop restarts, degraded does not),
+    /readyz for readiness — while keeping the heartbeat file wired as
+    the exec-probe fallback's data source."""
+    for path in static_daemonsets():
+        with open(path) as f:
+            doc = yaml.safe_load(f)
+        (ctr,) = pod_spec(doc)["containers"]
+        env = {e["name"]: e["value"] for e in ctr["env"]}
+        assert env["TFD_METRICS_PORT"] == "9101", path
+        assert "TFD_HEARTBEAT_FILE" in env, path
+        ports = {p["name"]: p["containerPort"] for p in ctr["ports"]}
+        assert ports["metrics"] == 9101, path
+        assert ctr["livenessProbe"]["httpGet"]["path"] == "/healthz", path
+        assert ctr["livenessProbe"]["httpGet"]["port"] == "metrics", path
+        assert ctr["readinessProbe"]["httpGet"]["path"] == "/readyz", path
+
+
 def test_job_template_keeps_node_name_substitution():
     with open(os.path.join(STATIC, "tpu-feature-discovery-job.yaml.template")) as f:
         doc = yaml.safe_load(f)

@@ -35,6 +35,9 @@ FILE_KEYS = {
     "init-backoff-max": ("tfd", "initBackoffMax"),
     "max-consecutive-failures": ("tfd", "maxConsecutiveFailures"),
     "heartbeat-file": ("tfd", "heartbeatFile"),
+    "metrics-addr": ("tfd", "metricsAddr"),
+    "metrics-port": ("tfd", "metricsPort"),
+    "debug-endpoints": ("tfd", "debugEndpoints"),
 }
 
 # Two distinct valid raw values per flag (a wins the dominance checks).
@@ -46,6 +49,7 @@ VALUE_PAIRS = {
     "init-retries": ("3", "7"),
     "init-backoff-max": ("2s", "5s"),
     "max-consecutive-failures": ("2", "4"),
+    "metrics-port": ("9200", "9300"),
 }
 
 

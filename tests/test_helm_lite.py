@@ -137,6 +137,89 @@ def test_subchart_renders_gc_and_gate(tmp_path):
     ], "gc.enable=false must render no collector"
 
 
+def _tfd_daemonset(docs):
+    (ds,) = [
+        d
+        for d in docs
+        if d.get("kind") == "DaemonSet"
+        and "tpu-feature-discovery" in d["metadata"]["name"]
+    ]
+    return ds["spec"]["template"]["spec"]["containers"][0]
+
+
+def test_default_render_keeps_exec_probe_and_metrics_port():
+    """probes.http defaults false: the heartbeat exec livenessProbe
+    stays, but the metrics port + TFD_METRICS_PORT env render (the
+    introspection server is default-on in daemon mode)."""
+    ctr = _tfd_daemonset(render_chart(CHART))
+    assert "exec" in ctr["livenessProbe"]
+    assert "readinessProbe" not in ctr
+    env = {e["name"]: e["value"] for e in ctr["env"]}
+    assert env["TFD_METRICS_PORT"] == "9101"
+    assert env["TFD_METRICS_ADDR"] == "0.0.0.0"
+    (port,) = ctr["ports"]
+    assert port == {"name": "metrics", "containerPort": 9101, "protocol": "TCP"}
+
+
+def test_http_probes_toggle_switches_both_probes():
+    ctr = _tfd_daemonset(render_chart(CHART, values_overrides={"probes.http": True}))
+    assert ctr["livenessProbe"]["httpGet"] == {"path": "/healthz", "port": "metrics"}
+    assert ctr["readinessProbe"]["httpGet"] == {"path": "/readyz", "port": "metrics"}
+    # The heartbeat file stays wired either way — flipping the probe
+    # style back needs no daemon change.
+    env = {e["name"]: e["value"] for e in ctr["env"]}
+    assert "TFD_HEARTBEAT_FILE" in env
+
+
+def test_http_probes_require_metrics_port():
+    with pytest.raises(HelmFail, match="metrics.port"):
+        render_chart(
+            CHART,
+            values_overrides={
+                "probes.http": True,
+                "metrics": {"port": 0, "addr": "0.0.0.0"},
+            },
+        )
+
+
+def test_metrics_port_zero_disables_port_and_keeps_exec_probe():
+    ctr = _tfd_daemonset(
+        render_chart(
+            CHART, values_overrides={"metrics": {"port": 0, "addr": "0.0.0.0"}}
+        )
+    )
+    assert "ports" not in ctr
+    assert "exec" in ctr["livenessProbe"]
+    env = {e["name"]: e["value"] for e in ctr["env"]}
+    assert env["TFD_METRICS_PORT"] == "0"  # explicit: server disabled
+
+
+def test_servicemonitor_renders_behind_gate():
+    assert not [
+        d
+        for d in render_chart(CHART)
+        if d.get("kind") in ("ServiceMonitor", "Service")
+    ], "serviceMonitor.enabled=false must render no scrape objects"
+    docs = render_chart(CHART, values_overrides={"serviceMonitor.enabled": True})
+    (svc,) = [d for d in docs if d.get("kind") == "Service"]
+    (sm,) = [d for d in docs if d.get("kind") == "ServiceMonitor"]
+    assert svc["spec"]["clusterIP"] == "None"
+    (svc_port,) = svc["spec"]["ports"]
+    assert svc_port["targetPort"] == "metrics"
+    (endpoint,) = sm["spec"]["endpoints"]
+    assert endpoint["port"] == "metrics"
+    # The monitor must select the Service it ships with.
+    assert sm["spec"]["selector"]["matchLabels"] == svc["spec"]["selector"]
+    with pytest.raises(HelmFail, match="metrics.port"):
+        render_chart(
+            CHART,
+            values_overrides={
+                "serviceMonitor.enabled": True,
+                "metrics": {"port": 0, "addr": "0.0.0.0"},
+            },
+        )
+
+
 def test_unknown_construct_fails_loudly(tmp_path):
     """The safety property: helm-lite must never silently mis-render a
     construct it doesn't implement."""
@@ -153,7 +236,8 @@ def test_unknown_construct_fails_loudly(tmp_path):
 
 def _render_snippet(tmp_path, template, values="{}\n"):
     chart = tmp_path / "c"
-    (chart / "templates").mkdir(parents=True)
+    # exist_ok: tests render several snippets against one tmp_path.
+    (chart / "templates").mkdir(parents=True, exist_ok=True)
     (chart / "Chart.yaml").write_text("name: c\nversion: 0.0.1\n")
     (chart / "values.yaml").write_text(values)
     (chart / "templates" / "x.yml").write_text(template)

@@ -1,0 +1,655 @@
+"""Observability subsystem tests (obs/ + the instrumented layers).
+
+Four layers of evidence, all hermetic on CPU:
+
+1. Registry + exposition grammar: the dependency-free Counter/Gauge/
+   Histogram render valid text exposition 0.0.4, checked by the in-tree
+   promtool-grammar validator (tests/promtool_lite.py) — which itself
+   has negative tests so it cannot rot into accept-everything.
+2. Endpoint semantics: /healthz (live = cycles completing within 3x the
+   sleep interval), /readyz (ready = a label file written this epoch;
+   degraded stays ready), /debug/labels (provenance JSON, gated by
+   --debug-endpoints).
+3. The acceptance scrape: a supervised chaos run (pjrt_init:fail:2)
+   scraped LIVE over HTTP shows tfd_backend_init_failures_total=2, the
+   tfd_degraded gauge transitioning 1 -> 0, and per-labeler duration
+   histograms — plus a concurrent scrape-while-cycling race test.
+4. The no-socket contract: oneshot and --metrics-port 0 never bind.
+"""
+
+import json
+import os
+import queue
+import signal
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from promtool_lite import ExpositionError, validate_exposition
+
+from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+from gpu_feature_discovery_tpu.obs.registry import CONTENT_TYPE, Registry
+from gpu_feature_discovery_tpu.obs.server import (
+    IntrospectionServer,
+    IntrospectionState,
+)
+from gpu_feature_discovery_tpu.utils import timing
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DOCS = os.path.join(os.path.dirname(HERE), "docs")
+
+
+def _free_port():
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def _get(url, timeout=5):
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.status, resp.read().decode(), resp.headers
+
+
+def _sample_value(text, name, labels=""):
+    """Value of one exposition sample line, or None."""
+    prefix = f"{name}{labels} " if labels else f"{name} "
+    for line in text.splitlines():
+        if line.startswith(prefix):
+            return float(line.split(" ")[1])
+    return None
+
+
+# ---------------------------------------------------------------------------
+# registry + exposition grammar
+# ---------------------------------------------------------------------------
+
+def test_registry_renders_valid_exposition():
+    reg = Registry()
+    c = reg.counter("t_total", "a counter", labelnames=("k",))
+    g = reg.gauge("t_gauge", "a gauge")
+    h = reg.histogram("t_hist", "a histogram", buckets=(0.1, 1.0))
+    c.labels(k="x").inc()
+    c.labels(k="y").inc(2)
+    g.set(-3.5)
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(100)
+    text = reg.render()
+    fams = validate_exposition(text)
+    assert fams == {"t_total": "counter", "t_gauge": "gauge", "t_hist": "histogram"}
+    assert _sample_value(text, "t_total", '{k="x"}') == 1
+    assert _sample_value(text, "t_total", '{k="y"}') == 2
+    assert _sample_value(text, "t_gauge") == -3.5
+    assert _sample_value(text, "t_hist_bucket", '{le="0.1"}') == 1
+    assert _sample_value(text, "t_hist_bucket", '{le="1"}') == 2
+    assert _sample_value(text, "t_hist_bucket", '{le="+Inf"}') == 3
+    assert _sample_value(text, "t_hist_count") == 3
+    assert _sample_value(text, "t_hist_sum") == pytest.approx(100.55)
+
+
+def test_registry_escapes_label_values_and_help():
+    reg = Registry()
+    c = reg.counter("esc_total", 'help with \\ and\nnewline', labelnames=("v",))
+    c.labels(v='a"b\\c\nd').inc()
+    text = reg.render()
+    validate_exposition(text)
+    assert '# HELP esc_total help with \\\\ and\\nnewline' in text
+    assert 'esc_total{v="a\\"b\\\\c\\nd"} 1' in text
+
+
+def test_registry_rejects_bad_names_and_duplicates():
+    reg = Registry()
+    with pytest.raises(ValueError):
+        reg.counter("bad name", "x")
+    with pytest.raises(ValueError):
+        reg.counter("ok_total", "x", labelnames=("bad-label",))
+    reg.gauge("dup", "x")
+    with pytest.raises(ValueError):
+        reg.gauge("dup", "x")
+    with pytest.raises(ValueError):
+        reg.counter("neg_total", "x").inc(-1)
+    with pytest.raises(ValueError):
+        reg.histogram("h", "x", buckets=(1.0, 0.5))
+
+
+def test_labelless_series_render_as_zero_before_any_event():
+    reg = Registry()
+    reg.counter("zero_total", "never incremented")
+    assert "zero_total 0" in reg.render()
+
+
+@pytest.mark.parametrize(
+    "payload,why",
+    [
+        ("no_type_sample 1\n", "no TYPE"),
+        ("# TYPE t counter\nt 1\n", "no HELP"),
+        ("# HELP t x\n# TYPE t counter\nt 1\nt 2\n", "duplicate series"),
+        ("# HELP t x\n# TYPE t wat\nt 1\n", "unknown type"),
+        ("# HELP t x\n# TYPE t counter\nt -1\n", "negative counter"),
+        ("# HELP t x\n# TYPE t counter\nt 1", "missing trailing newline"),
+        (
+            "# HELP h x\n# TYPE h histogram\n"
+            'h_bucket{le="1"} 2\nh_bucket{le="+Inf"} 1\nh_sum 1\nh_count 1\n',
+            "non-cumulative buckets",
+        ),
+        (
+            "# HELP h x\n# TYPE h histogram\n"
+            'h_bucket{le="1"} 1\nh_sum 1\nh_count 1\n',
+            "no +Inf bucket",
+        ),
+        (
+            "# HELP h x\n# TYPE h histogram\n"
+            'h_bucket{le="+Inf"} 2\nh_sum 1\nh_count 1\n',
+            "_count != +Inf",
+        ),
+    ],
+)
+def test_promtool_lite_rejects_malformed_expositions(payload, why):
+    with pytest.raises(ExpositionError):
+        validate_exposition(payload)
+
+
+# ---------------------------------------------------------------------------
+# timing as a view over the registry (PR 1 contract preserved)
+# ---------------------------------------------------------------------------
+
+def test_timings_file_schema_golden(tmp_path):
+    """The --timings-file document is a PR 1 contract consumed by
+    scrapers: {"stages_ms": {stage: ms}}, ms rounded to 3 decimals,
+    sorted keys, rendered from a registry snapshot — pinned byte-for-byte."""
+    timing.reset_cycle()
+    timing.record("labeler.device", 0.0012344)
+    timing.record("labelgen.total", 0.0025)
+    path = tmp_path / "timings.json"
+    timing.write_timings_file(str(path))
+    golden = json.dumps(
+        {"stages_ms": {"labeler.device": 1.234, "labelgen.total": 2.5}},
+        sort_keys=True,
+    )
+    assert path.read_text() == golden
+    timing.reset_cycle()
+
+
+def test_cycle_summary_renders_total_first_from_registry():
+    timing.reset_cycle()
+    timing.record("labeler.health", 0.010)
+    timing.record("labelgen.total", 0.012)
+    timing.record("labeler.device", 0.001)
+    summary = timing.cycle_summary()
+    assert summary.startswith("labelgen.total=12.000ms")
+    assert "labeler.device=1.000ms" in summary
+    # The same spans landed in the Prometheus histogram store.
+    text = obs_metrics.REGISTRY.render()
+    assert 'tfd_stage_duration_seconds{stage="labeler.health"}' in text
+    timing.reset_cycle()
+    assert timing.cycle_summary() == ""
+
+
+# ---------------------------------------------------------------------------
+# instrumented layers
+# ---------------------------------------------------------------------------
+
+def test_label_write_and_churn_skip_metrics(tmp_path):
+    obs_metrics.reset_for_tests()
+    labels = Labels({"google.com/tpu.count": "4"})
+    path = str(tmp_path / "tfd")
+    labels.write_to_file(path)
+    assert obs_metrics.LABEL_WRITES.value() == 1
+    assert obs_metrics.LABEL_WRITE_SKIPS.value() == 0
+    assert obs_metrics.LABEL_FILE_BYTES.value() == len("google.com/tpu.count=4\n")
+    assert obs_metrics.LABELS_PUBLISHED.value() == 1
+    labels.write_to_file(path)  # unchanged -> churn-free skip
+    assert obs_metrics.LABEL_WRITES.value() == 1
+    assert obs_metrics.LABEL_WRITE_SKIPS.value() == 1
+    # The staged write fsynced and observed its cost.
+    assert _sample_value(
+        obs_metrics.REGISTRY.render(), "tfd_file_fsync_duration_seconds_count"
+    ) >= 1
+
+
+def test_engine_deadline_miss_and_straggler_harvest_metrics():
+    from gpu_feature_discovery_tpu.lm.engine import LabelEngine, LabelSource
+
+    obs_metrics.reset_for_tests()
+    release = threading.Event()
+
+    class SlowLabeler:
+        def labels(self):
+            release.wait(5)
+            return Labels({"slow": "done"})
+
+    engine = LabelEngine(parallel=True, timeout_s=0.05)
+    sources = [LabelSource("slowpoke", lambda: SlowLabeler())]
+    try:
+        engine.generate(sources)
+        assert obs_metrics.LABELER_DEADLINE_MISSES.value(labeler="slowpoke") == 1
+        assert obs_metrics.STALE_SOURCES.value() == 1
+        assert engine.last_provenance["slowpoke"] == {
+            "status": "stale",
+            "duration_ms": None,
+        }
+        release.set()
+        deadline = time.monotonic() + 5
+        while not engine._state["slowpoke"].inflight.done():
+            assert time.monotonic() < deadline, "straggler never finished"
+            time.sleep(0.005)
+        engine.generate(sources)  # harvests, then runs fresh
+        assert obs_metrics.STRAGGLERS_HARVESTED.value(labeler="slowpoke") == 1
+        assert obs_metrics.STALE_SOURCES.value() == 0
+        assert engine.last_provenance["slowpoke"]["status"] == "fresh"
+    finally:
+        release.set()
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# endpoint semantics
+# ---------------------------------------------------------------------------
+
+def test_healthz_goes_stale_after_three_sleep_intervals():
+    now = [100.0]
+    state = IntrospectionState(10.0, clock=lambda: now[0])
+    assert state.healthy()[0] is True  # grace: measured from start
+    now[0] += 29.9
+    assert state.healthy()[0] is True
+    now[0] += 0.2  # > 3x interval since start, no cycle yet
+    assert state.healthy()[0] is False
+    state.cycle_completed()
+    assert state.healthy()[0] is True
+    now[0] += 30.1
+    ok, detail = state.healthy()
+    assert ok is False and "no completed cycle" in detail
+
+
+def test_readyz_flips_on_first_write_and_stays_ready_degraded():
+    state = IntrospectionState(10.0)
+    assert state.ready()[0] is False
+    state.labels_written({"k": "v"}, mode="degraded")
+    assert state.ready()[0] is True  # degraded is still served
+    snap = state.debug_snapshot()
+    assert snap["degraded"] is True and snap["mode"] == "degraded"
+
+
+def test_server_endpoints_and_debug_gate():
+    state = IntrospectionState(60.0)
+    server = IntrospectionServer(
+        obs_metrics.REGISTRY, state, addr="127.0.0.1", port=0
+    )
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        code, body, headers = _get(base + "/metrics")
+        assert code == 200 and headers["Content-Type"] == CONTENT_TYPE
+        validate_exposition(body)
+        code, body, _ = _get(base + "/healthz")
+        assert code == 200
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(base + "/readyz")
+        assert e.value.code == 503
+        state.labels_written(
+            {"a": "b"}, {"device": {"status": "fresh", "duration_ms": 1.0}}
+        )
+        code, body, headers = _get(base + "/debug/labels")
+        assert code == 200 and headers["Content-Type"] == "application/json"
+        doc = json.loads(body)
+        assert doc["labels"] == {"a": "b"}
+        assert doc["sources"]["device"]["status"] == "fresh"
+        assert doc["generation"] == 1
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(base + "/nope")
+        assert e.value.code == 404
+    finally:
+        server.close()
+    # Closed server: the port is actually released.
+    with pytest.raises(OSError):
+        socket.create_connection(("127.0.0.1", server.port), timeout=0.5)
+
+
+def test_debug_endpoints_flag_gates_debug_labels():
+    state = IntrospectionState(60.0)
+    server = IntrospectionServer(
+        obs_metrics.REGISTRY, state, addr="127.0.0.1", port=0,
+        debug_endpoints=False,
+    )
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"http://127.0.0.1:{server.port}/debug/labels")
+        assert e.value.code == 404
+        assert _get(f"http://127.0.0.1:{server.port}/metrics")[0] == 200
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# daemon wiring: the oneshot-vs-daemon default split, port 0, bind failure
+# ---------------------------------------------------------------------------
+
+def _config(tmp_path, **cli):
+    from gpu_feature_discovery_tpu.config import new_config
+
+    machine = tmp_path / "machine-type"
+    machine.write_text("Google Compute Engine\n")
+    values = {
+        "machine-type-file": str(machine),
+        "output-file": str(tmp_path / "tfd"),
+    }
+    values.update(cli)
+    return new_config(cli_values=values, environ={})
+
+
+def test_server_default_on_in_daemon_off_in_oneshot(tmp_path):
+    from gpu_feature_discovery_tpu.cmd.main import start_introspection_server
+    from gpu_feature_discovery_tpu.config.flags import DEFAULT_METRICS_PORT
+
+    daemon_config = _config(tmp_path, **{"metrics-addr": "127.0.0.1",
+                                         "metrics-port": str(_free_port())})
+    server, state = start_introspection_server(daemon_config)
+    assert server is not None and state is not None
+    server.close()
+
+    # The built-in default is on (the daemonset needs no flag to serve).
+    assert daemon_config.flags.tfd.metrics_port != 0
+    assert DEFAULT_METRICS_PORT == 9101
+
+    oneshot_config = _config(
+        tmp_path, oneshot="true",
+        **{"metrics-addr": "127.0.0.1", "metrics-port": str(_free_port())},
+    )
+    assert start_introspection_server(oneshot_config) == (None, None)
+
+    disabled = _config(tmp_path, **{"metrics-port": "0"})
+    assert start_introspection_server(disabled) == (None, None)
+
+
+def test_oneshot_run_opens_no_socket(tmp_path):
+    """The acceptance contract: oneshot never serves, even with the port
+    explicitly set — the run completes with nothing listening."""
+    from gpu_feature_discovery_tpu.cmd.main import run
+    from gpu_feature_discovery_tpu.lm.labeler import Empty
+    from gpu_feature_discovery_tpu.resource.testing import new_single_host_manager
+
+    port = _free_port()
+    config = _config(
+        tmp_path, oneshot="true",
+        **{"metrics-addr": "127.0.0.1", "metrics-port": str(port)},
+    )
+    listeners = []
+    orig_init = IntrospectionServer.__init__
+
+    def spy_init(self, *a, **kw):
+        listeners.append(1)
+        return orig_init(self, *a, **kw)
+
+    IntrospectionServer.__init__ = spy_init
+    try:
+        assert run(new_single_host_manager("v4-8"), Empty(), config,
+                   queue.Queue()) is False
+    finally:
+        IntrospectionServer.__init__ = orig_init
+    assert not listeners, "oneshot bound an introspection server"
+    with pytest.raises(OSError):
+        socket.create_connection(("127.0.0.1", port), timeout=0.5)
+
+
+def test_bind_failure_degrades_to_no_server(tmp_path, caplog):
+    """Observability must not kill the daemon: a taken port logs a
+    warning and the epoch runs serverless."""
+    from gpu_feature_discovery_tpu.cmd.main import start_introspection_server
+
+    squatter = socket.socket()
+    squatter.bind(("127.0.0.1", 0))
+    squatter.listen(1)
+    port = squatter.getsockname()[1]
+    try:
+        config = _config(
+            tmp_path,
+            **{"metrics-addr": "127.0.0.1", "metrics-port": str(port)},
+        )
+        with caplog.at_level("WARNING", logger="tfd"):
+            assert start_introspection_server(config) == (None, None)
+        assert any(
+            "cannot bind introspection server" in r.message
+            for r in caplog.records
+        )
+    finally:
+        squatter.close()
+
+
+# ---------------------------------------------------------------------------
+# the acceptance scrape: live server during a supervised chaos run
+# ---------------------------------------------------------------------------
+
+def _run_supervised_daemon(tmp_path, fault_spec, port, sleep="0.01s",
+                           backoff="0.1s"):
+    """Start the REAL supervised daemon loop (cmd.main.run) in a thread
+    with the introspection server bound on ``port``; returns
+    (thread, sigs, result, config)."""
+    import gpu_feature_discovery_tpu.cmd.main as cmd_main
+    from gpu_feature_discovery_tpu.cmd.main import run
+    from gpu_feature_discovery_tpu.cmd.supervisor import Supervisor
+    from gpu_feature_discovery_tpu.lm.labeler import Empty
+    from gpu_feature_discovery_tpu.utils import faults
+
+    config = _config(
+        tmp_path,
+        **{
+            "sleep-interval": sleep,
+            "init-backoff-max": backoff,
+            "init-retries": "50",
+            "max-consecutive-failures": "50",
+            "metrics-addr": "127.0.0.1",
+            "metrics-port": str(port),
+        },
+    )
+    os.environ["TFD_BACKEND"] = "mock:v4-8"
+    faults.load_fault_spec(fault_spec)
+    sigs = queue.Queue()
+    result = {}
+
+    def target():
+        try:
+            result["restart"] = run(
+                lambda: cmd_main._build_manager(config),
+                Empty(),
+                config,
+                sigs,
+                supervisor=Supervisor(config),
+            )
+        except BaseException as e:  # noqa: BLE001 - reported by the test
+            result["error"] = e
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    return t, sigs, result, config
+
+
+def _stop_daemon(t, sigs, result):
+    from gpu_feature_discovery_tpu.utils import faults
+
+    sigs.put(signal.SIGTERM)
+    t.join(timeout=10)
+    faults.reset()
+    os.environ.pop("TFD_BACKEND", None)
+    assert not t.is_alive(), "daemon did not honor SIGTERM"
+    assert "error" not in result, result.get("error")
+
+
+def test_live_scrape_during_chaos_cycle(tmp_path):
+    """ISSUE 3 acceptance: TFD_FAULT_SPEC=pjrt_init:fail:2 under the
+    supervised daemon, scraped live over HTTP — the scrape shows
+    tfd_backend_init_failures_total=2, tfd_degraded transitioning 1 -> 0,
+    and per-labeler tfd_labeler_duration_seconds histograms; every
+    payload passes the promtool grammar; /healthz and /debug/labels
+    agree with the converged state."""
+    obs_metrics.reset_for_tests()
+    port = _free_port()
+    t, sigs, result, config = _run_supervised_daemon(
+        tmp_path, "pjrt_init:fail:2", port
+    )
+    base = f"http://127.0.0.1:{port}"
+    degraded_seen = set()
+    final = None
+    try:
+        deadline = time.monotonic() + 15
+        while time.monotonic() < deadline:
+            try:
+                _, body, _ = _get(base + "/metrics", timeout=2)
+            except (urllib.error.URLError, OSError):
+                time.sleep(0.002)  # server not bound yet
+                continue
+            degraded = _sample_value(body, "tfd_degraded")
+            if degraded is not None:
+                degraded_seen.add(degraded)
+            failures = _sample_value(body, "tfd_backend_init_failures_total")
+            full_cycles = _sample_value(
+                body, "tfd_cycles_total", '{outcome="full"}'
+            )
+            if (
+                failures == 2
+                and degraded == 0
+                and (full_cycles or 0) >= 1
+            ):
+                final = body
+                break
+            time.sleep(0.001)
+        assert final is not None, (
+            f"never converged; degraded_seen={degraded_seen}, "
+            f"last body:\n{body}"
+        )
+        validate_exposition(final)
+        # The acceptance triplet.
+        assert _sample_value(final, "tfd_backend_init_failures_total") == 2
+        assert degraded_seen >= {1.0, 0.0}, (
+            f"tfd_degraded never transitioned 1->0: {degraded_seen}"
+        )
+        assert _sample_value(
+            final, "tfd_labeler_duration_seconds_count",
+            '{labeler="machine-type"}',
+        ) >= 1
+        # Degraded cycles were published and counted while the backend
+        # was down.
+        assert _sample_value(
+            final, "tfd_cycles_total", '{outcome="degraded"}'
+        ) >= 1
+        # Probes + debug agree with the converged state.
+        assert _get(base + "/healthz")[0] == 200
+        assert _get(base + "/readyz")[0] == 200
+        doc = json.loads(_get(base + "/debug/labels")[1])
+        assert doc["mode"] == "full" and doc["degraded"] is False
+        assert "google.com/tpu.count" in doc["labels"]
+        assert doc["sources"]["device"]["status"] == "fresh"
+        assert doc["generation"] >= 1
+    finally:
+        _stop_daemon(t, sigs, result)
+    # Epoch over: the server released its port.
+    with pytest.raises(OSError):
+        socket.create_connection(("127.0.0.1", port), timeout=0.5)
+
+
+def test_concurrent_scrape_while_cycling_race(tmp_path):
+    """Scrape hammering from several threads while the daemon cycles
+    (with mid-run faults) must never yield a malformed payload or an
+    error — the registry lock + state lock make every scrape a
+    consistent snapshot."""
+    obs_metrics.reset_for_tests()
+    port = _free_port()
+    t, sigs, result, _ = _run_supervised_daemon(
+        tmp_path, "generate:raise:RuntimeError:2", port, sleep="0.002s"
+    )
+    base = f"http://127.0.0.1:{port}"
+    # Wait for the server to come up before unleashing the scrapers.
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        try:
+            _get(base + "/healthz", timeout=2)
+            break
+        except (urllib.error.URLError, OSError):
+            time.sleep(0.005)
+    errors = []
+    per_thread = [0, 0, 0, 0]
+
+    def scraper(idx):
+        # Time-bounded AND iteration-bounded: the assert below is on
+        # CORRECTNESS (every scrape well-formed, every thread served),
+        # not throughput — a loaded CI runner completing few iterations
+        # must not fail the race test.
+        end = time.monotonic() + 1.0
+        while time.monotonic() < end or per_thread[idx] == 0:
+            try:
+                _, body, _ = _get(base + "/metrics", timeout=5)
+                validate_exposition(body)
+                try:
+                    _get(base + "/debug/labels", timeout=5)
+                except urllib.error.HTTPError:
+                    pass  # 404 only if debug disabled; not here
+                per_thread[idx] += 1
+            except Exception as e:  # noqa: BLE001 - collected for assert
+                errors.append(repr(e))
+                return
+
+    threads = [
+        threading.Thread(target=scraper, args=(i,)) for i in range(4)
+    ]
+    try:
+        for th in threads:
+            th.start()
+        for th in threads:
+            th.join(timeout=30)
+    finally:
+        _stop_daemon(t, sigs, result)
+    assert not errors, errors
+    assert all(n >= 1 for n in per_thread), (
+        f"some scraper thread never completed a scrape: {per_thread}"
+    )
+
+
+# ---------------------------------------------------------------------------
+# docs drift guard: every registered series is documented
+# ---------------------------------------------------------------------------
+
+def test_every_metric_family_is_documented():
+    with open(os.path.join(DOCS, "observability.md")) as f:
+        doc = f.read()
+    for name, family in obs_metrics.REGISTRY.families().items():
+        assert f"`{name}`" in doc, f"metric {name} undocumented"
+        # The metric's TABLE row (not prose mentions) must state its type.
+        row = next(
+            (
+                line
+                for line in doc.splitlines()
+                if line.startswith(f"| `{name}`")
+            ),
+            "",
+        )
+        assert family.kind in row, (
+            f"{name}: no table row stating type {family.kind!r}"
+        )
+    for endpoint in ("/metrics", "/healthz", "/readyz", "/debug/labels"):
+        assert f"`{endpoint}`" in doc, f"endpoint {endpoint} undocumented"
+
+
+def test_observability_doc_names_no_phantom_metrics():
+    """Every tfd_* series the doc mentions must exist in the registry."""
+    import re
+
+    with open(os.path.join(DOCS, "observability.md")) as f:
+        doc = f.read()
+    known = set(obs_metrics.REGISTRY.families())
+    mentioned = set(re.findall(r"`(tfd_[a-z0-9_]+)`", doc))
+    # Histogram sample suffixes may be shown in examples.
+    mentioned = {
+        re.sub(r"_(bucket|sum|count)$", "", m)
+        if re.sub(r"_(bucket|sum|count)$", "", m) in known
+        else m
+        for m in mentioned
+    }
+    unknown = sorted(mentioned - known)
+    assert not unknown, f"doc names unregistered metrics: {unknown}"
