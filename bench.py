@@ -217,6 +217,87 @@ def main() -> int:
         file=sys.stderr,
     )
 
+    # Probe-isolation overhead (ISSUE 4 acceptance): what the sandboxed
+    # acquisition path costs the labeling cycle, asserted < 10% in CI.
+    # Methodology mirrors metrics_overhead_pct: ALTERNATING paired
+    # blocks, each block re-acquiring its backend then running
+    # block_cycles full labeling cycles; one arm acquires IN-PROCESS
+    # (manager.init() in this process — today's --probe-isolation=none
+    # path), the other through the SANDBOX (fork + init + snapshot in
+    # the child, SnapshotManager in the parent — the daemon default).
+    # The metric is the median across pairs of the per-pair cycle-p50
+    # delta: the fork itself is paid once per ACQUISITION (reported
+    # separately as probe_acquire_ms), so the steady-state claim under
+    # test is that labeling from a snapshot costs the same as labeling
+    # from the live backend. Always measured on the mock fixture — on a
+    # real TPU the in-process arm would seize the chip per block.
+    from gpu_feature_discovery_tpu import sandbox as tfd_sandbox
+    from gpu_feature_discovery_tpu.models import (
+        parse_accelerator_type as _parse_at,
+    )
+
+    iso_at = _parse_at("v5p-256")
+    iso_engine = new_label_engine(config)
+    iso_block_cycles = max(
+        10, int(os.environ.get("TFD_BENCH_ISO_BLOCK", "40"))
+    )
+    iso_pairs = max(3, int(os.environ.get("TFD_BENCH_ISO_PAIRS", "10")))
+    acquire_ms = []
+
+    def _iso_mock_manager():
+        return MockManager(
+            chips=[
+                MockChip(
+                    family=iso_at.spec.family,
+                    slice_topologies=[iso_at.topology_str],
+                )
+                for _ in range(iso_at.spec.chips_per_host)
+            ]
+        )
+
+    # One acquisition per arm, timed for the evidence: the fork cost is
+    # per-ACQUISITION (init + after faults), not per cycle, so it is
+    # reported as its own number instead of smeared into the cycle
+    # blocks where it would only add noise.
+    inproc_mgr = _iso_mock_manager()
+    inproc_mgr.init()
+    for _ in range(3):
+        t_acq = time.perf_counter()
+        sandbox_mgr = tfd_sandbox.SnapshotManager(
+            tfd_sandbox.probe_device_snapshot(_iso_mock_manager(), 30.0)
+        )
+        acquire_ms.append((time.perf_counter() - t_acq) * 1e3)
+
+    def _iso_block(mgr):
+        block_ms = []
+        for _ in range(iso_block_cycles):
+            t0 = time.perf_counter()
+            cycle_labels = iso_engine.generate(
+                new_label_sources(mgr, interconnect, config, timestamp=timestamp)
+            )
+            mgr.shutdown()
+            cycle_labels.write_to_file(out_file)
+            block_ms.append((time.perf_counter() - t0) * 1e3)
+        return statistics.median(block_ms)
+
+    _iso_block(inproc_mgr)  # warm caches outside the comparison
+    iso_ratios = []
+    for _ in range(iso_pairs):
+        p50_inproc = _iso_block(inproc_mgr)
+        p50_sandbox = _iso_block(sandbox_mgr)
+        iso_ratios.append((p50_sandbox - p50_inproc) / p50_inproc * 100.0)
+    iso_engine.close()
+    probe_isolation_overhead_pct = round(statistics.median(iso_ratios), 2)
+    probe_acquire_ms = round(statistics.median(acquire_ms), 3)
+    print(
+        f"bench: probe isolation overhead median="
+        f"{probe_isolation_overhead_pct}% over {iso_pairs} paired blocks "
+        f"of {iso_block_cycles} cycles (sandbox acquisition itself: "
+        f"p50={probe_acquire_ms}ms per fork+init+snapshot); pair ratios "
+        f"{[round(r, 1) for r in sorted(iso_ratios)]}",
+        file=sys.stderr,
+    )
+
     # Burn-in cycle cost (VERDICT r2 next-round #7): on the real chip,
     # measure what a --with-burnin labeling cycle costs next to the plain
     # cycle, proving the --burnin-interval amortization claim with a
@@ -464,6 +545,13 @@ def main() -> int:
                 # scraper) vs off — CI asserts < 5%. Negative = noise
                 # (the two runs are statistically identical).
                 "metrics_overhead_pct": metrics_overhead_pct,
+                # Sandbox acceptance (ISSUE 4): steady-state cycle p50
+                # labeling from a sandbox-acquired snapshot vs the live
+                # in-process backend (median of alternating paired
+                # blocks) — CI asserts < 10%. The per-acquisition fork
+                # cost is reported separately, not amortized away.
+                "probe_isolation_overhead_pct": probe_isolation_overhead_pct,
+                "probe_acquire_ms": probe_acquire_ms,
                 # Supervisor acceptance: cycles from first (faulted) cycle
                 # to the label file holding full labels again, with 2
                 # injected backend-init failures (degraded labels served

@@ -36,7 +36,10 @@ from gpu_feature_discovery_tpu.cmd.supervisor import (
 from gpu_feature_discovery_tpu.config.spec import Config, ConfigError
 from gpu_feature_discovery_tpu.hostinfo.provider import ChainedProvider
 from gpu_feature_discovery_tpu.info.version import get_version_string
-from gpu_feature_discovery_tpu.lm.engine import new_label_engine
+from gpu_feature_discovery_tpu.lm.engine import (
+    STALE_SOURCES_LABEL,
+    new_label_engine,
+)
 from gpu_feature_discovery_tpu.lm.interconnect import InterconnectLabeler
 from gpu_feature_discovery_tpu.lm.labeler import Labeler
 from gpu_feature_discovery_tpu.lm.labelers import (
@@ -93,9 +96,19 @@ def build_arg_parser() -> argparse.ArgumentParser:
     return parser
 
 
-def new_os_watcher() -> "queue.Queue[int]":
-    """Buffered signal channel (cmd/gpu-feature-discovery/watchers.go:26-31)."""
-    sigs: "queue.Queue[int]" = queue.Queue()
+def new_os_watcher() -> "queue.SimpleQueue[int]":
+    """Buffered signal channel (cmd/gpu-feature-discovery/watchers.go:26-31).
+
+    SimpleQueue, NOT queue.Queue: the handler runs ON the main thread at
+    an arbitrary bytecode boundary, so it can interrupt the run loop
+    inside the queue's own ``get`` bookkeeping. queue.Queue.put takes
+    the same non-reentrant mutex ``get`` holds — a SIGHUP landing in
+    that window deadlocks the daemon, and every further signal stacks
+    one more blocked handler on the pile (reproduced by the signal-storm
+    test once sandboxed probing made epochs long enough to hit the
+    window reliably). SimpleQueue.put is explicitly reentrant /
+    signal-handler-safe by contract."""
+    sigs: "queue.SimpleQueue[int]" = queue.SimpleQueue()
     for s in WATCHED_SIGNALS:
         signal.signal(s, lambda signum, _frame: sigs.put(signum))
     return sigs
@@ -128,6 +141,10 @@ def start(argv: Optional[list] = None) -> int:
 
     log.info("Starting OS watcher.")
     sigs = new_os_watcher()
+    # Cross-epoch memory (run()'s process_state contract): a SIGHUP
+    # reload of a process that already served live labels must not
+    # re-enter the restored regime from its own state file.
+    process_state: dict = {"live_full_served": False}
 
     while True:
         log.info("Loading configuration.")
@@ -190,6 +207,7 @@ def start(argv: Optional[list] = None) -> int:
                     config,
                     sigs,
                     supervisor=Supervisor(config),
+                    process_state=process_state,
                 )
         except Exception as e:  # noqa: BLE001 - match reference error-to-exit
             log.error("Error: %s", e)
@@ -257,7 +275,29 @@ def _build_manager(config: Config) -> Manager:
     degraded mode (non-device labels + the tfd.degraded marker) replaces
     the fallback wrapper's silent swap-to-null. init() is idempotent, so
     the per-cycle init() inside new_label_sources stays a cheap
-    re-check."""
+    re-check.
+
+    Under ``--probe-isolation=subprocess`` (the daemon default via
+    ``auto``) the entire acquisition — backend selection, ``init()``'s
+    PJRT client creation, the chip/topology/version enumeration — runs
+    in a forked child under the ``--probe-timeout`` SIGKILL budget
+    (sandbox/probe.py, which keeps the ``pjrt_init`` fault site and the
+    init-attempt metric in THIS process, where their state lives); a
+    hang or a native SIGSEGV in libtpu surfaces as one more retryable
+    init failure (ProbeTimeout/ProbeCrash are ResourceErrors) instead of
+    a wedged or dead pod, and the parent labels from the returned
+    snapshot."""
+    from gpu_feature_discovery_tpu import sandbox
+    from gpu_feature_discovery_tpu.config.flags import DEFAULT_PROBE_TIMEOUT
+
+    if sandbox.isolation_mode(config) == "subprocess":
+        tfd = config.flags.tfd
+        timeout = (
+            tfd.probe_timeout
+            if tfd.probe_timeout is not None
+            else DEFAULT_PROBE_TIMEOUT
+        )
+        return sandbox.acquire_snapshot_manager(config, timeout)
     manager = factory.new_manager(config, wrap_fallback=False)
     manager.init()
     return manager
@@ -308,7 +348,7 @@ class _TolerantPCI:
 
 
 def _check_signal(
-    sigs: "queue.Queue[int]", timeout: Optional[float] = None
+    sigs: "queue.SimpleQueue[int]", timeout: Optional[float] = None
 ) -> Optional[str]:
     """One signal-queue read: "restart" (SIGHUP), "shutdown", or None.
     ``timeout=None`` polls without blocking — the phase-boundary check."""
@@ -326,7 +366,9 @@ def _check_signal(
     return "shutdown"
 
 
-def _wait_for_signal(sigs: "queue.Queue[int]", duration: float) -> Optional[str]:
+def _wait_for_signal(
+    sigs: "queue.SimpleQueue[int]", duration: float
+) -> Optional[str]:
     """Sleep up to ``duration`` seconds, waking for signals. Returns the
     first decision, or None when the wait ran out (rerun)."""
     deadline = time.monotonic() + duration
@@ -343,8 +385,9 @@ def run(
     manager: Union[Manager, Callable[[], Manager]],
     interconnect: Labeler,
     config: Config,
-    sigs: "queue.Queue[int]",
+    sigs: "queue.SimpleQueue[int]",
     supervisor: Optional[Supervisor] = None,
+    process_state: Optional[dict] = None,
 ) -> bool:
     """run() (main.go:148-210). Returns True to request a config reload
     (SIGHUP), False for clean exit.
@@ -360,6 +403,13 @@ def run(
     degraded labels; only InitRetriesExhausted / TooManyConsecutive-
     Failures escape to start()'s error-to-exit. Oneshot keeps the
     reference's strict parity — the first error propagates.
+
+    ``process_state`` is start()'s cross-epoch memory (one dict for the
+    process lifetime): once any epoch has served a live full cycle,
+    later SIGHUP-reload epochs skip the --state-dir restore — restoring
+    is for process (re)starts, and a reload of a healthy daemon must
+    not republish its own current labels under a false
+    "restored from a previous run" marker.
     """
     output_file = config.flags.tfd.output_file
     oneshot = config.flags.tfd.oneshot
@@ -381,8 +431,57 @@ def run(
     # file, but once this epoch owns the file its markers must stay
     # current (a reserve may overwrite an earlier reserve).
     wrote_this_epoch = False
+    # Anti-flap hysteresis (--flap-window > 1): per-epoch, daemon only —
+    # oneshot publishes exactly what it measured.
+    flap = None
+    if supervised:
+        from gpu_feature_discovery_tpu.config.flags import DEFAULT_FLAP_WINDOW
+        from gpu_feature_discovery_tpu.sandbox import FlapDamper
+
+        window = (
+            config.flags.tfd.flap_window
+            if config.flags.tfd.flap_window is not None
+            else DEFAULT_FLAP_WINDOW
+        )
+        flap = FlapDamper(window)
     try:
         timestamp_labeler = new_timestamp_labeler(config)
+        if supervised and not (
+            process_state is not None and process_state.get("live_full_served")
+        ):
+            # Restored last-good state (--state-dir): serve the previous
+            # run's labels on the epoch's VERY FIRST write — before any
+            # backend init is attempted — so a restart during a backend
+            # outage (or a crash-looping native stack) never strips the
+            # node of its device labels while the supervisor retries.
+            # Skipped on reload epochs of a process that already served
+            # live labels (see the process_state contract above).
+            restored = supervisor.restore_last_good()
+            if restored is not None:
+                from gpu_feature_discovery_tpu.cmd.supervisor import (
+                    RESTORED_LABEL,
+                )
+
+                restored[RESTORED_LABEL] = "true"
+                try:
+                    restored.write_to_file(output_file)
+                except Exception as e:  # noqa: BLE001 - restore is best-effort
+                    log.warning("could not serve restored labels: %s", e)
+                else:
+                    wrote_this_epoch = True
+                    log.info(
+                        "serving %d restored labels until the first live "
+                        "cycle completes",
+                        len(restored),
+                    )
+                    if flap is not None:
+                        # Seed the damper with the restored baseline so
+                        # the restore->live transition is damped like any
+                        # other (a marginal backend's first enumeration
+                        # must hold the window before shrinking the set).
+                        flap.observe(restored)
+                    if obs_state is not None:
+                        obs_state.labels_written(restored, {}, mode="restored")
         while True:
             # Per-cycle spans only: without the reset, a cached-health
             # cycle would re-report the last probe's cost as current.
@@ -433,6 +532,24 @@ def run(
                     log.warning("no labels generated from any source")
                 log.info("Cycle timings: %s", timing.cycle_summary())
                 timing.write_timings_file(config.flags.tfd.timings_file or "")
+
+                if supervised and supervisor.restored and (
+                    cycle_mode == "degraded" or STALE_SOURCES_LABEL in labels
+                ):
+                    # Restored regime: any cycle that is NOT trustworthy
+                    # live inventory — backend down, or a "full" outcome
+                    # with stale (deadline-missed, possibly empty)
+                    # sources — overlays its fresh facts onto the
+                    # restored inventory instead of stripping the node.
+                    # A CLEAN full cycle publishes pure live labels and
+                    # ends the regime (cycle_succeeded below).
+                    labels = supervisor.with_restored(labels)
+
+                if flap is not None:
+                    # Hysteresis decides what actually publishes: a
+                    # change that has not held --flap-window cycles
+                    # re-serves the previous set + tfd.flapping.
+                    labels = flap.observe(labels)
 
                 log.info(
                     "Writing labels to output file %s", output_file or "<stdout>"
@@ -514,8 +631,14 @@ def run(
                 continue
             else:
                 if supervised:
-                    supervisor.cycle_succeeded(labels)
+                    supervisor.cycle_succeeded(labels, mode=cycle_mode)
                     supervisor.touch_heartbeat()
+                    if (
+                        cycle_mode == "full"
+                        and process_state is not None
+                        and not supervisor.restored
+                    ):
+                        process_state["live_full_served"] = True
                 if obs_state is not None:
                     obs_state.cycle_completed()
 
@@ -535,6 +658,12 @@ def run(
                 return False
     finally:
         engine.close()
+        # The process-wide sweep on top of engine.close()'s per-source
+        # cancels: no probe child may outlive its epoch (a SIGHUP reload
+        # must not orphan one).
+        from gpu_feature_discovery_tpu.sandbox import kill_stray_children
+
+        kill_stray_children()
         if obs_server is not None:
             # Synchronous close releases the port before a SIGHUP reload
             # rebinds it.

@@ -53,6 +53,7 @@ from gpu_feature_discovery_tpu.config.spec import Config
 from gpu_feature_discovery_tpu.lm.labels import Labels
 from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
 from gpu_feature_discovery_tpu.resource.types import Manager
+from gpu_feature_discovery_tpu.sandbox.state import LabelStateStore
 from gpu_feature_discovery_tpu.utils.retry import BackoffPolicy
 
 log = logging.getLogger("tfd.supervisor")
@@ -66,6 +67,13 @@ DEGRADED_LABEL = "google.com/tpu.tfd.degraded"
 # the value counts CONSECUTIVE failed cycles. Cleared (by absence) the
 # first cycle that completes normally.
 UNHEALTHY_CYCLES_LABEL = "google.com/tpu.tfd.unhealthy-cycles"
+
+# Published while the labels in the file are restored last-good state
+# from a previous run (--state-dir): full device facts, but measured
+# before this process started. Cleared (by absence) by the first LIVE
+# full cycle; degraded cycles keep it — the restored inventory plus
+# fresh non-device facts is what the file then holds.
+RESTORED_LABEL = "google.com/tpu.tfd.restored"
 
 # Backoff base for both init re-attempts and failed-cycle retries; the
 # cap comes from --init-backoff-max.
@@ -119,12 +127,20 @@ class Supervisor:
         self._consecutive_failures = 0
         self._last_good: Optional[Labels] = None
         self._heartbeat_warned = False
+        # Persisted last-good state (--state-dir): restarts re-serve the
+        # previous run's labels until a live cycle replaces them.
+        self._state_store: Optional[LabelStateStore] = (
+            LabelStateStore(tfd.state_dir) if tfd.state_dir else None
+        )
+        self._restored = False
         # The degraded/streak gauges reflect THIS epoch from its very
         # first scrape — an armed-but-healthy supervisor must read 0,
         # not "series absent".
         obs_metrics.DEGRADED.set(0)
         obs_metrics.CONSECUTIVE_CYCLE_FAILURES.set(0)
         obs_metrics.BACKEND_INIT_BACKOFF.set(0)
+        obs_metrics.RESTORED.set(0)
+        obs_metrics.FLAPPING.set(0)
 
     # -- backend init -----------------------------------------------------
 
@@ -181,25 +197,107 @@ class Supervisor:
         """True while the backend has failed init and not yet recovered."""
         return self._init_failures > 0
 
+    # -- restored last-good state (--state-dir) ---------------------------
+
+    def restore_last_good(self) -> Optional[Labels]:
+        """Load the previous run's persisted label set, prime the
+        last-good cache with it, and enter the restored regime. Returns
+        the cleaned label set the epoch should publish (the caller adds
+        the marker and writes), or None when there is no usable state."""
+        if self._state_store is None:
+            return None
+        restored = self._state_store.load()
+        if restored is None:
+            return None
+        cleaned = self._strip_markers(restored)
+        if not cleaned:
+            return None
+        self._last_good = cleaned
+        self._restored = True
+        obs_metrics.STATE_RESTORES.inc()
+        obs_metrics.RESTORED.set(1)
+        log.info(
+            "restored %d last-good labels from %s; serving them with "
+            "%s=true until the first live cycle",
+            len(cleaned),
+            self._state_store.path,
+            RESTORED_LABEL,
+        )
+        return Labels(cleaned)
+
+    @property
+    def restored(self) -> bool:
+        """True while the published labels are (at least partly) restored
+        state rather than this process's own measurements."""
+        return self._restored
+
+    def with_restored(self, labels: Labels) -> Labels:
+        """Overlay a degraded cycle's fresh labels onto the restored
+        inventory: fresh non-device facts win key-by-key, the restored
+        device facts stay published (that is the whole point — a down
+        backend must not strip the node), and the marker says so."""
+        if not self._restored or self._last_good is None:
+            return labels
+        merged = Labels(self._last_good)
+        merged.update(labels)
+        merged[RESTORED_LABEL] = "true"
+        return merged
+
     # -- per-cycle containment --------------------------------------------
 
-    def cycle_succeeded(self, labels: Labels) -> None:
-        """A cycle generated AND wrote labels: reset the failure streak
-        and remember the output for future re-serves. EVERY status
-        marker (unhealthy counter, degraded flag, engine staleness) is
-        stripped from the remembered copy: markers describe the cycle
-        that published them, so a re-serve must re-apply only what is
-        true at re-serve time — a tfd.degraded captured while the
+    @staticmethod
+    def _strip_markers(labels: Labels) -> Labels:
+        """Drop every status marker: markers describe the cycle that
+        published them, so a remembered/persisted copy must re-apply only
+        what is true at re-serve time — a tfd.degraded captured while the
         backend was down must not resurface after it recovered."""
+        from gpu_feature_discovery_tpu.lm.engine import STALE_SOURCES_LABEL
+        from gpu_feature_discovery_tpu.sandbox.flap import FLAPPING_LABEL
+
+        cleaned = Labels(labels)
+        for marker in (
+            UNHEALTHY_CYCLES_LABEL,
+            DEGRADED_LABEL,
+            RESTORED_LABEL,
+            STALE_SOURCES_LABEL,
+            FLAPPING_LABEL,
+        ):
+            cleaned.pop(marker, None)
+        return cleaned
+
+    def cycle_succeeded(self, labels: Labels, mode: str = "full") -> None:
+        """A cycle generated AND wrote labels: reset the failure streak
+        and remember the (marker-stripped) output for future re-serves.
+        A CLEAN full cycle additionally ends the restored regime — live
+        measurements replaced the previous run's state — and persists
+        the cleaned set to --state-dir for the next restart. Degraded
+        cycles persist nothing, and neither does a full cycle whose
+        sources went STALE (a deadline-missed device labeler with no
+        cache serves an empty set under a "full" outcome): restoring a
+        device-less subset would strip the node of its labels, the
+        exact failure the state exists to prevent."""
         from gpu_feature_discovery_tpu.lm.engine import STALE_SOURCES_LABEL
 
         self._consecutive_failures = 0
         obs_metrics.CONSECUTIVE_CYCLE_FAILURES.set(0)
-        remembered = Labels(labels)
-        remembered.pop(UNHEALTHY_CYCLES_LABEL, None)
-        remembered.pop(DEGRADED_LABEL, None)
-        remembered.pop(STALE_SOURCES_LABEL, None)
+        stale = STALE_SOURCES_LABEL in labels
+        remembered = self._strip_markers(labels)
         self._last_good = remembered
+        if mode != "full" or stale:
+            return
+        if self._restored:
+            self._restored = False
+            obs_metrics.RESTORED.set(0)
+            log.info("first live full cycle completed; %s cleared", RESTORED_LABEL)
+        if self._state_store is not None and "google.com/tpu.count" in remembered:
+            # Only device-carrying sets are worth restoring — and a
+            # device-LESS "full" cycle (the factory's fallback-to-null
+            # on a TPU node whose backends all failed enumerates zero
+            # chips without erroring) must never clobber a previously
+            # persisted inventory: restoring a stripped set after the
+            # next restart is the exact failure the store exists to
+            # prevent.
+            self._state_store.save(remembered)
 
     def cycle_failed(self, error: BaseException) -> float:
         """Contain one cycle failure. Returns the capped backoff delay
@@ -246,6 +344,8 @@ class Supervisor:
         labels[UNHEALTHY_CYCLES_LABEL] = str(self._consecutive_failures)
         if self.degraded:
             labels[DEGRADED_LABEL] = "true"
+        if self._restored:
+            labels[RESTORED_LABEL] = "true"
         return labels
 
     # -- liveness ----------------------------------------------------------

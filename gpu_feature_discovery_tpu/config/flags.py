@@ -17,6 +17,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from gpu_feature_discovery_tpu.config.spec import (
     Config,
     ConfigError,
+    PROBE_ISOLATION_AUTO,
+    PROBE_ISOLATION_MODES,
     TOPOLOGY_STRATEGIES,
     TOPOLOGY_STRATEGY_NONE,
     parse_bool as _parse_bool,
@@ -49,6 +51,13 @@ DEFAULT_METRICS_PORT = 9101
 # wait is 2 s, a metadata-server timeout ~1 s) so staleness marks
 # genuine degradation, not routine variance.
 DEFAULT_LABELER_TIMEOUT = 10.0
+# Probe sandbox defaults (sandbox/probe.py): the wall-clock budget a
+# forked probe child gets before SIGKILL. 30s rides out a slow cold PJRT
+# init (multi-host rendezvous, libtpu warmup) while still bounding a
+# genuinely wedged native call well under the liveness probe's patience.
+DEFAULT_PROBE_TIMEOUT = 30.0
+# Anti-flap hysteresis window: 1 = publish every cycle unchanged.
+DEFAULT_FLAP_WINDOW = 1
 
 _DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
 _DURATION_UNITS = {
@@ -342,6 +351,58 @@ FLAG_DEFS: List[FlagDef] = [
         getter=lambda c: _f(c).tfd.debug_endpoints,
     ),
     FlagDef(
+        name="probe-timeout",
+        env_vars=("TFD_PROBE_TIMEOUT",),
+        parse=parse_duration,
+        default=DEFAULT_PROBE_TIMEOUT,
+        help="with --probe-isolation=subprocess, hard wall-clock budget "
+        "(Go duration, e.g. 30s) for the forked device-probe child; a "
+        "child exceeding it is SIGKILLed and the failure is retried as a "
+        "degraded backend init — a hang inside libtpu/PJRT kills only "
+        "the child, never the daemon",
+        setter=lambda c, v: setattr(_f(c).tfd, "probe_timeout", v),
+        getter=lambda c: _f(c).tfd.probe_timeout,
+    ),
+    FlagDef(
+        name="probe-isolation",
+        env_vars=("TFD_PROBE_ISOLATION",),
+        parse=str,
+        default=PROBE_ISOLATION_AUTO,
+        help="where backend snapshot enumeration (PJRT init + chip/"
+        "topology/version probing) runs: 'subprocess' forks a killable "
+        "probe child (--probe-timeout bounds it); 'none' keeps the "
+        "in-process path; 'auto' (default) is subprocess for the "
+        "supervised daemon and none for oneshot",
+        setter=lambda c, v: setattr(_f(c).tfd, "probe_isolation", v),
+        getter=lambda c: _f(c).tfd.probe_isolation,
+    ),
+    FlagDef(
+        name="state-dir",
+        env_vars=("TFD_STATE_DIR",),
+        parse=str,
+        default="",
+        help="directory where the last successful cycle's label set is "
+        "persisted atomically; on restart the daemon re-serves it "
+        "immediately with google.com/tpu.tfd.restored=true until the "
+        "first live cycle completes, so a crash-looping backend never "
+        "strips the node of its labels (empty = disabled)",
+        setter=lambda c, v: setattr(_f(c).tfd, "state_dir", v),
+        getter=lambda c: _f(c).tfd.state_dir,
+    ),
+    FlagDef(
+        name="flap-window",
+        env_vars=("TFD_FLAP_WINDOW",),
+        parse=_parse_positive_int,
+        default=DEFAULT_FLAP_WINDOW,
+        help="daemon mode: a change to the published label set "
+        "(chip count, health, degraded transitions) must hold for this "
+        "many consecutive cycles before the output file changes; while "
+        "suppressed the previous labels are re-served with "
+        "google.com/tpu.tfd.flapping=true (1 = publish every cycle)",
+        setter=lambda c, v: setattr(_f(c).tfd, "flap_window", v),
+        getter=lambda c: _f(c).tfd.flap_window,
+    ),
+    FlagDef(
         name="heartbeat-file",
         env_vars=("TFD_HEARTBEAT_FILE",),
         parse=str,
@@ -394,6 +455,12 @@ def new_config(
     if strategy not in TOPOLOGY_STRATEGIES:
         raise ConfigError(
             f"invalid tpu-topology-strategy: {strategy!r} (want one of {TOPOLOGY_STRATEGIES})"
+        )
+    isolation = config.flags.tfd.probe_isolation
+    if isolation not in PROBE_ISOLATION_MODES:
+        raise ConfigError(
+            f"invalid probe-isolation: {isolation!r} "
+            f"(want one of {PROBE_ISOLATION_MODES})"
         )
     return config
 

@@ -33,6 +33,19 @@ TOPOLOGY_STRATEGIES = (
 
 FULL_TPU_RESOURCE_NAME = "google.com/tpu"
 
+# Probe-isolation modes (sandbox/probe.py): `none` keeps the reference's
+# in-process probing; `subprocess` forks a killable probe child; `auto`
+# (the default) resolves to subprocess for the supervised daemon and none
+# for oneshot, preserving the oneshot/golden path byte for byte.
+PROBE_ISOLATION_NONE = "none"
+PROBE_ISOLATION_SUBPROCESS = "subprocess"
+PROBE_ISOLATION_AUTO = "auto"
+PROBE_ISOLATION_MODES = (
+    PROBE_ISOLATION_NONE,
+    PROBE_ISOLATION_SUBPROCESS,
+    PROBE_ISOLATION_AUTO,
+)
+
 
 @dataclass
 class ReplicatedResource:
@@ -124,6 +137,13 @@ class TfdFlags:
     metrics_addr: Optional[str] = None
     metrics_port: Optional[int] = None  # 0 = disabled
     debug_endpoints: Optional[bool] = None
+    # Probe sandbox (sandbox/): process-isolated device probing with a
+    # SIGKILL-enforced wall-clock budget, persisted last-good label state
+    # re-served across restarts, and anti-flap publish hysteresis.
+    probe_timeout: Optional[float] = None  # seconds
+    probe_isolation: Optional[str] = None  # none | subprocess | auto
+    state_dir: Optional[str] = None  # "" = disabled
+    flap_window: Optional[int] = None  # 1 = disabled
 
 
 @dataclass
@@ -177,6 +197,10 @@ class Config:
                     "metricsAddr": self.flags.tfd.metrics_addr,
                     "metricsPort": self.flags.tfd.metrics_port,
                     "debugEndpoints": self.flags.tfd.debug_endpoints,
+                    "probeTimeout": self.flags.tfd.probe_timeout,
+                    "probeIsolation": self.flags.tfd.probe_isolation,
+                    "stateDir": self.flags.tfd.state_dir,
+                    "flapWindow": self.flags.tfd.flap_window,
                 },
             },
             "sharing": {
@@ -250,6 +274,10 @@ def parse_config_file(path: str) -> Config:
     if version != VERSION:
         raise ConfigError(f"unknown version: {version}")
 
+    # Deferred to call time to avoid a module cycle (flags imports spec);
+    # one local import serves every duration-typed key below.
+    from gpu_feature_discovery_tpu.config.flags import parse_duration
+
     config = Config(version=version)
     flags = raw.get("flags", {}) or {}
     config.flags.tpu_topology_strategy = _opt_str(flags.get("tpuTopologyStrategy"))
@@ -262,10 +290,7 @@ def parse_config_file(path: str) -> Config:
     config.flags.tfd.oneshot = _opt_bool(tfd.get("oneshot"))
     config.flags.tfd.no_timestamp = _opt_bool(tfd.get("noTimestamp"))
     if tfd.get("sleepInterval") is not None:
-        # Deferred import to avoid a cycle (flags imports spec).
-        from gpu_feature_discovery_tpu.config.flags import parse_duration
-
-        config.flags.tfd.sleep_interval = parse_duration(tfd["sleepInterval"])
+                config.flags.tfd.sleep_interval = parse_duration(tfd["sleepInterval"])
     config.flags.tfd.output_file = _opt_str(tfd.get("outputFile"))
     config.flags.tfd.machine_type_file = _opt_str(tfd.get("machineTypeFile"))
     config.flags.tfd.with_burnin = _opt_bool(tfd.get("withBurnin"))
@@ -273,16 +298,12 @@ def parse_config_file(path: str) -> Config:
         config.flags.tfd.burnin_interval = parse_positive_int(tfd["burninInterval"])
     config.flags.tfd.parallel_labelers = _opt_bool(tfd.get("parallelLabelers"))
     if tfd.get("labelerTimeout") is not None:
-        from gpu_feature_discovery_tpu.config.flags import parse_duration
-
-        config.flags.tfd.labeler_timeout = parse_duration(tfd["labelerTimeout"])
+                config.flags.tfd.labeler_timeout = parse_duration(tfd["labelerTimeout"])
     config.flags.tfd.timings_file = _opt_str(tfd.get("timingsFile"))
     if tfd.get("initRetries") is not None:
         config.flags.tfd.init_retries = parse_positive_int(tfd["initRetries"])
     if tfd.get("initBackoffMax") is not None:
-        from gpu_feature_discovery_tpu.config.flags import parse_duration
-
-        config.flags.tfd.init_backoff_max = parse_duration(tfd["initBackoffMax"])
+                config.flags.tfd.init_backoff_max = parse_duration(tfd["initBackoffMax"])
     if tfd.get("maxConsecutiveFailures") is not None:
         config.flags.tfd.max_consecutive_failures = parse_positive_int(
             tfd["maxConsecutiveFailures"]
@@ -292,6 +313,12 @@ def parse_config_file(path: str) -> Config:
     if tfd.get("metricsPort") is not None:
         config.flags.tfd.metrics_port = parse_nonneg_int(tfd["metricsPort"])
     config.flags.tfd.debug_endpoints = _opt_bool(tfd.get("debugEndpoints"))
+    if tfd.get("probeTimeout") is not None:
+                config.flags.tfd.probe_timeout = parse_duration(tfd["probeTimeout"])
+    config.flags.tfd.probe_isolation = _opt_str(tfd.get("probeIsolation"))
+    config.flags.tfd.state_dir = _opt_str(tfd.get("stateDir"))
+    if tfd.get("flapWindow") is not None:
+        config.flags.tfd.flap_window = parse_positive_int(tfd["flapWindow"])
 
     config.resources = raw.get("resources", {}) or {}
     config.sharing = Sharing.from_dict(raw.get("sharing", {}) or {})

@@ -21,6 +21,12 @@ single source). This engine replaces that merge in the daemon loop:
   running. Its result is harvested into the cache when a later cycle
   finds it finished — the straggler is never resubmitted while in flight,
   so a wedged source occupies exactly one pool thread, not one per cycle.
+  A source backed by the probe sandbox (LabelSource.cancel set —
+  sandbox/probe.py) goes further: the deadline miss SIGKILLs its forked
+  probe child, so even a straggler wedged inside NATIVE code frees its
+  worker thread within milliseconds instead of leaking it for the process
+  lifetime; ``close()`` kills any child still in flight at epoch end so a
+  SIGHUP reload cannot orphan one.
 - Merging stays ordered: results land in source-list order whatever order
   the futures finish in, so the last-writer-wins override semantics (and
   the golden output files) are byte-identical to the sequential merge.
@@ -87,11 +93,20 @@ class LabelSource:
     the pool saves a cross-thread handoff apiece — which would otherwise
     more than double the all-fast cycle's p50 (~0.13 ms per handoff
     against a ~0.5 ms cycle). Default True: an unknown source gets full
-    deadline protection, never silent inline trust."""
+    deadline protection, never silent inline trust.
+
+    ``cancel`` is the sandbox escalation hook (sandbox/probe.py
+    SandboxedCall.cancel): a source whose blocking work runs in a forked
+    probe child provides it, and a deadline miss then SIGKILLs the child
+    instead of abandoning a live worker thread — the leak the thread-only
+    deadline could never fix, because a thread blocked inside native code
+    cannot be interrupted from Python. Sources without it keep the
+    abandon-and-harvest behavior."""
 
     name: str
     produce: Callable[[], Labeler]
     offload: bool = True
+    cancel: Optional[Callable[[], None]] = None
 
     def run(self) -> Labels:
         from gpu_feature_discovery_tpu.utils.faults import maybe_inject
@@ -106,6 +121,13 @@ class _SourceState:
 
     last_good: Optional[Labels] = None
     inflight: Optional[concurrent.futures.Future] = None
+    # The in-flight submission's cancel hook (sandbox-backed sources);
+    # None for plain sources.
+    cancel: Optional[Callable[[], None]] = None
+    # The engine killed this submission's probe child itself (deadline
+    # escalation / close): its failure is self-inflicted and must not
+    # surface as a broken source at harvest time.
+    cancelled: bool = False
 
 
 class _DaemonPool:
@@ -223,7 +245,35 @@ class LabelEngine:
     def close(self) -> None:
         """Retire the pool at epoch end. Workers are daemon threads, so a
         SIGHUP reload proceeds immediately while an orphaned straggler
-        finishes (or wedges) in the background without blocking exit."""
+        finishes (or wedges) in the background without blocking exit.
+
+        Sandbox-backed stragglers get more than abandonment: any source
+        still in flight with a cancel hook has its probe child SIGKILLed
+        NOW — a SIGHUP reload must not orphan a forked child probing on
+        behalf of an epoch that no longer exists. Only THIS engine's
+        children: the process-wide stray sweep
+        (sandbox.kill_stray_children) is epoch-scoped and belongs to the
+        daemon loop's teardown (cmd/main.run's finally) — an embedder
+        closing its own engine must not SIGKILL another engine's (or the
+        acquisition path's) probe mid-flight."""
+        for name, state in self._state.items():
+            fut = state.inflight
+            if fut is None or fut.done():
+                continue
+            if state.cancel is not None and not state.cancelled:
+                state.cancelled = True
+                try:
+                    state.cancel()
+                    log.info(
+                        "epoch close: cancelled in-flight probe for "
+                        "labeler %r",
+                        name,
+                    )
+                except Exception:  # noqa: BLE001 - close must not raise
+                    log.warning(
+                        "cancel hook for labeler %r failed:", name,
+                        exc_info=True,
+                    )
         if self._pool is not None:
             self._pool.shutdown()
             self._pool = None
@@ -262,6 +312,8 @@ class LabelEngine:
             # next cycle must wait on THIS future, not stack a second
             # probe behind a still-running one.
             state.inflight = fut
+            state.cancel = src.cancel
+            state.cancelled = False
             futures[src.name] = fut
 
         if futures:
@@ -291,6 +343,21 @@ class LabelEngine:
             except concurrent.futures.TimeoutError:
                 stale.append(src.name)
                 labels = state.last_good if state.last_good is not None else Labels()
+                if state.cancel is not None and not state.cancelled:
+                    # Sandbox-backed source: escalate the deadline miss
+                    # to child SIGKILL. The worker thread unblocks as
+                    # soon as the child dies, so the straggler costs a
+                    # few milliseconds of thread time, not a leaked
+                    # thread wedged in native code forever.
+                    state.cancelled = True
+                    try:
+                        state.cancel()
+                    except Exception:  # noqa: BLE001 - escalation best-effort
+                        log.warning(
+                            "cancel hook for labeler %r failed:",
+                            src.name,
+                            exc_info=True,
+                        )
             except BaseException:
                 state.inflight = None  # consumed: surfacing it this cycle
                 raise
@@ -338,8 +405,21 @@ class LabelEngine:
     def _harvest(self, name: str, state: _SourceState) -> None:
         """Fold a finished straggler's result into the cache. Its error —
         if it failed rather than finished — surfaces now: the alternative
-        is a source that is served stale forever with nobody told why."""
+        is a source that is served stale forever with nobody told why.
+        Exception to that exception: a straggler whose probe child the
+        ENGINE killed (deadline escalation) failed by the engine's own
+        hand, so its death is consumed silently and the source simply
+        resubmits fresh."""
         fut, state.inflight = state.inflight, None
+        cancelled, state.cancelled = state.cancelled, False
+        if cancelled and fut.exception() is not None:
+            log.info(
+                "labeler %r: probe child was killed at the deadline; "
+                "resubmitting fresh (%s)",
+                name,
+                fut.exception(),
+            )
+            return
         state.last_good = fut.result()
         obs_metrics.STRAGGLERS_HARVESTED.labels(labeler=name).inc()
         log.info("labeler %r caught up; straggler result cached", name)

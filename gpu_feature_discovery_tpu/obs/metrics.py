@@ -78,6 +78,46 @@ DEGRADED = REGISTRY.gauge(
     "being published (the tfd.degraded marker), else 0.",
 )
 
+# -- probe sandbox + restart/flap resilience (sandbox/) ---------------------
+
+PROBE_DURATION = REGISTRY.histogram(
+    "tfd_probe_duration_seconds",
+    "Wall time of each sandboxed device probe (forked child: PJRT init + "
+    "snapshot enumeration), whatever its outcome.",
+)
+PROBE_KILLS = REGISTRY.counter(
+    "tfd_probe_kills_total",
+    "Probe children SIGKILLed: wall-clock budget exceeded "
+    "(--probe-timeout), engine deadline-miss escalation, or epoch-close "
+    "cleanup of an in-flight child.",
+)
+PROBE_CRASHES = REGISTRY.counter(
+    "tfd_probe_crashes_total",
+    "Probe children that died to a signal (native SIGSEGV et al.) — "
+    "contained as retryable init failures instead of killing the daemon.",
+)
+STATE_RESTORES = REGISTRY.counter(
+    "tfd_state_restores_total",
+    "Epoch starts that re-served persisted last-good labels from "
+    "--state-dir (published with the tfd.restored marker).",
+)
+RESTORED = REGISTRY.gauge(
+    "tfd_restored",
+    "1 while the published labels are restored last-good state from a "
+    "previous run (the tfd.restored marker), cleared by the first live "
+    "full cycle; else 0.",
+)
+FLAP_SUPPRESSED = REGISTRY.counter(
+    "tfd_flap_suppressed_total",
+    "Cycles whose label change was suppressed by the --flap-window "
+    "hysteresis (previous labels re-served with the tfd.flapping marker).",
+)
+FLAPPING = REGISTRY.gauge(
+    "tfd_flapping",
+    "1 while a label change is being held back by the --flap-window "
+    "hysteresis, else 0.",
+)
+
 # -- label engine (lm/engine.py) --------------------------------------------
 
 LABELER_DURATION = REGISTRY.histogram(

@@ -53,6 +53,16 @@ def new_manager(config: Config, wrap_fallback: bool = True) -> Manager:
     return with_config(manager, config)
 
 
+def select_manager(config: Config) -> Manager:
+    """Backend selection WITHOUT the ``pjrt_init`` fault site or the
+    init-attempt metric: the probe sandbox (sandbox/probe.py) runs this
+    full chain — platform detection, dlopen probes, the auto chain's
+    eager jax verification — inside its forked child, after firing the
+    site and the metric in the PARENT where their state lives. Every
+    native-touching step of backend selection is then killable."""
+    return _get_manager(config)
+
+
 def with_config(manager: Manager, config: Config) -> Manager:
     """WithConfig (factory.go:33-39)."""
     if config.flags.fail_on_init_error:

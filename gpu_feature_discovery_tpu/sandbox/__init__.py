@@ -1,0 +1,60 @@
+"""Probe sandbox subsystem: process-isolated device probing.
+
+The worst production failure mode of a node agent speaking to a native
+driver stack is a wedged or crashing native call: libtpu/PJRT can hang or
+SIGSEGV *inside C code*, where no Python-side deadline can interrupt it
+(lm/engine.py documents the leaked-straggler consequence) and where a
+crash takes the whole daemon down despite the supervisor's per-cycle
+containment. This package moves every native-touching probe into a
+killable forked child process and adds the two recovery behaviors that
+ride on it:
+
+- ``probe``    — fork/kill/reap machinery + the sandboxed snapshot probe
+                 (``probe_device_snapshot``) the supervised daemon
+                 acquires its backend through.
+- ``snapshot`` — the serializable device inventory a probe child ships
+                 back over a pipe, and the ``SnapshotManager`` that
+                 serves it to the labelers in the parent.
+- ``state``    — persisted last-good label state (``--state-dir``):
+                 restarts re-serve the previous labels immediately
+                 instead of stripping the node bare while a crash-looping
+                 backend retries.
+- ``flap``     — anti-flap hysteresis (``--flap-window``): label
+                 transitions must hold for N consecutive cycles before
+                 the published file changes.
+"""
+
+from gpu_feature_discovery_tpu.sandbox.flap import FLAPPING_LABEL, FlapDamper
+from gpu_feature_discovery_tpu.sandbox.probe import (
+    ProbeCrash,
+    ProbeError,
+    ProbeTimeout,
+    SandboxedCall,
+    acquire_snapshot_manager,
+    isolation_mode,
+    kill_stray_children,
+    probe_device_snapshot,
+    run_probe,
+)
+from gpu_feature_discovery_tpu.sandbox.snapshot import (
+    DeviceSnapshot,
+    SnapshotManager,
+)
+from gpu_feature_discovery_tpu.sandbox.state import LabelStateStore
+
+__all__ = [
+    "FLAPPING_LABEL",
+    "FlapDamper",
+    "ProbeCrash",
+    "ProbeError",
+    "ProbeTimeout",
+    "SandboxedCall",
+    "acquire_snapshot_manager",
+    "isolation_mode",
+    "kill_stray_children",
+    "probe_device_snapshot",
+    "run_probe",
+    "DeviceSnapshot",
+    "SnapshotManager",
+    "LabelStateStore",
+]

@@ -1,0 +1,128 @@
+"""Anti-flap hysteresis for published labels (``--flap-window``).
+
+A backend oscillating across cycles — a chip that enumerates every other
+init, health labels blinking with a marginal probe, degraded mode
+toggling with a racing metadata server — turns into NFD label churn and
+scheduler thrash at exactly the moment the node is least trustworthy.
+The damper requires any change to the published label set to HOLD for
+``--flap-window`` consecutive cycles before it goes out; while a change
+is being suppressed the previously published labels are re-served with
+``google.com/tpu.tfd.flapping=true`` so operators can see the
+oscillation without the fleet reacting to it.
+
+Comparison ignores the transient status markers (stale-sources,
+unhealthy-cycles, restored, flapping itself): those describe the cycle,
+not the inventory, and must keep flowing through unsuppressed. The
+degraded marker and the device labels ARE compared — a full<->degraded
+transition is precisely the chip-count/health/degraded flap the window
+exists to damp. ``--flap-window=1`` (the default) publishes every cycle
+unchanged: zero behavior change unless an operator opts in.
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, Optional
+
+from gpu_feature_discovery_tpu.lm.labels import Labels
+
+log = logging.getLogger("tfd.sandbox")
+
+FLAPPING_LABEL = "google.com/tpu.tfd.flapping"
+
+# Labels excluded from the change comparison; on a suppressed cycle the
+# CURRENT cycle's values flow through (they describe this cycle
+# truthfully whatever inventory is served). The status markers belong
+# here by definition; the timestamp does too — it is a freshness
+# signal, constant within an epoch but different across epochs, and a
+# restore->live transition must not count as "the labels changed"
+# merely because the clock moved.
+_TRANSIENT_MARKERS = (
+    FLAPPING_LABEL,
+    "google.com/tpu.tfd.stale-sources",
+    "google.com/tpu.tfd.unhealthy-cycles",
+    "google.com/tpu.tfd.restored",
+    "google.com/tfd.timestamp",
+)
+
+
+def _normalize(labels: Dict[str, str]) -> Dict[str, str]:
+    return {k: v for k, v in labels.items() if k not in _TRANSIENT_MARKERS}
+
+
+class FlapDamper:
+    """Per-epoch hysteresis over the composed label set. ``observe``
+    takes the labels a cycle wants to publish and returns the labels that
+    SHOULD be published."""
+
+    def __init__(self, window: int = 1):
+        self.window = max(1, int(window))
+        self._published: Optional[Dict[str, str]] = None
+        self._pending: Optional[Dict[str, str]] = None
+        self._pending_count = 0
+
+    @property
+    def suppressing(self) -> bool:
+        return self._pending is not None
+
+    def observe(self, labels: Labels) -> Labels:
+        from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+        candidate = _normalize(labels)
+        if self._published is None or self.window <= 1:
+            # First publish of the epoch, or damping disabled: publish
+            # as-is (an epoch's first labels have nothing to flap FROM).
+            self._accept(candidate)
+            return labels
+
+        if candidate == self._published:
+            # Steady state; a pending change that reverted never held its
+            # window — exactly the flap the damper exists to suppress.
+            if self._pending is not None:
+                log.info(
+                    "label change reverted before holding %d cycles; "
+                    "suppressed flap never published",
+                    self.window,
+                )
+            self._accept(candidate)
+            return labels
+
+        if self._pending == candidate:
+            self._pending_count += 1
+        else:
+            self._pending = dict(candidate)
+            self._pending_count = 1
+
+        if self._pending_count >= self.window:
+            log.info(
+                "label change held for %d consecutive cycles; publishing",
+                self._pending_count,
+            )
+            self._accept(candidate)
+            return labels
+
+        obs_metrics.FLAP_SUPPRESSED.inc()
+        obs_metrics.FLAPPING.set(1)
+        log.warning(
+            "suppressing label change (%d/%d cycles held); re-serving "
+            "previous labels with %s",
+            self._pending_count,
+            self.window,
+            FLAPPING_LABEL,
+        )
+        served = Labels(self._published)
+        # Transient markers from the CURRENT cycle keep flowing — they
+        # describe this cycle truthfully whatever inventory is served.
+        for marker in _TRANSIENT_MARKERS:
+            if marker in labels and marker != FLAPPING_LABEL:
+                served[marker] = labels[marker]
+        served[FLAPPING_LABEL] = "true"
+        return served
+
+    def _accept(self, candidate: Dict[str, str]) -> None:
+        from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+        self._published = dict(candidate)
+        self._pending = None
+        self._pending_count = 0
+        obs_metrics.FLAPPING.set(0)

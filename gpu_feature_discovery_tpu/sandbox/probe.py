@@ -1,0 +1,558 @@
+"""Forked probe children with a hard wall-clock budget enforced by SIGKILL.
+
+The contract every caller gets:
+
+- The probed function runs in a forked child; a hang in native code
+  (libtpu, PJRT, a wedged metadata fd) wedges ONLY the child. At the
+  ``--probe-timeout`` deadline the parent SIGKILLs it — SIGKILL because a
+  thread blocked inside a C extension never services Python-level signals,
+  which is the exact pathology that motivates the sandbox.
+- A child that dies to a signal (native SIGSEGV/SIGBUS/SIGKILL) surfaces
+  as ``ProbeCrash`` with the signal name and the tail of the child's
+  captured stderr — the only postmortem a native crash leaves.
+- Every child is reaped (``waitpid``) on every exit path, so no zombies
+  accumulate across cycles or SIGHUP reloads; children that somehow
+  outlive their caller (an abandoned engine straggler) are registered in
+  a module-level table and killed by ``kill_stray_children()`` at epoch
+  end (lm/engine.LabelEngine.close wires it).
+
+Both probe errors subclass ``ResourceError``, so the supervised daemon's
+existing degraded-mode machinery treats a hang or a native crash as one
+more retryable backend-init failure — degraded labels and backoff instead
+of a wedged or dead pod.
+
+Chaos sites (``TFD_FAULT_SPEC`` grammar, utils/faults.py):
+
+    probe.timeout   consumed in the PARENT: the probe reports a timeout
+                    immediately, no child spawned (deterministic and
+                    fast for unit tests).
+    probe.hang      consumed in the PARENT, enacted in the CHILD: the
+                    child sleeps forever before probing, so the parent
+                    must SIGKILL it at the deadline — the full kill path.
+    probe.segv      consumed in the PARENT, enacted in the CHILD: the
+                    child raises SIGSEGV on itself — the real
+                    crash-containment path, stderr capture included.
+
+Parent-side consumption matters: the countdown must live in the parent's
+registry. A child decrements only its own fork-copied memory, so a
+child-side ``maybe_inject`` would re-fire forever and no chaos scenario
+could converge.
+
+Fork-from-threads caveat: the daemon has other threads at fork time
+(engine pool, obs server), so the child starts with fork-copied lock
+STATE and only the forking thread. CPython reinitializes the logging and
+import machinery locks at fork, and the child's probe path deliberately
+touches no other shared lock (no metrics, no label writes) before
+exiting — but a future probe fn that grabs an arbitrary lock could
+inherit it held-by-nobody and wedge. The budget is the backstop either
+way: a wedged child is SIGKILLed at the deadline and retried, exactly
+like a real native hang.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import select
+import signal
+import struct
+import sys
+import tempfile
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional, Set
+
+from gpu_feature_discovery_tpu.resource.types import Manager, ResourceError
+from gpu_feature_discovery_tpu.sandbox.snapshot import DeviceSnapshot
+
+log = logging.getLogger("tfd.sandbox")
+
+# How much of the child's captured stderr a crash/error report carries.
+# Big enough that a faulthandler stack dump (re-pointed at the captured
+# stderr below) does not push out the native library's own last words.
+STDERR_TAIL_BYTES = 8192
+
+# Length prefix framing for the result pipe: a partial frame (child died
+# mid-write) is detected instead of parsed.
+_LEN = struct.Struct(">I")
+
+# Probe children still alive (pid set). run_probe registers on fork and
+# unregisters after reap; kill_stray_children sweeps whatever is left —
+# the SIGHUP-reload safety net for children an abandoned engine straggler
+# thread was awaiting.
+_live_lock = threading.Lock()
+_live_children: Set[int] = set()
+
+
+class ProbeError(ResourceError):
+    """Base: the sandboxed probe did not produce a snapshot."""
+
+
+class ProbeTimeout(ProbeError):
+    """The child exceeded the wall-clock budget and was SIGKILLed."""
+
+
+class ProbeCrash(ProbeError):
+    """The child died to a signal (native SIGSEGV et al.)."""
+
+
+@dataclass
+class ProbeResult:
+    """What one child run produced. ``status`` is ok | timeout | crash |
+    error; exactly one of payload / error detail is meaningful."""
+
+    status: str
+    duration_s: float
+    payload: Optional[dict] = None
+    error_type: str = ""
+    error: str = ""
+    term_signal: Optional[int] = None
+    stderr_tail: str = ""
+
+
+def _register(pid: int) -> None:
+    with _live_lock:
+        _live_children.add(pid)
+
+
+def _discard(pid: int) -> None:
+    """Withdraw a pid from the kill-eligible set. MUST happen before the
+    owner's waitpid: a pid is only recyclable once reaped, so the
+    invariant "kills target only registered pids, registration ends
+    before reaping" guarantees no SIGKILL can ever land on a recycled
+    pid that now names an unrelated process (this daemon runs
+    privileged — a stale kill would be a host-process kill)."""
+    with _live_lock:
+        _live_children.discard(pid)
+
+
+def kill_if_live(pid: int) -> bool:
+    """SIGKILL ``pid`` iff it is still a registered (unreaped) probe
+    child; the registry lock serializes against the owner's pre-reap
+    discard, so the kill can never race pid recycling."""
+    with _live_lock:
+        if pid not in _live_children:
+            return False
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            return False
+        return True
+
+
+def kill_stray_children() -> int:
+    """SIGKILL + reap every probe child still registered. Called at
+    engine/epoch close so a SIGHUP reload (or an abandoned straggler
+    thread) can never orphan a probing child or leak a zombie. Returns
+    how many children were killed. The whole sweep holds the registry
+    lock: an owner thread concurrently reaching its own reap waits, then
+    finds its pid gone and its waitpid answered with ECHILD — never the
+    other way around with a recycled pid."""
+    killed = 0
+    with _live_lock:
+        for pid in sorted(_live_children):
+            if _kill_and_reap(pid):
+                killed += 1
+        _live_children.clear()
+    if killed:
+        log.warning("killed %d stray probe child(ren) at epoch end", killed)
+    return killed
+
+
+def _kill_and_reap(pid: int) -> bool:
+    """Best-effort SIGKILL + bounded reap of one REGISTERED child (the
+    caller holds the registry lock, so the owner cannot reap it
+    concurrently). True when the child was still alive to kill."""
+    alive = False
+    try:
+        os.kill(pid, signal.SIGKILL)
+        alive = True
+    except OSError:
+        pass
+    # Bounded: a SIGKILLed (or already-exited) child reaps in
+    # milliseconds; ECHILD means it was never ours to begin with.
+    deadline = time.monotonic() + 5.0
+    while time.monotonic() < deadline:
+        try:
+            done, _ = os.waitpid(pid, os.WNOHANG)
+        except ChildProcessError:
+            return alive
+        if done == pid:
+            return alive
+        time.sleep(0.005)
+    return alive
+
+
+def run_probe(
+    fn: Callable[[], dict],
+    timeout_s: float,
+    hang: bool = False,
+    segv: bool = False,
+    pid_box: Optional[list] = None,
+) -> ProbeResult:
+    """Run ``fn`` in a forked child under a hard deadline; ``fn`` must
+    return a JSON-serializable dict. ``hang``/``segv`` are the chaos
+    behaviors (consumed by the caller from the fault registry — parent
+    side — and enacted here). ``pid_box``, when given, receives the
+    child pid at spawn so a canceller can SIGKILL it mid-flight."""
+    r_fd, w_fd = os.pipe()
+    stderr_file = tempfile.NamedTemporaryFile(
+        prefix="tfd-probe-stderr-", delete=False
+    )
+    start = time.monotonic()
+    pid = os.fork()
+    if pid == 0:
+        # -- child ---------------------------------------------------------
+        # No cleanup handlers, no atexit, no pytest finalizers: whatever
+        # happens, leave through os._exit. stderr goes to the temp file
+        # so a native crash's last words survive the process.
+        try:
+            os.close(r_fd)
+            os.dup2(stderr_file.fileno(), 2)
+            sys.stderr = os.fdopen(2, "w", buffering=1, closefd=False)
+            # Re-point faulthandler at the REDIRECTED stderr: a native
+            # crash's stack dump then lands in the captured tail the
+            # parent reports, instead of on whatever fd the parent's
+            # handler (pytest's, cmd/main's) had duplicated earlier.
+            try:
+                import faulthandler
+
+                faulthandler.enable(file=sys.stderr, all_threads=False)
+            except Exception:  # noqa: BLE001 - diagnostics only
+                pass
+            if hang:
+                # Simulated wedged native call: sleep far past any
+                # plausible budget; only SIGKILL ends this.
+                while True:
+                    time.sleep(3600)
+            if segv:
+                # Simulated native crash: a real signal death, so the
+                # parent exercises the same WIFSIGNALED path a libtpu
+                # SIGSEGV takes.
+                os.kill(os.getpid(), signal.SIGSEGV)
+            payload = fn()
+            data = json.dumps({"status": "ok", "payload": payload}).encode()
+        except BaseException as e:  # noqa: BLE001 - shipped to the parent
+            try:
+                data = json.dumps(
+                    {
+                        "status": "error",
+                        "error_type": type(e).__name__,
+                        "error": str(e),
+                    }
+                ).encode()
+            except Exception:  # noqa: BLE001 - unserializable error detail
+                data = json.dumps(
+                    {"status": "error", "error_type": "Exception", "error": ""}
+                ).encode()
+        try:
+            os.write(w_fd, _LEN.pack(len(data)) + data)
+        except OSError:
+            pass
+        finally:
+            os._exit(0)
+
+    # -- parent -----------------------------------------------------------
+    os.close(w_fd)
+    stderr_file.close()
+    _register(pid)
+    if pid_box is not None:
+        pid_box.append(pid)
+    try:
+        frame = _read_frame(r_fd, start + timeout_s)
+        duration = time.monotonic() - start
+        if frame is None:
+            # Deadline passed with no complete frame: hard-kill. The
+            # child may ALSO be already dead (crash) — waitpid decides.
+            # Through kill_if_live: the epoch-close sweeper may have
+            # killed AND reaped this pid already, and a direct kill
+            # would then target a recyclable pid.
+            kill_if_live(pid)
+            _discard(pid)
+            status = _reap(pid)
+            tail = _stderr_tail(stderr_file.name)
+            if status is not None and os.WIFSIGNALED(status) and (
+                os.WTERMSIG(status) != signal.SIGKILL
+            ):
+                return ProbeResult(
+                    status="crash",
+                    duration_s=duration,
+                    term_signal=os.WTERMSIG(status),
+                    stderr_tail=tail,
+                )
+            return ProbeResult(
+                status="timeout", duration_s=duration, stderr_tail=tail
+            )
+        _discard(pid)
+        status = _reap(pid)
+        duration = time.monotonic() - start
+        if frame == b"":
+            # EOF without a frame: the child died before writing —
+            # a crash if a signal killed it, an error otherwise.
+            tail = _stderr_tail(stderr_file.name)
+            if status is not None and os.WIFSIGNALED(status):
+                return ProbeResult(
+                    status="crash",
+                    duration_s=duration,
+                    term_signal=os.WTERMSIG(status),
+                    stderr_tail=tail,
+                )
+            return ProbeResult(
+                status="error",
+                duration_s=duration,
+                error_type="ProbeError",
+                error="probe child exited without reporting a result",
+                stderr_tail=tail,
+            )
+        try:
+            doc = json.loads(frame.decode())
+        except ValueError:
+            return ProbeResult(
+                status="error",
+                duration_s=duration,
+                error_type="ProbeError",
+                error="probe child returned an unparseable result frame",
+                stderr_tail=_stderr_tail(stderr_file.name),
+            )
+        if doc.get("status") == "ok":
+            return ProbeResult(
+                status="ok", duration_s=duration, payload=doc.get("payload")
+            )
+        return ProbeResult(
+            status="error",
+            duration_s=duration,
+            error_type=str(doc.get("error_type", "Exception")),
+            error=str(doc.get("error", "")),
+            stderr_tail=_stderr_tail(stderr_file.name),
+        )
+    finally:
+        _discard(pid)
+        os.close(r_fd)
+        try:
+            os.unlink(stderr_file.name)
+        except OSError:
+            pass
+
+
+def _read_frame(r_fd: int, deadline: float) -> Optional[bytes]:
+    """Read one length-prefixed frame from the pipe by ``deadline``.
+    Returns the frame body, b"" on EOF-before-frame, or None when the
+    deadline expired first (a partial frame counts as EOF — the child
+    died mid-write and will never finish it)."""
+    buf = b""
+    want: Optional[int] = None
+    while True:
+        remaining = deadline - time.monotonic()
+        if remaining <= 0:
+            return None
+        try:
+            ready, _, _ = select.select([r_fd], [], [], remaining)
+        except InterruptedError:
+            continue
+        if not ready:
+            return None
+        chunk = os.read(r_fd, 65536)
+        if not chunk:
+            # EOF. A complete frame would have returned below already.
+            return b""
+        buf += chunk
+        if want is None and len(buf) >= _LEN.size:
+            want = _LEN.unpack_from(buf)[0]
+        if want is not None and len(buf) >= _LEN.size + want:
+            return buf[_LEN.size:_LEN.size + want]
+
+
+def _reap(pid: int) -> Optional[int]:
+    """Blocking waitpid; None when someone else got there first. A
+    SIGKILLed child exits promptly, so the block is bounded in practice."""
+    try:
+        _, status = os.waitpid(pid, 0)
+        return status
+    except ChildProcessError:
+        return None
+
+
+def _stderr_tail(path: str) -> str:
+    try:
+        with open(path, "rb") as f:
+            f.seek(0, os.SEEK_END)
+            size = f.tell()
+            f.seek(max(0, size - STDERR_TAIL_BYTES))
+            return f.read().decode(errors="replace").strip()
+    except OSError:
+        return ""
+
+
+# ---------------------------------------------------------------------------
+# the snapshot probe — what the supervised daemon acquires its backend with
+# ---------------------------------------------------------------------------
+
+def probe_device_snapshot(manager: Manager, timeout_s: float) -> DeviceSnapshot:
+    """Initialize ``manager`` and walk its device inventory INSIDE a
+    forked child; return the reconstructed snapshot in the parent."""
+
+    def _snapshot() -> dict:
+        manager.init()
+        return DeviceSnapshot.from_manager(manager).to_dict()
+
+    return _run_snapshot_probe(_snapshot, timeout_s)
+
+
+def acquire_snapshot_manager(config, timeout_s: float) -> "Manager":
+    """The supervised daemon's sandboxed acquisition unit: backend
+    SELECTION + init + enumeration all inside one forked child, a
+    SnapshotManager over the result in the parent.
+
+    Selection must run in the child too, not just ``init()``: with
+    ``--fail-on-init-error=false`` the factory's auto chain EAGERLY
+    inits jax to decide whether to fall through to the native/hostinfo
+    backends — done in the parent, that eager init would be exactly the
+    unkillable native call the sandbox exists to contain. Only the
+    ``pjrt_init`` fault site and the init-attempt metric fire in the
+    parent, where their countdown/registry state lives (a child-side
+    countdown decrements fork-copied memory and re-fires forever)."""
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+    from gpu_feature_discovery_tpu.resource import factory
+    from gpu_feature_discovery_tpu.sandbox.snapshot import SnapshotManager
+    from gpu_feature_discovery_tpu.utils import faults
+
+    obs_metrics.BACKEND_INIT_ATTEMPTS.inc()
+    faults.maybe_inject("pjrt_init")
+
+    def _select_and_snapshot() -> dict:
+        manager = factory.select_manager(config)
+        manager.init()
+        return DeviceSnapshot.from_manager(manager).to_dict()
+
+    return SnapshotManager(_run_snapshot_probe(_select_and_snapshot, timeout_s))
+
+
+def _run_snapshot_probe(fn: Callable[[], dict], timeout_s: float) -> DeviceSnapshot:
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+    from gpu_feature_discovery_tpu.utils import faults
+
+    if faults.consume("probe.timeout"):
+        # Synthesized in the parent: no child was spawned, so neither the
+        # kill counter nor the duration histogram records anything — the
+        # metrics state facts about real children only.
+        raise ProbeTimeout(
+            f"injected fault at 'probe.timeout' ({faults.FAULT_SPEC_ENV}): "
+            f"probe treated as exceeding its {timeout_s:.1f}s budget"
+        )
+    # At most ONE behavior per probe: with several sites armed (the
+    # acceptance spec arms hang + segv together) they fire on successive
+    # probes, not all on the first — each probe must exercise its own
+    # containment path.
+    hang = faults.consume("probe.hang")
+    segv = False if hang else faults.consume("probe.segv")
+
+    result = run_probe(fn, timeout_s, hang=hang, segv=segv)
+    obs_metrics.PROBE_DURATION.observe(result.duration_s)
+    if result.status == "ok":
+        return DeviceSnapshot.from_dict(result.payload or {})
+    if result.status == "timeout":
+        obs_metrics.PROBE_KILLS.inc()
+        raise ProbeTimeout(
+            f"device probe exceeded its {timeout_s:.1f}s budget and was "
+            f"SIGKILLed after {result.duration_s:.1f}s"
+            + (f"; child stderr tail:\n{result.stderr_tail}"
+               if result.stderr_tail else "")
+        )
+    if result.status == "crash":
+        obs_metrics.PROBE_CRASHES.inc()
+        signame = signal.Signals(result.term_signal).name \
+            if result.term_signal is not None else "?"
+        raise ProbeCrash(
+            f"device probe child died to {signame} after "
+            f"{result.duration_s:.2f}s"
+            + (f"; child stderr tail:\n{result.stderr_tail}"
+               if result.stderr_tail else "")
+        )
+    raise ResourceError(
+        f"device probe failed in child: {result.error_type}: {result.error}"
+    )
+
+
+def isolation_mode(config) -> str:
+    """Resolve ``--probe-isolation`` to an effective mode. ``auto`` (the
+    default) is subprocess for the supervised daemon and none for
+    oneshot, which keeps the oneshot/golden path byte-for-byte the
+    reference's in-process probe.
+
+    ``--with-burnin`` also resolves auto to none: the burn-in probe
+    needs a live PJRT client IN the daemon process (its device handles,
+    probe workspaces, and compilation cache are process-resident by
+    design — ops/healthcheck.py), and a parent that holds the exclusive
+    chip would make every forked child's init fail, turning one
+    transient fault into permanently degraded labels. An EXPLICIT
+    ``--probe-isolation=subprocess`` still wins — the operator asked —
+    with the interaction documented in docs/operations.md."""
+    tfd = config.flags.tfd
+    mode = tfd.probe_isolation or "auto"
+    if mode != "auto":
+        return mode
+    if tfd.oneshot or tfd.with_burnin:
+        return "none"
+    return "subprocess"
+
+
+class SandboxedCall:
+    """A callable that runs ``fn`` in a probe child each invocation and
+    exposes ``cancel()`` — the hook behind ``LabelSource.cancel``: a
+    source whose blocking work runs through one of these gets its child
+    SIGKILLed on a deadline miss or at epoch close instead of leaking a
+    worker thread wedged in native code (lm/engine.py). This is the SEAM
+    for sandbox-backing engine sources — the engine-side escalation and
+    the reload-safety contract are pinned by tests/test_sandbox.py and
+    tests/test_reload.py; in-tree sources adopt it as their blocking
+    work moves into probe children."""
+
+    def __init__(self, fn: Callable[[], dict], timeout_s: float):
+        self._fn = fn
+        self._timeout_s = timeout_s
+        self._pids: list = []
+        self._lock = threading.Lock()
+
+    def __call__(self) -> dict:
+        box: list = []
+        with self._lock:
+            self._pids = box
+        try:
+            result = run_probe(self._fn, self._timeout_s, pid_box=box)
+        finally:
+            # The child is reaped: a cancel() arriving after this point
+            # must find nothing, or it could SIGKILL a recycled pid.
+            with self._lock:
+                self._pids = []
+        if result.status == "ok":
+            return result.payload or {}
+        if result.status == "timeout":
+            raise ProbeTimeout(
+                f"sandboxed call exceeded {self._timeout_s:.1f}s"
+            )
+        if result.status == "crash":
+            raise ProbeCrash(
+                f"sandboxed call died to signal {result.term_signal}"
+            )
+        raise ResourceError(
+            f"sandboxed call failed: {result.error_type}: {result.error}"
+        )
+
+    def cancel(self) -> None:
+        """SIGKILL the in-flight child, if any. The worker thread blocked
+        in run_probe sees EOF + a signaled wait status and returns
+        promptly — one idle pool thread reclaimed instead of leaked.
+        Kills go through the registry (kill_if_live): a pid whose owner
+        already reaped it is no longer killable, so a cancel racing a
+        normal completion can never hit a recycled pid."""
+        with self._lock:
+            pids = list(self._pids)
+        from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+        for pid in pids:
+            if not kill_if_live(pid):
+                continue
+            obs_metrics.PROBE_KILLS.inc()
+            log.warning("SIGKILLed in-flight probe child %d", pid)

@@ -1,0 +1,256 @@
+"""Serializable device snapshot + the Manager that serves it.
+
+The probe child cannot hand live backend objects across the process
+boundary (a ``JaxChip`` holds a PJRT device owned by the child's client,
+which dies with the child), so the child walks the initialized manager
+into plain data — exactly the facts the labelers consume through the
+``Manager``/``Chip`` seam (resource/types.py) — and ships it back as
+JSON. The parent reconstructs a ``SnapshotManager`` over it: every
+labeler runs unchanged, and ``tests/test_sandbox.py`` pins that the
+label output is identical to probing the live manager in-process.
+
+JSON rather than pickle on purpose: a child that is killed or crashes
+mid-write leaves a truncated payload, and a truncated JSON document
+fails parsing loudly instead of executing arbitrary bytecode the way a
+corrupt pickle could.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+from typing import Dict, List, Tuple
+
+from gpu_feature_discovery_tpu.resource.types import Chip, Manager, ResourceError
+
+SNAPSHOT_VERSION = 1
+
+
+@dataclass
+class SliceSnapshot:
+    """One slice partition: name (its topology string), the attribute
+    family, and the whole-partition memory."""
+
+    name: str
+    memory_mb: int
+    generation: Tuple[int, int]
+    attributes: Dict[str, object] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "name": self.name,
+            "memory_mb": self.memory_mb,
+            "generation": list(self.generation),
+            "attributes": dict(self.attributes),
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, object]) -> "SliceSnapshot":
+        return SliceSnapshot(
+            name=str(d["name"]),
+            memory_mb=int(d["memory_mb"]),
+            generation=tuple(d["generation"]),  # type: ignore[arg-type]
+            attributes=dict(d.get("attributes") or {}),
+        )
+
+
+@dataclass
+class ChipSnapshot:
+    """One enumerated chip as the labelers see it."""
+
+    name: str
+    memory_mb: int
+    generation: Tuple[int, int]
+    slice_capable: bool
+    slice_enabled: bool
+    slices: List[SliceSnapshot] = field(default_factory=list)
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "name": self.name,
+            "memory_mb": self.memory_mb,
+            "generation": list(self.generation),
+            "slice_capable": self.slice_capable,
+            "slice_enabled": self.slice_enabled,
+            "slices": [s.to_dict() for s in self.slices],
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, object]) -> "ChipSnapshot":
+        return ChipSnapshot(
+            name=str(d["name"]),
+            memory_mb=int(d["memory_mb"]),
+            generation=tuple(d["generation"]),  # type: ignore[arg-type]
+            slice_capable=bool(d["slice_capable"]),
+            slice_enabled=bool(d["slice_enabled"]),
+            slices=[SliceSnapshot.from_dict(s) for s in d.get("slices") or []],
+        )
+
+
+@dataclass
+class DeviceSnapshot:
+    """Everything a labeling pass reads off a Manager, as plain data."""
+
+    driver_version: str
+    runtime_version: Tuple[int, int]
+    chips: List[ChipSnapshot] = field(default_factory=list)
+    version: int = SNAPSHOT_VERSION
+
+    def to_dict(self) -> Dict[str, object]:
+        return {
+            "version": self.version,
+            "driver_version": self.driver_version,
+            "runtime_version": list(self.runtime_version),
+            "chips": [c.to_dict() for c in self.chips],
+        }
+
+    @staticmethod
+    def from_dict(d: Dict[str, object]) -> "DeviceSnapshot":
+        version = int(d.get("version", 0))
+        if version != SNAPSHOT_VERSION:
+            raise ResourceError(
+                f"device snapshot version {version} != {SNAPSHOT_VERSION} "
+                "(parent and probe child must run the same code)"
+            )
+        return DeviceSnapshot(
+            driver_version=str(d["driver_version"]),
+            runtime_version=tuple(d["runtime_version"]),  # type: ignore[arg-type]
+            chips=[ChipSnapshot.from_dict(c) for c in d.get("chips") or []],
+        )
+
+    @staticmethod
+    def from_manager(manager: Manager) -> "DeviceSnapshot":
+        """Walk an INITIALIZED manager into a snapshot. Runs inside the
+        probe child, where every native call it makes is killable. The
+        zero-chip case snapshots to an empty inventory — the labelers'
+        Null-path semantics (no labels) carry through unchanged, and the
+        version probes are skipped because they may need live devices."""
+        chips = manager.get_chips()
+        if not chips:
+            return DeviceSnapshot(driver_version="", runtime_version=(0, 0))
+        return DeviceSnapshot(
+            driver_version=manager.get_driver_version(),
+            runtime_version=tuple(manager.get_runtime_version()),
+            chips=[_snapshot_chip(chip) for chip in chips],
+        )
+
+
+def _snapshot_chip(chip: Chip) -> ChipSnapshot:
+    slice_enabled = chip.is_slice_enabled()
+    slices: List[SliceSnapshot] = []
+    if slice_enabled:
+        for sl in chip.get_slices():
+            slices.append(
+                SliceSnapshot(
+                    name=sl.get_name(),
+                    memory_mb=sl.get_total_memory_mb(),
+                    generation=tuple(sl.get_generation()),
+                    attributes=dict(sl.get_attributes()),
+                )
+            )
+    return ChipSnapshot(
+        name=chip.get_name(),
+        memory_mb=chip.get_total_memory_mb(),
+        generation=tuple(chip.get_generation()),
+        slice_capable=chip.is_slice_capable(),
+        slice_enabled=slice_enabled,
+        slices=slices,
+    )
+
+
+class SnapshotSlice(Chip):
+    """Reconstructed slice partition: pure data, same contract surface as
+    a live SlicePartition (full-chip-only methods raise, mirroring the
+    MIG-device split in resource/types.py)."""
+
+    def __init__(self, snap: SliceSnapshot, parent: "SnapshotChip"):
+        self._snap = snap
+        self._parent = parent
+
+    def is_slice_enabled(self) -> bool:
+        raise ResourceError("is_slice_enabled not supported for slice partitions")
+
+    def is_slice_capable(self) -> bool:
+        raise ResourceError("is_slice_capable not supported for slice partitions")
+
+    def get_slices(self) -> List[Chip]:
+        raise ResourceError("get_slices not supported for slice partitions")
+
+    def get_attributes(self) -> Dict[str, object]:
+        return dict(self._snap.attributes)
+
+    def get_name(self) -> str:
+        return self._snap.name
+
+    def get_total_memory_mb(self) -> int:
+        return self._snap.memory_mb
+
+    def get_parent_chip(self) -> Chip:
+        return self._parent
+
+    def get_generation(self) -> Tuple[int, int]:
+        return tuple(self._snap.generation)
+
+
+class SnapshotChip(Chip):
+    """Reconstructed full chip."""
+
+    def __init__(self, snap: ChipSnapshot):
+        self._snap = snap
+        self._slices = [SnapshotSlice(s, self) for s in snap.slices]
+
+    def is_slice_enabled(self) -> bool:
+        return self._snap.slice_enabled
+
+    def is_slice_capable(self) -> bool:
+        return self._snap.slice_capable
+
+    def get_slices(self) -> List[Chip]:
+        return list(self._slices)
+
+    def get_attributes(self) -> Dict[str, object]:
+        raise ResourceError("get_attributes only supported for slice partitions")
+
+    def get_name(self) -> str:
+        return self._snap.name
+
+    def get_total_memory_mb(self) -> int:
+        return self._snap.memory_mb
+
+    def get_parent_chip(self) -> Chip:
+        raise ResourceError("get_parent_chip only supported for slice partitions")
+
+    def get_generation(self) -> Tuple[int, int]:
+        return tuple(self._snap.generation)
+
+
+class SnapshotManager(Manager):
+    """A Manager over a completed probe's snapshot. init()/shutdown() are
+    no-ops — the probing already happened, in the child — so the daemon
+    loop's per-cycle init/shutdown choreography costs nothing, exactly
+    like the held-client JaxManager it stands in for."""
+
+    def __init__(self, snapshot: DeviceSnapshot):
+        self._snapshot = snapshot
+        self._chips = [SnapshotChip(c) for c in snapshot.chips]
+
+    @property
+    def snapshot(self) -> DeviceSnapshot:
+        return self._snapshot
+
+    def init(self) -> None:
+        pass
+
+    def shutdown(self) -> None:
+        pass
+
+    def get_chips(self) -> List[Chip]:
+        return list(self._chips)
+
+    def get_driver_version(self) -> str:
+        if not self._snapshot.driver_version:
+            raise ResourceError("snapshot carries no driver version")
+        return self._snapshot.driver_version
+
+    def get_runtime_version(self) -> Tuple[int, int]:
+        major, minor = self._snapshot.runtime_version
+        return (int(major), int(minor))

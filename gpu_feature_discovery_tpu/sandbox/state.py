@@ -1,0 +1,119 @@
+"""Persisted last-good label state (``--state-dir``).
+
+Without it, a daemon restart during a backend outage strips the node:
+the exiting daemon removes its output file (reference parity), the new
+epoch has no last-good cache, and until the first successful init the
+node carries only degraded non-device labels — NFD drops the device
+labels and the scheduler thrashes, even though nothing about the
+hardware changed. With a state dir, every successful FULL cycle persists
+the cleaned label set atomically; the next epoch re-serves it on its
+very first write, marked ``google.com/tpu.tfd.restored=true`` until a
+live cycle replaces it. A crash-looping backend therefore degrades the
+node's freshness, never its inventory.
+
+The document is versioned JSON written through the same
+fsync-before-rename writer the label file uses (lm/labels.py), so a node
+crash cannot leave a truncated state file — and a truncated/corrupt file
+loads as "no state" with a warning, never as garbage labels.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Dict, Optional
+
+from gpu_feature_discovery_tpu.lm.labels import Labels, _write_file_atomically
+
+log = logging.getLogger("tfd.sandbox")
+
+STATE_VERSION = 1
+STATE_FILENAME = "last-good-labels.json"
+STATE_MODE = 0o644
+
+
+class LabelStateStore:
+    """Load/save the last-good label set under one directory. All
+    failures are contained: persistence must never be able to fail a
+    labeling cycle (same contract as the heartbeat touch)."""
+
+    def __init__(self, state_dir: str):
+        self._dir = state_dir
+        self._path = os.path.join(state_dir, STATE_FILENAME)
+        self._save_warned = False
+        self._last_saved: Optional[Dict[str, str]] = None
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def load(self) -> Optional[Labels]:
+        """The persisted label set, or None (absent, unreadable, corrupt,
+        wrong version, or not a flat str->str map)."""
+        try:
+            with open(self._path) as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError) as e:
+            log.warning("ignoring unreadable state file %s: %s", self._path, e)
+            return None
+        if not isinstance(doc, dict) or doc.get("version") != STATE_VERSION:
+            log.warning(
+                "ignoring state file %s: unsupported document version %r",
+                self._path,
+                doc.get("version") if isinstance(doc, dict) else None,
+            )
+            return None
+        labels = doc.get("labels")
+        if not isinstance(labels, dict) or not labels or not all(
+            isinstance(k, str) and isinstance(v, str) for k, v in labels.items()
+        ):
+            log.warning(
+                "ignoring state file %s: labels are not a non-empty "
+                "str->str map",
+                self._path,
+            )
+            return None
+        return Labels(labels)
+
+    def save(self, labels: Dict[str, str]) -> bool:
+        """Persist ``labels`` atomically; returns False (after a
+        once-per-epoch warning) on any failure. Callers pass the CLEANED
+        set — status markers describe a moment, not the inventory, and
+        must never be resurrected by a restore.
+
+        Churn-free within an epoch: a steady-state daemon produces the
+        identical set every cycle (the timestamp label is per-epoch
+        constant), and re-fsyncing an unchanged document to the node's
+        disk every sleep interval buys nothing — the skip means
+        ``saved_unix`` records when the CONTENT was last new, not the
+        last cycle."""
+        if self._last_saved is not None and dict(labels) == self._last_saved:
+            return True
+        doc = {
+            "version": STATE_VERSION,
+            "saved_unix": int(time.time()),
+            "labels": dict(labels),
+        }
+        try:
+            os.makedirs(self._dir, exist_ok=True)
+            _write_file_atomically(
+                self._path,
+                json.dumps(doc, sort_keys=True).encode(),
+                STATE_MODE,
+            )
+            self._last_saved = dict(labels)
+            return True
+        except OSError as e:
+            if not self._save_warned:
+                self._save_warned = True
+                log.warning(
+                    "cannot persist label state to %s: %s "
+                    "(restarts will start cold)",
+                    self._path,
+                    e,
+                )
+            return False

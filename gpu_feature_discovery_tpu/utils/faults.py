@@ -28,6 +28,18 @@ Instrumented sites:
     generate           lm.engine.LabelEngine.generate (cycle entry)
     labeler.<name>     lm.engine.LabelSource.run (one named labeler)
     write              lm.labels.Labels.write_to_file
+    probe.timeout      sandbox.probe.probe_device_snapshot — the probe
+                       reports an immediate timeout, no child spawned
+    probe.hang         sandbox probe child hangs until the parent's
+                       SIGKILL at --probe-timeout (the full kill path)
+    probe.segv         sandbox probe child dies to a real SIGSEGV (the
+                       native-crash containment path)
+
+The ``probe.*`` sites are BEHAVIORAL: the sandbox driver consumes them
+with ``consume()`` (countdown without raising) in the PARENT process and
+enacts the behavior in/around the forked child — a child-side countdown
+would decrement only the child's fork-copied registry and re-fire
+forever, so no chaos scenario could converge.
 
 The registry is process-global and loaded lazily from the environment on
 first use; tests install specs directly with ``load_fault_spec`` and MUST
@@ -105,6 +117,26 @@ class FaultRegistry:
             remaining,
         )
         raise fault.exc_type(f"injected fault at {site!r} ({FAULT_SPEC_ENV})")
+
+    def take(self, site: str) -> bool:
+        """Countdown WITHOUT raising: True when ``site`` was armed with
+        shots remaining (one is consumed). The behavioral sites — the
+        sandbox ``probe.*`` family — translate the armed state into an
+        action (hang the child, SIGSEGV it) rather than an exception."""
+        fault = self._faults.get(site)
+        if fault is None:
+            return False
+        with self._lock:
+            if fault.remaining <= 0:
+                return False
+            fault.remaining -= 1
+            remaining = fault.remaining
+        log.warning(
+            "fault injection: arming behavior at site %r (%d left)",
+            site,
+            remaining,
+        )
+        return True
 
 
 def parse_fault_spec(spec: str) -> FaultRegistry:
@@ -186,12 +218,26 @@ def reset() -> None:
 
 def maybe_inject(site: str) -> None:
     """The instrumented-site hook: no-op unless a spec armed ``site``."""
+    reg = _ensure_loaded()
+    if reg is not None:
+        reg.fire(site)
+
+
+def consume(site: str) -> bool:
+    """Behavioral-site hook: True when ``site`` is armed (one shot is
+    consumed), without raising. Must be called from the process that owns
+    the registry state — for the sandbox, the PARENT."""
+    reg = _ensure_loaded()
+    if reg is None:
+        return False
+    return reg.take(site)
+
+
+def _ensure_loaded() -> Optional[FaultRegistry]:
     global _loaded
     if not _loaded:
         _loaded = True
         spec = os.environ.get(FAULT_SPEC_ENV, "")
         if spec:
             load_fault_spec(spec)
-    reg = _registry
-    if reg is not None:
-        reg.fire(site)
+    return _registry

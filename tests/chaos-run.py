@@ -46,9 +46,28 @@ def read_labels(path):
         return {}
 
 
-def run_chaos(spec, workdir, backend="mock:v4-8"):
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
+              assert_probe_kills=None):
     """Execute one chaos scenario; returns a result dict (raises
-    AssertionError on contract violations)."""
+    AssertionError on contract violations).
+
+    ``probe_timeout`` bounds the sandboxed device probe (the default
+    0.5s keeps the probe.hang row convergent well inside the 8s budget;
+    the CI workflow's hang-injection row overrides to 2s).
+    ``assert_probe_kills``, when set, binds the introspection server on
+    an ephemeral port and asserts via a live /metrics scrape that (a)
+    exactly that many probe children were SIGKILLed and (b) recovery
+    landed within one probe-timeout + backoff window."""
     import gpu_feature_discovery_tpu.cmd.main as cmd_main
     from gpu_feature_discovery_tpu.cmd.main import run
     from gpu_feature_discovery_tpu.cmd.supervisor import (
@@ -58,26 +77,34 @@ def run_chaos(spec, workdir, backend="mock:v4-8"):
     )
     from gpu_feature_discovery_tpu.config import new_config
     from gpu_feature_discovery_tpu.lm.labeler import Empty
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
     from gpu_feature_discovery_tpu.utils import faults
 
     machine = os.path.join(workdir, "machine-type")
     with open(machine, "w") as f:
         f.write("Google Compute Engine\n")
     out = os.path.join(workdir, "tfd")
-    config = new_config(
-        cli_values={
-            "oneshot": False,
-            "output-file": out,
-            "machine-type-file": machine,
-            "sleep-interval": "0.01s",
-            "init-backoff-max": "0.02s",
-            # Generous bounds: chaos proves containment/recovery, the
-            # escalation bounds get their own tests (test_supervisor.py).
-            "init-retries": "50",
-            "max-consecutive-failures": "50",
-        },
-        environ={},
-    )
+    cli_values = {
+        "oneshot": False,
+        "output-file": out,
+        "machine-type-file": machine,
+        "sleep-interval": "0.01s",
+        "init-backoff-max": "0.02s",
+        # Generous bounds: chaos proves containment/recovery, the
+        # escalation bounds get their own tests (test_supervisor.py).
+        "init-retries": "50",
+        "max-consecutive-failures": "50",
+        # Sandboxed probing runs at the daemon default (subprocess) so
+        # every chaos row exercises the fork/kill/reap machinery too.
+        "probe-timeout": probe_timeout,
+    }
+    metrics_port = None
+    if assert_probe_kills is not None:
+        obs_metrics.reset_for_tests()
+        metrics_port = _free_port()
+        cli_values["metrics-addr"] = "127.0.0.1"
+        cli_values["metrics-port"] = str(metrics_port)
+    config = new_config(cli_values=cli_values, environ={})
     saved_backend = os.environ.get("TFD_BACKEND")
     os.environ["TFD_BACKEND"] = backend
     faults.load_fault_spec(spec)
@@ -128,6 +155,46 @@ def run_chaos(spec, workdir, backend="mock:v4-8"):
         assert converged is not None, (
             f"did not converge to full clean labels; last: {read_labels(out)}"
         )
+        if assert_probe_kills is not None:
+            # Recovery within one backoff window of the kill: the hung
+            # probe costs its full timeout, then one capped backoff
+            # (0.02s) + one healthy probe must converge it.
+            from gpu_feature_discovery_tpu.config.flags import parse_duration
+
+            budget = parse_duration(probe_timeout) + 2.0
+            assert elapsed < budget, (
+                f"converged in {elapsed:.2f}s, outside the probe-timeout "
+                f"+ backoff window ({budget:.2f}s)"
+            )
+            import urllib.request
+
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{metrics_port}/metrics", timeout=5
+            ) as resp:
+                exposition = resp.read().decode()
+            kills = next(
+                (
+                    float(line.split(" ")[1])
+                    for line in exposition.splitlines()
+                    if line.startswith("tfd_probe_kills_total ")
+                ),
+                None,
+            )
+            assert kills == float(assert_probe_kills), (
+                f"expected tfd_probe_kills_total=={assert_probe_kills}, "
+                f"scraped {kills}"
+            )
+            degraded_now = next(
+                (
+                    float(line.split(" ")[1])
+                    for line in exposition.splitlines()
+                    if line.startswith("tfd_degraded ")
+                ),
+                None,
+            )
+            assert degraded_now == 0.0, (
+                f"tfd_degraded still {degraded_now} after convergence"
+            )
     finally:
         sigs.put(signal.SIGTERM)
         t.join(timeout=5)
@@ -153,6 +220,20 @@ def main(argv=None):
         default=os.environ.get("TFD_FAULT_SPEC", ""),
         help="fault spec (defaults to $TFD_FAULT_SPEC)",
     )
+    parser.add_argument(
+        "--probe-timeout",
+        default="0.5s",
+        help="--probe-timeout handed to the daemon under test (the CI "
+        "hang-injection row uses 2s; Go duration or bare seconds)",
+    )
+    parser.add_argument(
+        "--assert-probe-kills",
+        type=int,
+        default=None,
+        help="scrape /metrics after convergence and assert exactly this "
+        "many probe children were SIGKILLed, with recovery inside one "
+        "probe-timeout + backoff window",
+    )
     args = parser.parse_args(argv)
     if not args.spec:
         parser.error("no fault spec: pass --spec or set TFD_FAULT_SPEC")
@@ -161,7 +242,12 @@ def main(argv=None):
     # explicit load in run_chaos is the only source.
     os.environ.pop("TFD_FAULT_SPEC", None)
     with tempfile.TemporaryDirectory(prefix="tfd-chaos-") as workdir:
-        result = run_chaos(args.spec, workdir)
+        result = run_chaos(
+            args.spec,
+            workdir,
+            probe_timeout=args.probe_timeout,
+            assert_probe_kills=args.assert_probe_kills,
+        )
     print(
         f"chaos: spec={result['spec']!r} converged in {result['converged_s']}s "
         f"with {result['labels']} labels"

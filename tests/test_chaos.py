@@ -19,6 +19,13 @@ CHAOS_SPECS = [
     "write:raise:OSError:2",
     "labeler.interconnect:raise:RuntimeError:2",
     "pjrt_init:fail:1,write:raise:OSError,generate:raise:RuntimeError",
+    # Probe-sandbox sites (sandbox/probe.py): a hung probe child that the
+    # parent must SIGKILL at --probe-timeout, a child dying to a real
+    # SIGSEGV (native-crash containment), and parent-synthesized probe
+    # timeouts — each must converge like any other init fault.
+    "probe.hang:fail:1",
+    "probe.segv:fail:1",
+    "probe.timeout:fail:2",
 ]
 
 

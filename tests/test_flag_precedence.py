@@ -38,6 +38,10 @@ FILE_KEYS = {
     "metrics-addr": ("tfd", "metricsAddr"),
     "metrics-port": ("tfd", "metricsPort"),
     "debug-endpoints": ("tfd", "debugEndpoints"),
+    "probe-timeout": ("tfd", "probeTimeout"),
+    "probe-isolation": ("tfd", "probeIsolation"),
+    "state-dir": ("tfd", "stateDir"),
+    "flap-window": ("tfd", "flapWindow"),
 }
 
 # Two distinct valid raw values per flag (a wins the dominance checks).
@@ -50,6 +54,9 @@ VALUE_PAIRS = {
     "init-backoff-max": ("2s", "5s"),
     "max-consecutive-failures": ("2", "4"),
     "metrics-port": ("9200", "9300"),
+    "probe-timeout": ("5s", "8s"),
+    "probe-isolation": ("none", "subprocess"),
+    "flap-window": ("2", "4"),
 }
 
 

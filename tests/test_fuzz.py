@@ -71,6 +71,89 @@ def test_parse_tpu_env_round_trips_wellformed_docs(kv):
 
 
 # ---------------------------------------------------------------------------
+# TFD_FAULT_SPEC grammar (utils/faults.py)
+# ---------------------------------------------------------------------------
+#
+# The spec is an operator/CI surface: anything typed into it must either
+# parse into a registry or raise ConfigError — never crash, never hang,
+# never half-arm. Fuzz both arbitrary spec-shaped text and well-formed
+# entries (round-trip property).
+
+_SPEC_ALPHABET = string.ascii_lowercase + string.digits + ":,._- "
+_KNOWN_EXCS = ["OSError", "RuntimeError", "ValueError", "TimeoutError",
+               "ResourceError"]
+
+
+@given(st.text(alphabet=_SPEC_ALPHABET, max_size=80))
+@settings(max_examples=300)
+def test_fault_spec_arbitrary_text_arms_cleanly_or_raises_config_error(text):
+    from gpu_feature_discovery_tpu.utils.faults import (
+        FaultRegistry,
+        parse_fault_spec,
+    )
+
+    try:
+        reg = parse_fault_spec(text)
+    except ConfigError:
+        return  # the contract: malformed specs fail loudly and typed
+    assert isinstance(reg, FaultRegistry)
+    # Whatever armed must also COUNT DOWN cleanly through both hooks.
+    for site in reg.sites:
+        assert reg.take(site) in (True, False)
+
+
+@given(
+    st.lists(
+        st.tuples(
+            st.text(
+                alphabet=string.ascii_lowercase + "._-", min_size=1, max_size=12
+            ),
+            st.one_of(
+                st.integers(min_value=1, max_value=99).map(
+                    lambda n: ("fail", str(n))
+                ),
+                st.tuples(
+                    st.sampled_from(_KNOWN_EXCS),
+                    st.integers(min_value=1, max_value=9),
+                ).map(lambda t: ("raise", f"{t[0]}:{t[1]}")),
+            ),
+        ),
+        min_size=1,
+        max_size=5,
+        unique_by=lambda e: e[0],
+    )
+)
+@settings(max_examples=200)
+def test_fault_spec_wellformed_entries_round_trip(entries):
+    from gpu_feature_discovery_tpu.utils.faults import parse_fault_spec
+
+    spec = ",".join(f"{site}:{mode}:{rest}" for site, (mode, rest) in entries)
+    reg = parse_fault_spec(spec)
+    assert set(reg.sites) == {site for site, _ in entries}
+
+
+@given(st.text(alphabet=_SPEC_ALPHABET, max_size=60))
+@settings(max_examples=200)
+def test_fault_spec_maybe_inject_never_crashes_unarmed_sites(text):
+    """maybe_inject on a NEVER-armed site must be a no-op whatever spec
+    is loaded — the instrumented production call sites depend on it."""
+    from gpu_feature_discovery_tpu.utils import faults as faults_mod
+
+    try:
+        faults_mod.load_fault_spec(text)
+    except ConfigError:
+        faults_mod.reset()
+        return
+    try:
+        faults_mod.maybe_inject("site-that-is-never-armed-by-the-alphabet!")
+        assert faults_mod.consume(
+            "site-that-is-never-armed-by-the-alphabet!"
+        ) is False
+    finally:
+        faults_mod.reset()
+
+
+# ---------------------------------------------------------------------------
 # duration parser
 # ---------------------------------------------------------------------------
 

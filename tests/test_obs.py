@@ -539,8 +539,20 @@ def test_live_scrape_during_chaos_cycle(tmp_path):
         assert _sample_value(
             final, "tfd_cycles_total", '{outcome="degraded"}'
         ) >= 1
-        # Probes + debug agree with the converged state.
-        assert _get(base + "/healthz")[0] == 200
+        # Probes + debug agree with the converged state. /healthz keys
+        # on a 3x-sleep-interval (30 ms here) staleness window, so a
+        # single scheduler hiccup between cycles can 503 one read —
+        # poll briefly: the contract is "healthy once converged", not
+        # "every 30 ms window on a loaded CI box is hiccup-free".
+        health_deadline = time.monotonic() + 5
+        while True:
+            try:
+                assert _get(base + "/healthz")[0] == 200
+                break
+            except urllib.error.HTTPError:
+                if time.monotonic() >= health_deadline:
+                    raise
+                time.sleep(0.01)
         assert _get(base + "/readyz")[0] == 200
         doc = json.loads(_get(base + "/debug/labels")[1])
         assert doc["mode"] == "full" and doc["degraded"] is False
@@ -634,6 +646,36 @@ def test_every_metric_family_is_documented():
         )
     for endpoint in ("/metrics", "/healthz", "/readyz", "/debug/labels"):
         assert f"`{endpoint}`" in doc, f"endpoint {endpoint} undocumented"
+
+
+def test_probe_sandbox_metric_families_registered_and_documented():
+    """The ISSUE 4 families must exist (removing one silently would pass
+    the generic sweep by vacuity) and each must carry a typed table row
+    in docs/observability.md."""
+    expected = {
+        "tfd_probe_duration_seconds": "histogram",
+        "tfd_probe_kills_total": "counter",
+        "tfd_probe_crashes_total": "counter",
+        "tfd_state_restores_total": "counter",
+        "tfd_restored": "gauge",
+        "tfd_flap_suppressed_total": "counter",
+        "tfd_flapping": "gauge",
+    }
+    families = obs_metrics.REGISTRY.families()
+    with open(os.path.join(DOCS, "observability.md")) as f:
+        doc = f.read()
+    for name, kind in expected.items():
+        assert name in families, f"probe-sandbox metric {name} missing"
+        assert families[name].kind == kind, name
+        row = next(
+            (
+                line
+                for line in doc.splitlines()
+                if line.startswith(f"| `{name}`")
+            ),
+            "",
+        )
+        assert kind in row, f"{name}: no doc table row stating {kind!r}"
 
 
 def test_observability_doc_names_no_phantom_metrics():

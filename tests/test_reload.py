@@ -4,10 +4,19 @@ per-epoch reset — metadata-provider cache, burn-in schedule, warn-once
 keys — exactly once per epoch. These wrap the REAL functions with
 counters, drive start() through two epochs (SIGHUP then SIGTERM), and
 assert the choreography; a regression that drops one reset from start()
-fails here instead of resurfacing as a stale-cache field bug."""
+fails here instead of resurfacing as a stale-cache field bug.
 
+Also pinned here: the epoch-close half of the straggler-leak fix —
+``engine.close()`` SIGKILLs any in-flight sandbox probe child, so a
+SIGHUP reload can never orphan a forked child probing on behalf of an
+epoch that no longer exists."""
+
+import os
 import queue
 import signal
+import time
+
+import pytest
 
 import gpu_feature_discovery_tpu.cmd.main as cmd_main
 from gpu_feature_discovery_tpu.hostinfo import provider as hostinfo_provider
@@ -86,3 +95,66 @@ def test_sighup_rebuilds_engine_and_reruns_epoch_resets(tmp_path, monkeypatch):
     assert calls["burnin"] == 2, "burn-in schedule reset skipped on reload"
     assert calls["warn"] == 2, "warn-once reset skipped on reload"
     assert calls["metadata"] == 2, "metadata cache reset skipped on reload"
+
+
+# ---------------------------------------------------------------------------
+# epoch close vs in-flight sandbox probes (the straggler-leak fix)
+# ---------------------------------------------------------------------------
+
+def _wait_until(pred, timeout=5.0, interval=0.002):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+def test_engine_close_kills_inflight_probe_child():
+    """A cycle aborted by one source's error leaves another source's
+    sandbox probe child in flight (submitted, never collected). Epoch
+    close — what a SIGHUP reload runs before rebuilding the engine —
+    must SIGKILL that child: an orphaned probe would otherwise keep a
+    PJRT client (and the chip) seized on behalf of a dead epoch."""
+    from gpu_feature_discovery_tpu.lm.engine import LabelEngine, LabelSource
+    from gpu_feature_discovery_tpu.lm.labels import Labels
+    from gpu_feature_discovery_tpu.sandbox import SandboxedCall
+
+    call = SandboxedCall(lambda: time.sleep(3600) or {}, timeout_s=3600.0)
+
+    class SandboxBacked:
+        def labels(self):
+            call()
+            return Labels()
+
+    def broken_produce():
+        raise RuntimeError("sibling source failed; cycle aborts")
+
+    engine = LabelEngine(parallel=True, timeout_s=30.0)
+    sources = [
+        LabelSource("broken", broken_produce),
+        LabelSource("sandboxed", lambda: SandboxBacked(), cancel=call.cancel),
+    ]
+    try:
+        with pytest.raises(RuntimeError):
+            engine.generate(sources)
+        assert _wait_until(lambda: call._pids), "probe child never spawned"
+        (pid,) = call._pids
+        assert _pid_alive(pid), "child should still be probing mid-abort"
+    finally:
+        engine.close()
+    assert _wait_until(lambda: not _pid_alive(pid)), (
+        "engine.close() left the in-flight probe child alive"
+    )
+    state = engine._state["sandboxed"]
+    assert _wait_until(lambda: state.inflight.done()), (
+        "worker thread still blocked after the child was killed"
+    )

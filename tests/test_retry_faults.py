@@ -4,6 +4,8 @@ The daemon-level recovery behaviors they enable are covered by
 test_supervisor.py and test_chaos.py; these pin the primitives' own
 contracts — deterministic delays, strict spec parsing, finite countdowns."""
 
+import random
+
 import pytest
 
 from gpu_feature_discovery_tpu.config.spec import ConfigError
@@ -39,6 +41,46 @@ def test_backoff_jitter_stays_within_fraction():
 def test_backoff_rejects_negative_attempt():
     with pytest.raises(ValueError):
         BackoffPolicy().delay(-1)
+
+
+def test_backoff_rng_is_injectable_and_deterministic():
+    """The jitter source is a per-policy injectable random.Random, not
+    the module-global `random`: a seeded generator pins the EXACT delay
+    sequence (Mersenne Twister is stable across CPython versions), so
+    supervisor backoff-timing tests carry zero residual flake risk."""
+    pinned = [
+        1.027885359692,
+        1.810004302089,
+        3.820023454695,
+        7.557137181038,
+        16.756707885325,
+    ]
+    p = BackoffPolicy(
+        base=1.0, factor=2.0, cap=30.0, jitter=0.1, rng=random.Random(42)
+    )
+    assert [round(p.delay(a), 12) for a in range(5)] == pinned
+    # Same seed, fresh policy: the whole sequence reproduces.
+    p2 = BackoffPolicy(
+        base=1.0, factor=2.0, cap=30.0, jitter=0.1, rng=random.Random(42)
+    )
+    assert [round(p2.delay(a), 12) for a in range(5)] == pinned
+
+
+def test_backoff_policies_do_not_share_rng_state():
+    """The default factory gives each policy its OWN generator: drawing
+    from one policy must not perturb another's sequence (the module-
+    global-random failure mode this field exists to rule out)."""
+    a = BackoffPolicy(rng=random.Random(7))
+    b = BackoffPolicy(rng=random.Random(7))
+    seq_b = [b.delay(i) for i in range(3)]
+    for _ in range(10):
+        a.delay(3)  # drain a's generator
+    c = BackoffPolicy(rng=random.Random(7))
+    assert [c.delay(i) for i in range(3)] == seq_b, (
+        "draining one policy's generator perturbed another's sequence"
+    )
+    d1, d2 = BackoffPolicy(), BackoffPolicy()
+    assert d1.rng is not d2.rng
 
 
 # ---------------------------------------------------------------------------
@@ -87,6 +129,34 @@ def test_raise_mode_uses_named_exception_type():
         faults.maybe_inject("r")
     faults.maybe_inject("w")  # default count is 1
     faults.maybe_inject("r")
+
+
+def test_consume_counts_down_without_raising():
+    """Behavioral sites (the sandbox probe.* family) drain through
+    consume(): armed -> True with one shot spent, drained/unarmed ->
+    False, and consume never raises whatever mode armed the site."""
+    faults.load_fault_spec("probe.hang:fail:2,probe.segv:raise:OSError")
+    assert faults.consume("probe.hang") is True
+    assert faults.consume("probe.hang") is True
+    assert faults.consume("probe.hang") is False  # drained
+    assert faults.consume("probe.segv") is True  # mode irrelevant
+    assert faults.consume("probe.segv") is False
+    assert faults.consume("never-armed") is False
+
+
+def test_consume_and_fire_share_the_countdown():
+    faults.load_fault_spec("site:fail:2")
+    assert faults.consume("site") is True
+    with pytest.raises(faults.FaultInjected):
+        faults.maybe_inject("site")
+    assert faults.consume("site") is False  # both shots spent
+
+
+def test_consume_loads_lazily_from_environment(monkeypatch):
+    monkeypatch.setenv(faults.FAULT_SPEC_ENV, "probe.hang:fail:1")
+    faults.reset()
+    assert faults.consume("probe.hang") is True
+    assert faults.consume("probe.hang") is False
 
 
 def test_registry_loads_lazily_from_environment(monkeypatch):

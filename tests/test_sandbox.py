@@ -1,0 +1,816 @@
+"""Probe-sandbox acceptance + unit tests (ISSUE 4).
+
+Four layers of evidence, all hermetic on CPU:
+
+1. The fork/kill/reap machinery (sandbox/probe.py): every outcome —
+   ok, timeout (SIGKILL at the budget), crash (signal death with stderr
+   tail), child error — plus the no-zombie and stray-child contracts.
+2. Snapshot fidelity: labeling from a sandbox-acquired SnapshotManager
+   is label-for-label identical to probing the live manager in-process,
+   across every mock inventory shape and topology strategy.
+3. The chaos acceptance scenario: with probe.hang + probe.segv armed,
+   the daemon SIGKILLs the hung child within --probe-timeout + 1s,
+   survives the native crash publishing degraded labels in the same
+   cycle, and converges to full labels — never exiting.
+4. Restart resilience (--state-dir) and anti-flap hysteresis
+   (--flap-window): restored labels on the epoch's very first write
+   before any backend init succeeds; label transitions held for the
+   window with the tfd.flapping marker while suppressed.
+"""
+
+import json
+import os
+import queue
+import signal
+import threading
+import time
+
+import pytest
+
+import gpu_feature_discovery_tpu.cmd.main as cmd_main
+from gpu_feature_discovery_tpu import sandbox
+from gpu_feature_discovery_tpu.cmd.main import run
+from gpu_feature_discovery_tpu.cmd.supervisor import (
+    DEGRADED_LABEL,
+    RESTORED_LABEL,
+    Supervisor,
+)
+from gpu_feature_discovery_tpu.config import new_config
+from gpu_feature_discovery_tpu.config.spec import ConfigError
+from gpu_feature_discovery_tpu.lm.labeler import Empty
+from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.lm.tpu import new_tpu_labeler
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+from gpu_feature_discovery_tpu.resource.testing import (
+    new_mixed_slice_manager,
+    new_multihost_worker_manager,
+    new_single_host_manager,
+    new_uniform_slice_manager,
+)
+from gpu_feature_discovery_tpu.resource.types import ResourceError
+from gpu_feature_discovery_tpu.sandbox import (
+    FLAPPING_LABEL,
+    DeviceSnapshot,
+    FlapDamper,
+    LabelStateStore,
+    ProbeCrash,
+    ProbeTimeout,
+    SandboxedCall,
+    SnapshotManager,
+)
+from gpu_feature_discovery_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def cfg(tmp_path, **cli):
+    machine = tmp_path / "machine-type"
+    machine.write_text("Google Compute Engine\n")
+    values = {
+        "oneshot": False,
+        "machine-type-file": str(machine),
+        "output-file": str(tmp_path / "tfd"),
+        "sleep-interval": "0.01s",
+        "init-backoff-max": "0.02s",
+        "init-retries": "50",
+        "max-consecutive-failures": "50",
+    }
+    values.update(cli)
+    return new_config(cli_values=values, environ={})
+
+
+def labels_at(path):
+    try:
+        with open(path) as f:
+            return dict(line.strip().split("=", 1) for line in f if "=" in line)
+    except OSError:
+        return {}
+
+
+def wait_until(pred, timeout=10.0, interval=0.002):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def start_daemon(config, interconnect=None):
+    sigs = queue.Queue()
+    result = {}
+
+    def target():
+        try:
+            result["restart"] = run(
+                lambda: cmd_main._build_manager(config),
+                interconnect if interconnect is not None else Empty(),
+                config,
+                sigs,
+                supervisor=Supervisor(config),
+            )
+        except BaseException as e:  # noqa: BLE001 - surfaced by the test
+            result["error"] = e
+
+    t = threading.Thread(target=target)
+    t.start()
+    return t, sigs, result
+
+
+def stop_daemon(t, sigs, result):
+    sigs.put(signal.SIGTERM)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert "error" not in result, result.get("error")
+    return result
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# layer 1: fork/kill/reap machinery
+# ---------------------------------------------------------------------------
+
+def test_run_probe_ok_round_trips_payload():
+    r = sandbox.run_probe(lambda: {"a": 1, "b": ["x"]}, 5.0)
+    assert r.status == "ok"
+    assert r.payload == {"a": 1, "b": ["x"]}
+
+
+def test_run_probe_timeout_kills_within_budget_plus_one_second():
+    t0 = time.monotonic()
+    r = sandbox.run_probe(lambda: time.sleep(60) or {}, 0.3)
+    elapsed = time.monotonic() - t0
+    assert r.status == "timeout"
+    assert elapsed < 0.3 + 1.0, f"kill took {elapsed:.2f}s"
+
+
+def test_run_probe_crash_reports_signal_and_stderr_tail():
+    def boom():
+        import sys
+
+        print("native stack about to go", file=sys.stderr, flush=True)
+        os.kill(os.getpid(), signal.SIGSEGV)
+
+    r = sandbox.run_probe(boom, 5.0)
+    assert r.status == "crash"
+    assert r.term_signal == signal.SIGSEGV
+    assert "native stack about to go" in r.stderr_tail
+
+
+def test_run_probe_child_error_ships_type_and_message():
+    def err():
+        raise ValueError("enumeration exploded")
+
+    r = sandbox.run_probe(err, 5.0)
+    assert r.status == "error"
+    assert r.error_type == "ValueError"
+    assert "enumeration exploded" in r.error
+
+
+def test_run_probe_leaves_no_zombies():
+    import subprocess
+
+    for _ in range(3):
+        sandbox.run_probe(lambda: {}, 5.0)
+        sandbox.run_probe(lambda: time.sleep(60) or {}, 0.05)
+    out = subprocess.run(
+        ["ps", "--ppid", str(os.getpid()), "-o", "stat="],
+        capture_output=True,
+        text=True,
+    ).stdout
+    zombies = [s for s in out.split() if s.startswith("Z")]
+    assert not zombies, f"probe children left zombies: {zombies}"
+
+
+def test_kill_stray_children_sweeps_registered_pids():
+    # Simulate an orphan: a child registered but whose owner never reaps
+    # (fork directly through the registry's own bookkeeping).
+    pid = os.fork()
+    if pid == 0:
+        time.sleep(3600)
+        os._exit(0)
+    sandbox.probe._register(pid)
+    try:
+        assert _pid_alive(pid)
+        killed = sandbox.kill_stray_children()
+        assert killed >= 1
+        assert wait_until(lambda: not _pid_alive(pid), timeout=5)
+        # A reaped pid is no longer killable through the registry.
+        assert sandbox.probe.kill_if_live(pid) is False
+    finally:
+        sandbox.probe._discard(pid)
+        try:
+            os.kill(pid, signal.SIGKILL)
+            os.waitpid(pid, 0)
+        except OSError:
+            pass
+
+
+def test_sandboxed_call_cancel_kills_inflight_child():
+    call = SandboxedCall(lambda: time.sleep(60) or {}, timeout_s=30.0)
+    result = {}
+
+    def target():
+        try:
+            call()
+        except BaseException as e:  # noqa: BLE001 - inspected below
+            result["error"] = e
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    assert wait_until(lambda: call._pids, timeout=5), "child never spawned"
+    (pid,) = call._pids
+    assert _pid_alive(pid)
+    call.cancel()
+    t.join(timeout=5)
+    assert not t.is_alive(), "worker thread stayed blocked after cancel"
+    assert not _pid_alive(pid)
+    assert isinstance(result.get("error"), ResourceError)
+
+
+def test_engine_deadline_miss_escalates_to_child_sigkill():
+    """The straggler-leak fix (lm/engine.py): a sandbox-backed source
+    that misses its deadline gets its probe child SIGKILLed — the worker
+    thread frees within milliseconds instead of leaking, the self-
+    inflicted death is swallowed at harvest, and the source resubmits
+    fresh on the next cycle."""
+    from gpu_feature_discovery_tpu.lm.engine import LabelEngine, LabelSource
+
+    obs_metrics.reset_for_tests()
+    calls = {"n": 0}
+    call = SandboxedCall(lambda: time.sleep(3600) or {}, timeout_s=3600.0)
+
+    class SandboxBacked:
+        def labels(self):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                call()  # wedged "native" probe, first cycle only
+            return Labels({"probed": "fresh"})
+
+    engine = LabelEngine(parallel=True, timeout_s=0.1)
+    sources = [
+        LabelSource("sandboxed", lambda: SandboxBacked(), cancel=call.cancel)
+    ]
+    try:
+        first = engine.generate(sources)
+        assert "probed" not in first  # no last-good yet: served empty
+        assert obs_metrics.PROBE_KILLS.value() == 1, (
+            "deadline miss did not SIGKILL the probe child"
+        )
+        state = engine._state["sandboxed"]
+        assert wait_until(lambda: state.inflight.done()), (
+            "worker thread still wedged after the kill"
+        )
+        # Next cycle: the engine-inflicted death is consumed silently
+        # and the source runs fresh.
+        second = engine.generate(sources)
+        assert second.get("probed") == "fresh"
+    finally:
+        engine.close()
+
+
+# ---------------------------------------------------------------------------
+# layer 2: snapshot fidelity — sandboxed labels == in-process labels
+# ---------------------------------------------------------------------------
+
+BUILDERS = [
+    ("single-host", lambda: new_single_host_manager("v4-8")),
+    ("uniform-slice", lambda: new_uniform_slice_manager("v4-8")),
+    ("multihost-worker", lambda: new_multihost_worker_manager("v5p-64")),
+    ("mixed", lambda: new_mixed_slice_manager("v5e")),
+]
+
+
+@pytest.mark.parametrize("strategy", ["none", "single", "mixed"])
+@pytest.mark.parametrize("name,builder", BUILDERS, ids=[b[0] for b in BUILDERS])
+def test_snapshot_labels_identical_to_live_manager(tmp_path, name, builder,
+                                                   strategy):
+    config = cfg(tmp_path, **{"tpu-topology-strategy": strategy})
+    live = dict(new_tpu_labeler(builder(), config).labels())
+    snap_mgr = SnapshotManager(sandbox.probe_device_snapshot(builder(), 10.0))
+    sandboxed = dict(new_tpu_labeler(snap_mgr, config).labels())
+    assert sandboxed == live
+
+
+def test_snapshot_json_round_trip():
+    snap = DeviceSnapshot.from_manager(
+        _inited(new_uniform_slice_manager("v5p-64"))
+    )
+    doc = json.loads(json.dumps(snap.to_dict()))
+    again = DeviceSnapshot.from_dict(doc)
+    assert again.to_dict() == snap.to_dict()
+
+
+def _inited(m):
+    m.init()
+    return m
+
+
+def test_snapshot_rejects_version_mismatch():
+    snap = DeviceSnapshot.from_manager(_inited(new_single_host_manager()))
+    doc = snap.to_dict()
+    doc["version"] = 999
+    with pytest.raises(ResourceError):
+        DeviceSnapshot.from_dict(doc)
+
+
+def test_probe_device_snapshot_chaos_sites(tmp_path):
+    obs_metrics.reset_for_tests()
+    faults.load_fault_spec("probe.timeout:fail:1,probe.hang:fail:1,probe.segv:fail:1")
+    m = new_single_host_manager()
+    with pytest.raises(ProbeTimeout):
+        sandbox.probe_device_snapshot(m, 5.0)  # synthesized, no child
+    # Synthesized timeout spawns and kills nothing: the metrics state
+    # facts about real children only.
+    assert obs_metrics.PROBE_KILLS.value() == 0
+    with pytest.raises(ProbeTimeout):
+        sandbox.probe_device_snapshot(m, 0.2)  # real hang, real SIGKILL
+    with pytest.raises(ProbeCrash) as e:
+        sandbox.probe_device_snapshot(m, 5.0)  # real SIGSEGV
+    assert "SIGSEGV" in str(e.value)
+    assert obs_metrics.PROBE_KILLS.value() == 1
+    assert obs_metrics.PROBE_CRASHES.value() == 1
+    # Faults drained: the next probe is healthy.
+    snap = sandbox.probe_device_snapshot(m, 5.0)
+    assert len(snap.chips) == 4
+
+
+def test_isolation_mode_resolution(tmp_path):
+    assert sandbox.isolation_mode(cfg(tmp_path)) == "subprocess"
+    assert sandbox.isolation_mode(cfg(tmp_path, oneshot=True)) == "none"
+    # Burn-in needs a process-resident PJRT client, which a sandboxed
+    # parent must not hold — auto resolves to in-process probing.
+    assert sandbox.isolation_mode(
+        cfg(tmp_path, **{"with-burnin": True})
+    ) == "none"
+    assert sandbox.isolation_mode(
+        cfg(tmp_path, **{"probe-isolation": "none"})
+    ) == "none"
+    assert sandbox.isolation_mode(
+        cfg(tmp_path, oneshot=True, **{"probe-isolation": "subprocess"})
+    ) == "subprocess"
+    assert sandbox.isolation_mode(
+        cfg(tmp_path, **{"with-burnin": True, "probe-isolation": "subprocess"})
+    ) == "subprocess"  # explicit wins; interaction documented
+    with pytest.raises(ConfigError):
+        cfg(tmp_path, **{"probe-isolation": "container"})
+
+
+# ---------------------------------------------------------------------------
+# layer 3: the chaos acceptance scenario
+# ---------------------------------------------------------------------------
+
+def test_acceptance_hang_then_segv_then_converge(tmp_path, monkeypatch):
+    """ISSUE 4 acceptance (1)-(3): probe.hang:fail:1,probe.segv:fail:1 —
+    the daemon (1) SIGKILLs the hung child within --probe-timeout + 1s,
+    (2) survives the simulated native crash without exiting, publishing
+    degraded labels within the same cycle, and (3) converges to full
+    labels after recovery."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    obs_metrics.reset_for_tests()
+    probe_timeout = 0.4
+    config = cfg(tmp_path, **{"probe-timeout": str(probe_timeout)})
+    out = config.flags.tfd.output_file
+    faults.load_fault_spec("probe.hang:fail:1,probe.segv:fail:1")
+
+    t, sigs, result = start_daemon(config)
+    try:
+        # (1)+(2): the hung child is killed at the budget and the SAME
+        # cycle publishes degraded labels. The kill-latency criterion
+        # (--probe-timeout + 1s) is measured where it is defined — the
+        # probe's own wall time, straight from the duration histogram —
+        # not from daemon start, which also pays thread/epoch setup on a
+        # loaded machine.
+        assert wait_until(
+            lambda: labels_at(out).get(DEGRADED_LABEL) == "true",
+        ), f"no degraded labels after the hung probe; file: {labels_at(out)}"
+        assert obs_metrics.PROBE_KILLS.value() == 1, (
+            "hung probe child was not SIGKILLed"
+        )
+        exposition = obs_metrics.REGISTRY.render()
+        max_probe_s = None
+        for line in exposition.splitlines():
+            if line.startswith("tfd_probe_duration_seconds_sum "):
+                max_probe_s = float(line.split(" ")[1])
+        assert max_probe_s is not None
+        assert max_probe_s < probe_timeout + 1.0, (
+            f"hung probe held for {max_probe_s:.2f}s, past the "
+            f"{probe_timeout}s budget + 1s kill allowance"
+        )
+        assert t.is_alive(), "daemon exited on the hung probe"
+
+        # (2) continued: the next acquisition dies to a REAL SIGSEGV; the
+        # daemon survives it as another degraded cycle.
+        assert wait_until(lambda: obs_metrics.PROBE_CRASHES.value() == 1), (
+            "native crash never surfaced through the sandbox"
+        )
+        assert t.is_alive(), "daemon exited on the native crash"
+
+        # (3): faults drained — full labels, markers gone.
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+            and DEGRADED_LABEL not in labels_at(out)
+        ), f"did not converge; file: {labels_at(out)}"
+    finally:
+        stop_daemon(t, sigs, result)
+    assert result["restart"] is False
+
+
+# ---------------------------------------------------------------------------
+# layer 4a: restart-surviving label state (--state-dir)
+# ---------------------------------------------------------------------------
+
+def test_acceptance_restart_serves_restored_labels_first(tmp_path, monkeypatch):
+    """ISSUE 4 acceptance (4): after a restart with a warm --state-dir,
+    the daemon serves restored last-good labels with tfd.restored=true on
+    the very first write, BEFORE any backend init succeeds — proven by a
+    backend that never succeeds (pjrt_init:fail:99) yet a file that still
+    carries the device labels."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    state_dir = str(tmp_path / "state")
+    config = cfg(tmp_path, **{"state-dir": state_dir})
+    out = config.flags.tfd.output_file
+
+    # Run 1: a healthy epoch persists its last-good labels.
+    t, sigs, result = start_daemon(config)
+    try:
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+        )
+        assert wait_until(
+            lambda: os.path.exists(os.path.join(state_dir, "last-good-labels.json"))
+        ), "full cycle did not persist state"
+    finally:
+        stop_daemon(t, sigs, result)
+    assert not os.path.exists(out), "daemon exit must remove the output file"
+
+    # Run 2: warm state, backend that NEVER initializes.
+    obs_metrics.reset_for_tests()
+    faults.load_fault_spec("pjrt_init:fail:99")
+    config2 = cfg(tmp_path, **{"state-dir": state_dir})
+    t, sigs, result = start_daemon(config2)
+    try:
+        assert wait_until(lambda: labels_at(out)), "no first write"
+        first = labels_at(out)
+        assert first.get(RESTORED_LABEL) == "true", (
+            f"first write not marked restored: {first}"
+        )
+        assert first.get("google.com/tpu.count") == "4", (
+            f"restored write lost the device labels: {first}"
+        )
+        # Degraded cycles keep the restored inventory: the crash-looping
+        # backend never strips the node bare.
+        assert wait_until(
+            lambda: labels_at(out).get(DEGRADED_LABEL) == "true"
+            and labels_at(out).get("google.com/tpu.count") == "4"
+            and labels_at(out).get(RESTORED_LABEL) == "true"
+        ), f"degraded cycle stripped restored labels: {labels_at(out)}"
+        assert obs_metrics.STATE_RESTORES.value() == 1
+        assert obs_metrics.RESTORED.value() == 1
+    finally:
+        stop_daemon(t, sigs, result)
+
+
+def test_restored_marker_clears_on_first_live_full_cycle(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    state_dir = str(tmp_path / "state")
+    store = LabelStateStore(state_dir)
+    store.save({"google.com/tpu.count": "4", "google.com/tpu.machine": "gce"})
+    obs_metrics.reset_for_tests()
+    config = cfg(tmp_path, **{"state-dir": state_dir})
+    out = config.flags.tfd.output_file
+    t, sigs, result = start_daemon(config)
+    try:
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+            and RESTORED_LABEL not in labels_at(out)
+        ), f"restored marker never cleared: {labels_at(out)}"
+        # The gauge follows the write by a few statements in the run
+        # loop, so poll rather than read-once.
+        assert wait_until(lambda: obs_metrics.RESTORED.value() == 0)
+    finally:
+        stop_daemon(t, sigs, result)
+
+
+def test_state_store_round_trip_and_corruption(tmp_path):
+    store = LabelStateStore(str(tmp_path / "s"))
+    assert store.load() is None  # cold
+    assert store.save({"a": "1", "b": "2"})
+    assert dict(store.load()) == {"a": "1", "b": "2"}
+    # Corrupt file -> None, not garbage.
+    with open(store.path, "w") as f:
+        f.write('{"version": 1, "labels":')
+    assert store.load() is None
+    # Wrong version -> None.
+    with open(store.path, "w") as f:
+        json.dump({"version": 99, "labels": {"a": "1"}}, f)
+    assert store.load() is None
+    # Non-str values -> None.
+    with open(store.path, "w") as f:
+        json.dump({"version": 1, "labels": {"a": 1}}, f)
+    assert store.load() is None
+    # Empty labels -> None (a restore must have something to say).
+    with open(store.path, "w") as f:
+        json.dump({"version": 1, "labels": {}}, f)
+    assert store.load() is None
+
+
+def test_state_store_save_is_churn_free(tmp_path):
+    """An unchanged label set must not re-fsync the node's disk every
+    cycle: the second identical save is a no-op (mtime untouched)."""
+    store = LabelStateStore(str(tmp_path / "s"))
+    assert store.save({"a": "1"})
+    first_mtime = os.stat(store.path).st_mtime_ns
+    assert store.save({"a": "1"})  # identical: skipped
+    assert os.stat(store.path).st_mtime_ns == first_mtime
+    assert store.save({"a": "2"})  # changed: written
+    assert dict(store.load()) == {"a": "2"}
+
+
+def test_state_store_save_failure_is_contained(tmp_path):
+    blocked = tmp_path / "blocked"
+    blocked.write_text("a file where the dir should be")
+    store = LabelStateStore(str(blocked))
+    assert store.save({"a": "1"}) is False  # no raise
+
+
+def test_supervisor_strips_restored_and_flapping_markers(tmp_path):
+    sup = Supervisor(cfg(tmp_path))
+    sup.cycle_succeeded(
+        Labels(
+            {
+                "google.com/tpu.machine": "gce",
+                RESTORED_LABEL: "true",
+                FLAPPING_LABEL: "true",
+            }
+        )
+    )
+    sup.cycle_failed(RuntimeError("boom"))
+    reserve = sup.reserve_labels()
+    assert RESTORED_LABEL not in reserve
+    assert FLAPPING_LABEL not in reserve
+    assert reserve["google.com/tpu.machine"] == "gce"
+
+
+def test_reserve_carries_restored_marker_while_restored(tmp_path, monkeypatch):
+    state_dir = str(tmp_path / "state")
+    LabelStateStore(state_dir).save({"google.com/tpu.count": "4"})
+    sup = Supervisor(cfg(tmp_path, **{"state-dir": state_dir}))
+    assert sup.restore_last_good() is not None
+    sup.cycle_failed(RuntimeError("first cycle failed"))
+    reserve = sup.reserve_labels()
+    assert reserve[RESTORED_LABEL] == "true"
+    assert reserve["google.com/tpu.count"] == "4"
+
+
+def test_stale_full_cycle_neither_persists_nor_clears_restored(tmp_path):
+    """A "full" cycle whose sources went stale (deadline-missed device
+    labeler, empty cache) must not be trusted as live inventory: it
+    neither ends the restored regime nor lands in --state-dir — else a
+    crash-loop would restore a device-less set as the node's labels."""
+    from gpu_feature_discovery_tpu.lm.engine import STALE_SOURCES_LABEL
+
+    state_dir = str(tmp_path / "state")
+    LabelStateStore(state_dir).save({"google.com/tpu.count": "4"})
+    sup = Supervisor(cfg(tmp_path, **{"state-dir": state_dir}))
+    assert sup.restore_last_good() is not None
+    stale_full = Labels(
+        {"google.com/tfd.timestamp": "1", STALE_SOURCES_LABEL: "device"}
+    )
+    sup.cycle_succeeded(stale_full, mode="full")
+    assert sup.restored, "stale full cycle must not clear the restored regime"
+    assert dict(LabelStateStore(state_dir).load()) == {
+        "google.com/tpu.count": "4"
+    }, "stale full cycle must not overwrite the persisted inventory"
+    clean_full = Labels(
+        {"google.com/tfd.timestamp": "1", "google.com/tpu.count": "4"}
+    )
+    sup.cycle_succeeded(clean_full, mode="full")
+    assert not sup.restored
+    assert "google.com/tfd.timestamp" in LabelStateStore(state_dir).load()
+
+
+def test_stale_full_cycle_publishes_restored_overlay(tmp_path, monkeypatch):
+    """ISSUE 4 invariant, publish side: while restored, a "full" cycle
+    whose OFFLOADED source (interconnect here) misses its deadline with
+    an empty cache must not strip the restored facts from the file — the
+    overlay keeps the restored inventory + marker until a CLEAN full
+    cycle takes over."""
+    import threading as _threading
+
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    state_dir = str(tmp_path / "state")
+    LabelStateStore(state_dir).save(
+        {
+            "google.com/tpu.count": "4",
+            "google.com/tpu.slice.topology": "2x2x1",
+        }
+    )
+    release = _threading.Event()
+
+    class WedgedInterconnect:
+        def labels(self):
+            release.wait(30)
+            return Labels()
+
+    config = cfg(
+        tmp_path,
+        **{"state-dir": state_dir, "labeler-timeout": "0.05s"},
+    )
+    out = config.flags.tfd.output_file
+    t, sigs, result = start_daemon(config, interconnect=WedgedInterconnect())
+    try:
+        # Full cycles run (backend healthy) but interconnect is stale:
+        # the restored slice fact must stay published with the marker.
+        assert wait_until(
+            lambda: "google.com/tpu.tfd.stale-sources" in labels_at(out)
+        ), f"no stale cycle observed: {labels_at(out)}"
+        l = labels_at(out)
+        assert l.get("google.com/tpu.tfd.restored") == "true", l
+        assert l.get("google.com/tpu.slice.topology") == "2x2x1", (
+            f"stale full cycle stripped the restored inventory: {l}"
+        )
+        release.set()
+        # Clean full cycle: live labels take over, regime ends. The
+        # restored slice fact disappears (the live backend does not
+        # publish it) — that is the live truth, not a strip.
+        assert wait_until(
+            lambda: "google.com/tpu.tfd.restored" not in labels_at(out)
+            and labels_at(out).get("google.com/tpu.count") == "4"
+        ), f"never converged to live labels: {labels_at(out)}"
+    finally:
+        release.set()
+        stop_daemon(t, sigs, result)
+
+
+def test_deviceless_full_cycle_never_clobbers_persisted_inventory(tmp_path):
+    """A clean "full" cycle that enumerated ZERO chips (the factory's
+    silent fallback-to-null on a TPU node whose backends all failed)
+    must not overwrite the persisted device inventory — a restart would
+    otherwise restore the stripped set."""
+    state_dir = str(tmp_path / "state")
+    store = LabelStateStore(state_dir)
+    store.save({"google.com/tpu.count": "4", "google.com/tpu.machine": "gce"})
+    sup = Supervisor(cfg(tmp_path, **{"state-dir": state_dir}))
+    deviceless = Labels({"google.com/tfd.timestamp": "123"})
+    sup.cycle_succeeded(deviceless, mode="full")
+    assert dict(LabelStateStore(state_dir).load()) == {
+        "google.com/tpu.count": "4",
+        "google.com/tpu.machine": "gce",
+    }, "deviceless full cycle clobbered the persisted inventory"
+
+
+def test_sighup_reload_does_not_reenter_restored_regime(tmp_path, monkeypatch):
+    """run()'s process_state contract: once a process has served a live
+    full cycle, a reload epoch must not republish its own state file
+    under a false tfd.restored marker (start() shares one dict across
+    epochs). A fresh process (no shared state, or none yet served)
+    restores as before."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    state_dir = str(tmp_path / "state")
+    LabelStateStore(state_dir).save({"google.com/tpu.count": "4"})
+    process_state = {"live_full_served": False}
+
+    def one_epoch(signal_first):
+        config = cfg(tmp_path, **{"state-dir": state_dir})
+        sigs = queue.Queue()
+        sigs.put(signal_first)
+        restart = run(
+            lambda: cmd_main._build_manager(config),
+            Empty(),
+            config,
+            sigs,
+            supervisor=Supervisor(config),
+            process_state=process_state,
+        )
+        return restart
+
+    restores_before = obs_metrics.STATE_RESTORES.value()
+    # Epoch 1: cold, warm state on disk -> restores, then serves a live
+    # full cycle before honoring the queued SIGHUP at the phase boundary.
+    assert one_epoch(signal.SIGHUP) is True
+    assert obs_metrics.STATE_RESTORES.value() == restores_before + 1
+    assert process_state["live_full_served"] is True
+    # Epoch 2 (the reload): must NOT restore again.
+    assert one_epoch(signal.SIGTERM) is False
+    assert obs_metrics.STATE_RESTORES.value() == restores_before + 1
+
+
+# ---------------------------------------------------------------------------
+# layer 4b: anti-flap hysteresis (--flap-window)
+# ---------------------------------------------------------------------------
+
+def test_flap_damper_holds_changes_for_window():
+    obs_metrics.reset_for_tests()
+    damper = FlapDamper(window=3)
+    full = Labels({"google.com/tpu.count": "4"})
+    degraded = Labels({DEGRADED_LABEL: "true"})
+
+    assert dict(damper.observe(full)) == dict(full)  # first publish
+    # A degraded transition must hold 3 cycles; cycles 1-2 re-serve the
+    # full set with the flapping marker.
+    for held in (1, 2):
+        served = damper.observe(degraded)
+        assert served.get("google.com/tpu.count") == "4", held
+        assert served.get(FLAPPING_LABEL) == "true", held
+        assert obs_metrics.FLAPPING.value() == 1
+    served = damper.observe(degraded)  # third consecutive: publishes
+    assert served.get(DEGRADED_LABEL) == "true"
+    assert FLAPPING_LABEL not in served
+    assert obs_metrics.FLAPPING.value() == 0
+    assert obs_metrics.FLAP_SUPPRESSED.value() == 2
+
+
+def test_flap_damper_reverted_change_never_publishes():
+    obs_metrics.reset_for_tests()
+    damper = FlapDamper(window=3)
+    a = Labels({"google.com/tpu.count": "4"})
+    b = Labels({"google.com/tpu.count": "3"})
+    damper.observe(a)
+    assert damper.observe(b).get("google.com/tpu.count") == "4"  # held
+    back = damper.observe(a)  # reverted before the window
+    assert back.get("google.com/tpu.count") == "4"
+    assert FLAPPING_LABEL not in back
+    assert not damper.suppressing
+
+
+def test_flap_damper_window_one_is_passthrough():
+    damper = FlapDamper(window=1)
+    a = Labels({"k": "1"})
+    b = Labels({"k": "2"})
+    assert dict(damper.observe(a)) == {"k": "1"}
+    assert dict(damper.observe(b)) == {"k": "2"}
+
+
+def test_flap_window_in_daemon_suppresses_recovery_transition(
+    tmp_path, monkeypatch
+):
+    """Integrated: degraded -> full recovery under --flap-window=2 spends
+    one cycle flapping (old degraded set re-served) before full labels
+    publish."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    obs_metrics.reset_for_tests()
+    config = cfg(tmp_path, **{"flap-window": "2"})
+    out = config.flags.tfd.output_file
+    faults.load_fault_spec("pjrt_init:fail:2")
+    t, sigs, result = start_daemon(config)
+    try:
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+            and FLAPPING_LABEL not in labels_at(out)
+            and DEGRADED_LABEL not in labels_at(out)
+        ), f"did not converge; file: {labels_at(out)}"
+        # The recovery transition was damped at least once on the way.
+        assert obs_metrics.FLAP_SUPPRESSED.value() >= 1, (
+            "flap window never suppressed the degraded->full transition"
+        )
+    finally:
+        stop_daemon(t, sigs, result)
+
+
+# ---------------------------------------------------------------------------
+# byte-identity: --probe-isolation=none keeps the golden path untouched
+# ---------------------------------------------------------------------------
+
+def test_isolation_none_sequential_golden_byte_identical(tmp_path):
+    """--probe-isolation=none + --parallel-labelers=false (the full
+    reference-parity stack) produces byte-identical output to the
+    default oneshot run — the sandbox must be unobservable when off."""
+    def oneshot(subdir, **cli):
+        d = tmp_path / subdir
+        d.mkdir()
+        machine = d / "machine-type"
+        machine.write_text("Google Compute Engine\n")
+        values = {
+            "oneshot": True,
+            "no-timestamp": True,  # the only per-run-varying label
+            "machine-type-file": str(machine),
+            "output-file": str(d / "tfd"),
+        }
+        values.update(cli)
+        config = new_config(cli_values=values, environ={})
+        restart = run(
+            new_single_host_manager("v4-8"), Empty(), config, queue.Queue()
+        )
+        assert restart is False
+        with open(config.flags.tfd.output_file, "rb") as f:
+            return f.read()
+
+    baseline = oneshot("base")
+    explicit_none = oneshot(
+        "none",
+        **{"probe-isolation": "none", "parallel-labelers": False},
+    )
+    assert explicit_none == baseline
