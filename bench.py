@@ -298,6 +298,87 @@ def main() -> int:
         file=sys.stderr,
     )
 
+    # Persistent-broker metrics (ISSUE 5): the broker replaces fork+init
+    # per acquisition with one RPC against a long-lived worker, so the
+    # claim under test is broker_request_p50_ms < probe_acquire_ms (the
+    # fork-per-acquisition cost measured above). Also measured:
+    # broker_respawn_ms (SIGKILL the worker, time detection + respawn +
+    # first served request — what a crash costs the daemon) and
+    # first_labels_ms (broker spawn + acquisition + one full engine
+    # cycle + write — the cold-start path the warm-start keeps off the
+    # first health cycle).
+    import signal as _signal
+
+    from gpu_feature_discovery_tpu.sandbox import BrokerClient, BrokerManager
+
+    broker_config = new_config(
+        cli_values={
+            "oneshot": "false",
+            "output-file": out_file,
+            "tpu-topology-strategy": "single",
+            "init-backoff-max": "0.05s",
+        },
+        environ={},
+        config_file=None,
+    )
+    saved_bench_backend = os.environ.get("TFD_BACKEND")
+    os.environ["TFD_BACKEND"] = "mock:v4-8"
+    try:
+        t0 = time.perf_counter()
+        broker_client = BrokerClient(broker_config)
+        broker_mgr = BrokerManager(broker_client)
+        fl_engine = new_label_engine(broker_config)
+        fl_labels = fl_engine.generate(
+            new_label_sources(
+                broker_mgr, interconnect, broker_config, timestamp=timestamp
+            )
+        )
+        broker_mgr.shutdown()
+        fl_labels.write_to_file(out_file)
+        first_labels_ms = round((time.perf_counter() - t0) * 1e3, 3)
+        fl_engine.close()
+
+        req_iters = max(
+            10, int(os.environ.get("TFD_BENCH_BROKER_ITERS", "50"))
+        )
+        req_ms = []
+        for _ in range(req_iters):
+            t_req = time.perf_counter()
+            broker_client.snapshot()
+            req_ms.append((time.perf_counter() - t_req) * 1e3)
+        broker_request_p50_ms = round(statistics.median(req_ms), 3)
+
+        respawn_ms = []
+        for _ in range(3):
+            os.kill(broker_client.pid, _signal.SIGKILL)
+            t_resp = time.perf_counter()
+            while True:
+                # First attempt observes the death (reap), the retry
+                # respawns and serves — the full crash-to-recovery cost.
+                # No backoff applies: the window opens only on spawn
+                # FAILURES, and these spawns succeed.
+                try:
+                    broker_client.ping()
+                    break
+                except Exception:  # noqa: BLE001 - the observed death
+                    pass
+            respawn_ms.append((time.perf_counter() - t_resp) * 1e3)
+        broker_respawn_ms = round(statistics.median(respawn_ms), 3)
+        broker_client.close()
+    finally:
+        if saved_bench_backend is None:
+            os.environ.pop("TFD_BACKEND", None)
+        else:
+            os.environ["TFD_BACKEND"] = saved_bench_backend
+    print(
+        f"bench: broker request p50={broker_request_p50_ms}ms over "
+        f"{req_iters} snapshot RPCs (vs fork-per-acquisition "
+        f"p50={probe_acquire_ms}ms); respawn-to-serving "
+        f"p50={broker_respawn_ms}ms; first labels via broker in "
+        f"{first_labels_ms}ms",
+        file=sys.stderr,
+    )
+
     # Burn-in cycle cost (VERDICT r2 next-round #7): on the real chip,
     # measure what a --with-burnin labeling cycle costs next to the plain
     # cycle, proving the --burnin-interval amortization claim with a
@@ -552,6 +633,15 @@ def main() -> int:
                 # cost is reported separately, not amortized away.
                 "probe_isolation_overhead_pct": probe_isolation_overhead_pct,
                 "probe_acquire_ms": probe_acquire_ms,
+                # Broker acceptance (ISSUE 5): steady-state acquisition
+                # through the persistent broker (one snapshot RPC) vs
+                # the fork+init+enumeration it replaces — CI asserts
+                # broker_request_p50_ms < probe_acquire_ms. respawn =
+                # SIGKILL-to-serving; first_labels = cold spawn + one
+                # full labeling cycle.
+                "broker_request_p50_ms": broker_request_p50_ms,
+                "broker_respawn_ms": broker_respawn_ms,
+                "first_labels_ms": first_labels_ms,
                 # Supervisor acceptance: cycles from first (faulted) cycle
                 # to the label file holding full labels again, with 2
                 # injected backend-init failures (degraded labels served

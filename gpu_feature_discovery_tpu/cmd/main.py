@@ -286,11 +286,23 @@ def _build_manager(config: Config) -> Manager:
     hang or a native SIGSEGV in libtpu surfaces as one more retryable
     init failure (ProbeTimeout/ProbeCrash are ResourceErrors) instead of
     a wedged or dead pod, and the parent labels from the returned
-    snapshot."""
+    snapshot.
+
+    With the persistent broker on (``--probe-broker``, default ``auto``
+    = on for the daemon — sandbox/broker.py) the fork+init above is paid
+    ONCE per worker lifetime instead of per acquisition: the first
+    acquisition spawns the long-lived worker (that spawn carries the
+    fault site and the init-attempt metric), and every later one —
+    including the supervisor's rebuild after a failed cycle — is a
+    single snapshot RPC against the worker's held client.
+    ``--probe-broker=off`` restores the fork-per-acquisition path byte
+    for byte."""
     from gpu_feature_discovery_tpu import sandbox
     from gpu_feature_discovery_tpu.config.flags import DEFAULT_PROBE_TIMEOUT
 
     if sandbox.isolation_mode(config) == "subprocess":
+        if sandbox.broker_enabled(config):
+            return sandbox.acquire_broker_manager(config)
         tfd = config.flags.tfd
         timeout = (
             tfd.probe_timeout
@@ -658,11 +670,21 @@ def run(
                 return False
     finally:
         engine.close()
+        # The broker worker is epoch-scoped: a SIGHUP reload must close
+        # it GRACEFULLY (shutdown RPC, SIGKILL fallback) so the next
+        # epoch spawns a fresh one under the new config. Closed BEFORE
+        # the stray sweep; the sweep's exemption covers the live worker
+        # in between, so it can never be mistaken for an orphaned probe
+        # child and SIGKILL-respawn-stormed on every reload.
+        from gpu_feature_discovery_tpu.sandbox import (
+            close_broker,
+            kill_stray_children,
+        )
+
+        close_broker()
         # The process-wide sweep on top of engine.close()'s per-source
         # cancels: no probe child may outlive its epoch (a SIGHUP reload
         # must not orphan one).
-        from gpu_feature_discovery_tpu.sandbox import kill_stray_children
-
         kill_stray_children()
         if obs_server is not None:
             # Synchronous close releases the port before a SIGHUP reload

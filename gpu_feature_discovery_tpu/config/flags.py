@@ -17,6 +17,8 @@ from typing import Any, Callable, Dict, List, Optional, Sequence
 from gpu_feature_discovery_tpu.config.spec import (
     Config,
     ConfigError,
+    PROBE_BROKER_AUTO,
+    PROBE_BROKER_MODES,
     PROBE_ISOLATION_AUTO,
     PROBE_ISOLATION_MODES,
     TOPOLOGY_STRATEGIES,
@@ -58,6 +60,11 @@ DEFAULT_LABELER_TIMEOUT = 10.0
 DEFAULT_PROBE_TIMEOUT = 30.0
 # Anti-flap hysteresis window: 1 = publish every cycle unchanged.
 DEFAULT_FLAP_WINDOW = 1
+# Persistent probe broker (sandbox/broker.py): recycle the long-lived
+# worker after this many served requests; 0 = keep it for the epoch's
+# lifetime (the default — the worker is stateless between requests, so
+# recycling exists only as a hedge against slow native leaks).
+DEFAULT_BROKER_MAX_REQUESTS = 0
 
 _DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
 _DURATION_UNITS = {
@@ -377,6 +384,33 @@ FLAG_DEFS: List[FlagDef] = [
         getter=lambda c: _f(c).tfd.probe_isolation,
     ),
     FlagDef(
+        name="probe-broker",
+        env_vars=("TFD_PROBE_BROKER",),
+        parse=str,
+        default=PROBE_BROKER_AUTO,
+        help="persistent probe broker (sandbox/broker.py): 'on' routes "
+        "backend acquisition (and the burn-in probe) through ONE "
+        "long-lived sandboxed worker that initializes PJRT once and "
+        "serves snapshot/health requests over a pipe RPC — acquisition "
+        "after the first costs one RPC instead of fork+init; 'off' "
+        "restores the fork-per-acquisition path; 'auto' (default) is on "
+        "for the supervised daemon and off for oneshot",
+        setter=lambda c, v: setattr(_f(c).tfd, "probe_broker", v),
+        getter=lambda c: _f(c).tfd.probe_broker,
+    ),
+    FlagDef(
+        name="broker-max-requests",
+        env_vars=("TFD_BROKER_MAX_REQUESTS",),
+        parse=_parse_nonneg_int,
+        default=DEFAULT_BROKER_MAX_REQUESTS,
+        help="with the probe broker on, gracefully recycle the worker "
+        "after this many served requests (a hedge against slow native "
+        "leaks in libtpu); 0 (default) keeps the worker for the config "
+        "epoch's lifetime",
+        setter=lambda c, v: setattr(_f(c).tfd, "broker_max_requests", v),
+        getter=lambda c: _f(c).tfd.broker_max_requests,
+    ),
+    FlagDef(
         name="state-dir",
         env_vars=("TFD_STATE_DIR",),
         parse=str,
@@ -461,6 +495,12 @@ def new_config(
         raise ConfigError(
             f"invalid probe-isolation: {isolation!r} "
             f"(want one of {PROBE_ISOLATION_MODES})"
+        )
+    broker = config.flags.tfd.probe_broker
+    if broker not in PROBE_BROKER_MODES:
+        raise ConfigError(
+            f"invalid probe-broker: {broker!r} "
+            f"(want one of {PROBE_BROKER_MODES})"
         )
     return config
 

@@ -46,6 +46,19 @@ PROBE_ISOLATION_MODES = (
     PROBE_ISOLATION_AUTO,
 )
 
+# Persistent probe broker modes (sandbox/broker.py): `on` routes every
+# backend acquisition (and the burn-in) through one long-lived sandboxed
+# worker; `off` restores the fork-per-acquisition path byte for byte;
+# `auto` (the default) is on for the supervised daemon, off for oneshot.
+PROBE_BROKER_ON = "on"
+PROBE_BROKER_OFF = "off"
+PROBE_BROKER_AUTO = "auto"
+PROBE_BROKER_MODES = (
+    PROBE_BROKER_ON,
+    PROBE_BROKER_OFF,
+    PROBE_BROKER_AUTO,
+)
+
 
 @dataclass
 class ReplicatedResource:
@@ -144,6 +157,12 @@ class TfdFlags:
     probe_isolation: Optional[str] = None  # none | subprocess | auto
     state_dir: Optional[str] = None  # "" = disabled
     flap_window: Optional[int] = None  # 1 = disabled
+    # Persistent probe broker (sandbox/broker.py): one long-lived
+    # sandboxed PJRT worker serving probe requests over a pipe RPC,
+    # replacing fork+init per acquisition; recycled after
+    # broker_max_requests served requests (0 = never).
+    probe_broker: Optional[str] = None  # auto | on | off
+    broker_max_requests: Optional[int] = None  # 0 = never recycle
 
 
 @dataclass
@@ -201,6 +220,8 @@ class Config:
                     "probeIsolation": self.flags.tfd.probe_isolation,
                     "stateDir": self.flags.tfd.state_dir,
                     "flapWindow": self.flags.tfd.flap_window,
+                    "probeBroker": self.flags.tfd.probe_broker,
+                    "brokerMaxRequests": self.flags.tfd.broker_max_requests,
                 },
             },
             "sharing": {
@@ -319,6 +340,11 @@ def parse_config_file(path: str) -> Config:
     config.flags.tfd.state_dir = _opt_str(tfd.get("stateDir"))
     if tfd.get("flapWindow") is not None:
         config.flags.tfd.flap_window = parse_positive_int(tfd["flapWindow"])
+    config.flags.tfd.probe_broker = _opt_str(tfd.get("probeBroker"))
+    if tfd.get("brokerMaxRequests") is not None:
+        config.flags.tfd.broker_max_requests = parse_nonneg_int(
+            tfd["brokerMaxRequests"]
+        )
 
     config.resources = raw.get("resources", {}) or {}
     config.sharing = Sharing.from_dict(raw.get("sharing", {}) or {})

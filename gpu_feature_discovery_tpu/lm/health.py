@@ -195,6 +195,15 @@ def new_health_labeler(manager: Manager, config: Config) -> Labeler:
         return Empty()
     if not manager.get_chips():
         return Empty()
+    broker = getattr(manager, "broker", None)
+    if broker is not None:
+        # Broker-routed burn-in (sandbox/broker.py): the probe executes
+        # in the long-lived worker, where the PJRT client actually lives
+        # — the daemon process never touches the chip, so
+        # --probe-isolation=auto can stay `subprocess` under
+        # --with-burnin. jax is only needed in the WORKER; no parent-side
+        # import gate.
+        return _broker_health_labels(manager, broker, config)
     try:
         from gpu_feature_discovery_tpu.ops.healthcheck import measure_node_health
     except ImportError as e:
@@ -288,6 +297,21 @@ def new_health_labeler(manager: Manager, config: Config) -> Labeler:
         except Exception as e:  # noqa: BLE001 - degraded chip must not kill labeling
             report, error = None, e
         probe_ms = (time.perf_counter() - t0) * 1e3
+    return _labels_from_probe(sched, manager, report, error, probe_ms)
+
+
+def _labels_from_probe(
+    sched: _BurninSchedule,
+    manager: Manager,
+    report,
+    error,
+    probe_ms: float,
+) -> Labels:
+    """One probe outcome → published labels + schedule/cache updates.
+    Shared by the in-process probe and the broker-routed one
+    (sandbox/broker.py executes the probe in its worker and ships the
+    report back; ``error`` is then a string rather than an exception —
+    both render the same way)."""
     if error is not None:
         # Devices were ACQUIRED but the burn-in computation failed on them:
         # that is a chip-execution failure, the one case health.ok=false is
@@ -388,3 +412,62 @@ def new_health_labeler(manager: Manager, config: Config) -> Labeler:
         {k: v for k, v in labels.items() if k != HEALTH_PROBE_MS}
     )
     return labels
+
+
+def _broker_health_labels(manager, broker, config: Config) -> Labeler:
+    """The burn-in labeler when acquisition runs through the persistent
+    broker: scheduling, caching, and label rendering stay in the PARENT
+    (same _BurninSchedule, same interval/cache/failure-streak policy as
+    the in-process path), while the probe itself is one ``health`` RPC
+    executed in the worker that holds the PJRT client. The engine routes
+    this source with cancel→kill (lm/tpu.py): a --labeler-timeout miss
+    SIGKILLs the worker instead of leaking a thread, and the broker
+    respawns on next use. The worker pre-warms the probe kernels at
+    spawn (sandbox/broker.py _child_prewarm), so the first probe here no
+    longer pays the XLA compile on the label-serving path.
+
+    One deliberate difference from the in-process path: acquirability is
+    confirmed per PROBING cycle (an RPC), not per cycle — the worker
+    holds the client, and the per-cycle snapshot refresh already proves
+    the worker live in between."""
+    sched = _schedule_for(manager)
+    interval = config.flags.tfd.burnin_interval or 1
+    if not sched.due(interval):
+        return sched.cached
+    outcome = broker.health()
+    status = outcome.get("status")
+    if status == "unacquirable":
+        # Same semantics as _acquire_tpu_devices returning None in
+        # process: says nothing about chip health, publish nothing, drop
+        # the cache so recovery re-probes immediately.
+        warn_once(
+            log,
+            "health:unacquirable",
+            "burn-in skipped: no local TPU devices acquirable in the "
+            "broker worker (chip busy, PJRT unusable, or CPU fallback); "
+            "publishing no health labels",
+        )
+        sched.cached = None
+        sched.consecutive_failures = 0
+        return Empty()
+    if status == "warming":
+        # The worker's probe (or its kernel pre-warm) is still
+        # compiling/running: publish base labels without health this
+        # cycle and collect on a later one — the in-process path's
+        # first-probe semantics (sched.cached stays None, so the next
+        # probing cycle re-asks). The RPC answered within its bounded
+        # wait, so the engine deadline never kills the worker over a
+        # cold XLA compile.
+        log.info(
+            "burn-in probe still warming in the broker worker; "
+            "publishing base labels without health this cycle"
+        )
+        return Empty()
+    probe_ms = float(outcome.get("probe_ms") or 0.0)
+    if status == "probe-failed":
+        return _labels_from_probe(
+            sched, manager, None, outcome.get("error", ""), probe_ms
+        )
+    return _labels_from_probe(
+        sched, manager, outcome.get("report") or {}, None, probe_ms
+    )

@@ -51,6 +51,17 @@ def tpu_label_sources(manager: Manager, config: Config) -> List[LabelSource]:
     if not manager.get_chips():
         return []
     machine_type_file = config.flags.tfd.machine_type_file
+    # Broker-backed manager (sandbox/broker.py): the health probe runs in
+    # the broker worker, so the engine's deadline escalation can SIGKILL
+    # it (cancel→kill) instead of abandoning a thread wedged in native
+    # code — the LabelSource.cancel seam the sandbox defined, now used by
+    # an in-tree source.
+    broker = getattr(manager, "broker", None)
+    health_cancel = (
+        broker.kill_child
+        if broker is not None and config.flags.tfd.with_burnin
+        else None
+    )
     return [
         # Offload split (engine rationale — each pool handoff costs
         # ~0.13-0.3 ms against a ~0.5 ms cycle): machine-type is ONE read
@@ -71,6 +82,7 @@ def tpu_label_sources(manager: Manager, config: Config) -> List[LabelSource]:
             "health",
             lambda: new_health_labeler(manager, config),
             offload=bool(config.flags.tfd.with_burnin),
+            cancel=health_cancel,
         ),
     ]
 

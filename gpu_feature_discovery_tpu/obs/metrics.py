@@ -96,6 +96,27 @@ PROBE_CRASHES = REGISTRY.counter(
     "Probe children that died to a signal (native SIGSEGV et al.) — "
     "contained as retryable init failures instead of killing the daemon.",
 )
+BROKER_REQUESTS = REGISTRY.counter(
+    "tfd_broker_requests_total",
+    "Requests (snapshot/health/ping) served by the persistent probe "
+    "broker worker — acquisitions through a live broker advance this "
+    "while tfd_backend_init_attempts_total stays flat.",
+)
+BROKER_REQUEST_DURATION = REGISTRY.histogram(
+    "tfd_broker_request_duration_seconds",
+    "Round-trip time of each broker request (pipe RPC against the "
+    "long-lived worker's held PJRT client), whatever its outcome.",
+)
+BROKER_RESPAWNS = REGISTRY.counter(
+    "tfd_broker_respawns_total",
+    "Broker workers respawned after a previous worker died (crash, "
+    "hang-kill, EOF) or was recycled (--broker-max-requests).",
+)
+BROKER_UP = REGISTRY.gauge(
+    "tfd_broker_up",
+    "1 while a broker worker is live and serving requests, else 0 "
+    "(including --probe-broker=off, where no worker ever exists).",
+)
 STATE_RESTORES = REGISTRY.counter(
     "tfd_state_restores_total",
     "Epoch starts that re-served persisted last-good labels from "

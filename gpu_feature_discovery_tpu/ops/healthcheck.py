@@ -313,6 +313,42 @@ def _warm_probe_kernels(
     return (time.perf_counter() - t0) * 1e3
 
 
+def warm_probe_kernels_for(devices: tuple) -> float:
+    """Pre-compile + first-execute the probe kernels for ``devices`` at
+    the SAME geometry (and kernel set) ``measure_node_health`` would
+    pick for them, so a later probe finds everything warm. The broker
+    worker (sandbox/broker.py) calls this right after init, off the
+    label-serving path, which is what removes ``first_probe_compile_ms``
+    from the first health cycle; idempotent via the warmed-key memo.
+    Returns the wall ms spent (0.0 when already warm).
+
+    Non-TPU devices warm only the burn-in + pack kernels: the wall-clock
+    probe path they take runs no HBM pallas kernel (compiled
+    ``pallas_call`` is TPU-only; hbm_gbps is None on those platforms),
+    so warming it would crash for a kernel no probe will ever run."""
+    devices = tuple(devices)
+    on_tpu = all(d.platform == "tpu" for d in devices)
+    if on_tpu:
+        return _warm_probe_kernels(
+            devices, TPU_PROBE_SIZE, TPU_PROBE_DEPTH, jnp.bfloat16,
+            PROBE_HBM_MIB,
+        )
+    key = (devices, DEFAULT_PROBE_SIZE, DEFAULT_PROBE_DEPTH, "wall")
+    if key in _warmed_probe_keys:
+        return 0.0
+    t0 = time.perf_counter()
+    step = _jitted_burnin()
+    pack = _jitted_health_pack()
+    for d in devices:
+        xb, wsb = _burnin_workspace(
+            d, DEFAULT_PROBE_SIZE, DEFAULT_PROBE_DEPTH, jnp.bfloat16
+        )
+        cs, rms = step(xb, wsb)
+        jax.block_until_ready(pack(cs, rms, jnp.zeros((), jnp.float32)))
+    _warmed_probe_keys.add(key)
+    return (time.perf_counter() - t0) * 1e3
+
+
 def _measure_node_health_traced(
     devices: list,
     size: int = 512,

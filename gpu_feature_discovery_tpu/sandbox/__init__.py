@@ -12,6 +12,12 @@ ride on it:
 - ``probe``    — fork/kill/reap machinery + the sandboxed snapshot probe
                  (``probe_device_snapshot``) the supervised daemon
                  acquires its backend through.
+- ``broker``   — the persistent probe broker (``--probe-broker``): one
+                 long-lived sandboxed worker that initializes PJRT once,
+                 holds the chip, and serves snapshot/health requests over
+                 a pipe RPC — the fork+init cost is paid per worker
+                 lifetime instead of per acquisition, and the burn-in
+                 gains an isolated execution site.
 - ``snapshot`` — the serializable device inventory a probe child ships
                  back over a pipe, and the ``SnapshotManager`` that
                  serves it to the labelers in the parent.
@@ -24,6 +30,18 @@ ride on it:
                  the published file changes.
 """
 
+from gpu_feature_discovery_tpu.sandbox.broker import (
+    BrokerClient,
+    BrokerCrash,
+    BrokerError,
+    BrokerManager,
+    BrokerTimeout,
+    acquire_broker_manager,
+    broker_enabled,
+    broker_mode,
+    close_broker,
+    get_broker,
+)
 from gpu_feature_discovery_tpu.sandbox.flap import FLAPPING_LABEL, FlapDamper
 from gpu_feature_discovery_tpu.sandbox.probe import (
     ProbeCrash,
@@ -43,6 +61,16 @@ from gpu_feature_discovery_tpu.sandbox.snapshot import (
 from gpu_feature_discovery_tpu.sandbox.state import LabelStateStore
 
 __all__ = [
+    "BrokerClient",
+    "BrokerCrash",
+    "BrokerError",
+    "BrokerManager",
+    "BrokerTimeout",
+    "acquire_broker_manager",
+    "broker_enabled",
+    "broker_mode",
+    "close_broker",
+    "get_broker",
     "FLAPPING_LABEL",
     "FlapDamper",
     "ProbeCrash",

@@ -1,0 +1,997 @@
+"""Persistent probe broker: one long-lived sandboxed PJRT worker.
+
+PR 4's fork-per-acquisition sandbox bought crash/hang containment at a
+recurring price: every backend acquisition re-paid fork + PJRT init +
+enumeration (``probe_acquire_ms`` in bench), and ``--probe-isolation=auto``
+had to drop to ``none`` under ``--with-burnin`` because the burn-in needs
+a process-resident PJRT client that a chip-holding parent would deny to
+every forked child. The broker amortizes the isolation boundary instead
+of re-buying it — the same shape the reference GFD uses by keeping NVML
+attached for the daemon's lifetime while NFD consumes the label file:
+
+- ONE forked worker per config epoch initializes PJRT ONCE (backend
+  selection + ``init()`` + the compile-cache warm-up all inside the
+  child), holds the chip, and serves requests over the sandbox's
+  length-prefixed-JSON pipe framing as a request/response RPC:
+  ``snapshot`` (fresh device enumeration off the held client), ``health``
+  (the burn-in probe, giving ``--probe-isolation=auto`` an isolated
+  execution site even with ``--with-burnin``), ``ping``, ``shutdown``.
+- Every request runs under a hard wall-clock deadline (``--probe-timeout``)
+  enforced by SIGKILL — a request wedged in native code kills only the
+  worker, exactly like a PR 4 probe child.
+- A dead worker (crash, hang-kill, EOF, junk frame) is respawned on the
+  next use under a capped backoff (cap = ``--init-backoff-max``, the same
+  pacing the supervisor applies to acquisition); a healthy worker is
+  recycled proactively after ``--broker-max-requests`` served requests
+  (0 = never) so a slow native leak cannot accumulate forever.
+- A supervisor backend rebuild after a failed cycle REUSES the live
+  worker: acquisition through a running broker is one ``snapshot`` RPC,
+  no fork, no PJRT init — ``tfd_backend_init_attempts_total`` stays flat
+  while ``tfd_broker_requests_total`` advances.
+
+Kill discipline matches sandbox/probe.py: the worker pid is registered in
+the probe child registry (kills go through ``kill_if_live``, so a cancel
+racing a reap can never SIGKILL a recycled pid) but EXEMPTED from
+``kill_stray_children``'s epoch-close sweep — the broker is closed
+GRACEFULLY by the daemon loop (``close_broker`` in ``run()``'s finally),
+and a sweep SIGKILL would instead look like a crash and provoke a respawn
+storm on every SIGHUP reload.
+
+Fault sites (``TFD_FAULT_SPEC``): spawn consumes the acquisition family
+(``probe.timeout``/``probe.hang``/``probe.segv`` — a broker spawn IS a
+device probe, so the chaos rows behave identically under either
+acquisition path) and requests consume ``broker.hang`` / ``broker.crash``
+(the worker hangs on / crashes at one request — the kill-at-deadline and
+crash-respawn paths). All consumed in the PARENT, enacted in the child.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import select
+import signal
+import struct
+import sys
+import tempfile
+import threading
+import time
+from typing import Optional, Tuple
+
+from gpu_feature_discovery_tpu.resource.types import Manager, ResourceError
+from gpu_feature_discovery_tpu.sandbox.probe import (
+    ProbeCrash,
+    ProbeError,
+    ProbeTimeout,
+    _stderr_tail,
+)
+from gpu_feature_discovery_tpu.sandbox.snapshot import (
+    DeviceSnapshot,
+    SnapshotChip,
+    SnapshotManager,
+)
+
+log = logging.getLogger("tfd.sandbox")
+
+# Same length-prefix framing as the one-shot probe pipe (sandbox/probe.py
+# _LEN): a partial frame is detected instead of parsed.
+_LEN = struct.Struct(">I")
+
+# A response larger than this is a corrupt length prefix, not a snapshot:
+# the largest legitimate payload (a full device snapshot) is a few KiB.
+# Rejecting immediately turns a junk prefix into a typed error instead of
+# a deadline-long wait for bytes that will never come.
+MAX_FRAME_BYTES = 32 << 20
+
+# How long a graceful close waits for the worker to honor the shutdown
+# request before escalating to SIGKILL.
+GRACEFUL_CLOSE_S = 2.0
+
+
+class BrokerError(ProbeError):
+    """The broker could not serve the request (worker dead/unspawnable)."""
+
+
+class BrokerTimeout(ProbeTimeout):
+    """A broker request exceeded the deadline; the worker was SIGKILLed."""
+
+
+class BrokerCrash(ProbeCrash):
+    """The broker worker died (signal, EOF, or an unparseable frame)."""
+
+
+class _FrameReader:
+    """Buffered length-prefixed-frame reader over a pipe fd. Unlike the
+    one-shot probe's reader, leftover bytes PERSIST between frames — the
+    broker pipe carries many frames over the worker's lifetime."""
+
+    def __init__(self, fd: int):
+        self._fd = fd
+        self._buf = b""
+
+    def read(self, deadline: float) -> Optional[bytes]:
+        """One frame body by ``deadline``: bytes on success, ``b""`` on
+        EOF-before-frame, ``None`` when the deadline expired. A length
+        prefix past MAX_FRAME_BYTES raises BrokerCrash immediately — a
+        corrupt prefix must become a typed error, never a silent wait."""
+        want: Optional[int] = None
+        while True:
+            if want is None and len(self._buf) >= _LEN.size:
+                want = _LEN.unpack_from(self._buf)[0]
+                if want > MAX_FRAME_BYTES:
+                    self._buf = b""
+                    raise BrokerCrash(
+                        f"broker frame length {want} exceeds "
+                        f"{MAX_FRAME_BYTES} bytes (corrupt length prefix)"
+                    )
+            if want is not None and len(self._buf) >= _LEN.size + want:
+                frame = self._buf[_LEN.size:_LEN.size + want]
+                self._buf = self._buf[_LEN.size + want:]
+                return frame
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                return None
+            try:
+                ready, _, _ = select.select([self._fd], [], [], remaining)
+            except InterruptedError:
+                continue
+            except OSError:
+                return b""
+            if not ready:
+                return None
+            try:
+                chunk = os.read(self._fd, 65536)
+            except OSError:
+                return b""
+            if not chunk:
+                return b""
+            self._buf += chunk
+
+
+def _write_frame(fd: int, doc: dict) -> None:
+    data = json.dumps(doc).encode()
+    os.write(fd, _LEN.pack(len(data)) + data)
+
+
+# ---------------------------------------------------------------------------
+# the worker (child) side
+# ---------------------------------------------------------------------------
+
+def _child_read_request(fd: int, buf: bytes) -> Tuple[Optional[bytes], bytes]:
+    """Blocking read of one request frame; (None, _) on EOF/corruption."""
+    want: Optional[int] = None
+    while True:
+        if want is None and len(buf) >= _LEN.size:
+            want = _LEN.unpack_from(buf)[0]
+            if want > MAX_FRAME_BYTES:
+                return None, b""
+        if want is not None and len(buf) >= _LEN.size + want:
+            return buf[_LEN.size:_LEN.size + want], buf[_LEN.size + want:]
+        try:
+            chunk = os.read(fd, 65536)
+        except OSError:
+            return None, b""
+        if not chunk:
+            return None, b""
+        buf += chunk
+
+
+# How long a health request waits synchronously for the probe before
+# answering "warming" and letting a later request collect the result —
+# the same bounded first-probe wait the in-process path uses
+# (lm/health.FIRST_PROBE_WAIT_S): steady-state probes (kernels compiled)
+# finish far inside it, while a cold XLA compile (tens of seconds on
+# real chips) must never hold the RPC past the engine's labeler deadline
+# — a deadline miss SIGKILLs the worker, and a compile that is killed
+# and restarted every cycle would never converge.
+HEALTH_WAIT_S = 2.0
+
+
+class _HealthProbe:
+    """Worker-side async burn-in: one probe thread at a time; requests
+    collect the outcome when ready and get ``warming`` in between."""
+
+    def __init__(self, chip_lock: threading.Lock):
+        self._chip_lock = chip_lock
+        self._thread: Optional[threading.Thread] = None
+        self._outcome: Optional[dict] = None
+
+    def _run(self, devices) -> None:
+        from gpu_feature_discovery_tpu.ops.healthcheck import (
+            measure_node_health,
+        )
+
+        t0 = time.perf_counter()
+        try:
+            with self._chip_lock:
+                report = measure_node_health(devices=devices)
+        except Exception as e:  # noqa: BLE001 - shipped to the parent
+            self._outcome = {
+                "status": "probe-failed",
+                "error": str(e),
+                "probe_ms": (time.perf_counter() - t0) * 1e3,
+            }
+            return
+        self._outcome = {
+            "status": "ok",
+            "report": report,
+            "probe_ms": (time.perf_counter() - t0) * 1e3,
+        }
+
+    def request(self) -> dict:
+        """One ``health`` RPC. Outcome vocabulary mirrors lm/health.py's
+        in-process distinctions: ``unacquirable`` (says nothing about
+        chip health) vs ``probe-failed`` (devices acquired, computation
+        failed — the honest health.ok=false signal) vs ``ok`` with the
+        report — plus ``warming`` while the probe (or the kernel
+        pre-warm holding the chip lock) is still running."""
+        if self._thread is not None:
+            self._thread.join(HEALTH_WAIT_S)
+            if self._thread.is_alive():
+                return {"status": "warming"}
+            self._thread = None
+            outcome, self._outcome = self._outcome, None
+            return outcome or {"status": "probe-failed", "error": "probe thread died"}
+        from gpu_feature_discovery_tpu.lm.health import _acquire_tpu_devices
+
+        devices = _acquire_tpu_devices()
+        if devices is None:
+            return {"status": "unacquirable"}
+        self._thread = threading.Thread(
+            target=self._run, args=(devices,),
+            name="tfd-broker-health", daemon=True,
+        )
+        self._thread.start()
+        return self.request()
+
+
+def _child_prewarm(chip_lock: threading.Lock) -> None:
+    """Warm-start: pre-compile the probe kernels right after init, OFF the
+    label-serving path (a background thread — ``snapshot`` requests serve
+    immediately while this compiles), so the first health cycle no longer
+    eats ``first_probe_compile_ms``. Rides the persistent compilation
+    cache (utils/jaxenv.py) when TFD_COMPILATION_CACHE_DIR is set. Purely
+    an optimization: any failure is swallowed — the first health request
+    then compiles lazily, exactly as before."""
+    try:
+        from gpu_feature_discovery_tpu.utils.jaxenv import (
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache()
+        from gpu_feature_discovery_tpu.lm.health import _acquire_tpu_devices
+
+        devices = _acquire_tpu_devices()
+        if devices is None:
+            return
+        from gpu_feature_discovery_tpu.ops.healthcheck import (
+            warm_probe_kernels_for,
+        )
+
+        with chip_lock:
+            warm_ms = warm_probe_kernels_for(tuple(devices))
+        log.info("broker worker pre-warmed probe kernels in %.0f ms", warm_ms)
+    except Exception:  # noqa: BLE001 - warm-start is best-effort
+        log.debug("broker kernel pre-warm failed:", exc_info=True)
+
+
+def _child_main(req_r: int, resp_w: int, config) -> None:
+    """The worker body: select + init the backend ONCE, report ready,
+    then serve requests until EOF or a shutdown request. Never returns —
+    every path leaves through os._exit (no atexit, no pytest finalizers,
+    same contract as the one-shot probe child)."""
+    from gpu_feature_discovery_tpu.resource import factory
+
+    try:
+        manager = factory.select_manager(config)
+        manager.init()
+    except BaseException as e:  # noqa: BLE001 - shipped to the parent
+        try:
+            _write_frame(
+                resp_w,
+                {
+                    "status": "error",
+                    "error_type": type(e).__name__,
+                    "error": str(e),
+                },
+            )
+        except OSError:
+            pass
+        os._exit(1)
+    _write_frame(resp_w, {"status": "ready"})
+
+    # Serializes the chip between the warm-up thread and health requests:
+    # both compile/execute on the held client, and two concurrent probes
+    # would double-seize the device.
+    chip_lock = threading.Lock()
+    health_probe = _HealthProbe(chip_lock)
+    if config.flags.tfd.with_burnin:
+        threading.Thread(
+            target=_child_prewarm,
+            args=(chip_lock,),
+            name="tfd-broker-prewarm",
+            daemon=True,
+        ).start()
+
+    buf = b""
+    while True:
+        frame, buf = _child_read_request(req_r, buf)
+        if frame is None:
+            os._exit(0)  # parent closed the pipe (or sent garbage)
+        try:
+            req = json.loads(frame.decode())
+        except ValueError:
+            os._exit(1)
+        if req.get("hang"):
+            # broker.hang: a wedged native call mid-request; only the
+            # parent's SIGKILL at the deadline ends this.
+            while True:
+                time.sleep(3600)
+        if req.get("crash"):
+            # broker.crash: a real signal death mid-request. Default
+            # action restored first — instant deterministic death (see
+            # the injected-segv note in BrokerClient._spawn).
+            signal.signal(signal.SIGSEGV, signal.SIG_DFL)
+            os.kill(os.getpid(), signal.SIGSEGV)
+        op = req.get("op")
+        try:
+            if op == "ping":
+                resp = {"status": "ok"}
+            elif op == "snapshot":
+                resp = {
+                    "status": "ok",
+                    "snapshot": DeviceSnapshot.from_manager(manager).to_dict(),
+                }
+            elif op == "health":
+                resp = health_probe.request()
+            elif op == "shutdown":
+                try:
+                    _write_frame(resp_w, {"status": "ok"})
+                except OSError:
+                    pass
+                os._exit(0)
+            else:
+                resp = {
+                    "status": "error",
+                    "error_type": "BrokerError",
+                    "error": f"unknown op {op!r}",
+                }
+        except BaseException as e:  # noqa: BLE001 - a transient op failure
+            # must not kill the held client; the parent decides whether
+            # to degrade the cycle or recycle the worker.
+            resp = {
+                "status": "error",
+                "error_type": type(e).__name__,
+                "error": str(e),
+            }
+        try:
+            _write_frame(resp_w, resp)
+        except OSError:
+            os._exit(0)
+
+
+# ---------------------------------------------------------------------------
+# the client (parent) side
+# ---------------------------------------------------------------------------
+
+class BrokerClient:
+    """Parent-side handle on the broker worker. Thread-safe: requests are
+    serialized under one lock (the engine's health worker and the run
+    loop's snapshot refresh may overlap); ``kill_child`` takes only the
+    pid lock so a deadline-escalation cancel can fire while a request is
+    blocked mid-read."""
+
+    def __init__(self, config):
+        from gpu_feature_discovery_tpu.config.flags import (
+            DEFAULT_INIT_BACKOFF_MAX,
+            DEFAULT_PROBE_TIMEOUT,
+        )
+        from gpu_feature_discovery_tpu.utils.retry import BackoffPolicy
+
+        tfd = config.flags.tfd
+        self._config = config
+        self._timeout_s = (
+            tfd.probe_timeout
+            if tfd.probe_timeout is not None
+            else DEFAULT_PROBE_TIMEOUT
+        )
+        self._max_requests = tfd.broker_max_requests or 0
+        backoff_cap = (
+            tfd.init_backoff_max
+            if tfd.init_backoff_max is not None
+            else DEFAULT_INIT_BACKOFF_MAX
+        )
+        # Respawn pacing: capped backoff against a crash-looping native
+        # stack, deliberately at HALF the supervisor's schedule (same
+        # base/cap halved, jitter off). The supervisor already paces
+        # acquisition attempts with its own jittered policy (lower bound
+        # 0.9x of the raw delay), so a supervisor-driven retry must
+        # ALWAYS find this window open — a broker-side refusal would
+        # surface as an extra init failure the fault budget never
+        # injected. The half-schedule still refuses genuinely unpaced
+        # hot-loops (an embedder retrying in a tight loop).
+        self._policy = BackoffPolicy(
+            base=min(1.0, backoff_cap) / 2.0,
+            cap=backoff_cap / 2.0,
+            jitter=0.0,
+        )
+        self._lock = threading.Lock()       # serializes requests/spawn
+        self._pid_lock = threading.Lock()   # pid + inflight flag only
+        self._pid: Optional[int] = None
+        # A worker mid-spawn (forked, READY not yet seen): kill_child
+        # must be able to reach it too — PJRT init is the hang-prone
+        # step, and a deadline escalation that lands during a respawn
+        # must not be a silent no-op.
+        self._spawning: Optional[int] = None
+        self._req_w: Optional[int] = None
+        self._reader: Optional[_FrameReader] = None
+        self._resp_r: Optional[int] = None
+        self._stderr_path: Optional[str] = None
+        self._inflight = False
+        self._served = 0
+        self._spawn_failures = 0
+        self._next_spawn = 0.0
+        self._ever_spawned = False
+
+    # -- lifecycle --------------------------------------------------------
+
+    @property
+    def alive(self) -> bool:
+        with self._pid_lock:
+            return self._pid is not None
+
+    @property
+    def pid(self) -> Optional[int]:
+        with self._pid_lock:
+            return self._pid
+
+    def _ensure_running(self) -> None:
+        """Spawn the worker if none is live. Caller holds ``_lock``."""
+        with self._pid_lock:
+            if self._pid is not None:
+                return
+        self._spawn()
+
+    def _spawn(self) -> None:
+        from gpu_feature_discovery_tpu import sandbox
+        from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+        from gpu_feature_discovery_tpu.utils import faults
+
+        now = time.monotonic()
+        if now < self._next_spawn:
+            raise BrokerError(
+                f"broker respawn backing off for another "
+                f"{self._next_spawn - now:.3f}s after "
+                f"{self._spawn_failures} consecutive failure(s)"
+            )
+        # The spawn IS the backend acquisition: the init-attempt metric
+        # and the pjrt_init fault site fire here, in the parent, exactly
+        # once per worker lifetime — a rebuild that reuses the live
+        # worker fires neither (the acceptance invariant).
+        obs_metrics.BACKEND_INIT_ATTEMPTS.inc()
+        try:
+            faults.maybe_inject("pjrt_init")
+            if faults.consume("probe.timeout"):
+                raise BrokerTimeout(
+                    f"injected fault at 'probe.timeout' "
+                    f"({faults.FAULT_SPEC_ENV}): broker spawn treated as "
+                    f"exceeding its {self._timeout_s:.1f}s budget"
+                )
+        except BaseException:
+            self._spawn_failed(now)
+            raise
+        hang = faults.consume("probe.hang")
+        segv = False if hang else faults.consume("probe.segv")
+
+        req_r, req_w = os.pipe()
+        resp_r, resp_w = os.pipe()
+        stderr_file = tempfile.NamedTemporaryFile(
+            prefix="tfd-broker-stderr-", delete=False
+        )
+        start = time.monotonic()
+        pid = os.fork()
+        if pid == 0:
+            # -- child ----------------------------------------------------
+            try:
+                os.close(req_w)
+                os.close(resp_r)
+                # The worker must die to a group SIGTERM instead of
+                # queueing it on the parent's inherited signal handler.
+                for s in (signal.SIGTERM, signal.SIGINT, signal.SIGHUP,
+                          signal.SIGQUIT):
+                    signal.signal(s, signal.SIG_DFL)
+                os.dup2(stderr_file.fileno(), 2)
+                sys.stderr = os.fdopen(2, "w", buffering=1, closefd=False)
+                try:
+                    import faulthandler
+
+                    faulthandler.enable(file=sys.stderr, all_threads=False)
+                except Exception:  # noqa: BLE001 - diagnostics only
+                    pass
+                if hang:
+                    while True:
+                        time.sleep(3600)
+                if segv:
+                    # Injected crash: reset SIGSEGV to the default action
+                    # first so the kernel kills the child INSTANTLY. The
+                    # faulthandler dump adds nothing for an injected
+                    # fault, and under load its stack walk in a
+                    # fork-from-threads child can wedge past the probe
+                    # budget — turning a deterministic crash scenario
+                    # into a flaky deadline kill. Real native crashes
+                    # still dump through faulthandler.
+                    signal.signal(signal.SIGSEGV, signal.SIG_DFL)
+                    os.kill(os.getpid(), signal.SIGSEGV)
+                _child_main(req_r, resp_w, self._config)
+            except BaseException:  # noqa: BLE001 - never unwind into pytest
+                pass
+            finally:
+                os._exit(1)
+
+        # -- parent -----------------------------------------------------
+        os.close(req_r)
+        os.close(resp_w)
+        stderr_file.close()
+        # Registered like any probe child (kills must go through the
+        # registry's recycled-pid discipline) but exempt from the
+        # epoch-close sweep: the broker outlives individual acquisitions
+        # and is closed gracefully by close(), never by the sweep.
+        sandbox.probe._register(pid)
+        sandbox.probe.exempt_from_sweep(pid)
+        with self._pid_lock:
+            self._spawning = pid
+        reader = _FrameReader(resp_r)
+        try:
+            frame = reader.read(start + self._timeout_s)
+        except BrokerCrash:
+            frame = b""
+        finally:
+            with self._pid_lock:
+                self._spawning = None
+        duration = time.monotonic() - start
+        obs_metrics.PROBE_DURATION.observe(duration)
+
+        def _fail_cleanup():
+            os.close(req_w)
+            os.close(resp_r)
+            try:
+                os.unlink(stderr_file.name)
+            except OSError:
+                pass
+            self._spawn_failed(time.monotonic())
+
+        if frame is None:
+            # Deadline with no READY: hard-kill. The worker may ALSO be
+            # already dead (crash whose EOF we lost the race to) —
+            # waitpid decides, same as the one-shot probe's timeout path.
+            sandbox.probe.kill_if_live(pid)
+            status = self._reap(pid)
+            tail = _stderr_tail(stderr_file.name)
+            _fail_cleanup()
+            if status is not None and os.WIFSIGNALED(status) and (
+                os.WTERMSIG(status) != signal.SIGKILL
+            ):
+                obs_metrics.PROBE_CRASHES.inc()
+                signame = signal.Signals(os.WTERMSIG(status)).name
+                raise BrokerCrash(
+                    f"broker worker died to {signame} during init after "
+                    f"{duration:.2f}s"
+                    + (f"; worker stderr tail:\n{tail}" if tail else "")
+                )
+            obs_metrics.PROBE_KILLS.inc()
+            raise BrokerTimeout(
+                f"broker worker init exceeded its {self._timeout_s:.1f}s "
+                f"budget and was SIGKILLed after {duration:.1f}s"
+                + (f"; worker stderr tail:\n{tail}" if tail else "")
+            )
+        if frame == b"":
+            sandbox.probe.kill_if_live(pid)
+            status = self._reap(pid)
+            tail = _stderr_tail(stderr_file.name)
+            _fail_cleanup()
+            if status is not None and os.WIFSIGNALED(status):
+                obs_metrics.PROBE_CRASHES.inc()
+                signame = signal.Signals(os.WTERMSIG(status)).name
+                raise BrokerCrash(
+                    f"broker worker died to {signame} during init after "
+                    f"{duration:.2f}s"
+                    + (f"; worker stderr tail:\n{tail}" if tail else "")
+                )
+            raise BrokerError(
+                "broker worker exited during init without reporting"
+                + (f"; worker stderr tail:\n{tail}" if tail else "")
+            )
+        try:
+            doc = json.loads(frame.decode())
+        except ValueError:
+            sandbox.probe.kill_if_live(pid)
+            self._reap(pid)
+            _fail_cleanup()
+            raise BrokerCrash("broker worker sent an unparseable ready frame")
+        if doc.get("status") != "ready":
+            self._reap(pid)
+            _fail_cleanup()
+            raise ResourceError(
+                f"broker worker init failed: "
+                f"{doc.get('error_type', 'Exception')}: {doc.get('error', '')}"
+            )
+        respawn = self._ever_spawned
+        self._ever_spawned = True
+        if respawn:
+            obs_metrics.BROKER_RESPAWNS.inc()
+        self._spawn_failures = 0
+        self._next_spawn = 0.0
+        self._served = 0
+        with self._pid_lock:
+            self._pid = pid
+        self._req_w = req_w
+        self._resp_r = resp_r
+        self._reader = reader
+        self._stderr_path = stderr_file.name
+        obs_metrics.BROKER_UP.set(1)
+        log.info(
+            "broker worker %d ready in %.0f ms%s",
+            pid,
+            duration * 1e3,
+            " (respawn)" if respawn else "",
+        )
+
+    def _spawn_failed(self, now: float) -> None:
+        self._spawn_failures += 1
+        delay = self._policy.delay(min(self._spawn_failures - 1, 63))
+        self._next_spawn = now + delay
+
+    def _reap(self, pid: int) -> Optional[int]:
+        """Discard-then-reap, the registry invariant: a pid leaves the
+        kill-eligible set BEFORE waitpid can recycle it."""
+        from gpu_feature_discovery_tpu import sandbox
+
+        sandbox.probe.unexempt_from_sweep(pid)
+        sandbox.probe._discard(pid)
+        deadline = time.monotonic() + 5.0
+        while time.monotonic() < deadline:
+            try:
+                done, status = os.waitpid(pid, os.WNOHANG)
+            except ChildProcessError:
+                return None
+            if done == pid:
+                return status
+            time.sleep(0.005)
+        return None
+
+    def _mark_dead(self) -> None:
+        """Forget the worker after its death was observed (already killed
+        and reaped by the caller). Closes the parent-side fds."""
+        from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+        with self._pid_lock:
+            self._pid = None
+            self._inflight = False
+        for fd in (self._req_w, self._resp_r):
+            if fd is not None:
+                try:
+                    os.close(fd)
+                except OSError:
+                    pass
+        self._req_w = self._resp_r = None
+        self._reader = None
+        if self._stderr_path:
+            try:
+                os.unlink(self._stderr_path)
+            except OSError:
+                pass
+        self._stderr_path = None
+        obs_metrics.BROKER_UP.set(0)
+
+    # -- the RPC ----------------------------------------------------------
+
+    def request(self, op: str, timeout_s: Optional[float] = None) -> dict:
+        """One request/response round trip under the SIGKILL deadline.
+        Raises BrokerTimeout (worker killed), BrokerCrash (worker died or
+        framed garbage), or ResourceError (the op itself failed in the
+        worker — the worker stays up)."""
+        from gpu_feature_discovery_tpu import sandbox
+        from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+        from gpu_feature_discovery_tpu.utils import faults
+
+        budget = timeout_s if timeout_s is not None else self._timeout_s
+        with self._lock:
+            self._ensure_running()
+            payload = {"op": op}
+            if faults.consume("broker.hang"):
+                payload["hang"] = True
+            elif faults.consume("broker.crash"):
+                payload["crash"] = True
+            pid = self.pid
+            stderr_path = self._stderr_path
+            start = time.monotonic()
+            with self._pid_lock:
+                self._inflight = True
+            try:
+                try:
+                    _write_frame(self._req_w, payload)
+                except OSError:
+                    # EPIPE: the worker already died between requests
+                    # (e.g. a SIGTERM addressed to it) — reap and report
+                    # how it went, same vocabulary as a mid-request death.
+                    sandbox.probe.kill_if_live(pid)
+                    status = self._reap(pid)
+                    self._mark_dead()
+                    if status is not None and os.WIFSIGNALED(status):
+                        signame = signal.Signals(os.WTERMSIG(status)).name
+                        raise BrokerCrash(
+                            f"broker worker died to {signame} before the "
+                            f"{op!r} request"
+                        )
+                    raise BrokerCrash(
+                        "broker worker pipe closed before the request"
+                    )
+                try:
+                    frame = self._reader.read(start + budget)
+                except BrokerCrash:
+                    obs_metrics.BROKER_REQUEST_DURATION.observe(
+                        time.monotonic() - start
+                    )
+                    sandbox.probe.kill_if_live(pid)
+                    self._reap(pid)
+                    self._mark_dead()
+                    raise
+                duration = time.monotonic() - start
+                # Every outcome that reached the worker lands in the
+                # histogram — the deadline-kill tail is exactly the
+                # latency an operator needs to see, not only the happy
+                # path.
+                obs_metrics.BROKER_REQUEST_DURATION.observe(duration)
+                if frame is None:
+                    # Deadline: the request wedged (native hang) — the
+                    # same SIGKILL contract as a one-shot probe child.
+                    # waitpid still decides: a worker that died to its
+                    # OWN signal just before the deadline reports as a
+                    # crash, not a timeout.
+                    sandbox.probe.kill_if_live(pid)
+                    status = self._reap(pid)
+                    tail = _stderr_tail(stderr_path or "")
+                    self._mark_dead()
+                    if status is not None and os.WIFSIGNALED(status) and (
+                        os.WTERMSIG(status) != signal.SIGKILL
+                    ):
+                        signame = signal.Signals(os.WTERMSIG(status)).name
+                        raise BrokerCrash(
+                            f"broker worker died to {signame} during "
+                            f"{op!r} after {duration:.2f}s"
+                            + (f"; worker stderr tail:\n{tail}"
+                               if tail else "")
+                        )
+                    raise BrokerTimeout(
+                        f"broker {op!r} request exceeded its {budget:.1f}s "
+                        f"budget; worker SIGKILLed after {duration:.1f}s"
+                        + (f"; worker stderr tail:\n{tail}" if tail else "")
+                    )
+                if frame == b"":
+                    # EOF: the worker exited (or wedged with a closed
+                    # pipe — kill first so the reap is bounded).
+                    sandbox.probe.kill_if_live(pid)
+                    status = self._reap(pid)
+                    tail = _stderr_tail(stderr_path or "")
+                    self._mark_dead()
+                    if status is not None and os.WIFSIGNALED(status):
+                        signame = signal.Signals(os.WTERMSIG(status)).name
+                        raise BrokerCrash(
+                            f"broker worker died to {signame} during "
+                            f"{op!r} after {duration:.2f}s"
+                            + (f"; worker stderr tail:\n{tail}" if tail else "")
+                        )
+                    raise BrokerCrash(
+                        f"broker worker closed the pipe during {op!r}"
+                        + (f"; worker stderr tail:\n{tail}" if tail else "")
+                    )
+                try:
+                    doc = json.loads(frame.decode())
+                except ValueError:
+                    sandbox.probe.kill_if_live(pid)
+                    self._reap(pid)
+                    self._mark_dead()
+                    raise BrokerCrash(
+                        f"broker worker returned an unparseable {op!r} "
+                        "response frame"
+                    )
+            finally:
+                with self._pid_lock:
+                    self._inflight = False
+            obs_metrics.BROKER_REQUESTS.inc()
+            self._served += 1
+            if self._max_requests and self._served >= self._max_requests:
+                # Proactive recycle OFF the failure path: close the aged
+                # worker now; the next request respawns fresh.
+                log.info(
+                    "broker worker %s served %d requests "
+                    "(--broker-max-requests); recycling",
+                    pid,
+                    self._served,
+                )
+                self._close_worker_locked()
+            if doc.get("status") == "error":
+                raise ResourceError(
+                    f"broker {op!r} failed in worker: "
+                    f"{doc.get('error_type', 'Exception')}: "
+                    f"{doc.get('error', '')}"
+                )
+            return doc
+
+    def snapshot(self) -> DeviceSnapshot:
+        doc = self.request("snapshot")
+        return DeviceSnapshot.from_dict(doc.get("snapshot") or {})
+
+    def health(self) -> dict:
+        """The burn-in probe, executed in the worker. Returns the child's
+        outcome document (status ok | unacquirable | probe-failed)."""
+        return self.request("health")
+
+    def ping(self) -> bool:
+        return self.request("ping").get("status") == "ok"
+
+    def kill_child(self) -> None:
+        """The engine's cancel→kill hook (LabelSource.cancel): SIGKILL the
+        worker when a broker-routed labeler misses its deadline. Only
+        fires while a request is actually in flight — a cancel racing a
+        completed request must not execute a healthy idle worker. The
+        blocked request thread sees EOF and raises; the next use
+        respawns. Takes only the pid lock, never the request lock the
+        blocked thread holds."""
+        from gpu_feature_discovery_tpu import sandbox
+
+        with self._pid_lock:
+            pid = self._pid if self._inflight else None
+            if pid is None:
+                # A respawn blocked in PJRT init is just as killable:
+                # the spawn's READY read sees EOF and fails promptly.
+                pid = self._spawning
+        if pid is None:
+            return
+        if sandbox.probe.kill_if_live(pid):
+            log.warning(
+                "SIGKILLed broker worker %d (deadline escalation)", pid
+            )
+
+    def _close_worker_locked(self) -> None:
+        """Graceful worker shutdown; caller holds ``_lock``. Sends the
+        shutdown op, waits briefly, escalates to SIGKILL."""
+        from gpu_feature_discovery_tpu import sandbox
+
+        with self._pid_lock:
+            pid = self._pid
+        if pid is None:
+            return
+        try:
+            _write_frame(self._req_w, {"op": "shutdown"})
+            self._reader.read(time.monotonic() + GRACEFUL_CLOSE_S)
+        except (OSError, BrokerCrash):
+            pass
+        try:
+            os.close(self._req_w)  # EOF: belt and braces
+        except OSError:
+            pass
+        self._req_w = None
+        # Withdraw from the registry BEFORE reaping (the discard-before-
+        # reap invariant: a reaped pid is recyclable, so it must already
+        # be invisible to the sweep and to cancel hooks by then). Close
+        # is the pid's sole owner from here — it holds the request lock,
+        # kill_child is inflight-gated off, and an unregistered pid is
+        # untouchable through the registry — so the direct SIGKILL
+        # fallback below can never land on a recycled pid: WE are the
+        # parent, and the pid cannot recycle until we waitpid it.
+        sandbox.probe._discard(pid)
+        sandbox.probe.unexempt_from_sweep(pid)
+        deadline = time.monotonic() + GRACEFUL_CLOSE_S
+        reaped = False
+        while time.monotonic() < deadline:
+            try:
+                done, _status = os.waitpid(pid, os.WNOHANG)
+            except ChildProcessError:
+                reaped = True
+                break
+            if done == pid:
+                reaped = True
+                break
+            time.sleep(0.005)
+        if not reaped:
+            # Did not honor the shutdown: hard-kill and reap.
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+            try:
+                os.waitpid(pid, 0)
+            except ChildProcessError:
+                pass
+        self._mark_dead()
+
+    def close(self) -> None:
+        """Retire the broker: graceful shutdown, SIGKILL fallback, reap.
+        Idempotent; the daemon loop calls it at epoch end (SIGHUP close)
+        so a reload rebuilds the worker under the new config."""
+        with self._lock:
+            self._close_worker_locked()
+
+
+class BrokerManager(SnapshotManager):
+    """The Manager the daemon labels through when the broker is on. Same
+    label-for-label contract as SnapshotManager (the identity tests pin
+    it), with one upgrade: ``init()`` — which new_label_sources calls at
+    the top of every cycle — refreshes the snapshot with one ``snapshot``
+    RPC off the worker's held client, so every cycle labels from a FRESH
+    enumeration (the reference GFD's query-NVML-each-loop shape) instead
+    of the acquisition-time freeze. A refresh failure raises ResourceError
+    and the supervisor contains it like any cycle fault."""
+
+    def __init__(self, client: BrokerClient):
+        self.broker = client
+        super().__init__(client.snapshot())
+
+    def init(self) -> None:
+        snapshot = self.broker.snapshot()
+        self._snapshot = snapshot
+        self._chips = [SnapshotChip(c) for c in snapshot.chips]
+
+    def shutdown(self) -> None:
+        pass  # the worker holds the client; close_broker retires it
+
+
+# ---------------------------------------------------------------------------
+# mode resolution + the per-epoch active broker
+# ---------------------------------------------------------------------------
+
+def broker_mode(config) -> str:
+    """Resolve ``--probe-broker`` to on|off. ``auto`` (the default) is on
+    for the supervised daemon and off for oneshot — a one-off labeling
+    Job has no second acquisition to amortize."""
+    tfd = config.flags.tfd
+    mode = tfd.probe_broker or "auto"
+    if mode != "auto":
+        return mode
+    return "off" if tfd.oneshot else "on"
+
+
+def broker_enabled(config) -> bool:
+    """True when acquisitions should go through the broker: broker mode
+    on AND the sandbox active (``isolation_mode`` == subprocess; the
+    import is deferred because isolation_mode consults broker_mode for
+    the burn-in interaction)."""
+    from gpu_feature_discovery_tpu.sandbox.probe import isolation_mode
+
+    return broker_mode(config) == "on" and isolation_mode(config) == "subprocess"
+
+
+_active_lock = threading.Lock()
+_active: Optional[BrokerClient] = None
+
+
+def get_broker(config) -> BrokerClient:
+    """The process's active broker client, created on first use. One per
+    config epoch: ``close_broker()`` (run()'s finally) retires it, so a
+    SIGHUP reload builds a fresh worker under the new config."""
+    global _active
+    with _active_lock:
+        if _active is None:
+            _active = BrokerClient(config)
+        return _active
+
+
+def close_broker() -> None:
+    """Epoch teardown: gracefully retire the active broker (no-op when
+    none exists). Runs BEFORE the stray-child sweep in run()'s finally —
+    the sweep exemption covers the window in between."""
+    global _active
+    with _active_lock:
+        client, _active = _active, None
+    if client is not None:
+        client.close()
+
+
+def acquire_broker_manager(config) -> Manager:
+    """The broker-path acquisition unit (cmd/main._build_manager): ensure
+    the worker is up (spawn = the one PJRT init, with the pjrt_init fault
+    site and init-attempt metric) and wrap a fresh snapshot. With a live
+    worker this is one RPC — no fork, no init."""
+    return BrokerManager(get_broker(config))

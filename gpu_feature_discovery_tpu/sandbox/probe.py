@@ -85,6 +85,25 @@ _LEN = struct.Struct(">I")
 _live_lock = threading.Lock()
 _live_children: Set[int] = set()
 
+# Registered pids the epoch-close sweep must NOT kill: the persistent
+# broker worker (sandbox/broker.py) registers here for the recycled-pid
+# kill discipline but deliberately outlives individual acquisitions — it
+# is closed GRACEFULLY by close_broker() in run()'s teardown, and a sweep
+# SIGKILL would read as a crash and provoke a respawn storm on every
+# SIGHUP reload.
+_sweep_exempt: Set[int] = set()
+
+
+def exempt_from_sweep(pid: int) -> None:
+    """Shield a registered pid from kill_stray_children (broker worker)."""
+    with _live_lock:
+        _sweep_exempt.add(pid)
+
+
+def unexempt_from_sweep(pid: int) -> None:
+    with _live_lock:
+        _sweep_exempt.discard(pid)
+
 
 class ProbeError(ResourceError):
     """Base: the sandboxed probe did not produce a snapshot."""
@@ -153,9 +172,13 @@ def kill_stray_children() -> int:
     killed = 0
     with _live_lock:
         for pid in sorted(_live_children):
+            if pid in _sweep_exempt:
+                # The live broker worker: closed gracefully by its owner
+                # (close_broker), never by the sweep.
+                continue
             if _kill_and_reap(pid):
                 killed += 1
-        _live_children.clear()
+        _live_children.intersection_update(_sweep_exempt)
     if killed:
         log.warning("killed %d stray probe child(ren) at epoch end", killed)
     return killed
@@ -230,7 +253,14 @@ def run_probe(
             if segv:
                 # Simulated native crash: a real signal death, so the
                 # parent exercises the same WIFSIGNALED path a libtpu
-                # SIGSEGV takes.
+                # SIGSEGV takes. Default action restored first: the
+                # faulthandler dump adds nothing for an INJECTED crash,
+                # and under load its stack walk in a fork-from-threads
+                # child can wedge past the probe budget, turning the
+                # deterministic crash scenario into a flaky deadline
+                # kill. Real native crashes still dump through the
+                # handler re-pointed above.
+                signal.signal(signal.SIGSEGV, signal.SIG_DFL)
                 os.kill(os.getpid(), signal.SIGSEGV)
             payload = fn()
             data = json.dumps({"status": "ok", "payload": payload}).encode()
@@ -481,20 +511,29 @@ def isolation_mode(config) -> str:
     oneshot, which keeps the oneshot/golden path byte-for-byte the
     reference's in-process probe.
 
-    ``--with-burnin`` also resolves auto to none: the burn-in probe
-    needs a live PJRT client IN the daemon process (its device handles,
-    probe workspaces, and compilation cache are process-resident by
-    design — ops/healthcheck.py), and a parent that holds the exclusive
-    chip would make every forked child's init fail, turning one
-    transient fault into permanently degraded labels. An EXPLICIT
-    ``--probe-isolation=subprocess`` still wins — the operator asked —
-    with the interaction documented in docs/operations.md."""
+    ``--with-burnin`` interaction: the burn-in probe needs a LIVE PJRT
+    client resident in its executing process (device handles, probe
+    workspaces, compilation cache — ops/healthcheck.py), and a parent
+    that holds the exclusive chip would make every forked child's init
+    fail. With the persistent broker ON (sandbox/broker.py, the daemon
+    default), the broker WORKER is that resident process — it holds the
+    client and executes the burn-in on request — so auto stays
+    subprocess: isolation and burn-in finally compose. Only with the
+    broker off (``--probe-broker=off``) does auto fall back to none
+    under burn-in, preserving the PR 4 behavior byte for byte. An
+    EXPLICIT ``--probe-isolation=subprocess`` always wins — the operator
+    asked — with the interaction documented in docs/operations.md."""
     tfd = config.flags.tfd
     mode = tfd.probe_isolation or "auto"
     if mode != "auto":
         return mode
-    if tfd.oneshot or tfd.with_burnin:
+    if tfd.oneshot:
         return "none"
+    if tfd.with_burnin:
+        from gpu_feature_discovery_tpu.sandbox.broker import broker_mode
+
+        if broker_mode(config) != "on":
+            return "none"
     return "subprocess"
 
 

@@ -34,8 +34,13 @@ Instrumented sites:
                        SIGKILL at --probe-timeout (the full kill path)
     probe.segv         sandbox probe child dies to a real SIGSEGV (the
                        native-crash containment path)
+    broker.hang        the persistent broker worker (sandbox/broker.py)
+                       hangs on ONE request; the parent SIGKILLs it at
+                       --probe-timeout and respawns on next use
+    broker.crash       the broker worker dies to a real SIGSEGV at one
+                       request (the crash-respawn path)
 
-The ``probe.*`` sites are BEHAVIORAL: the sandbox driver consumes them
+The ``probe.*`` and ``broker.*`` sites are BEHAVIORAL: the sandbox driver consumes them
 with ``consume()`` (countdown without raising) in the PARENT process and
 enacts the behavior in/around the forked child — a child-side countdown
 would decrement only the child's fork-copied registry and re-fire

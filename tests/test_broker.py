@@ -1,0 +1,694 @@
+"""Persistent probe broker acceptance + unit tests (ISSUE 5).
+
+Layers of evidence, all hermetic on CPU:
+
+1. RPC machinery (sandbox/broker.py): spawn/ready, snapshot/ping round
+   trips, per-request SIGKILL deadline, crash/EOF surfacing, respawn
+   with capped backoff, recycling after --broker-max-requests.
+2. Snapshot fidelity: labeling through a broker-acquired BrokerManager
+   is label-for-label identical to probing the live manager in-process.
+3. The acceptance scenario: with --probe-broker=on, a supervisor backend
+   rebuild after an injected cycle failure serves fresh (non-restored,
+   non-degraded) labels WITHOUT re-running PJRT init —
+   tfd_backend_init_attempts_total stays flat while
+   tfd_broker_requests_total advances; a broker.hang injection is killed
+   within --probe-timeout + 1s, respawned, and the node converges.
+4. --probe-broker=off restores the PR 4 fork-per-acquisition path: no
+   worker ever spawns, and the published labels are byte-identical.
+5. The burn-in routes through the worker (--with-burnin no longer forces
+   --probe-isolation=auto down to none) with cancel→kill wired.
+"""
+
+import os
+import queue
+import signal
+import threading
+import time
+
+import pytest
+
+import gpu_feature_discovery_tpu.cmd.main as cmd_main
+from gpu_feature_discovery_tpu import sandbox
+from gpu_feature_discovery_tpu.cmd.main import run
+from gpu_feature_discovery_tpu.cmd.supervisor import (
+    DEGRADED_LABEL,
+    RESTORED_LABEL,
+    Supervisor,
+    UNHEALTHY_CYCLES_LABEL,
+)
+from gpu_feature_discovery_tpu.config import new_config
+from gpu_feature_discovery_tpu.lm.labeler import Empty
+from gpu_feature_discovery_tpu.lm.tpu import new_tpu_labeler, tpu_label_sources
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+from gpu_feature_discovery_tpu.resource.testing import (
+    new_mixed_slice_manager,
+    new_single_host_manager,
+    new_uniform_slice_manager,
+)
+from gpu_feature_discovery_tpu.resource.types import ResourceError
+from gpu_feature_discovery_tpu.sandbox import (
+    BrokerClient,
+    BrokerCrash,
+    BrokerManager,
+    BrokerTimeout,
+)
+from gpu_feature_discovery_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_broker_and_faults():
+    faults.reset()
+    yield
+    faults.reset()
+    sandbox.close_broker()
+
+
+def cfg(tmp_path, **cli):
+    machine = tmp_path / "machine-type"
+    machine.write_text("Google Compute Engine\n")
+    values = {
+        "oneshot": False,
+        "machine-type-file": str(machine),
+        "output-file": str(tmp_path / "tfd"),
+        "sleep-interval": "0.01s",
+        "init-backoff-max": "0.02s",
+        "init-retries": "50",
+        "max-consecutive-failures": "50",
+    }
+    values.update(cli)
+    return new_config(cli_values=values, environ={})
+
+
+def labels_at(path):
+    try:
+        with open(path) as f:
+            return dict(line.strip().split("=", 1) for line in f if "=" in line)
+    except OSError:
+        return {}
+
+
+def wait_until(pred, timeout=10.0, interval=0.002):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def start_daemon(config, interconnect=None):
+    sigs = queue.Queue()
+    result = {}
+
+    def target():
+        try:
+            result["restart"] = run(
+                lambda: cmd_main._build_manager(config),
+                interconnect if interconnect is not None else Empty(),
+                config,
+                sigs,
+                supervisor=Supervisor(config),
+            )
+        except BaseException as e:  # noqa: BLE001 - surfaced by the test
+            result["error"] = e
+
+    t = threading.Thread(target=target)
+    t.start()
+    return t, sigs, result
+
+
+def stop_daemon(t, sigs, result):
+    sigs.put(signal.SIGTERM)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert "error" not in result, result.get("error")
+    return result
+
+
+def _pid_alive(pid):
+    try:
+        os.kill(pid, 0)
+        return True
+    except OSError:
+        return False
+
+
+# ---------------------------------------------------------------------------
+# layer 1: RPC machinery
+# ---------------------------------------------------------------------------
+
+def test_broker_spawn_serves_snapshot_and_ping(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    client = BrokerClient(cfg(tmp_path))
+    try:
+        assert client.ping() is True
+        snap = client.snapshot()
+        assert len(snap.chips) == 4
+        pid = client.pid
+        assert _pid_alive(pid)
+        # Requests reuse the SAME worker: no fork per request.
+        assert client.pid == pid
+    finally:
+        client.close()
+    assert not client.alive
+    assert not _pid_alive(pid)
+
+
+def test_broker_reuse_never_reinits_backend(tmp_path, monkeypatch):
+    """The perf contract: after the one spawn, acquisitions are RPCs —
+    tfd_backend_init_attempts_total stays flat while
+    tfd_broker_requests_total advances."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    obs_metrics.reset_for_tests()
+    config = cfg(tmp_path)
+    managers = [sandbox.acquire_broker_manager(config) for _ in range(3)]
+    for m in managers:
+        m.init()  # the per-cycle snapshot refresh
+        assert len(m.get_chips()) == 4
+    assert obs_metrics.BACKEND_INIT_ATTEMPTS.value() == 1, (
+        "acquisition through a live broker must not re-run PJRT init"
+    )
+    assert obs_metrics.BROKER_REQUESTS.value() >= 6  # 3 acquires + 3 refreshes
+    assert obs_metrics.BROKER_UP.value() == 1
+    sandbox.close_broker()
+    assert obs_metrics.BROKER_UP.value() == 0
+
+
+def test_broker_request_hang_killed_within_budget_and_respawns(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    obs_metrics.reset_for_tests()
+    client = BrokerClient(cfg(tmp_path, **{"probe-timeout": "0.3s"}))
+    try:
+        assert client.ping()
+        pid = client.pid
+        faults.load_fault_spec("broker.hang:fail:1")
+        t0 = time.monotonic()
+        with pytest.raises(BrokerTimeout):
+            client.ping()
+        elapsed = time.monotonic() - t0
+        assert elapsed < 0.3 + 1.0, f"kill took {elapsed:.2f}s"
+        assert not _pid_alive(pid)
+        assert not client.alive
+        # Next use respawns (the backoff only paces spawn FAILURES).
+        assert client.ping()
+        assert client.pid != pid
+        assert obs_metrics.BROKER_RESPAWNS.value() == 1
+    finally:
+        client.close()
+
+
+def test_broker_request_crash_surfaces_and_respawns(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    client = BrokerClient(cfg(tmp_path))
+    try:
+        assert client.ping()
+        faults.load_fault_spec("broker.crash:fail:1")
+        with pytest.raises(BrokerCrash) as e:
+            client.ping()
+        assert "SIGSEGV" in str(e.value)
+        assert client.ping()  # respawned
+    finally:
+        client.close()
+
+
+def test_broker_spawn_failure_backs_off(tmp_path, monkeypatch):
+    """A failed spawn opens a backoff window; retrying inside it is a
+    typed error (no fork), and the window reopens (cap 20 ms here)."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    client = BrokerClient(cfg(tmp_path))
+    try:
+        faults.load_fault_spec("pjrt_init:fail:1")
+        with pytest.raises(faults.FaultInjected):
+            client.ping()
+        with pytest.raises(ResourceError, match="backing off"):
+            client.ping()
+        assert wait_until(
+            lambda: time.sleep(0.02) or _try_ping(client), timeout=5
+        ), "spawn never recovered after the backoff window"
+    finally:
+        client.close()
+
+
+def _try_ping(client):
+    try:
+        return client.ping()
+    except ResourceError:
+        return False
+
+
+def test_broker_recycles_after_max_requests(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    obs_metrics.reset_for_tests()
+    client = BrokerClient(cfg(tmp_path, **{"broker-max-requests": "2"}))
+    try:
+        pids = set()
+        for _ in range(6):
+            client.ping()
+            if client.pid is not None:
+                pids.add(client.pid)
+        assert len(pids) >= 2, "worker never recycled at the request cap"
+        assert obs_metrics.BROKER_RESPAWNS.value() >= 2
+        # Recycling re-runs PJRT init (honestly counted).
+        assert obs_metrics.BACKEND_INIT_ATTEMPTS.value() >= 3
+    finally:
+        client.close()
+
+
+def test_broker_worker_dies_to_sigterm_not_parent_queue(tmp_path, monkeypatch):
+    """The worker resets inherited signal handlers: a SIGTERM addressed
+    to it must kill it (container shutdown sends the group a TERM), not
+    enqueue on the parent's fork-copied watcher state."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    client = BrokerClient(cfg(tmp_path))
+    try:
+        assert client.ping()
+        pid = client.pid
+        os.kill(pid, signal.SIGTERM)
+
+        def _zombie_or_gone():
+            try:
+                with open(f"/proc/{pid}/status") as f:
+                    return "State:\tZ" in f.read()
+            except OSError:
+                return True
+
+        assert wait_until(_zombie_or_gone, timeout=5), (
+            "worker ignored SIGTERM (inherited parent handler state?)"
+        )
+        # The next request observes the death (reaping the zombie) and
+        # the one after respawns.
+        with pytest.raises(BrokerCrash, match="SIGTERM"):
+            client.ping()
+        assert not _pid_alive(pid), "death observed but worker not reaped"
+        assert client.ping()  # and the client recovers
+    finally:
+        client.close()
+
+
+def test_broker_close_is_idempotent_and_graceful(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    client = BrokerClient(cfg(tmp_path))
+    assert client.ping()
+    pid = client.pid
+    client.close()
+    client.close()  # idempotent
+    assert not _pid_alive(pid)
+    # No zombie left behind.
+    import subprocess
+
+    out = subprocess.run(
+        ["ps", "--ppid", str(os.getpid()), "-o", "stat="],
+        capture_output=True,
+        text=True,
+    ).stdout
+    assert not [s for s in out.split() if s.startswith("Z")]
+
+
+def test_kill_child_only_fires_while_request_inflight(tmp_path, monkeypatch):
+    """The cancel→kill hook must not execute a healthy IDLE worker: a
+    cancel racing a completed request is a no-op."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    client = BrokerClient(cfg(tmp_path))
+    try:
+        assert client.ping()
+        pid = client.pid
+        client.kill_child()  # idle: no-op
+        assert _pid_alive(pid)
+        assert client.ping()
+    finally:
+        client.close()
+
+
+def test_kill_child_unblocks_inflight_request(tmp_path, monkeypatch):
+    """Deadline escalation: cancel from another thread SIGKILLs the
+    worker mid-request and the blocked request raises promptly."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    client = BrokerClient(cfg(tmp_path, **{"probe-timeout": "30s"}))
+    result = {}
+    try:
+        assert client.ping()
+        faults.load_fault_spec("broker.hang:fail:1")
+
+        def target():
+            try:
+                client.ping()
+            except BaseException as e:  # noqa: BLE001 - inspected below
+                result["error"] = e
+
+        t = threading.Thread(target=target, daemon=True)
+        t.start()
+        assert wait_until(lambda: client._inflight, timeout=5)
+        time.sleep(0.05)  # let the request reach the hung worker
+        client.kill_child()
+        t.join(timeout=5)
+        assert not t.is_alive(), "request stayed blocked after the kill"
+        assert isinstance(result.get("error"), ResourceError)
+    finally:
+        client.close()
+
+
+# ---------------------------------------------------------------------------
+# layer 2: snapshot fidelity through the broker
+# ---------------------------------------------------------------------------
+
+BUILDERS = [
+    ("single-host", "mock:v4-8", lambda: new_single_host_manager("v4-8")),
+    ("uniform-slice", "mock-slice:v4-8",
+     lambda: new_uniform_slice_manager("v4-8")),
+    ("mixed", "mock-mixed:v5e", lambda: new_mixed_slice_manager("v5e")),
+]
+
+
+@pytest.mark.parametrize("strategy", ["none", "single", "mixed"])
+@pytest.mark.parametrize(
+    "name,backend,builder", BUILDERS, ids=[b[0] for b in BUILDERS]
+)
+def test_broker_labels_identical_to_live_manager(
+    tmp_path, monkeypatch, name, backend, builder, strategy
+):
+    monkeypatch.setenv("TFD_BACKEND", backend)
+    config = cfg(tmp_path, **{"tpu-topology-strategy": strategy})
+    live = dict(new_tpu_labeler(builder(), config).labels())
+    broker_mgr = sandbox.acquire_broker_manager(config)
+    brokered = dict(new_tpu_labeler(broker_mgr, config).labels())
+    assert brokered == live
+
+
+# ---------------------------------------------------------------------------
+# layer 3: the acceptance scenario
+# ---------------------------------------------------------------------------
+
+def test_acceptance_rebuild_reuses_live_broker(tmp_path, monkeypatch):
+    """ISSUE 5 acceptance: with --probe-broker=on, a supervisor backend
+    rebuild after an injected cycle failure serves fresh (non-restored,
+    non-degraded) labels WITHOUT re-running PJRT init —
+    tfd_backend_init_attempts_total stays flat while
+    tfd_broker_requests_total advances."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    obs_metrics.reset_for_tests()
+    config = cfg(tmp_path, **{"probe-broker": "on"})
+    out = config.flags.tfd.output_file
+    faults.load_fault_spec("generate:raise:RuntimeError:1")
+
+    t, sigs, result = start_daemon(config)
+    try:
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+            and DEGRADED_LABEL not in labels_at(out)
+            and RESTORED_LABEL not in labels_at(out)
+            and UNHEALTHY_CYCLES_LABEL not in labels_at(out)
+        ), f"did not converge to fresh labels: {labels_at(out)}"
+        assert obs_metrics.BACKEND_INIT_ATTEMPTS.value() == 1, (
+            "the rebuild after the failed cycle re-ran PJRT init instead "
+            "of reusing the live broker"
+        )
+        assert obs_metrics.BROKER_REQUESTS.value() >= 2, (
+            "acquisitions did not flow through the broker"
+        )
+    finally:
+        stop_daemon(t, sigs, result)
+
+
+def test_acceptance_broker_hang_killed_respawned_converges(
+    tmp_path, monkeypatch
+):
+    """ISSUE 5 acceptance: a broker.hang injection is killed within
+    --probe-timeout + 1s, the worker is respawned, and the node
+    converges to full labels."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    obs_metrics.reset_for_tests()
+    probe_timeout = 0.4
+    config = cfg(tmp_path, **{
+        "probe-broker": "on",
+        "probe-timeout": str(probe_timeout),
+    })
+    out = config.flags.tfd.output_file
+    faults.load_fault_spec("broker.hang:fail:1")
+
+    t, sigs, result = start_daemon(config)
+    try:
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+            and DEGRADED_LABEL not in labels_at(out)
+        ), f"did not converge after the hung request: {labels_at(out)}"
+        # Kill latency measured where it is defined: the request's own
+        # round-trip duration, straight from the histogram sum.
+        exposition = obs_metrics.REGISTRY.render()
+        dur_sum = next(
+            float(line.split(" ")[1])
+            for line in exposition.splitlines()
+            if line.startswith("tfd_broker_request_duration_seconds_sum ")
+        )
+        assert dur_sum < probe_timeout + 1.0, (
+            f"hung request held {dur_sum:.2f}s, past the "
+            f"{probe_timeout}s budget + 1s kill allowance"
+        )
+        assert wait_until(lambda: obs_metrics.BROKER_RESPAWNS.value() >= 1)
+        assert t.is_alive(), "daemon exited on the hung broker request"
+    finally:
+        stop_daemon(t, sigs, result)
+
+
+def test_acceptance_broker_crash_contained(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    obs_metrics.reset_for_tests()
+    config = cfg(tmp_path, **{"probe-broker": "on"})
+    out = config.flags.tfd.output_file
+    faults.load_fault_spec("broker.crash:fail:1")
+    t, sigs, result = start_daemon(config)
+    try:
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+            and DEGRADED_LABEL not in labels_at(out)
+        ), f"did not converge after the worker crash: {labels_at(out)}"
+        assert t.is_alive()
+    finally:
+        stop_daemon(t, sigs, result)
+
+
+# ---------------------------------------------------------------------------
+# layer 4: --probe-broker=off restores the PR 4 path byte-identically
+# ---------------------------------------------------------------------------
+
+def test_probe_broker_off_restores_fork_per_acquisition(tmp_path, monkeypatch):
+    """With the broker off, no worker ever spawns (tfd_broker_up stays 0,
+    no respawns, no requests) and the published labels are byte-identical
+    to the broker-on daemon's."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+
+    def daemon_output(subdir, broker_mode):
+        d = tmp_path / subdir
+        d.mkdir()
+        machine = d / "machine-type"
+        machine.write_text("Google Compute Engine\n")
+        config = new_config(
+            cli_values={
+                "oneshot": False,
+                "no-timestamp": True,  # the only per-run-varying label
+                "machine-type-file": str(machine),
+                "output-file": str(d / "tfd"),
+                "sleep-interval": "5s",
+                "probe-broker": broker_mode,
+            },
+            environ={},
+        )
+        t, sigs, result = start_daemon(config)
+        try:
+            assert wait_until(
+                lambda: labels_at(str(d / "tfd")).get("google.com/tpu.count")
+                == "4"
+            )
+            with open(d / "tfd", "rb") as f:
+                return f.read()
+        finally:
+            stop_daemon(t, sigs, result)
+
+    obs_metrics.reset_for_tests()
+    off_bytes = daemon_output("off", "off")
+    assert obs_metrics.BROKER_REQUESTS.value() == 0
+    assert obs_metrics.BROKER_RESPAWNS.value() == 0
+    assert obs_metrics.BROKER_UP.value() == 0
+    assert sandbox.broker._active is None, (
+        "--probe-broker=off must never instantiate a broker client"
+    )
+    on_bytes = daemon_output("on", "on")
+    assert on_bytes == off_bytes
+
+
+# ---------------------------------------------------------------------------
+# layer 5: the burn-in routes through the worker
+# ---------------------------------------------------------------------------
+
+def test_burnin_health_routed_through_broker_worker(tmp_path, monkeypatch):
+    """--with-burnin + broker: the health labeler issues a ``health`` RPC
+    instead of touching jax in the daemon process. On this CPU host the
+    worker honestly reports unacquirable (no TPU devices), so the cycle
+    publishes base labels without health — the same observable the
+    in-process path gives — while the request count proves the probe ran
+    in the worker."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    obs_metrics.reset_for_tests()
+    config = cfg(tmp_path, **{"with-burnin": True})
+    assert sandbox.isolation_mode(config) == "subprocess"
+    assert sandbox.broker_enabled(config)
+    manager = sandbox.acquire_broker_manager(config)
+    requests_before = obs_metrics.BROKER_REQUESTS.value()
+
+    from gpu_feature_discovery_tpu.lm.health import new_health_labeler
+
+    labels = new_health_labeler(manager, config).labels()
+    assert dict(labels) == {}, "CPU worker must publish no health labels"
+    assert obs_metrics.BROKER_REQUESTS.value() == requests_before + 1, (
+        "the health probe did not go through the broker"
+    )
+
+
+def test_burnin_source_carries_broker_cancel_hook(tmp_path, monkeypatch):
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    config = cfg(tmp_path, **{"with-burnin": True})
+    manager = sandbox.acquire_broker_manager(config)
+    sources = {s.name: s for s in tpu_label_sources(manager, config)}
+    assert sources["health"].cancel is not None, (
+        "broker-routed health source must expose cancel→kill"
+    )
+    assert sources["health"].offload is True
+    # Without burn-in the health source stays inline and uncancellable.
+    plain = cfg(tmp_path)
+    plain_manager = sandbox.acquire_broker_manager(plain)
+    plain_sources = {
+        s.name: s for s in tpu_label_sources(plain_manager, plain)
+    }
+    assert plain_sources["health"].cancel is None
+
+
+def test_burnin_daemon_cycle_with_broker_completes(tmp_path, monkeypatch):
+    """End to end: a burn-in daemon under auto isolation + auto broker
+    completes full cycles (health honestly absent on CPU) — the
+    composition PR 4 had to forbid."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    config = cfg(tmp_path, **{"with-burnin": True})
+    out = config.flags.tfd.output_file
+    t, sigs, result = start_daemon(config)
+    try:
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+            and DEGRADED_LABEL not in labels_at(out)
+        ), f"burn-in daemon never served full labels: {labels_at(out)}"
+    finally:
+        stop_daemon(t, sigs, result)
+
+
+# ---------------------------------------------------------------------------
+# epoch lifecycle: sweep exemption + graceful close (satellite 2 unit half;
+# the reload pin lives in tests/test_reload.py)
+# ---------------------------------------------------------------------------
+
+def test_sweep_exempts_live_broker_worker(tmp_path, monkeypatch):
+    """kill_stray_children must leave the live broker worker alone: it is
+    registered (kill discipline) but exempt — a sweep SIGKILL would read
+    as a crash and respawn-storm every SIGHUP reload."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    client = BrokerClient(cfg(tmp_path))
+    try:
+        assert client.ping()
+        pid = client.pid
+        killed = sandbox.kill_stray_children()
+        assert killed == 0
+        assert _pid_alive(pid), "sweep SIGKILLed the live broker worker"
+        assert client.ping(), "worker unusable after the sweep"
+    finally:
+        client.close()
+    assert not _pid_alive(pid)
+
+
+def test_broker_manager_is_snapshot_manager(tmp_path, monkeypatch):
+    """BrokerManager keeps the SnapshotManager contract (the supervisor
+    and labelers treat it identically); init() refreshes the snapshot."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    manager = sandbox.acquire_broker_manager(cfg(tmp_path))
+    from gpu_feature_discovery_tpu.sandbox import SnapshotManager
+
+    assert isinstance(manager, SnapshotManager)
+    assert isinstance(manager, BrokerManager)
+    first = manager.snapshot
+    manager.init()
+    assert manager.snapshot is not first, "init() must refresh the snapshot"
+    assert manager.snapshot.to_dict() == first.to_dict()
+    manager.shutdown()  # no-op: the worker holds the client
+    assert manager.broker.alive
+
+
+def test_worker_health_probe_answers_warming_while_compiling(monkeypatch):
+    """Review fix (first-probe protection, relocated into the worker): a
+    health request must answer within its bounded wait while the probe
+    is still compiling — 'warming', collected by a later request — so a
+    cold XLA compile can never hold the RPC past the engine's labeler
+    deadline and get the worker SIGKILLed every cycle."""
+    from gpu_feature_discovery_tpu.lm import health as lm_health
+    from gpu_feature_discovery_tpu.ops import healthcheck as hc
+    from gpu_feature_discovery_tpu.sandbox import broker as broker_mod
+
+    release = threading.Event()
+
+    def slow_measure(devices=None):
+        release.wait(30)
+        return {"healthy": True, "tflops": 10.0, "timing": "wall-clock"}
+
+    monkeypatch.setattr(lm_health, "_acquire_tpu_devices", lambda: ["dev"])
+    monkeypatch.setattr(hc, "measure_node_health", slow_measure)
+    monkeypatch.setattr(broker_mod, "HEALTH_WAIT_S", 0.05)
+
+    probe = broker_mod._HealthProbe(threading.Lock())
+    t0 = time.monotonic()
+    assert probe.request()["status"] == "warming"
+    assert time.monotonic() - t0 < 5.0, "health RPC blocked behind the compile"
+    assert probe.request()["status"] == "warming"  # still in flight
+    release.set()
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        outcome = probe.request()
+        if outcome["status"] != "warming":
+            break
+    assert outcome["status"] == "ok"
+    assert outcome["report"]["tflops"] == 10.0
+    # Collected exactly once; the next request starts a FRESH probe.
+    release.clear()
+    assert probe.request()["status"] == "warming"
+    release.set()
+
+
+def test_kill_child_reaches_worker_mid_spawn(tmp_path, monkeypatch):
+    """Review fix: a deadline escalation landing while the client is
+    respawning (PJRT init in flight — the hang-prone step) must kill the
+    spawning worker, not no-op until the spawn's own budget expires."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    client = BrokerClient(cfg(tmp_path, **{"probe-timeout": "30s"}))
+    faults.load_fault_spec("probe.hang:fail:1")
+    result = {}
+
+    def target():
+        try:
+            client.ping()
+        except BaseException as e:  # noqa: BLE001 - inspected below
+            result["error"] = e
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    try:
+        assert wait_until(lambda: client._spawning is not None, timeout=5), (
+            "spawn never reached the hang-prone window"
+        )
+        client.kill_child()
+        t.join(timeout=5)
+        assert not t.is_alive(), (
+            "request stayed blocked on the hung spawn after the kill"
+        )
+        assert isinstance(result.get("error"), ResourceError)
+        # The client recovers on next use.
+        assert wait_until(
+            lambda: time.sleep(0.03) or _try_ping(client), timeout=5
+        )
+    finally:
+        client.close()

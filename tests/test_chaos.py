@@ -26,6 +26,12 @@ CHAOS_SPECS = [
     "probe.hang:fail:1",
     "probe.segv:fail:1",
     "probe.timeout:fail:2",
+    # Persistent-broker sites (sandbox/broker.py): the long-lived worker
+    # hangs on one request (killed at --probe-timeout, respawned) or dies
+    # to a real SIGSEGV mid-request — both must converge like any other
+    # contained acquisition fault.
+    "broker.hang:fail:1",
+    "broker.crash:fail:1",
 ]
 
 

@@ -42,6 +42,8 @@ FILE_KEYS = {
     "probe-isolation": ("tfd", "probeIsolation"),
     "state-dir": ("tfd", "stateDir"),
     "flap-window": ("tfd", "flapWindow"),
+    "probe-broker": ("tfd", "probeBroker"),
+    "broker-max-requests": ("tfd", "brokerMaxRequests"),
 }
 
 # Two distinct valid raw values per flag (a wins the dominance checks).
@@ -57,6 +59,8 @@ VALUE_PAIRS = {
     "probe-timeout": ("5s", "8s"),
     "probe-isolation": ("none", "subprocess"),
     "flap-window": ("2", "4"),
+    "probe-broker": ("on", "off"),
+    "broker-max-requests": ("5", "9"),
 }
 
 

@@ -345,3 +345,178 @@ def test_helm_lite_fails_controlled_on_arbitrary_templates(
             f"template {''.join(fragments)!r}: {e}"
         ) from e
     assert isinstance(docs, list)
+
+
+# ---------------------------------------------------------------------------
+# broker RPC framing (sandbox/broker.py — ISSUE 5)
+# ---------------------------------------------------------------------------
+#
+# The broker pipe is a trust boundary with a crashed/corrupted worker on
+# the other side: whatever bytes arrive — truncated length prefixes,
+# oversized frames, junk JSON — the PARENT must surface a clean typed
+# error (ProbeCrash-style) and respawn on next use, never hang and never
+# crash.
+
+def _read_all_frames(data, deadline_s=0.5):
+    """Feed ``data`` into a pipe at EOF and drain the frame reader."""
+    import os as _os
+    import time as _time
+
+    from gpu_feature_discovery_tpu.sandbox.broker import _FrameReader
+
+    r_fd, w_fd = _os.pipe()
+    try:
+        _os.write(w_fd, data)
+    finally:
+        _os.close(w_fd)
+    reader = _FrameReader(r_fd)
+    frames = []
+    try:
+        deadline = _time.monotonic() + deadline_s
+        while True:
+            frame = reader.read(deadline)
+            if frame is None or frame == b"":
+                return frames, frame
+            frames.append(frame)
+    finally:
+        _os.close(r_fd)
+
+
+@given(st.binary(max_size=300))
+@settings(max_examples=300, deadline=None)
+def test_broker_frame_reader_arbitrary_bytes_never_hang_never_crash(data):
+    import time as _time
+
+    from gpu_feature_discovery_tpu.sandbox.broker import BrokerCrash
+
+    t0 = _time.monotonic()
+    try:
+        frames, tail = _read_all_frames(data)
+    except BrokerCrash:
+        pass  # the contract: oversized prefixes fail loudly and typed
+    else:
+        assert tail in (None, b"")
+        assert all(isinstance(f, bytes) for f in frames)
+    # A closed pipe must resolve promptly — EOF, not a deadline wait.
+    assert _time.monotonic() - t0 < 2.0
+
+
+@given(
+    # min_size=1: the real protocol frames JSON documents, never empty
+    # bodies — and the drain helper reads b"" as EOF.
+    st.lists(
+        st.binary(min_size=1, max_size=64), min_size=1, max_size=5
+    )
+)
+@settings(max_examples=200, deadline=None)
+def test_broker_frame_reader_roundtrips_wellformed_frames(bodies):
+    import struct as _struct
+
+    wire = b"".join(
+        _struct.pack(">I", len(b)) + b for b in bodies
+    )
+    frames, tail = _read_all_frames(wire)
+    assert frames == bodies
+    assert tail == b""  # exactly consumed, EOF after
+
+
+def test_broker_frame_reader_truncated_length_prefix_is_eof():
+    frames, tail = _read_all_frames(b"\x00\x00")
+    assert frames == [] and tail == b""
+
+
+def test_broker_frame_reader_truncated_body_is_eof_not_hang():
+    import struct as _struct
+
+    # Prefix promises 100 bytes, only 3 arrive before EOF (a worker that
+    # died mid-write): EOF, never a deadline-long wait.
+    frames, tail = _read_all_frames(_struct.pack(">I", 100) + b"abc")
+    assert frames == [] and tail == b""
+
+
+def test_broker_frame_reader_oversized_prefix_raises_typed_error():
+    import struct as _struct
+    import time as _time
+
+    from gpu_feature_discovery_tpu.sandbox.broker import BrokerCrash
+
+    t0 = _time.monotonic()
+    with pytest.raises(BrokerCrash):
+        _read_all_frames(_struct.pack(">I", 0xFFFFFFF0) + b"x" * 64)
+    # Rejected immediately off the prefix — no wait for 4 GiB that will
+    # never come.
+    assert _time.monotonic() - t0 < 1.0
+
+
+def test_broker_junk_json_response_clean_error_then_respawn(
+    tmp_path, monkeypatch
+):
+    """A worker that frames syntactically valid garbage (fuzzed JSON) is
+    treated exactly like a crash: typed error, worker killed + reaped,
+    next request respawns a fresh worker — the parent never hangs and
+    never believes the garbage."""
+    import os as _os
+    import struct as _struct
+    import time as _time
+
+    from gpu_feature_discovery_tpu.config import new_config
+    from gpu_feature_discovery_tpu.sandbox import broker as broker_mod
+    from gpu_feature_discovery_tpu.sandbox import probe as probe_mod
+    from gpu_feature_discovery_tpu.sandbox.broker import (
+        BrokerClient,
+        BrokerCrash,
+        _FrameReader,
+    )
+
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    machine = tmp_path / "machine-type"
+    machine.write_text("Google Compute Engine\n")
+    config = new_config(
+        cli_values={
+            "oneshot": False,
+            "output-file": str(tmp_path / "tfd"),
+            "machine-type-file": str(machine),
+            "probe-timeout": "2s",
+            "init-backoff-max": "0.02s",
+        },
+        environ={},
+    )
+    client = BrokerClient(config)
+    # Hand-wire a FAKE worker: a dummy child that never answers, plus a
+    # response pipe the test pre-loads with junk.
+    req_r, req_w = _os.pipe()
+    resp_r, resp_w = _os.pipe()
+    dummy = _os.fork()
+    if dummy == 0:
+        _time.sleep(3600)
+        _os._exit(0)
+    probe_mod._register(dummy)
+    junk = b'{"status": '  # truncated JSON — json.loads must fail
+    _os.write(resp_w, _struct.pack(">I", len(junk)) + junk)
+    with client._pid_lock:
+        client._pid = dummy
+    client._req_w = req_w
+    client._resp_r = resp_r
+    client._reader = _FrameReader(resp_r)
+    client._ever_spawned = True
+    try:
+        with pytest.raises(BrokerCrash, match="unparseable"):
+            client.request("ping")
+        assert not client.alive, "junk response did not retire the worker"
+        # The dummy was killed + reaped through the registry.
+        try:
+            _os.kill(dummy, 0)
+            alive = True
+        except OSError:
+            alive = False
+        assert not alive, "fake worker survived the junk-frame kill"
+        # Respawn: the next request spawns a REAL worker and serves.
+        assert client.ping() is True
+    finally:
+        for fd in (req_r, resp_w):
+            try:
+                _os.close(fd)
+            except OSError:
+                pass
+        client.close()
+        broker_mod.close_broker()

@@ -188,3 +188,24 @@ def test_jax_manager_release_clears_probe_workspaces():
     assert hc._burnin_workspace.cache_info().currsize == 0
     assert stream_workspace.cache_info().currsize == 0
     assert not hc._warmed_probe_keys
+
+
+def test_warm_probe_kernels_for_matches_probe_geometry_and_memoizes():
+    """The broker worker's warm-start entry point (ISSUE 5): warms the
+    probe kernels at the geometry measure_node_health would pick for the
+    devices, and memoizes — the second call costs nothing, so the warm
+    thread and a concurrent first probe can never double-compile."""
+    import jax
+
+    from gpu_feature_discovery_tpu.ops import healthcheck as hc
+
+    devices = tuple(jax.local_devices()[:1])
+    hc.reset_probe_workspaces()
+    try:
+        first = hc.warm_probe_kernels_for(devices)
+        assert first > 0.0, "cold warm-up must report the compile cost"
+        assert hc.warm_probe_kernels_for(devices) == 0.0, (
+            "second warm-up must be a memoized no-op"
+        )
+    finally:
+        hc.reset_probe_workspaces()

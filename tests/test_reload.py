@@ -158,3 +158,58 @@ def test_engine_close_kills_inflight_probe_child():
     assert _wait_until(lambda: state.inflight.done()), (
         "worker thread still blocked after the child was killed"
     )
+
+
+# ---------------------------------------------------------------------------
+# epoch close vs the persistent broker worker (ISSUE 5 satellite)
+# ---------------------------------------------------------------------------
+
+def test_sighup_closes_broker_gracefully_no_respawn_storm(tmp_path, monkeypatch):
+    """The reload pin for the stray-sweep exemption: each epoch spawns
+    ONE broker worker (one backend init), the epoch-close teardown closes
+    it GRACEFULLY — run()'s finally runs close_broker() and the stray
+    sweep leaves the live worker alone — so a SIGHUP reload never
+    SIGKILLs the worker into the crash-respawn path. A respawn counter
+    above zero here would be exactly the respawn storm the exemption
+    exists to prevent."""
+    import subprocess
+
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+    from gpu_feature_discovery_tpu.sandbox import broker as broker_mod
+
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    obs_metrics.reset_for_tests()
+
+    sigs = queue.Queue()
+    sigs.put(signal.SIGHUP)   # epoch 1: reload at the first phase boundary
+    sigs.put(signal.SIGTERM)  # epoch 2: clean exit
+    monkeypatch.setattr(cmd_main, "new_os_watcher", lambda: sigs)
+
+    machine = tmp_path / "machine-type"
+    machine.write_text("Google Compute Engine\n")
+    rc = cmd_main.start(
+        [
+            "--output-file", str(tmp_path / "tfd"),
+            "--machine-type-file", str(machine),
+            "--sleep-interval", "30s",  # never served: signals preempt it
+        ]
+    )
+    assert rc == 0
+    assert obs_metrics.BACKEND_INIT_ATTEMPTS.value() == 2, (
+        "each epoch must spawn exactly one broker worker (one PJRT init)"
+    )
+    assert obs_metrics.BROKER_RESPAWNS.value() == 0, (
+        "a reload epoch-close SIGKILLed the worker instead of closing it "
+        "gracefully (the respawn storm the sweep exemption prevents)"
+    )
+    assert obs_metrics.BROKER_UP.value() == 0, "final epoch left the worker up"
+    assert broker_mod._active is None, "close_broker() skipped at epoch end"
+    # No worker outlived the process's epochs: no zombies, no strays.
+    out = subprocess.run(
+        ["ps", "--ppid", str(os.getpid()), "-o", "stat="],
+        capture_output=True,
+        text=True,
+    ).stdout
+    assert not [s for s in out.split() if s.startswith("Z")], (
+        "broker workers left zombies across reload epochs"
+    )

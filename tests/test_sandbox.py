@@ -350,10 +350,16 @@ def test_probe_device_snapshot_chaos_sites(tmp_path):
 def test_isolation_mode_resolution(tmp_path):
     assert sandbox.isolation_mode(cfg(tmp_path)) == "subprocess"
     assert sandbox.isolation_mode(cfg(tmp_path, oneshot=True)) == "none"
-    # Burn-in needs a process-resident PJRT client, which a sandboxed
-    # parent must not hold — auto resolves to in-process probing.
+    # Burn-in needs a resident PJRT client. With the persistent broker on
+    # (the daemon default) the broker WORKER is that resident process, so
+    # auto stays isolated even under --with-burnin (ISSUE 5); only with
+    # the broker off does auto fall back to in-process probing (the PR 4
+    # behavior).
     assert sandbox.isolation_mode(
         cfg(tmp_path, **{"with-burnin": True})
+    ) == "subprocess"
+    assert sandbox.isolation_mode(
+        cfg(tmp_path, **{"with-burnin": True, "probe-broker": "off"})
     ) == "none"
     assert sandbox.isolation_mode(
         cfg(tmp_path, **{"probe-isolation": "none"})
