@@ -35,6 +35,20 @@ paths survivable, per-cycle, without hiding genuine brokenness:
 
 Oneshot mode bypasses all of it: ``--oneshot`` keeps the reference's
 strict error-to-exit parity (tests and one-off Jobs want loud failures).
+
+Relationship to per-chip fault localization (lm/health.py,
+``--chip-probes``): a SICK CHIP is a *measurement*, not a daemon fault.
+The health labeler publishes the per-chip quarantine labels
+(``chip.<i>.ok=false``, the reduced ``chips.healthy`` inventory, the
+straggler verdict) inside a normally-completing cycle, so none of the
+machinery here fires — no degraded mode, no failure streak, no exit.
+This supervisor only sees the probe path when the probe *infrastructure*
+breaks (unacquirable devices, a crashed broker worker), which is exactly
+the division that keeps a node with 7 of 8 healthy chips fully live
+under an accurate inventory instead of CrashLooping over the eighth.
+The chip labels ride the last-good cache like any other label: degraded
+cycles and re-serves keep publishing the last measured per-chip verdicts
+(with the degraded/unhealthy markers saying how stale they may be).
 """
 
 from __future__ import annotations

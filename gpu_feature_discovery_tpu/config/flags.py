@@ -25,6 +25,7 @@ from gpu_feature_discovery_tpu.config.spec import (
     TOPOLOGY_STRATEGY_NONE,
     parse_bool as _parse_bool,
     parse_config_file,
+    parse_fraction as _parse_fraction,
     parse_nonneg_int as _parse_nonneg_int,
     parse_positive_int as _parse_positive_int,
 )
@@ -65,6 +66,15 @@ DEFAULT_FLAP_WINDOW = 1
 # lifetime (the default — the worker is stateless between requests, so
 # recycling exists only as a hedge against slow native leaks).
 DEFAULT_BROKER_MAX_REQUESTS = 0
+# Straggler detection (lm/health.py): a healthy chip whose throughput
+# falls below this fraction of the healthy-chip median on
+# STRAGGLER_CONFIRM_PROBES consecutive probes is published as
+# tpu.straggler-chip. Deliberately conservative: the wall-clock fallback's
+# per-chip rates are noisy (one-off worst/median ratios down to ~0.25 on
+# a loaded host), and a false quarantine is worse than a late one. On
+# device-profiler timing (tight per-chip spread) operators can raise it
+# toward 0.5.
+DEFAULT_STRAGGLER_THRESHOLD = 0.2
 
 _DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
 _DURATION_UNITS = {
@@ -409,6 +419,32 @@ FLAG_DEFS: List[FlagDef] = [
         "epoch's lifetime",
         setter=lambda c, v: setattr(_f(c).tfd, "broker_max_requests", v),
         getter=lambda c: _f(c).tfd.broker_max_requests,
+    ),
+    FlagDef(
+        name="chip-probes",
+        env_vars=("TFD_CHIP_PROBES",),
+        parse=_parse_bool,
+        default=True,
+        help="with --with-burnin, probe every chip individually over the "
+        "mesh-sharded burn-in and publish per-chip fault-localization "
+        "labels (google.com/tpu.chip.<i>.ok, chip.<i>.tflops, "
+        "chips.healthy/sick, straggler-chip) plus the ICI all-reduce "
+        "bandwidth probe; 'off' reproduces the aggregate-only health "
+        "labels byte for byte",
+        setter=lambda c, v: setattr(_f(c).tfd, "chip_probes", v),
+        getter=lambda c: _f(c).tfd.chip_probes,
+    ),
+    FlagDef(
+        name="straggler-threshold",
+        env_vars=("TFD_STRAGGLER_THRESHOLD",),
+        parse=_parse_fraction,
+        default=DEFAULT_STRAGGLER_THRESHOLD,
+        help="fraction in (0, 1): a healthy chip whose measured "
+        "throughput falls below this fraction of the healthy-chip median "
+        "on 2 consecutive probes is published as "
+        "google.com/tpu.straggler-chip",
+        setter=lambda c, v: setattr(_f(c).tfd, "straggler_threshold", v),
+        getter=lambda c: _f(c).tfd.straggler_threshold,
     ),
     FlagDef(
         name="state-dir",

@@ -163,6 +163,11 @@ class TfdFlags:
     # broker_max_requests served requests (0 = never).
     probe_broker: Optional[str] = None  # auto | on | off
     broker_max_requests: Optional[int] = None  # 0 = never recycle
+    # Per-chip fault localization (lm/health.py + ops/healthcheck.py):
+    # mesh-sharded burn-in with per-chip verdict labels and straggler
+    # detection; chip_probes=False reproduces the aggregate-only labels.
+    chip_probes: Optional[bool] = None
+    straggler_threshold: Optional[float] = None  # fraction of median, (0,1)
 
 
 @dataclass
@@ -222,6 +227,8 @@ class Config:
                     "flapWindow": self.flags.tfd.flap_window,
                     "probeBroker": self.flags.tfd.probe_broker,
                     "brokerMaxRequests": self.flags.tfd.broker_max_requests,
+                    "chipProbes": self.flags.tfd.chip_probes,
+                    "stragglerThreshold": self.flags.tfd.straggler_threshold,
                 },
             },
             "sharing": {
@@ -275,6 +282,20 @@ def parse_nonneg_int(value: Any) -> int:
     if n < 0:
         raise ConfigError(f"value must be >= 0: {value!r}")
     return n
+
+
+def parse_fraction(value: Any) -> float:
+    """Strict open-interval fraction parsing: (0, 1) exclusive. The
+    straggler threshold is a fraction of the median — 0 would never fire
+    and 1 would flag ordinary variance, so both are config errors, not
+    tuning choices."""
+    try:
+        f = float(str(value).strip())
+    except ValueError as e:
+        raise ConfigError(f"invalid fraction: {value!r}") from e
+    if not 0.0 < f < 1.0:
+        raise ConfigError(f"value must be in (0, 1) exclusive: {value!r}")
+    return f
 
 
 def parse_config_file(path: str) -> Config:
@@ -344,6 +365,11 @@ def parse_config_file(path: str) -> Config:
     if tfd.get("brokerMaxRequests") is not None:
         config.flags.tfd.broker_max_requests = parse_nonneg_int(
             tfd["brokerMaxRequests"]
+        )
+    config.flags.tfd.chip_probes = _opt_bool(tfd.get("chipProbes"))
+    if tfd.get("stragglerThreshold") is not None:
+        config.flags.tfd.straggler_threshold = parse_fraction(
+            tfd["stragglerThreshold"]
         )
 
     config.resources = raw.get("resources", {}) or {}

@@ -9,6 +9,27 @@ chip (ops/healthcheck.py measure_node_health) and publishes:
     google.com/tpu.health.ok            = true|false   (all chips finite)
     google.com/tpu.health.matmul-tflops = <int>        (worst chip's rate)
 
+With ``--chip-probes`` (the default) fault LOCALIZATION is part of the
+same probe: the burn-in additionally runs mesh-sharded across every local
+chip at once (ops/healthcheck.py sharded_chip_verdicts over the named
+chip mesh) and each probing cycle publishes per-chip labels —
+
+    google.com/tpu.chip.<i>.ok        = true|false
+    google.com/tpu.chip.<i>.tflops    = <int>   (plausibility-gated)
+    google.com/tpu.chip.<i>.hbm-gbps  = <int>   (plausibility-gated)
+    google.com/tpu.chips.healthy      = <n>
+    google.com/tpu.chips.sick         = <n>
+    google.com/tpu.straggler-chip     = <i>     (confirmed straggler only)
+    google.com/tpu.health.ici.allreduce-gbps = <int>  (TPU multi-chip)
+
+so a single sick chip quarantines ITSELF (schedulers can key off
+``chip.<i>.ok`` / the reduced ``chips.healthy`` inventory) instead of
+hiding inside the aggregate while the node keeps advertising itself as
+fully schedulable. A sick chip is a *measurement*, not a daemon fault:
+the cycle completes normally, the supervisor machinery
+(cmd/supervisor.py) never sees an error, and the node stays live with an
+accurate reduced inventory — no exit, no full-node DEGRADED.
+
 Off by default because it occupies the chip for ~tens of ms and must never
 contend with a workload that owns the TPU (same reasoning that keeps the
 factory probe from creating a PJRT client, SURVEY.md section 7 hard part #1).
@@ -49,6 +70,79 @@ HEALTH_TIMING = "google.com/tpu.health.timing"
 # chip sustains above spec. The margin absorbs spec-vs-measured unit slop
 # (GB/s spec vs GiB/s measurement is a 1.074x ratio).
 PLAUSIBILITY_MARGIN = 1.5
+
+# Per-chip fault-localization labels (--chip-probes). <i> is the chip's
+# position in the local device order — the same index PJRT enumerates.
+CHIP_OK_FMT = "google.com/tpu.chip.%d.ok"
+CHIP_TFLOPS_FMT = "google.com/tpu.chip.%d.tflops"
+CHIP_HBM_FMT = "google.com/tpu.chip.%d.hbm-gbps"
+CHIPS_HEALTHY = "google.com/tpu.chips.healthy"
+CHIPS_SICK = "google.com/tpu.chips.sick"
+STRAGGLER_CHIP = "google.com/tpu.straggler-chip"
+HEALTH_ICI_GBPS = "google.com/tpu.health.ici.allreduce-gbps"
+
+# A straggler must hold its deficit across this many CONSECUTIVE probes
+# before the label publishes: per-chip rates on the host-clock fallback
+# are noisy (a loaded CPU mesh shows one-off worst/median ratios down to
+# ~0.25), and a one-probe blip must not quarantine a healthy chip.
+STRAGGLER_CONFIRM_PROBES = 2
+
+
+def detect_straggler(per_chip, threshold: float):
+    """Single-probe straggler candidate: the index of the slowest HEALTHY
+    chip when its rate falls below ``threshold`` x the median of the
+    healthy chips' rates, else None. Needs >= 3 rated chips — with two,
+    the straggler drags the median toward itself and no robust baseline
+    exists. Ratio-based, so a uniform clock distortion (the wall-clock
+    fallback's tunnel latency) cancels out.
+
+    Detection reads the OPTIMISTIC per-chip rate (``tflops_best``, the
+    best iteration) when the probe provides one: host scheduling noise
+    stalls some iterations of a healthy chip — on a 2-core CI box running
+    8 virtual devices, median-based worst/median ratios fall to ~0.1
+    under load — but a genuinely degraded chip is slow on EVERY
+    iteration, so the best-of-iters separates noise from hardware where
+    the median cannot. The published ``chip.<i>.tflops`` label stays the
+    median (what a workload will see)."""
+    import statistics as _stats
+
+    rated = [
+        (i, float(e.get("tflops_best") or e["tflops"]))
+        for i, e in enumerate(per_chip)
+        if e.get("healthy") and (e.get("tflops_best") or e.get("tflops")) is not None
+    ]
+    if len(rated) < 3:
+        return None
+    median = _stats.median(rate for _, rate in rated)
+    if median <= 0:
+        return None
+    worst_idx, worst = min(rated, key=lambda r: r[1])
+    return worst_idx if worst < threshold * median else None
+
+
+class StragglerDetector:
+    """Consecutive-probe confirmation on top of ``detect_straggler``: the
+    SAME chip must be the candidate on ``confirm`` probes in a row.
+    Lives on the burn-in schedule, so a SIGHUP reload (new threshold) or
+    an unacquirable gap starts a fresh streak."""
+
+    def __init__(self, threshold: float, confirm: int = STRAGGLER_CONFIRM_PROBES):
+        self.threshold = threshold
+        self.confirm = max(1, confirm)
+        self._candidate = None
+        self._streak = 0
+
+    def observe(self, per_chip):
+        """Feed one probe's per-chip table; returns the CONFIRMED
+        straggler index or None."""
+        candidate = detect_straggler(per_chip, self.threshold)
+        if candidate is None or candidate != self._candidate:
+            self._candidate = candidate
+            self._streak = 1 if candidate is not None else 0
+            confirmed = candidate is not None and self._streak >= self.confirm
+            return candidate if confirmed else None
+        self._streak += 1
+        return candidate if self._streak >= self.confirm else None
 
 
 def _spec_peaks(manager: Manager) -> tuple:
@@ -130,6 +224,19 @@ class _BurninSchedule:
         self.cached: Labels | None = None
         self.consecutive_failures = 0
         self.first_probe_thread: _FirstProbeThread | None = None
+        # Straggler confirmation state (created lazily at the configured
+        # threshold; the schedule registry resets on SIGHUP, so a
+        # threshold change starts a fresh streak).
+        self.straggler: StragglerDetector | None = None
+        # Broker path only: True while the worker answered "warming" —
+        # the next RPC collects an already-running probe, so the parent
+        # must not burn chip.<i>.* fault shots on it.
+        self.broker_probe_pending = False
+        # The shots shipped with the launch that left a probe pending:
+        # if the worker dies before a collect RPC returns, the probe they
+        # were bound to never publishes, so they must be re-armed — the
+        # collect call's own (empty) sets cannot stand in for them.
+        self.pending_chip_faults: tuple = (frozenset(), frozenset())
 
     def due(self, interval: int) -> bool:
         self.cycle += 1
@@ -181,8 +288,17 @@ def _acquire_tpu_devices():
             e,
         )
         return None
-    if not devices or any(getattr(d, "platform", "") != "tpu" for d in devices):
+    if not devices:
         return None
+    if any(getattr(d, "platform", "") != "tpu" for d in devices):
+        # Hermetic-testing escape hatch (chaos chip-fault rows, bench):
+        # treat the virtual CPU mesh as acquirable so the REAL probe +
+        # per-chip localization path runs without hardware. Never set in
+        # production — a CPU matmul rate is not TPU health.
+        from gpu_feature_discovery_tpu.config.flags import env_flag
+
+        if not env_flag("TFD_BURNIN_ALLOW_CPU"):
+            return None
     return devices
 
 
@@ -236,6 +352,11 @@ def new_health_labeler(manager: Manager, config: Config) -> Labeler:
         # steadily-acquirable chips.
         sched.cached = None
         sched.consecutive_failures = 0
+        # The straggler confirmation streak must not survive the gap
+        # either: observations separated by an unacquirable stretch are
+        # not "consecutive probes", and two such observations must never
+        # add up to a quarantine.
+        sched.straggler = None
         # A pending first probe outcome must not survive the gap either:
         # mid-gap it will either error (chip taken away — busy, not
         # failed) or report pre-gap health. Abandon it; the reacquired
@@ -250,6 +371,27 @@ def new_health_labeler(manager: Manager, config: Config) -> Labeler:
         # stripped below) — a cycle that ran no probe must not carry the
         # previous probe's cost as if it were fresh (ADVICE r2).
         return sched.cached
+    chip_probes, _ = _chip_probe_opts(config)
+
+    def _armed_measure():
+        """Bind this probing cycle's chip-fault shots into the measure
+        call. Consumption happens HERE — at probe LAUNCH, in the process
+        that owns the fault registry — never on a collect-only cycle, so
+        an async first probe in flight cannot burn extra shots."""
+        import functools
+
+        from gpu_feature_discovery_tpu.utils import faults
+
+        if chip_probes:
+            sick, slow = faults.consume_chip_faults()
+        else:
+            sick, slow = frozenset(), frozenset()
+        return functools.partial(
+            measure_node_health,
+            per_chip=chip_probes,
+            sick_chips=sick,
+            slow_chips=slow,
+        )
     # The FIRST probe of a schedule pays XLA compilation (tens of seconds
     # on real chips). In daemon mode it runs in a background thread so the
     # cycle's BASE labels publish immediately; this and later cycles poll
@@ -273,7 +415,7 @@ def new_health_labeler(manager: Manager, config: Config) -> Labeler:
                     # racing a second one onto the chips.
                     sched.first_probe_thread = thread = inflight
                 else:
-                    thread = _FirstProbeThread(measure_node_health, devices)
+                    thread = _FirstProbeThread(_armed_measure(), devices)
                     sched.first_probe_thread = thread
                     _first_probe_inflight = thread
                     thread.start()
@@ -293,16 +435,48 @@ def new_health_labeler(manager: Manager, config: Config) -> Labeler:
     else:
         t0 = time.perf_counter()
         try:
-            report, error = measure_node_health(devices=devices), None
+            report, error = _armed_measure()(devices=devices), None
         except Exception as e:  # noqa: BLE001 - degraded chip must not kill labeling
             report, error = None, e
         probe_ms = (time.perf_counter() - t0) * 1e3
-    return _labels_from_probe(sched, manager, report, error, probe_ms)
+    return _labels_from_probe(sched, manager, config, report, error, probe_ms)
+
+
+def _chip_probe_opts(config: Config) -> tuple:
+    """Resolve (--chip-probes, --straggler-threshold) with defaults."""
+    from gpu_feature_discovery_tpu.config.flags import (
+        DEFAULT_STRAGGLER_THRESHOLD,
+    )
+
+    tfd = config.flags.tfd
+    chip = tfd.chip_probes if tfd.chip_probes is not None else True
+    threshold = (
+        tfd.straggler_threshold
+        if tfd.straggler_threshold is not None
+        else DEFAULT_STRAGGLER_THRESHOLD
+    )
+    return bool(chip), float(threshold)
+
+
+def _rate_plausible(value, host_clock: bool, peak: float) -> bool:
+    """The aggregate labels' plausibility policy as a predicate (per-chip
+    rates apply the same gates, but quietly — eight warn lines per probe
+    would be noise; the aggregate's warn_once already names the
+    condition): host-clock rates below 1 are dispatch/tunnel distortion,
+    rates above spec peak x margin are timing artifacts."""
+    if value is None:
+        return False
+    if host_clock and value < 1.0:
+        return False
+    if peak > 0.0 and value > peak * PLAUSIBILITY_MARGIN:
+        return False
+    return True
 
 
 def _labels_from_probe(
     sched: _BurninSchedule,
     manager: Manager,
+    config: Config,
     report,
     error,
     probe_ms: float,
@@ -325,6 +499,10 @@ def _labels_from_probe(
         # behavior the interval exists to prevent, VERDICT r1 weak #6).
         log.warning("burn-in failed on acquired TPU devices: %s", error)
         sched.consecutive_failures += 1
+        # A failed probe produced no per-chip table: the straggler streak
+        # breaks here — the probes on either side of the failure are not
+        # "consecutive" evidence against one chip.
+        sched.straggler = None
         labels = Labels({HEALTH_OK: "false"})
         sched.cached = labels if sched.consecutive_failures >= 2 else None
         return labels
@@ -355,7 +533,9 @@ def _labels_from_probe(
     # did NOT come from the device clock.
     host_clock = report.get("timing") != "device-profiler"
     tflops = report["tflops"]
-    if host_clock and tflops < 1.0:
+    if _rate_plausible(tflops, host_clock, peak_tf):
+        labels[HEALTH_TFLOPS] = str(int(tflops))
+    elif host_clock and tflops < 1.0:
         # Symmetric with the HBM lower bound: sub-1 TFLOP/s on a chip
         # whose outputs just came back finite is dispatch/tunnel latency
         # polluting a wall-clock measurement, not a hardware rate — a
@@ -366,7 +546,7 @@ def _labels_from_probe(
             "implausible matmul rate %.3f TFLOP/s; omitting label",
             tflops,
         )
-    elif peak_tf > 0.0 and tflops > peak_tf * PLAUSIBILITY_MARGIN:
+    else:
         # Above-spec readings are timing artifacts, never hardware: a
         # misparsed trace (wrong unit, truncated event) must not publish
         # e.g. 50000 TFLOP/s as fact (VERDICT r4 weak #5 / next-round #5).
@@ -378,11 +558,11 @@ def _labels_from_probe(
             tflops,
             peak_tf,
         )
-    else:
-        labels[HEALTH_TFLOPS] = str(int(tflops))
     hbm = report.get("hbm_gbps")
     if hbm is not None:
-        if host_clock and hbm < 1.0:
+        if _rate_plausible(hbm, host_clock, peak_hbm):
+            labels[HEALTH_HBM] = str(int(hbm))
+        elif host_clock and hbm < 1.0:
             # Sub-1 GiB/s is not a believable HBM reading on hardware that
             # just passed the checksum — a tunneled/virtualized device is
             # distorting timing; omit rather than publish a junk number.
@@ -394,7 +574,7 @@ def _labels_from_probe(
                 "implausible HBM bandwidth %.3f GiB/s; omitting label",
                 hbm,
             )
-        elif peak_hbm > 0.0 and hbm > peak_hbm * PLAUSIBILITY_MARGIN:
+        else:
             warn_once(
                 log,
                 "health:implausible-hbm-high",
@@ -403,10 +583,64 @@ def _labels_from_probe(
                 hbm,
                 peak_hbm,
             )
-        else:
-            labels[HEALTH_HBM] = str(int(hbm))
     if report.get("ici_ok") is not None:
         labels[HEALTH_ICI] = str(report["ici_ok"]).lower()
+    chip_probes, threshold = _chip_probe_opts(config)
+    table = report.get("per_chip") or []
+    if chip_probes and table:
+        from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+        # Per-chip fault localization: every chip gets its own verdict
+        # label, and the node-level healthy/sick counts are the reduced
+        # inventory a scheduler can act on while the node stays live.
+        healthy_n = sum(1 for e in table if e.get("healthy"))
+        labels[CHIPS_HEALTHY] = str(healthy_n)
+        labels[CHIPS_SICK] = str(len(table) - healthy_n)
+        for i, e in enumerate(table):
+            ok = bool(e.get("healthy"))
+            labels[CHIP_OK_FMT % i] = "true" if ok else "false"
+            obs_metrics.CHIP_OK.labels(chip=str(i)).set(1.0 if ok else 0.0)
+            chip_tflops = e.get("tflops")
+            if chip_tflops is not None:
+                # The metric carries the RAW rate (operators diff chips
+                # across scrapes); the label applies the same
+                # plausibility gates as the aggregate.
+                obs_metrics.CHIP_TFLOPS.labels(chip=str(i)).set(
+                    float(chip_tflops)
+                )
+                if _rate_plausible(chip_tflops, host_clock, peak_tf):
+                    labels[CHIP_TFLOPS_FMT % i] = str(int(chip_tflops))
+            chip_hbm = e.get("hbm_gbps")
+            if chip_hbm is not None and _rate_plausible(
+                chip_hbm, host_clock, peak_hbm
+            ):
+                labels[CHIP_HBM_FMT % i] = str(int(chip_hbm))
+        if sched.straggler is None or sched.straggler.threshold != threshold:
+            sched.straggler = StragglerDetector(threshold)
+        confirmed = sched.straggler.observe(table)
+        if confirmed is not None:
+            labels[STRAGGLER_CHIP] = str(confirmed)
+            obs_metrics.STRAGGLER_DETECTED.inc()
+            log.warning(
+                "straggler chip %d: throughput below %.2fx the median "
+                "for %d consecutive probes",
+                confirmed,
+                threshold,
+                sched.straggler.confirm,
+            )
+        ici_gbps = report.get("ici_gbps")
+        if report.get("chips_allreduce_ok") is False:
+            # A corrupt reduction's timing is not a bandwidth: suppress
+            # the rate label (ici.ok=false already published the fault,
+            # folded in by measure_node_health).
+            log.warning(
+                "chip-mesh all-reduce verdict disagreed across chips; "
+                "suppressing %s",
+                HEALTH_ICI_GBPS,
+            )
+            ici_gbps = None
+        if ici_gbps:
+            labels[HEALTH_ICI_GBPS] = str(int(ici_gbps))
     sched.consecutive_failures = 0
     sched.cached = Labels(
         {k: v for k, v in labels.items() if k != HEALTH_PROBE_MS}
@@ -434,9 +668,51 @@ def _broker_health_labels(manager, broker, config: Config) -> Labeler:
     interval = config.flags.tfd.burnin_interval or 1
     if not sched.due(interval):
         return sched.cached
-    outcome = broker.health()
+    chip_probes, _ = _chip_probe_opts(config)
+    # chip.<i>.* fault shots are consumed HERE (the parent owns the
+    # registry) and shipped in the RPC for the worker to enact — but only
+    # when this RPC may START a probe: while the worker is still
+    # "warming", the next RPC collects the already-running probe and must
+    # not burn shots it cannot deliver.
+    from gpu_feature_discovery_tpu.utils import faults
+
+    sick, slow = (frozenset(), frozenset())
+    if chip_probes and not sched.broker_probe_pending:
+        sick, slow = faults.consume_chip_faults()
+    # Everything in flight: shots shipped on THIS launch plus any shipped
+    # with a still-pending probe — a dead worker loses both the same way,
+    # so the rearm below must cover both or a "warming" launch followed by
+    # a worker death silently burns the injection budget.
+    pend_sick, pend_slow = sched.pending_chip_faults
+    sick_in_flight, slow_in_flight = sick | pend_sick, slow | pend_slow
+    try:
+        outcome = broker.health(
+            per_chip=chip_probes,
+            sick_chips=sorted(sick),
+            slow_chips=sorted(slow),
+        )
+    except Exception:
+        # The request died with the worker: the probe the shots were
+        # shipped to never published, so give them back for the next
+        # launch (consumption happens before the RPC — the indices
+        # travel in the request). The dead worker holds no probe either:
+        # the respawned one starts fresh.
+        faults.rearm_chip_faults(sick_in_flight, slow_in_flight)
+        sched.pending_chip_faults = (frozenset(), frozenset())
+        sched.broker_probe_pending = False
+        raise
     status = outcome.get("status")
+    sched.broker_probe_pending = status == "warming"
+    sched.pending_chip_faults = (
+        (sick_in_flight, slow_in_flight)
+        if status == "warming"
+        else (frozenset(), frozenset())
+    )
     if status == "unacquirable":
+        # The worker never launched a probe (a respawned worker holds no
+        # pending one either): nothing in flight was enacted — re-arm it
+        # all (same rationale as the except path).
+        faults.rearm_chip_faults(sick_in_flight, slow_in_flight)
         # Same semantics as _acquire_tpu_devices returning None in
         # process: says nothing about chip health, publish nothing, drop
         # the cache so recovery re-probes immediately.
@@ -449,6 +725,7 @@ def _broker_health_labels(manager, broker, config: Config) -> Labeler:
         )
         sched.cached = None
         sched.consecutive_failures = 0
+        sched.straggler = None
         return Empty()
     if status == "warming":
         # The worker's probe (or its kernel pre-warm) is still
@@ -466,8 +743,8 @@ def _broker_health_labels(manager, broker, config: Config) -> Labeler:
     probe_ms = float(outcome.get("probe_ms") or 0.0)
     if status == "probe-failed":
         return _labels_from_probe(
-            sched, manager, None, outcome.get("error", ""), probe_ms
+            sched, manager, config, None, outcome.get("error", ""), probe_ms
         )
     return _labels_from_probe(
-        sched, manager, outcome.get("report") or {}, None, probe_ms
+        sched, manager, config, outcome.get("report") or {}, None, probe_ms
     )

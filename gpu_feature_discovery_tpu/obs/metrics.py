@@ -139,6 +139,29 @@ FLAPPING = REGISTRY.gauge(
     "hysteresis, else 0.",
 )
 
+# -- per-chip fault localization (lm/health.py, --chip-probes) ---------------
+
+CHIP_OK = REGISTRY.gauge(
+    "tfd_chip_ok",
+    "Per-chip burn-in verdict from the mesh-sharded probe: 1 while the "
+    "chip's outputs are finite (the chip.<i>.ok label), 0 while sick. "
+    "Series persist at their last value across a chip-count shrink.",
+    labelnames=("chip",),
+)
+CHIP_TFLOPS = REGISTRY.gauge(
+    "tfd_chip_tflops",
+    "Per-chip sustained bf16 matmul rate from the last probe, RAW "
+    "(no plausibility gating — operators diff chips across scrapes; the "
+    "chip.<i>.tflops label applies the gates).",
+    labelnames=("chip",),
+)
+STRAGGLER_DETECTED = REGISTRY.counter(
+    "tfd_straggler_detected_total",
+    "Probes that CONFIRMED a straggler chip (throughput below "
+    "--straggler-threshold of the healthy-chip median on consecutive "
+    "probes — the tpu.straggler-chip label).",
+)
+
 # -- label engine (lm/engine.py) --------------------------------------------
 
 LABELER_DURATION = REGISTRY.histogram(

@@ -227,6 +227,12 @@ def measure_chip_health(
     return {
         "healthy": healthy,
         "tflops": burnin_flops(size, depth) / sec / 1e12,
+        # Optimistic rate (best iteration): the straggler detector's
+        # input — host scheduling noise stalls SOME iterations of a
+        # healthy chip, but a genuinely degraded chip is slow on every
+        # one, so the best-of-iters separates the two where the median
+        # cannot (lm/health.detect_straggler).
+        "tflops_best": burnin_flops(size, depth) / min(samples) / 1e12,
         "seconds": sec,
     }
 
@@ -274,10 +280,16 @@ def reset_probe_workspaces() -> None:
     stream_workspace.cache_clear()
     _burnin_workspace.cache_clear()
     _warmed_probe_keys.clear()
+    # The per-chip mesh programs and the all-reduce payload hold Device
+    # references (mesh construction) / device arrays too.
+    _sharded_verdict_fn.cache_clear()
+    _allreduce_fn.cache_clear()
+    _allreduce_workspace.cache_clear()
 
 
 def _warm_probe_kernels(
-    devices: tuple, size: int, depth: int, dtype, hbm_mib: int
+    devices: tuple, size: int, depth: int, dtype, hbm_mib: int,
+    per_chip: bool = True,
 ) -> float:
     """Compile + first-execute every probe kernel untraced; returns the
     wall ms spent (0.0 when already warm).
@@ -309,11 +321,16 @@ def _warm_probe_kernels(
         cs, rms = step(xb, wsb)
         total = hbm_fn(buf)
         jax.block_until_ready(pack(cs, rms, total))
+    if per_chip:
+        # --chip-probes=off must not pay the mesh-sharded programs'
+        # compile or occupy the chips executing them; a later flag flip
+        # just compiles lazily inside that probe.
+        _warm_per_chip_kernels(devices, size, depth, dtype)
     _warmed_probe_keys.add(key)
     return (time.perf_counter() - t0) * 1e3
 
 
-def warm_probe_kernels_for(devices: tuple) -> float:
+def warm_probe_kernels_for(devices: tuple, per_chip: bool = True) -> float:
     """Pre-compile + first-execute the probe kernels for ``devices`` at
     the SAME geometry (and kernel set) ``measure_node_health`` would
     pick for them, so a later probe finds everything warm. The broker
@@ -331,7 +348,7 @@ def warm_probe_kernels_for(devices: tuple) -> float:
     if on_tpu:
         return _warm_probe_kernels(
             devices, TPU_PROBE_SIZE, TPU_PROBE_DEPTH, jnp.bfloat16,
-            PROBE_HBM_MIB,
+            PROBE_HBM_MIB, per_chip=per_chip,
         )
     key = (devices, DEFAULT_PROBE_SIZE, DEFAULT_PROBE_DEPTH, "wall")
     if key in _warmed_probe_keys:
@@ -345,8 +362,270 @@ def warm_probe_kernels_for(devices: tuple) -> float:
         )
         cs, rms = step(xb, wsb)
         jax.block_until_ready(pack(cs, rms, jnp.zeros((), jnp.float32)))
+    if per_chip:
+        override = _probe_geometry_override()
+        wsize, wdepth = override if override is not None else (
+            DEFAULT_PROBE_SIZE, DEFAULT_PROBE_DEPTH
+        )
+        _warm_per_chip_kernels(devices, wsize, wdepth, jnp.bfloat16)
     _warmed_probe_keys.add(key)
     return (time.perf_counter() - t0) * 1e3
+
+
+# ---------------------------------------------------------------------------
+# Mesh-sharded per-chip probing (fault localization)
+# ---------------------------------------------------------------------------
+
+# Axis name of the local-chip probe mesh. The per-chip verdict program and
+# the ICI all-reduce bandwidth probe shard over the SAME named mesh, the
+# NamedSharding/shard_map shape that scales from an 8-chip host to a
+# supercluster worker without changing the probe code (SNIPPETS.md [2][3]).
+CHIP_MESH_AXIS = "chips"
+
+# chip.<i>.slow fault site: the injected straggler's measured throughput is
+# scaled by this factor. A chip cannot be made genuinely slower on demand,
+# so the slowdown is simulated at the measurement seam — far enough below
+# any sane --straggler-threshold AND below the loaded-host noise floor
+# that detection is deterministic: wall-clock per-chip rates on a 2-core
+# CI host have shown one-off best-of-iters dips to ~0.1x the median, and
+# a competing noisy chip must never steal the worst-chip slot from the
+# injected one mid-confirmation (2 consecutive candidate probes, no
+# shots to spare).
+SLOW_CHIP_FACTOR = 0.02
+
+# The sharded program is a VERDICT (non-finite detection through the full
+# matmul chain on every chip at once), not a rate probe — rates come from
+# the per-device timed kernels — so its geometry is capped: an
+# MXU-filling 2048-wide chain would double the probe's chip time for a
+# boolean the small chain detects identically (NaN propagates through any
+# depth >= 1). The cap keeps per_chip_probe_overhead_pct in single digits
+# at every probe geometry. 128 is one full MXU tile — the smallest shape
+# that still exercises the systolic-array datapath end to end.
+VERDICT_MAX_SIZE = 128
+VERDICT_MAX_DEPTH = 2
+
+# ICI all-reduce probe payload per chip. TPU: large enough that the ring
+# transfers dominate launch latency; elsewhere the number is not a
+# hardware measurement (ici_gbps is None off-TPU) so the buffer stays
+# small — the probe then only proves the collective completes and sums
+# correctly on the mesh.
+ICI_ALLREDUCE_MIB_TPU = 32
+ICI_ALLREDUCE_MIB_DEFAULT = 1
+ICI_ALLREDUCE_ITERS = 3
+
+# Hermetic-testing override for the probe geometry ("<size>x<depth>",
+# e.g. "128x2"): the chaos chip-fault rows probe 8 virtual CPU devices
+# every cycle and must converge in seconds, which the MXU-filling
+# defaults would not allow on an interpreter. Never set in production.
+BURNIN_GEOMETRY_ENV = "TFD_BURNIN_GEOMETRY"
+
+
+def chip_mesh(devices) -> Mesh:
+    """The named single-axis mesh over this host's local chips."""
+    import numpy as np
+
+    return Mesh(np.array(list(devices)), (CHIP_MESH_AXIS,))
+
+
+@functools.lru_cache(maxsize=None)
+def _sharded_verdict_fn(devices: tuple, size: int, depth: int, dtype):
+    """ONE jitted XLA program that burns in EVERY local chip at once over
+    the named chip mesh: each shard runs the depth-chained matmul on its
+    own chip and reports a per-shard finite-verdict, and a psum over the
+    mesh carries the healthy count across the ICI all-reduce path. The
+    sick mask is a runtime input, so one compiled program serves every
+    fault configuration (no per-fault retrace)."""
+    mesh = chip_mesh(devices)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(CHIP_MESH_AXIS),),
+        out_specs=(P(CHIP_MESH_AXIS), P(CHIP_MESH_AXIS)),
+    )
+    def chip_verdicts(sick):
+        x, ws = _burnin_input_arrays(size, depth, dtype)
+        # chip.<i>.sick: poison THIS shard's input so the standard
+        # finite-verdict logic detects it — the injection reproduces a
+        # sick chip's symptom (non-finite outputs), it does not bypass
+        # the detector.
+        poison = jnp.where(sick[0], jnp.float32(jnp.nan), jnp.float32(1.0))
+        cs, rms = burnin_step((x.astype(jnp.float32) * poison).astype(x.dtype), ws)
+        ok = jnp.logical_and(jnp.isfinite(cs), jnp.isfinite(rms))
+        healthy_count = lax.psum(ok.astype(jnp.int32), CHIP_MESH_AXIS)
+        return ok.reshape(1), healthy_count.reshape(1)
+
+    return mesh, jax.jit(chip_verdicts)
+
+
+def sharded_chip_verdicts(
+    devices, size: int, depth: int, dtype=jnp.bfloat16, sick_chips=frozenset()
+) -> Tuple[list, bool]:
+    """Run the sharded verdict program; returns ``(ok_per_chip,
+    allreduce_ok)``. ``allreduce_ok`` is True when every chip's psum of
+    the verdicts agrees with the host-side sum — a failed or corrupted
+    all-reduce shows up as a disagreeing count on some chip."""
+    import numpy as np
+
+    devices = tuple(devices)
+    mesh, fn = _sharded_verdict_fn(devices, size, depth, dtype)
+    sick = np.zeros(len(devices), dtype=bool)
+    for i in sick_chips:
+        if 0 <= int(i) < len(devices):
+            sick[int(i)] = True
+    with mesh:
+        ok, counts = jax.block_until_ready(fn(sick))
+    ok = np.asarray(ok)
+    counts = np.asarray(counts)
+    healthy = int(ok.sum())
+    return [bool(v) for v in ok], bool((counts == healthy).all())
+
+
+@functools.lru_cache(maxsize=None)
+def _allreduce_fn(devices: tuple):
+    mesh = chip_mesh(devices)
+
+    @functools.partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(CHIP_MESH_AXIS, None),),
+        out_specs=P(CHIP_MESH_AXIS, None),
+    )
+    def ici_allreduce(x):
+        return lax.psum(x, CHIP_MESH_AXIS)
+
+    return mesh, jax.jit(ici_allreduce)
+
+
+@functools.lru_cache(maxsize=None)
+def _allreduce_workspace(devices: tuple, rows_per_chip: int):
+    """Resident sharded all-ones payload for the all-reduce probe (same
+    residency/commit rationale as _burnin_workspace; cleared by
+    reset_probe_workspaces)."""
+    mesh = chip_mesh(devices)
+    sharding = NamedSharding(mesh, P(CHIP_MESH_AXIS, None))
+    buf = jnp.ones((len(devices) * rows_per_chip, 128), jnp.float32)
+    return jax.device_put(buf, sharding)
+
+
+def ici_allreduce_probe(
+    devices, mib_per_chip: Optional[int] = None, iters: int = ICI_ALLREDUCE_ITERS
+) -> dict:
+    """Time a psum over the chip mesh and report the sustained all-reduce
+    bandwidth in GiB/s per chip (median of ``iters``; ring cost model —
+    each chip moves ``2*(n-1)/n`` of its shard per reduction, which on
+    hardware rides the ICI links). ``checksum_ok`` verifies the reduction
+    actually summed every shard (ones in, n out, everywhere)."""
+    import numpy as np
+
+    devices = tuple(devices)
+    n = len(devices)
+    on_tpu = all(d.platform == "tpu" for d in devices)
+    if mib_per_chip is None:
+        mib_per_chip = ICI_ALLREDUCE_MIB_TPU if on_tpu else ICI_ALLREDUCE_MIB_DEFAULT
+    rows = max(1, (mib_per_chip << 20) // (128 * 4))
+    mesh, fn = _allreduce_fn(devices)
+    buf = _allreduce_workspace(devices, rows)
+    with mesh:
+        out = jax.block_until_ready(fn(buf))  # compile + warm
+        samples = []
+        for _ in range(max(1, iters)):
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(buf))
+            samples.append(time.perf_counter() - t0)
+    sec = statistics.median(samples)
+    arr = np.asarray(out[:1, :1])
+    checksum_ok = bool(arr[0, 0] == float(n))
+    shard_bytes = rows * 128 * 4
+    gbps = (
+        shard_bytes * (2.0 * (n - 1) / n) / sec / 2**30 if n > 1 and sec > 0 else 0.0
+    )
+    return {
+        "gbps": gbps,
+        "seconds": sec,
+        "bytes": shard_bytes,
+        "checksum_ok": checksum_ok,
+        "devices": n,
+    }
+
+
+def _warm_per_chip_kernels(devices: tuple, size: int, depth: int, dtype) -> None:
+    """Compile + first-execute the per-chip programs (sharded verdict and,
+    on multi-chip TPU, the all-reduce probe) at the geometry a per-chip
+    probe would use, so a later probe finds them warm — the
+    sharded-verdict compile otherwise lands inside the first probing
+    cycle's budget."""
+    sharded_chip_verdicts(
+        devices, min(size, VERDICT_MAX_SIZE), min(depth, VERDICT_MAX_DEPTH), dtype
+    )
+    on_tpu = all(d.platform == "tpu" for d in devices)
+    if on_tpu and len(devices) > 1:
+        mesh, fn = _allreduce_fn(devices)
+        rows = max(1, (ICI_ALLREDUCE_MIB_TPU << 20) // (128 * 4))
+        with mesh:
+            jax.block_until_ready(fn(_allreduce_workspace(devices, rows)))
+
+
+def _probe_geometry_override() -> Optional[Tuple[int, int]]:
+    """Parse BURNIN_GEOMETRY_ENV ("<size>x<depth>"); None when unset. A
+    malformed value raises — a typo'd test harness must fail loudly, not
+    silently probe at the wrong geometry."""
+    import os
+
+    raw = os.environ.get(BURNIN_GEOMETRY_ENV, "").strip()
+    if not raw:
+        return None
+    try:
+        size_s, depth_s = raw.lower().split("x")
+        size, depth = int(size_s), int(depth_s)
+    except ValueError as e:
+        raise ValueError(
+            f"{BURNIN_GEOMETRY_ENV}={raw!r}: want <size>x<depth>, e.g. 128x2"
+        ) from e
+    if size < 1 or depth < 1:
+        raise ValueError(f"{BURNIN_GEOMETRY_ENV}={raw!r}: size/depth must be >= 1")
+    return size, depth
+
+
+def _plane_device_rates(ms_by_plane: dict, devices: list) -> list:
+    """Map per-plane median durations (ms) onto the device list by the
+    trailing ordinal of the plane name ("/device:TPU:3" -> the local
+    device with ordinal 3, positional fallback). Plane names carry the
+    HOST-LOCAL ordinal, so the lookup prefers ``local_hardware_id`` —
+    the global ``id`` diverges on a multi-host slice, where host 1's
+    device ids start at 8 while its planes restart at 0. Entries with no
+    matching plane are None — a per-chip rate is never guessed."""
+    by_ordinal = {}
+    for plane, ms in ms_by_plane.items():
+        tail = str(plane).rsplit(":", 1)[-1]
+        if tail.isdigit():
+            by_ordinal[int(tail)] = ms
+    ordinals = []
+    for pos, d in enumerate(devices):
+        ordinal = getattr(d, "local_hardware_id", None)
+        if ordinal is None:
+            ordinal = getattr(d, "id", pos)
+        ordinals.append(ordinal)
+    if (
+        by_ordinal
+        and len(by_ordinal) == len(devices)
+        and not any(o in by_ordinal for o in ordinals)
+    ):
+        # Complete but disjoint numbering (no local_hardware_id exposed
+        # and the global ids don't start at 0 — a non-first pod-slice
+        # host on an older jax): sorted-plane position matches device
+        # order for every real PJRT plane set observed.
+        ranked = sorted(by_ordinal)
+        return [by_ordinal[ranked[pos]] for pos in range(len(devices))]
+    rates = []
+    for pos, ordinal in enumerate(ordinals):
+        ms = by_ordinal.get(ordinal)
+        if ms is None and len(ms_by_plane) == len(devices) and not by_ordinal:
+            # No plane carried an ordinal at all (exotic naming): the
+            # same sorted-position fallback.
+            ms = ms_by_plane[sorted(ms_by_plane)[pos]]
+        rates.append(ms)
+    return rates
 
 
 def _measure_node_health_traced(
@@ -357,6 +636,7 @@ def _measure_node_health_traced(
     dtype=jnp.bfloat16,
     hbm_mib: int = PROBE_HBM_MIB,
     hbm_iters: int = PROBE_HBM_ITERS,
+    per_chip: bool = True,
 ) -> Tuple[Optional[dict], Optional[str]]:
     """Probe every device with ON-DEVICE timing: dispatch the burn-in and
     HBM kernels under a profiler trace and read the kernels' execution
@@ -396,7 +676,9 @@ def _measure_node_health_traced(
     hbm_fn = _jitted_stream_sum(False)
     rows = probe_rows(hbm_mib)
     pack = _jitted_health_pack()
-    compile_ms = _warm_probe_kernels(tuple(devices), size, depth, dtype, hbm_mib)
+    compile_ms = _warm_probe_kernels(
+        tuple(devices), size, depth, dtype, hbm_mib, per_chip=per_chip
+    )
 
     t0 = time.perf_counter()
 
@@ -472,12 +754,47 @@ def _measure_node_health_traced(
     # bound — and sensitive to a DMA slot read early/late/twice, which a
     # sum-of-ones buffer could never see (ADVICE r5 #2).
     checksum_ok = all(float(p[2]) == expected_stream_sum(rows) for p in packed)
+    # Per-chip table: the traced path already times every chip separately
+    # (the device plane is keyed per device) — fault localization only
+    # needed the data kept apart instead of min()-aggregated away.
+    burnin_rates = _plane_device_rates(burnin_ms, devices)
+    burnin_best = _plane_device_rates(
+        {p: min(ds) * 1e3 for p, ds in burnin_durs.items()}, devices
+    )
+    hbm_rates = _plane_device_rates(hbm_ms, devices)
+    per_chip_table = []
+    for i, p in enumerate(packed):
+        chip_ok = bool(np.isfinite(p[0])) and bool(np.isfinite(p[1]))
+        chip_sum_ok = float(p[2]) == expected_stream_sum(rows)
+        b, h = burnin_rates[i], hbm_rates[i]
+        bb = burnin_best[i] if burnin_best[i] is not None else b
+        per_chip_table.append(
+            {
+                "healthy": chip_ok,
+                "tflops": (
+                    burnin_flops(size, depth) / (b / 1e3) / 1e12
+                    if b is not None
+                    else None
+                ),
+                "tflops_best": (
+                    burnin_flops(size, depth) / (bb / 1e3) / 1e12
+                    if bb is not None
+                    else None
+                ),
+                "hbm_gbps": (
+                    nbytes / (h / 1e3) / 2**30
+                    if h is not None and chip_sum_ok
+                    else None
+                ),
+            }
+        )
     return {
         "healthy": healthy,
         "tflops": tflops,
         "hbm_gbps": gbps if checksum_ok else None,
         "ici_ok": None,
         "chips": len(devices),
+        "per_chip": per_chip_table,
         "timing": "device-profiler",
         "phases": {
             # trace_ms is the chip-seizure window: dispatch + collection,
@@ -511,6 +828,7 @@ def _measure_node_health_wall(
     burnin_ms = (time.perf_counter() - t0) * 1e3
     hbm_gbps = None
     hbm_ms = 0.0
+    hbm = []
     if on_tpu:
         from gpu_feature_discovery_tpu.ops.hbm import measure_hbm_bandwidth
 
@@ -524,12 +842,28 @@ def _measure_node_health_wall(
         hbm_ms = (time.perf_counter() - t1) * 1e3
         if all(r["checksum_ok"] for r in hbm):
             hbm_gbps = min(r["gbps"] for r in hbm)
+    # Per-chip table: the wall path measured each device separately all
+    # along — keep the per-chip numbers next to the aggregate.
+    per_chip = [
+        {
+            "healthy": bool(r["healthy"]),
+            "tflops": float(r["tflops"]),
+            "tflops_best": float(r.get("tflops_best") or r["tflops"]),
+            "hbm_gbps": (
+                float(hbm[i]["gbps"])
+                if i < len(hbm) and hbm[i]["checksum_ok"]
+                else None
+            ),
+        }
+        for i, r in enumerate(reports)
+    ]
     return {
         "healthy": all(r["healthy"] for r in reports),
         "tflops": min(r["tflops"] for r in reports),
         "hbm_gbps": hbm_gbps,
         "ici_ok": None,
         "chips": len(reports),
+        "per_chip": per_chip,
         "timing": "wall-clock",
         "phases": {
             "burnin_ms": round(burnin_ms, 3),
@@ -544,10 +878,27 @@ def measure_node_health(
     iters: int = 4,
     ici: Optional[bool] = None,
     devices: Optional[list] = None,
+    per_chip: bool = False,
+    sick_chips=frozenset(),
+    slow_chips=frozenset(),
 ) -> dict:
     """Burn in EVERY local device and aggregate: a node is healthy only if
     all of its chips are, and the published rate is the worst chip's (the
     slowest chip governs what a workload will see).
+
+    Every report carries a ``per_chip`` table (per-device verdict + rates,
+    in device order). ``per_chip=True`` — the daemon's default via
+    ``--chip-probes`` — additionally runs the MESH-SHARDED probes: one
+    XLA program burns in every chip at once over the named chip mesh
+    (shard_map per-shard verdicts, ANDed into the table), and multi-chip
+    hosts get an ICI all-reduce bandwidth probe over the same mesh
+    (``ici_gbps``; None off-TPU, where the number is not a hardware
+    measurement). The ``chip.<i>.sick`` / ``chip.<i>.slow`` fault sites
+    (utils/faults.py, consumed by the CALLER) arrive here as
+    ``sick_chips`` / ``slow_chips``: a sick chip's shard input is
+    NaN-poisoned so the real finite-verdict detects it, a slow chip's
+    measured throughput is scaled by SLOW_CHIP_FACTOR (a chip cannot be
+    made genuinely slower on demand). Both require ``per_chip=True``.
 
     ``size``/``depth`` default by platform: the MXU-filling TPU geometry
     (TPU_PROBE_SIZE x TPU_PROBE_DEPTH — sustains ~90% of spec peak) on
@@ -579,6 +930,12 @@ def measure_node_health(
     if devices is None:
         devices = jax.local_devices()
     on_tpu = all(d.platform == "tpu" for d in devices)
+    override = _probe_geometry_override()
+    if override is not None:
+        # Hermetic-testing geometry (chaos chip-fault rows): applied only
+        # where the platform default would have been.
+        size = size if size is not None else override[0]
+        depth = depth if depth is not None else override[1]
     if size is None:
         size = TPU_PROBE_SIZE if on_tpu else DEFAULT_PROBE_SIZE
     if depth is None:
@@ -586,7 +943,7 @@ def measure_node_health(
     report = None
     if on_tpu and not _device_clock_unavailable:
         report, fail = _measure_node_health_traced(
-            devices, size=size, depth=depth, iters=iters
+            devices, size=size, depth=depth, iters=iters, per_chip=per_chip
         )
         if report is None:
             # Memoization policy (ADVICE r4 #1): every traced failure —
@@ -623,6 +980,77 @@ def measure_node_health(
         report = _measure_node_health_wall(
             devices, size=size, depth=depth, iters=iters, on_tpu=on_tpu
         )
+    if per_chip:
+        # A mis-indexed fault spec must fail loudly, not strand a chaos
+        # run in a silent convergence timeout: the parent-side consume
+        # already burned the shot (it has no inventory to check against),
+        # so the drop is named here, where the inventory is known.
+        out_of_range = sorted(
+            int(i)
+            for i in set(sick_chips) | set(slow_chips)
+            if not 0 <= int(i) < len(devices)
+        )
+        if out_of_range:
+            log.warning(
+                "injected chip fault index(es) %s outside the %d-device "
+                "inventory; the shot was consumed but cannot be enacted",
+                out_of_range,
+                len(devices),
+            )
+        dtype = jnp.bfloat16
+        t1 = time.perf_counter()
+        verdicts, allreduce_ok = sharded_chip_verdicts(
+            tuple(devices),
+            min(size, VERDICT_MAX_SIZE),
+            min(depth, VERDICT_MAX_DEPTH),
+            dtype,
+            sick_chips=frozenset(sick_chips),
+        )
+        report["phases"]["sharded_verdict_ms"] = round(
+            (time.perf_counter() - t1) * 1e3, 3
+        )
+        table = report.get("per_chip") or [
+            {"healthy": True, "tflops": None, "hbm_gbps": None} for _ in devices
+        ]
+        slow = {int(i) for i in slow_chips}
+        for i, entry in enumerate(table):
+            entry["id"] = i
+            if i < len(verdicts):
+                # Both detectors must agree the chip is fine: the
+                # per-device probe (its own kernels finite) AND the
+                # sharded program (finite under the collective program on
+                # the shared mesh).
+                entry["healthy"] = bool(entry["healthy"]) and verdicts[i]
+            if i in slow:
+                for rate_key in ("tflops", "tflops_best"):
+                    if entry.get(rate_key) is not None:
+                        entry[rate_key] = float(entry[rate_key]) * SLOW_CHIP_FACTOR
+        report["per_chip"] = table
+        report["healthy"] = bool(report["healthy"]) and all(
+            e["healthy"] for e in table
+        )
+        # Worst-chip aggregates track the (possibly fault-adjusted)
+        # per-chip table — the slowest chip governs the node's rate.
+        rates = [e["tflops"] for e in table if e.get("tflops") is not None]
+        if rates:
+            report["tflops"] = min(rates)
+        # The ICI all-reduce bandwidth probe rides the same named mesh —
+        # TPU only: off-TPU the number is not a hardware measurement
+        # (ici_gbps would be None regardless), and the verdict program's
+        # psum already proved the collective completes and sums
+        # correctly, so the extra timed dispatches would be pure
+        # per-cycle waste.
+        report["ici_gbps"] = None
+        if on_tpu and len(devices) > 1:
+            t2 = time.perf_counter()
+            allr = ici_allreduce_probe(devices)
+            report["phases"]["ici_allreduce_ms"] = round(
+                (time.perf_counter() - t2) * 1e3, 3
+            )
+            allreduce_ok = allreduce_ok and allr["checksum_ok"]
+            if allr["checksum_ok"]:
+                report["ici_gbps"] = allr["gbps"]
+        report["chips_allreduce_ok"] = allreduce_ok
     if ici is None:
         ici = on_tpu and len(devices) > 1
     elif ici and len(devices) < 2:
@@ -636,6 +1064,14 @@ def measure_node_health(
         sweep = ici_ring_sweep(Mesh(np.array(devices), ("ring",)))
         report["ici_ok"] = sweep["links_ok"] and sweep["allreduce_ok"]
         report["phases"]["ici_ms"] = round((time.perf_counter() - t1) * 1e3, 3)
+    if report.get("chips_allreduce_ok") is False:
+        # The verdict program's psum disagreed with the host-side sum on
+        # some chip (or the timed all-reduce's checksum failed): the
+        # reduction itself is corrupt. Fold it into the published
+        # collective verdict even when the ppermute sweep passed or did
+        # not run — a detected ICI fault must never stay an unread
+        # report key.
+        report["ici_ok"] = False
     report["phases"]["total_ms"] = round((time.perf_counter() - t_total) * 1e3, 3)
     return report
 

@@ -197,7 +197,7 @@ class _HealthProbe:
         self._thread: Optional[threading.Thread] = None
         self._outcome: Optional[dict] = None
 
-    def _run(self, devices) -> None:
+    def _run(self, devices, opts: dict) -> None:
         from gpu_feature_discovery_tpu.ops.healthcheck import (
             measure_node_health,
         )
@@ -205,7 +205,7 @@ class _HealthProbe:
         t0 = time.perf_counter()
         try:
             with self._chip_lock:
-                report = measure_node_health(devices=devices)
+                report = measure_node_health(devices=devices, **opts)
         except Exception as e:  # noqa: BLE001 - shipped to the parent
             self._outcome = {
                 "status": "probe-failed",
@@ -219,13 +219,19 @@ class _HealthProbe:
             "probe_ms": (time.perf_counter() - t0) * 1e3,
         }
 
-    def request(self) -> dict:
+    def request(self, req: Optional[dict] = None) -> dict:
         """One ``health`` RPC. Outcome vocabulary mirrors lm/health.py's
         in-process distinctions: ``unacquirable`` (says nothing about
         chip health) vs ``probe-failed`` (devices acquired, computation
         failed — the honest health.ok=false signal) vs ``ok`` with the
         report — plus ``warming`` while the probe (or the kernel
-        pre-warm holding the chip lock) is still running."""
+        pre-warm holding the chip lock) is still running.
+
+        ``req`` carries the parent-consumed per-chip options:
+        ``per_chip`` (--chip-probes) and the ``chip.<i>.sick`` /
+        ``chip.<i>.slow`` fault indices, bound into the probe THREAD at
+        start — a later collect request's (fault-less) options never
+        retroactively apply."""
         if self._thread is not None:
             self._thread.join(HEALTH_WAIT_S)
             if self._thread.is_alive():
@@ -238,15 +244,23 @@ class _HealthProbe:
         devices = _acquire_tpu_devices()
         if devices is None:
             return {"status": "unacquirable"}
+        req = req or {}
+        opts: dict = {}
+        if "per_chip" in req:
+            opts["per_chip"] = bool(req["per_chip"])
+        if req.get("sick_chips"):
+            opts["sick_chips"] = frozenset(int(i) for i in req["sick_chips"])
+        if req.get("slow_chips"):
+            opts["slow_chips"] = frozenset(int(i) for i in req["slow_chips"])
         self._thread = threading.Thread(
-            target=self._run, args=(devices,),
+            target=self._run, args=(devices, opts),
             name="tfd-broker-health", daemon=True,
         )
         self._thread.start()
         return self.request()
 
 
-def _child_prewarm(chip_lock: threading.Lock) -> None:
+def _child_prewarm(chip_lock: threading.Lock, per_chip: bool = True) -> None:
     """Warm-start: pre-compile the probe kernels right after init, OFF the
     label-serving path (a background thread — ``snapshot`` requests serve
     immediately while this compiles), so the first health cycle no longer
@@ -270,7 +284,7 @@ def _child_prewarm(chip_lock: threading.Lock) -> None:
         )
 
         with chip_lock:
-            warm_ms = warm_probe_kernels_for(tuple(devices))
+            warm_ms = warm_probe_kernels_for(tuple(devices), per_chip=per_chip)
         log.info("broker worker pre-warmed probe kernels in %.0f ms", warm_ms)
     except Exception:  # noqa: BLE001 - warm-start is best-effort
         log.debug("broker kernel pre-warm failed:", exc_info=True)
@@ -307,9 +321,14 @@ def _child_main(req_r: int, resp_w: int, config) -> None:
     chip_lock = threading.Lock()
     health_probe = _HealthProbe(chip_lock)
     if config.flags.tfd.with_burnin:
+        from gpu_feature_discovery_tpu.lm.health import _chip_probe_opts
+
         threading.Thread(
             target=_child_prewarm,
-            args=(chip_lock,),
+            # The parent's default resolution (--chip-probes on when
+            # unset): --chip-probes=off must not compile the
+            # mesh-sharded programs.
+            args=(chip_lock, _chip_probe_opts(config)[0]),
             name="tfd-broker-prewarm",
             daemon=True,
         ).start()
@@ -344,7 +363,7 @@ def _child_main(req_r: int, resp_w: int, config) -> None:
                     "snapshot": DeviceSnapshot.from_manager(manager).to_dict(),
                 }
             elif op == "health":
-                resp = health_probe.request()
+                resp = health_probe.request(req)
             elif op == "shutdown":
                 try:
                     _write_frame(resp_w, {"status": "ok"})
@@ -686,11 +705,17 @@ class BrokerClient:
 
     # -- the RPC ----------------------------------------------------------
 
-    def request(self, op: str, timeout_s: Optional[float] = None) -> dict:
+    def request(
+        self,
+        op: str,
+        timeout_s: Optional[float] = None,
+        extra: Optional[dict] = None,
+    ) -> dict:
         """One request/response round trip under the SIGKILL deadline.
-        Raises BrokerTimeout (worker killed), BrokerCrash (worker died or
-        framed garbage), or ResourceError (the op itself failed in the
-        worker — the worker stays up)."""
+        ``extra`` carries op parameters (the health RPC's per-chip fault
+        options). Raises BrokerTimeout (worker killed), BrokerCrash
+        (worker died or framed garbage), or ResourceError (the op itself
+        failed in the worker — the worker stays up)."""
         from gpu_feature_discovery_tpu import sandbox
         from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
         from gpu_feature_discovery_tpu.utils import faults
@@ -699,6 +724,8 @@ class BrokerClient:
         with self._lock:
             self._ensure_running()
             payload = {"op": op}
+            if extra:
+                payload.update(extra)
             if faults.consume("broker.hang"):
                 payload["hang"] = True
             elif faults.consume("broker.crash"):
@@ -823,10 +850,20 @@ class BrokerClient:
         doc = self.request("snapshot")
         return DeviceSnapshot.from_dict(doc.get("snapshot") or {})
 
-    def health(self) -> dict:
+    def health(
+        self, per_chip: bool = True, sick_chips=(), slow_chips=()
+    ) -> dict:
         """The burn-in probe, executed in the worker. Returns the child's
-        outcome document (status ok | unacquirable | probe-failed)."""
-        return self.request("health")
+        outcome document (status ok | unacquirable | probe-failed).
+        ``per_chip`` and the chip fault indices (consumed by the CALLER —
+        the parent owns the fault registry) ride the request frame; the
+        worker enacts them inside measure_node_health."""
+        extra: dict = {"per_chip": bool(per_chip)}
+        if sick_chips:
+            extra["sick_chips"] = [int(i) for i in sick_chips]
+        if slow_chips:
+            extra["slow_chips"] = [int(i) for i in slow_chips]
+        return self.request("health", extra=extra)
 
     def ping(self) -> bool:
         return self.request("ping").get("status") == "ok"

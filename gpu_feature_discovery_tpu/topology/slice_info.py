@@ -14,20 +14,37 @@ from gpu_feature_discovery_tpu.resource.types import Chip, Manager
 
 class SliceInfo:
     """Per-pass view of the node's chips keyed by slice binding
-    (mig.DeviceInfo analog)."""
+    (mig.DeviceInfo analog).
+
+    The grouping memo is keyed by the manager's CURRENT chip list, not
+    built-once-per-instance: a broker-backed manager re-enumerates every
+    cycle (sandbox/broker.BrokerManager.init refreshes the snapshot), so
+    an instance that outlives one label pass — or a pass that races a
+    mid-epoch chip-count change — must never serve the previous
+    enumeration's grouping. Same-list calls still probe each chip's
+    slice binding exactly once (is_slice_enabled is real device I/O on a
+    libtpu backend); only a changed list rebuilds."""
 
     def __init__(self, manager: Manager):
         self._manager = manager
         self._chips_map: Optional[Dict[bool, List[Chip]]] = None
+        self._chips_key: Optional[tuple] = None
 
     def get_chips_map(self) -> Dict[bool, List[Chip]]:
-        """Chips grouped by is_slice_enabled(); built on first use
-        (mig.go:41-64)."""
-        if self._chips_map is None:
+        """Chips grouped by is_slice_enabled(); built on first use and
+        invalidated when the manager's chip list changes (mig.go:41-64)."""
+        chips = self._manager.get_chips()
+        # id() keys cannot alias across invalidations: _chips_map keeps
+        # the keyed chips referenced, and CPython never recycles a live
+        # object's address — a fresh enumeration can only match the
+        # cached key by BEING the cached objects.
+        key = tuple(id(c) for c in chips)
+        if self._chips_map is None or key != self._chips_key:
             grouped: Dict[bool, List[Chip]] = {True: [], False: []}
-            for chip in self._manager.get_chips():
+            for chip in chips:
                 grouped[chip.is_slice_enabled()].append(chip)
             self._chips_map = grouped
+            self._chips_key = key
         return self._chips_map
 
     def get_chips_with_slices_enabled(self) -> List[Chip]:

@@ -39,12 +39,25 @@ Instrumented sites:
                        --probe-timeout and respawns on next use
     broker.crash       the broker worker dies to a real SIGSEGV at one
                        request (the crash-respawn path)
+    chip.<i>.sick      per-chip fault localization (--chip-probes): chip
+                       <i>'s shard input in the mesh-sharded burn-in is
+                       NaN-poisoned for one probe, so the REAL per-shard
+                       finite-verdict detects it and the labeler
+                       publishes chip.<i>.ok=false while the node stays
+                       live (ops/healthcheck.py sick_chips)
+    chip.<i>.slow      chip <i>'s measured throughput is scaled down for
+                       one probe (SLOW_CHIP_FACTOR) — the straggler-
+                       detection path (tpu.straggler-chip); confirmation
+                       takes 2 consecutive probes, so arm 2 shots
 
-The ``probe.*`` and ``broker.*`` sites are BEHAVIORAL: the sandbox driver consumes them
-with ``consume()`` (countdown without raising) in the PARENT process and
-enacts the behavior in/around the forked child — a child-side countdown
-would decrement only the child's fork-copied registry and re-fire
-forever, so no chaos scenario could converge.
+The ``probe.*``, ``broker.*`` and ``chip.*`` sites are BEHAVIORAL: the
+driver consumes them with ``consume()`` (countdown without raising) in
+the PARENT process and enacts the behavior in/around the forked child —
+a child-side countdown would decrement only the child's fork-copied
+registry and re-fire forever, so no chaos scenario could converge. For
+``chip.*`` the consumer is the health labeler (lm/health.py), per
+PROBING cycle, and the enactment site is wherever the probe executes:
+in-process, or shipped to the broker worker in the ``health`` RPC.
 
 The registry is process-global and loaded lazily from the environment on
 first use; tests install specs directly with ``load_fault_spec`` and MUST
@@ -57,6 +70,7 @@ from __future__ import annotations
 
 import logging
 import os
+import re
 import threading
 from typing import Dict, Optional, Tuple, Type
 
@@ -106,6 +120,13 @@ class FaultRegistry:
     def sites(self) -> Tuple[str, ...]:
         return tuple(self._faults)
 
+    def armed_sites(self) -> Tuple[str, ...]:
+        """Sites with shots remaining — the dynamic-site families
+        (chip.<i>.*) need to DISCOVER which indices are armed before
+        consuming them; listing does not consume."""
+        with self._lock:
+            return tuple(s for s, f in self._faults.items() if f.remaining > 0)
+
     def fire(self, site: str) -> None:
         fault = self._faults.get(site)
         if fault is None:
@@ -122,6 +143,18 @@ class FaultRegistry:
             remaining,
         )
         raise fault.exc_type(f"injected fault at {site!r} ({FAULT_SPEC_ENV})")
+
+    def untake(self, site: str) -> None:
+        """Give one consumed shot back. The broker health path consumes
+        chip shots BEFORE its RPC (the indices travel in the request), so
+        a request that failed or answered "unacquirable" — the probe the
+        shots were bound to never launched/published — must re-arm them
+        instead of silently burning the injection budget."""
+        fault = self._faults.get(site)
+        if fault is None:
+            return
+        with self._lock:
+            fault.remaining += 1
 
     def take(self, site: str) -> bool:
         """Countdown WITHOUT raising: True when ``site`` was armed with
@@ -236,6 +269,42 @@ def consume(site: str) -> bool:
     if reg is None:
         return False
     return reg.take(site)
+
+
+_CHIP_SITE_RE = re.compile(r"^chip\.(\d+)\.(sick|slow)$")
+
+
+def consume_chip_faults() -> Tuple[frozenset, frozenset]:
+    """Consume every armed ``chip.<i>.sick`` / ``chip.<i>.slow`` site (one
+    shot each) and return ``(sick_indices, slow_indices)``. Called by the
+    health labeler in the PARENT, once per probing cycle, right before a
+    probe is launched — the indices then travel to wherever the probe
+    executes (in-process measure, or the broker worker via the health
+    RPC)."""
+    reg = _ensure_loaded()
+    if reg is None:
+        return frozenset(), frozenset()
+    sick, slow = set(), set()
+    for site in reg.armed_sites():
+        m = _CHIP_SITE_RE.match(site)
+        if m is None:
+            continue
+        if reg.take(site):
+            (sick if m.group(2) == "sick" else slow).add(int(m.group(1)))
+    return frozenset(sick), frozenset(slow)
+
+
+def rearm_chip_faults(sick, slow) -> None:
+    """Give consumed ``chip.<i>.*`` shots back (see
+    FaultRegistry.untake): called when the probe the shots were shipped
+    to never ran."""
+    reg = _ensure_loaded()
+    if reg is None:
+        return
+    for i in sick:
+        reg.untake(f"chip.{i}.sick")
+    for i in slow:
+        reg.untake(f"chip.{i}.slow")
 
 
 def _ensure_loaded() -> Optional[FaultRegistry]:

@@ -32,7 +32,37 @@ CHAOS_SPECS = [
     # contained acquisition fault.
     "broker.hang:fail:1",
     "broker.crash:fail:1",
+    # Per-chip fault localization (--chip-probes): a sick chip must
+    # publish its own quarantine labels while the daemon keeps serving
+    # (no exit, no full-node DEGRADED), and an injected straggler must be
+    # confirmed over 2 consecutive probes and clear once the fault
+    # drains. The driver auto-configures the burn-in path for chip.*
+    # specs (real sharded probe on the 8-device virtual mesh).
+    "chip.3.sick:fail:1",
+    "chip.2.slow:fail:2",
 ]
+
+# Per-spec label expectations + convergence budgets beyond the generic
+# contract (chaos-run.py run_chaos kwargs). The chip rows pay real XLA
+# compiles, hence the larger budget.
+CHAOS_EXPECTATIONS = {
+    "chip.3.sick:fail:1": {
+        "expect_transient": [
+            "google.com/tpu.chip.3.ok=false",
+            "google.com/tpu.chips.sick=1",
+        ],
+        "expect_final": [
+            "google.com/tpu.chip.3.ok=true",
+            "google.com/tpu.chips.sick=0",
+        ],
+        "timeout_s": 90.0,
+    },
+    "chip.2.slow:fail:2": {
+        "expect_transient": ["google.com/tpu.straggler-chip=2"],
+        "expect_absent": ["google.com/tpu.straggler-chip"],
+        "timeout_s": 90.0,
+    },
+}
 
 
 def _driver():
@@ -46,8 +76,10 @@ def _driver():
 
 @pytest.mark.parametrize("fault_spec", CHAOS_SPECS)
 def test_daemon_converges_under_faults(fault_spec, tmp_path):
-    result = _driver().run_chaos(fault_spec, str(tmp_path))
-    assert result["converged_s"] < 8.0
+    kwargs = dict(CHAOS_EXPECTATIONS.get(fault_spec, {}))
+    budget = kwargs.get("timeout_s", 8.0)
+    result = _driver().run_chaos(fault_spec, str(tmp_path), **kwargs)
+    assert result["converged_s"] < budget
 
 
 def test_ci_matrix_matches_rows():

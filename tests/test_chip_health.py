@@ -1,0 +1,776 @@
+"""Per-chip fault localization (--chip-probes, ISSUE 6).
+
+Covers: golden per-chip label sets across the mock shapes (1/4/8 chips x
+3 topology strategies), the single-sick-chip and straggler-chip
+scenarios, byte-identity of --chip-probes=off against the aggregate-only
+labels, the broker RPC fault plumbing, and the 8-device MULTICHIP
+acceptance scenario on the REAL mesh-sharded probe.
+"""
+
+import queue
+
+import jax
+import pytest
+
+import gpu_feature_discovery_tpu.lm.health as health_mod
+from gpu_feature_discovery_tpu.cmd.main import run
+from gpu_feature_discovery_tpu.config.flags import (
+    DEFAULT_STRAGGLER_THRESHOLD,
+    new_config,
+)
+from gpu_feature_discovery_tpu.lm.health import (
+    CHIP_HBM_FMT,
+    CHIP_OK_FMT,
+    CHIP_TFLOPS_FMT,
+    CHIPS_HEALTHY,
+    CHIPS_SICK,
+    HEALTH_ICI_GBPS,
+    HEALTH_OK,
+    STRAGGLER_CHIP,
+    StragglerDetector,
+    detect_straggler,
+    new_health_labeler,
+)
+from gpu_feature_discovery_tpu.lm.labeler import Empty
+from gpu_feature_discovery_tpu.resource.testing import (
+    MockChip,
+    MockManager,
+    new_mixed_slice_manager,
+    new_single_host_manager,
+    new_uniform_slice_manager,
+)
+from gpu_feature_discovery_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _fresh_schedule():
+    """Process-global burn-in schedule isolation (same contract as
+    tests/test_health.py) + fault-registry hygiene."""
+    health_mod.reset_burnin_schedule()
+    health_mod._first_probe_inflight = None
+    original_wait = health_mod.FIRST_PROBE_WAIT_S
+    health_mod.FIRST_PROBE_WAIT_S = 300.0
+    yield
+    health_mod.FIRST_PROBE_WAIT_S = original_wait
+    health_mod.reset_burnin_schedule()
+    health_mod._first_probe_inflight = None
+    faults.reset()
+
+
+def cfg(**cli):
+    values = {"with-burnin": "true"}
+    values.update(cli)
+    return new_config(cli_values=values, environ={}, config_file=None)
+
+
+def _pretend_devices_are_tpus(monkeypatch):
+    monkeypatch.setattr(
+        health_mod, "_acquire_tpu_devices", lambda: jax.local_devices()
+    )
+
+
+def fixed_report(n, sick=(), rates=None, hbm=None, ici_gbps=None):
+    """A deterministic device-profiler report with an n-chip per_chip
+    table — the shape ops/healthcheck.measure_node_health(per_chip=True)
+    produces, with hand-picked plausible v5e rates."""
+    sick = set(sick)
+    rates = rates if rates is not None else [100.0 + i for i in range(n)]
+    hbm = hbm if hbm is not None else [500.0 + i for i in range(n)]
+    table = [
+        {
+            "id": i,
+            "healthy": i not in sick,
+            "tflops": float(rates[i]),
+            "hbm_gbps": float(hbm[i]),
+        }
+        for i in range(n)
+    ]
+    return {
+        "healthy": not sick,
+        "tflops": min(rates),
+        "hbm_gbps": min(hbm),
+        "ici_ok": None,
+        "chips": n,
+        "per_chip": table,
+        "ici_gbps": ici_gbps,
+        "timing": "device-profiler",
+    }
+
+
+def _fake_measure(monkeypatch, report_fn):
+    import gpu_feature_discovery_tpu.ops.healthcheck as hc
+
+    calls = {"n": 0, "kwargs": []}
+
+    def fake(**kw):
+        calls["n"] += 1
+        calls["kwargs"].append(kw)
+        return report_fn(calls["n"], kw)
+
+    monkeypatch.setattr(hc, "measure_node_health", fake)
+    return calls
+
+
+# ---------------------------------------------------------------------------
+# golden per-chip label sets: 1/4/8 chips x 3 strategies
+# ---------------------------------------------------------------------------
+
+def _manager_for(strategy, accel_type):
+    if strategy == "single":
+        return new_uniform_slice_manager(accel_type)
+    if strategy == "mixed":
+        from gpu_feature_discovery_tpu.models import parse_accelerator_type
+
+        at = parse_accelerator_type(accel_type)
+        return new_mixed_slice_manager(
+            at.spec.family, topologies=[["2x2"] for _ in range(at.chips)]
+        )
+    return new_single_host_manager(accel_type)
+
+
+@pytest.mark.parametrize("strategy", ["none", "single", "mixed"])
+@pytest.mark.parametrize("accel_type,n", [("v5e-1", 1), ("v5e-4", 4), ("v5e-8", 8)])
+def test_per_chip_golden_labels(
+    tmp_path, monkeypatch, strategy, accel_type, n
+):
+    """The full oneshot label file carries the EXACT per-chip family for
+    every mock shape and strategy: one ok/tflops/hbm-gbps triple per
+    chip, the healthy/sick counts, and no straggler on a clean node."""
+    _pretend_devices_are_tpus(monkeypatch)
+    _fake_measure(monkeypatch, lambda c, kw: fixed_report(n))
+    manager = _manager_for(strategy, accel_type)
+    out = tmp_path / "tfd"
+    config = cfg(
+        **{
+            "oneshot": "true",
+            "output-file": str(out),
+            "tpu-topology-strategy": strategy,
+            "machine-type-file": str(tmp_path / "missing"),
+        }
+    )
+    assert run(manager, Empty(), config, queue.Queue()) is False
+    labels = dict(
+        line.split("=", 1) for line in out.read_text().splitlines() if "=" in line
+    )
+    expected = {CHIPS_HEALTHY: str(n), CHIPS_SICK: "0"}
+    for i in range(n):
+        expected[CHIP_OK_FMT % i] = "true"
+        expected[CHIP_TFLOPS_FMT % i] = str(100 + i)
+        expected[CHIP_HBM_FMT % i] = str(500 + i)
+    for key, value in expected.items():
+        assert labels.get(key) == value, (key, labels.get(key))
+    assert STRAGGLER_CHIP not in labels
+    assert labels[HEALTH_OK] == "true"
+    # No stray chip indices beyond the table.
+    assert CHIP_OK_FMT % n not in labels
+
+
+def test_single_sick_chip_labels(monkeypatch):
+    """One sick chip: its own ok=false, everyone else true, counts say
+    7/1, the aggregate honestly reports the node unhealthy — and the
+    labeler RETURNS labels (a sick chip is a measurement, not a fault,
+    so the cycle completes and the supervisor machinery never fires)."""
+    _pretend_devices_are_tpus(monkeypatch)
+    _fake_measure(monkeypatch, lambda c, kw: fixed_report(8, sick={3}))
+    manager = MockManager(chips=[MockChip(family="v5e") for _ in range(8)])
+    labels = new_health_labeler(manager, cfg()).labels()
+    assert labels[CHIP_OK_FMT % 3] == "false"
+    for i in (0, 1, 2, 4, 5, 6, 7):
+        assert labels[CHIP_OK_FMT % i] == "true"
+    assert labels[CHIPS_HEALTHY] == "7"
+    assert labels[CHIPS_SICK] == "1"
+    assert labels[HEALTH_OK] == "false"
+
+
+def test_chip_probes_off_reproduces_aggregate_labels_byte_identical(
+    tmp_path, monkeypatch
+):
+    """--chip-probes=off must reproduce today's aggregate-only output
+    BYTE for byte, even when the measure reports a per-chip table (the
+    emission gate lives in the labeler, not the probe)."""
+    _pretend_devices_are_tpus(monkeypatch)
+
+    def run_to_bytes(out_name, chip_probes, with_table):
+        health_mod.reset_burnin_schedule()
+        health_mod._first_probe_inflight = None
+        report = fixed_report(4)
+        if not with_table:
+            # The pre-per-chip report shape.
+            report.pop("per_chip")
+            report.pop("ici_gbps")
+        _fake_measure(monkeypatch, lambda c, kw: dict(report))
+        out = tmp_path / out_name
+        config = cfg(
+            **{
+                "oneshot": "true",
+                "no-timestamp": "true",
+                "output-file": str(out),
+                "machine-type-file": str(tmp_path / "missing"),
+                "chip-probes": chip_probes,
+            }
+        )
+        manager = MockManager(chips=[MockChip(family="v5e") for _ in range(4)])
+        assert run(manager, Empty(), config, queue.Queue()) is False
+        return out.read_bytes()
+
+    off_bytes = run_to_bytes("tfd-off", "off", with_table=True)
+    legacy_bytes = run_to_bytes("tfd-legacy", "on", with_table=False)
+    assert off_bytes == legacy_bytes
+    assert b".chip." not in off_bytes
+
+
+def test_chip_rates_apply_plausibility_gates(monkeypatch):
+    """Per-chip rates ride the same gates as the aggregate: host-clock
+    sub-1 readings and above-spec-peak artifacts are omitted while the
+    verdict labels stay."""
+    _pretend_devices_are_tpus(monkeypatch)
+
+    def report(c, kw):
+        r = fixed_report(3, rates=[0.004, 100.0, 69000.0], hbm=[500.0] * 3)
+        r["timing"] = "wall-clock"
+        return r
+
+    _fake_measure(monkeypatch, report)
+    manager = MockManager(chips=[MockChip(family="v5e") for _ in range(3)])
+    labels = new_health_labeler(manager, cfg()).labels()
+    assert CHIP_TFLOPS_FMT % 0 not in labels      # host-clock floor
+    assert labels[CHIP_TFLOPS_FMT % 1] == "100"   # plausible
+    assert CHIP_TFLOPS_FMT % 2 not in labels      # above v5e spec peak
+    for i in range(3):
+        assert labels[CHIP_OK_FMT % i] == "true"
+
+
+# ---------------------------------------------------------------------------
+# straggler detection
+# ---------------------------------------------------------------------------
+
+def test_detect_straggler_fires_below_threshold():
+    table = fixed_report(8, rates=[100.0] * 7 + [10.0])["per_chip"]
+    assert detect_straggler(table, 0.2) == 7
+    assert detect_straggler(table, 0.05) is None  # 10% of median > 5%
+
+
+def test_detect_straggler_needs_three_rated_chips():
+    table = fixed_report(2, rates=[100.0, 1.0])["per_chip"]
+    assert detect_straggler(table, 0.2) is None
+
+
+def test_detect_straggler_ignores_sick_chips():
+    """A sick chip is quarantined by its ok label, not double-reported
+    as a straggler; the median is computed over healthy chips only."""
+    table = fixed_report(4, sick={0}, rates=[0.1, 100.0, 101.0, 102.0])[
+        "per_chip"
+    ]
+    assert detect_straggler(table, 0.5) is None
+
+
+def test_straggler_requires_consecutive_confirmation(monkeypatch):
+    """One slow probe is a blip (host-clock noise); the SAME chip slow on
+    2 consecutive probes publishes tpu.straggler-chip, and a clean probe
+    clears it."""
+    _pretend_devices_are_tpus(monkeypatch)
+    slow = [100.0] * 7 + [10.0]
+    clean = [100.0 + i for i in range(8)]
+    sequence = [slow, slow, clean]
+    _fake_measure(
+        monkeypatch,
+        lambda c, kw: fixed_report(8, rates=sequence[min(c, len(sequence)) - 1]),
+    )
+    manager = MockManager(chips=[MockChip(family="v5e") for _ in range(8)])
+    config = cfg(**{"burnin-interval": "1"})
+    first = new_health_labeler(manager, config).labels()
+    assert STRAGGLER_CHIP not in first  # streak of 1: unconfirmed
+    second = new_health_labeler(manager, config).labels()
+    assert second[STRAGGLER_CHIP] == "7"
+    third = new_health_labeler(manager, config).labels()
+    assert STRAGGLER_CHIP not in third
+
+
+def test_straggler_no_false_positives_across_50_jittered_cycles():
+    """50 clean probes with +/-30% deterministic per-chip jitter — far
+    rougher than device-clock spread — never confirm a straggler at the
+    default threshold."""
+    import random
+
+    rng = random.Random(1234)
+    detector = StragglerDetector(DEFAULT_STRAGGLER_THRESHOLD)
+    for _ in range(50):
+        rates = [100.0 * rng.uniform(0.7, 1.3) for _ in range(8)]
+        table = fixed_report(8, rates=rates)["per_chip"]
+        assert detector.observe(table) is None
+
+
+def test_straggler_streak_resets_across_unacquirable_gap(monkeypatch):
+    """Two slow observations separated by an unacquirable stretch are NOT
+    'consecutive probes': the confirmation streak starts fresh after the
+    gap, so the straggler publishes only once two genuinely consecutive
+    probes agree again."""
+    _pretend_devices_are_tpus(monkeypatch)
+    slow = [100.0] * 7 + [10.0]
+    _fake_measure(monkeypatch, lambda c, kw: fixed_report(8, rates=slow))
+    manager = MockManager(chips=[MockChip(family="v5e") for _ in range(8)])
+    config = cfg(**{"burnin-interval": "1"})
+    assert STRAGGLER_CHIP not in new_health_labeler(manager, config).labels()
+    monkeypatch.setattr(health_mod, "_acquire_tpu_devices", lambda: None)
+    assert new_health_labeler(manager, config).labels() == {}
+    _pretend_devices_are_tpus(monkeypatch)
+    after_gap = new_health_labeler(manager, config).labels()
+    assert STRAGGLER_CHIP not in after_gap  # fresh streak of 1, not 2
+    confirmed = new_health_labeler(manager, config).labels()
+    assert confirmed[STRAGGLER_CHIP] == "7"
+
+
+def test_straggler_streak_resets_across_failed_probe(monkeypatch):
+    """A failed probe produced no per-chip table: the observations on
+    either side of it are not consecutive evidence against one chip."""
+    _pretend_devices_are_tpus(monkeypatch)
+    slow = [100.0] * 7 + [10.0]
+
+    def report(c, kw):
+        if c == 2:
+            raise RuntimeError("probe blew up")
+        return fixed_report(8, rates=slow)
+
+    _fake_measure(monkeypatch, report)
+    manager = MockManager(chips=[MockChip(family="v5e") for _ in range(8)])
+    config = cfg(**{"burnin-interval": "1"})
+    assert STRAGGLER_CHIP not in new_health_labeler(manager, config).labels()
+    failed = new_health_labeler(manager, config).labels()
+    assert failed[HEALTH_OK] == "false"
+    after_failure = new_health_labeler(manager, config).labels()
+    assert STRAGGLER_CHIP not in after_failure  # fresh streak of 1
+    assert STRAGGLER_CHIP in new_health_labeler(manager, config).labels()
+
+
+def test_corrupt_allreduce_suppresses_gbps_label(monkeypatch):
+    """A report whose verdict psum disagreed across chips must not
+    publish its all-reduce timing as a bandwidth."""
+    _pretend_devices_are_tpus(monkeypatch)
+
+    def report(c, kw):
+        r = fixed_report(8, ici_gbps=123.0)
+        r["chips_allreduce_ok"] = False
+        return r
+
+    _fake_measure(monkeypatch, report)
+    manager = MockManager(chips=[MockChip(family="v5e") for _ in range(8)])
+    labels = new_health_labeler(manager, cfg()).labels()
+    assert HEALTH_ICI_GBPS not in labels
+
+
+def test_measure_folds_allreduce_disagreement_into_ici_ok(monkeypatch):
+    """measure_node_health forces the published collective verdict
+    (ici_ok -> health.ici.ok=false) when the verdict program's psum
+    disagreed — a detected ICI fault never stays an unread report key."""
+    import gpu_feature_discovery_tpu.ops.healthcheck as hc
+
+    devices = jax.local_devices()
+    monkeypatch.setattr(
+        hc,
+        "sharded_chip_verdicts",
+        lambda *a, **k: ([True] * len(devices), False),
+    )
+    report = hc.measure_node_health(
+        per_chip=True, ici=False, devices=devices, size=64, depth=1, iters=1
+    )
+    assert report["chips_allreduce_ok"] is False
+    assert report["ici_ok"] is False
+
+
+def test_warm_skips_per_chip_programs_when_disabled(monkeypatch):
+    """--chip-probes=off must not compile or execute the mesh-sharded
+    programs during kernel warming (in-process or broker prewarm)."""
+    import gpu_feature_discovery_tpu.ops.healthcheck as hc
+
+    calls = []
+    monkeypatch.setattr(
+        hc, "_warm_per_chip_kernels", lambda *a, **k: calls.append(a)
+    )
+    hc.reset_probe_workspaces()
+    devices = tuple(jax.local_devices())
+    hc.warm_probe_kernels_for(devices, per_chip=False)
+    assert calls == []
+    hc.reset_probe_workspaces()
+    hc.warm_probe_kernels_for(devices)
+    assert len(calls) == 1
+    hc.reset_probe_workspaces()
+
+
+def test_out_of_range_chip_fault_index_warns(caplog):
+    """A mis-indexed fault spec is named loudly where the inventory is
+    known, instead of stranding a chaos run in a convergence timeout."""
+    import logging
+
+    import gpu_feature_discovery_tpu.ops.healthcheck as hc
+
+    devices = jax.local_devices()
+    with caplog.at_level(logging.WARNING, logger="tfd.ops"):
+        report = hc.measure_node_health(
+            per_chip=True,
+            ici=False,
+            devices=devices,
+            size=64,
+            depth=1,
+            iters=1,
+            sick_chips=frozenset({99}),
+        )
+    assert "outside the" in caplog.text
+    assert all(e["healthy"] for e in report["per_chip"])
+
+
+def test_straggler_threshold_flag_validation():
+    from gpu_feature_discovery_tpu.config.spec import ConfigError
+
+    with pytest.raises(ConfigError):
+        cfg(**{"straggler-threshold": "0"})
+    with pytest.raises(ConfigError):
+        cfg(**{"straggler-threshold": "1.0"})
+    with pytest.raises(ConfigError):
+        cfg(**{"straggler-threshold": "nope"})
+    assert cfg(**{"straggler-threshold": "0.4"}).flags.tfd.straggler_threshold == 0.4
+    assert cfg().flags.tfd.straggler_threshold == DEFAULT_STRAGGLER_THRESHOLD
+
+
+# ---------------------------------------------------------------------------
+# fault-site plumbing (chip.<i>.sick / chip.<i>.slow)
+# ---------------------------------------------------------------------------
+
+def test_chip_faults_consumed_at_probe_launch(monkeypatch):
+    """The armed indices reach measure_node_health exactly once (consumed
+    at probe LAUNCH, parent-side), and the next probe runs clean."""
+    _pretend_devices_are_tpus(monkeypatch)
+    calls = _fake_measure(
+        monkeypatch,
+        lambda c, kw: fixed_report(8, sick=kw.get("sick_chips") or ()),
+    )
+    faults.load_fault_spec("chip.3.sick:fail:1,chip.5.slow:fail:1")
+    manager = MockManager(chips=[MockChip(family="v5e") for _ in range(8)])
+    config = cfg(**{"burnin-interval": "1"})
+    labels = new_health_labeler(manager, config).labels()
+    assert labels[CHIP_OK_FMT % 3] == "false"
+    assert calls["kwargs"][0]["sick_chips"] == frozenset({3})
+    assert calls["kwargs"][0]["slow_chips"] == frozenset({5})
+    assert calls["kwargs"][0]["per_chip"] is True
+    labels = new_health_labeler(manager, config).labels()
+    assert labels[CHIP_OK_FMT % 3] == "true"
+    assert calls["kwargs"][1]["sick_chips"] == frozenset()
+
+
+def test_chip_faults_noop_with_chip_probes_off(monkeypatch):
+    """chip.* sites require the per-chip path: with --chip-probes=off the
+    shots are NOT consumed (the fault registry stays armed, so a chaos
+    row misconfigured against an off daemon fails loudly by never
+    draining, instead of silently testing nothing)."""
+    _pretend_devices_are_tpus(monkeypatch)
+    calls = _fake_measure(monkeypatch, lambda c, kw: fixed_report(4))
+    reg = faults.load_fault_spec("chip.1.sick:fail:1")
+    manager = MockManager(chips=[MockChip(family="v5e") for _ in range(4)])
+    new_health_labeler(manager, cfg(**{"chip-probes": "off"})).labels()
+    assert calls["kwargs"][0]["per_chip"] is False
+    assert calls["kwargs"][0]["sick_chips"] == frozenset()
+    assert reg.armed_sites() == ("chip.1.sick",)
+
+
+def test_broker_health_rpc_carries_chip_faults(monkeypatch):
+    """Parent-side consumption, worker-side enactment: the broker path
+    ships the consumed indices in the health RPC instead of touching the
+    registry from the (fork-copied) worker."""
+    calls = {}
+
+    class FakeBroker:
+        def health(self, per_chip=True, sick_chips=(), slow_chips=()):
+            calls["rpc"] = (per_chip, list(sick_chips), list(slow_chips))
+            return {
+                "status": "ok",
+                "report": fixed_report(4, sick=set(sick_chips)),
+                "probe_ms": 5.0,
+            }
+
+    class FakeManager(MockManager):
+        broker = FakeBroker()
+
+    faults.load_fault_spec("chip.2.sick:fail:1")
+    manager = FakeManager(chips=[MockChip(family="v5e") for _ in range(4)])
+    labels = new_health_labeler(manager, cfg()).labels()
+    assert calls["rpc"] == (True, [2], [])
+    assert labels[CHIP_OK_FMT % 2] == "false"
+    assert labels[CHIPS_SICK] == "1"
+
+
+def test_broker_warming_cycles_do_not_burn_chip_shots(monkeypatch):
+    """While the worker answers 'warming' the parent is only COLLECTING —
+    a shot consumed there would vanish without ever being enacted."""
+    outcomes = iter(
+        [
+            {"status": "warming"},
+            {"status": "warming"},
+            {
+                "status": "ok",
+                "report": fixed_report(4),
+                "probe_ms": 5.0,
+            },
+        ]
+    )
+    shipped = []
+
+    class FakeBroker:
+        def health(self, per_chip=True, sick_chips=(), slow_chips=()):
+            shipped.append(list(sick_chips))
+            return next(outcomes)
+
+    class FakeManager(MockManager):
+        broker = FakeBroker()
+
+    reg = faults.load_fault_spec("chip.1.sick:fail:1,chip.3.sick:fail:1")
+    manager = FakeManager(chips=[MockChip(family="v5e") for _ in range(4)])
+    config = cfg(**{"burnin-interval": "1"})
+    new_health_labeler(manager, config).labels()  # launches: consumes both
+    assert shipped[0] == [1, 3]
+    new_health_labeler(manager, config).labels()  # warming: collect only
+    assert shipped[1] == []
+    assert reg.armed_sites() == ()  # nothing re-armed, nothing re-burned
+    new_health_labeler(manager, config).labels()
+    assert shipped[2] == []
+
+
+def test_broker_unacquirable_rearms_chip_fault_shots(monkeypatch):
+    """An 'unacquirable' answer means the worker never launched a probe:
+    the shipped shots were not enacted and must re-arm, not silently
+    burn — the next real launch delivers them."""
+    outcomes = iter(
+        [
+            {"status": "unacquirable"},
+            {
+                "status": "ok",
+                "report": fixed_report(4, sick={2}),
+                "probe_ms": 5.0,
+            },
+        ]
+    )
+    shipped = []
+
+    class FakeBroker:
+        def health(self, per_chip=True, sick_chips=(), slow_chips=()):
+            shipped.append(list(sick_chips))
+            return next(outcomes)
+
+    class FakeManager(MockManager):
+        broker = FakeBroker()
+
+    reg = faults.load_fault_spec("chip.2.sick:fail:1")
+    manager = FakeManager(chips=[MockChip(family="v5e") for _ in range(4)])
+    config = cfg(**{"burnin-interval": "1"})
+    new_health_labeler(manager, config).labels()  # unacquirable cycle
+    assert shipped[0] == [2]
+    assert reg.armed_sites() == ("chip.2.sick",)  # given back
+    labels = new_health_labeler(manager, config).labels()
+    assert shipped[1] == [2]  # delivered to the real launch
+    assert labels[CHIP_OK_FMT % 2] == "false"
+
+
+def test_broker_rpc_failure_rearms_chip_fault_shots(monkeypatch):
+    """A request that dies with the worker never published its probe:
+    the shots re-arm and the pending-collect gate resets (the respawned
+    worker holds no probe)."""
+    outcomes = iter(
+        [
+            RuntimeError("worker died mid-request"),
+            {
+                "status": "ok",
+                "report": fixed_report(4, sick={1}),
+                "probe_ms": 5.0,
+            },
+        ]
+    )
+    shipped = []
+
+    class FakeBroker:
+        def health(self, per_chip=True, sick_chips=(), slow_chips=()):
+            shipped.append(list(sick_chips))
+            out = next(outcomes)
+            if isinstance(out, Exception):
+                raise out
+            return out
+
+    class FakeManager(MockManager):
+        broker = FakeBroker()
+
+    reg = faults.load_fault_spec("chip.1.sick:fail:1")
+    manager = FakeManager(chips=[MockChip(family="v5e") for _ in range(4)])
+    config = cfg(**{"burnin-interval": "1"})
+    with pytest.raises(RuntimeError):
+        new_health_labeler(manager, config).labels()
+    assert shipped[0] == [1]
+    assert reg.armed_sites() == ("chip.1.sick",)  # given back
+    assert not health_mod._schedule_for(manager).broker_probe_pending
+    labels = new_health_labeler(manager, config).labels()
+    assert shipped[1] == [1]
+    assert labels[CHIP_OK_FMT % 1] == "false"
+
+
+def test_broker_death_after_warming_rearms_shipped_chip_shots(monkeypatch):
+    """Shots shipped with a launch that answered 'warming' are still in
+    flight when a later collect RPC dies with the worker: the probe they
+    were bound to never publishes, so they must re-arm — the collect
+    call's own empty shot sets cannot stand in for them."""
+    outcomes = iter(
+        [
+            {"status": "warming"},
+            RuntimeError("worker died before collect"),
+            {
+                "status": "ok",
+                "report": fixed_report(4, sick={3}),
+                "probe_ms": 5.0,
+            },
+        ]
+    )
+    shipped = []
+
+    class FakeBroker:
+        def health(self, per_chip=True, sick_chips=(), slow_chips=()):
+            shipped.append(list(sick_chips))
+            out = next(outcomes)
+            if isinstance(out, Exception):
+                raise out
+            return out
+
+    class FakeManager(MockManager):
+        broker = FakeBroker()
+
+    reg = faults.load_fault_spec("chip.3.sick:fail:1")
+    manager = FakeManager(chips=[MockChip(family="v5e") for _ in range(4)])
+    config = cfg(**{"burnin-interval": "1"})
+    new_health_labeler(manager, config).labels()  # launch: ships the shot
+    assert shipped[0] == [3]
+    with pytest.raises(RuntimeError):
+        new_health_labeler(manager, config).labels()  # collect RPC dies
+    assert shipped[1] == []  # the collect itself consumed nothing
+    assert reg.armed_sites() == ("chip.3.sick",)  # shipped shot given back
+    sched = health_mod._schedule_for(manager)
+    assert not sched.broker_probe_pending
+    assert sched.pending_chip_faults == (frozenset(), frozenset())
+    labels = new_health_labeler(manager, config).labels()
+    assert shipped[2] == [3]  # redelivered to the fresh launch
+    assert labels[CHIP_OK_FMT % 3] == "false"
+
+
+def test_plane_rates_map_by_local_ordinal_on_multihost():
+    """Device planes are named by the HOST-LOCAL ordinal: on a non-first
+    pod-slice host (global ids 8..15, planes 0..7) the mapping must ride
+    local_hardware_id, never the global id."""
+    from gpu_feature_discovery_tpu.ops.healthcheck import _plane_device_rates
+
+    class Dev:
+        def __init__(self, gid, local=None):
+            self.id = gid
+            if local is not None:
+                self.local_hardware_id = local
+
+    planes = {f"/device:TPU:{k}": float(10 + k) for k in range(8)}
+    host1 = [Dev(8 + k, local=k) for k in range(8)]
+    assert _plane_device_rates(planes, host1) == [
+        float(10 + k) for k in range(8)
+    ]
+    # Older jax without local_hardware_id: the global ids are disjoint
+    # from every plane ordinal — sorted-position fallback, not all-None.
+    host1_old = [Dev(8 + k) for k in range(8)]
+    assert _plane_device_rates(planes, host1_old) == [
+        float(10 + k) for k in range(8)
+    ]
+
+
+def test_worker_health_probe_enacts_rpc_chip_faults(monkeypatch):
+    """The worker-side _HealthProbe threads the RPC's indices into
+    measure_node_health (in-process replica of the child path)."""
+    import threading
+    import time as _time
+
+    from gpu_feature_discovery_tpu.ops import healthcheck as hc
+    from gpu_feature_discovery_tpu.sandbox import broker as broker_mod
+
+    seen = {}
+
+    def measure(devices=None, **kw):
+        seen.update(kw)
+        return fixed_report(2, sick=kw.get("sick_chips") or ())
+
+    monkeypatch.setattr(health_mod, "_acquire_tpu_devices", lambda: ["dev"])
+    monkeypatch.setattr(hc, "measure_node_health", measure)
+    probe = broker_mod._HealthProbe(threading.Lock())
+    deadline = _time.monotonic() + 10
+    outcome = probe.request({"per_chip": True, "sick_chips": [1]})
+    while outcome["status"] == "warming" and _time.monotonic() < deadline:
+        outcome = probe.request()
+    assert outcome["status"] == "ok"
+    assert seen["per_chip"] is True
+    assert seen["sick_chips"] == frozenset({1})
+    assert outcome["report"]["per_chip"][1]["healthy"] is False
+
+
+# ---------------------------------------------------------------------------
+# acceptance: the REAL mesh-sharded probe on the 8-device MULTICHIP mock
+# ---------------------------------------------------------------------------
+
+def test_acceptance_sick_chip_localized_on_real_8_device_probe(monkeypatch):
+    """ISSUE 6 acceptance, probe half (the daemon-level no-exit half is
+    tests/test_chaos.py::chip-sick): on the 8 virtual CPU devices with
+    chip.3.sick injected, the REAL sharded probe publishes
+    chip.3.ok=false + ok=true for the 7 others + chips.sick=1, and
+    clearing the fault converges the labels back on the next probe."""
+    devices = jax.local_devices()
+    if len(devices) < 8:
+        pytest.skip("needs the 8-device virtual CPU mesh")
+    monkeypatch.setattr(health_mod, "_acquire_tpu_devices", lambda: devices)
+    monkeypatch.setenv("TFD_BURNIN_GEOMETRY", "128x2")
+    faults.load_fault_spec("chip.3.sick:fail:1")
+    manager = MockManager(chips=[MockChip(family="v5e") for _ in range(8)])
+    config = cfg(**{"oneshot": "true", "burnin-interval": "1"})
+
+    labels = new_health_labeler(manager, config).labels()
+    assert labels[CHIP_OK_FMT % 3] == "false"
+    for i in (0, 1, 2, 4, 5, 6, 7):
+        assert labels[CHIP_OK_FMT % i] == "true"
+    assert labels[CHIPS_SICK] == "1"
+    assert labels[HEALTH_OK] == "false"
+
+    # Fault budget drained: the next probing cycle converges.
+    labels = new_health_labeler(manager, config).labels()
+    assert labels[CHIPS_SICK] == "0"
+    assert labels[CHIP_OK_FMT % 3] == "true"
+    assert labels[HEALTH_OK] == "true"
+
+
+def test_real_probe_reports_allreduce_and_no_cpu_ici_rate(monkeypatch):
+    """The verdict program's psum proves the collective over the chip
+    mesh (chips_allreduce_ok) while the TIMED all-reduce bandwidth probe
+    stays TPU-only: off-TPU its number is not a hardware measurement
+    (ici_gbps None), so the extra dispatches are never paid there."""
+    from gpu_feature_discovery_tpu.ops import healthcheck as hc
+
+    devices = jax.local_devices()
+    if len(devices) < 2:
+        pytest.skip("needs a multi-device mesh")
+    report = hc.measure_node_health(
+        size=128, depth=2, iters=1, ici=False, per_chip=True, devices=devices
+    )
+    assert report["chips_allreduce_ok"] is True
+    assert report["ici_gbps"] is None
+    assert len(report["per_chip"]) == len(devices)
+    assert all(e["healthy"] for e in report["per_chip"])
+    assert "sharded_verdict_ms" in report["phases"]
+    assert "ici_allreduce_ms" not in report["phases"]  # TPU-only probe
+
+
+def test_ici_allreduce_probe_direct():
+    """The bandwidth probe itself (unit level, CPU mesh): collective
+    completes, checksum verifies every shard was summed, ring cost model
+    reports a positive rate on a multi-chip mesh."""
+    from gpu_feature_discovery_tpu.ops import healthcheck as hc
+
+    devices = jax.local_devices()
+    if len(devices) < 2:
+        pytest.skip("needs a multi-device mesh")
+    result = hc.ici_allreduce_probe(devices, mib_per_chip=1, iters=2)
+    assert result["checksum_ok"] is True
+    assert result["devices"] == len(devices)
+    assert result["gbps"] > 0

@@ -44,6 +44,8 @@ FILE_KEYS = {
     "flap-window": ("tfd", "flapWindow"),
     "probe-broker": ("tfd", "probeBroker"),
     "broker-max-requests": ("tfd", "brokerMaxRequests"),
+    "chip-probes": ("tfd", "chipProbes"),
+    "straggler-threshold": ("tfd", "stragglerThreshold"),
 }
 
 # Two distinct valid raw values per flag (a wins the dominance checks).
@@ -61,6 +63,7 @@ VALUE_PAIRS = {
     "flap-window": ("2", "4"),
     "probe-broker": ("on", "off"),
     "broker-max-requests": ("5", "9"),
+    "straggler-threshold": ("0.3", "0.7"),
 }
 
 

@@ -11,6 +11,9 @@ as type expressions so quoted annotations don't false-positive.
 import ast
 import glob
 import os
+import sys
+
+import pytest
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 REPO = os.path.dirname(HERE)
@@ -276,6 +279,12 @@ def test_undefined_name_checker_handles_global_lazy_init():
     assert staticcheck.check_undefined_names("<fixture>", src) == []
 
 
+@pytest.mark.skipif(
+    sys.version_info < (3, 12),
+    reason="PEP 695 `type` statements only parse on Python >= 3.12 — the "
+    "checker's TypeAlias handling (staticcheck._collect_bindings) cannot "
+    "execute on an interpreter whose ast.parse rejects the syntax",
+)
 def test_undefined_name_checker_handles_pep695_type_alias():
     src = "type Pair = tuple[int, int]\ndef f(p: Pair) -> Pair:\n    return p\n"
     assert staticcheck.check_undefined_names("<fixture>", src) == []

@@ -678,6 +678,34 @@ def test_probe_sandbox_metric_families_registered_and_documented():
         assert kind in row, f"{name}: no doc table row stating {kind!r}"
 
 
+def test_chip_metric_families_registered_and_documented():
+    """The per-chip fault-localization families (ISSUE 6) must exist and
+    carry typed doc rows — same anti-vacuity contract as the sandbox
+    families above."""
+    expected = {
+        "tfd_chip_ok": "gauge",
+        "tfd_chip_tflops": "gauge",
+        "tfd_straggler_detected_total": "counter",
+    }
+    families = obs_metrics.REGISTRY.families()
+    with open(os.path.join(DOCS, "observability.md")) as f:
+        doc = f.read()
+    for name, kind in expected.items():
+        assert name in families, f"chip metric {name} missing"
+        assert families[name].kind == kind, name
+        row = next(
+            (
+                line
+                for line in doc.splitlines()
+                if line.startswith(f"| `{name}`")
+            ),
+            "",
+        )
+        assert kind in row, f"{name}: no doc table row stating {kind!r}"
+    assert families["tfd_chip_ok"].labelnames == ("chip",)
+    assert families["tfd_chip_tflops"].labelnames == ("chip",)
+
+
 def test_observability_doc_names_no_phantom_metrics():
     """Every tfd_* series the doc mentions must exist in the registry."""
     import re

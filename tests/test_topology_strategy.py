@@ -217,3 +217,46 @@ def test_multiple_models_warns_and_labels_both(caplog):
     # last-writer-wins across models: exactly one product survives
     assert labels["google.com/tpu.product"] in ("tpu-v4", "tpu-v5p")
     assert labels["google.com/tpu.count"] == "1"
+
+
+# ---------------------------------------------------------------------------
+# SliceInfo staleness (ISSUE 6 satellite)
+# ---------------------------------------------------------------------------
+
+def test_slice_info_invalidates_when_chip_list_changes():
+    """The grouping memo must track the manager's CURRENT chip list: a
+    broker-backed manager re-enumerates every cycle, so a SliceInfo that
+    outlives one label pass must never serve the previous enumeration's
+    grouping (a mid-epoch chip-count change would otherwise publish a
+    stale inventory)."""
+    from gpu_feature_discovery_tpu.topology import SliceInfo
+
+    first = [MockChip(family="v5e"), MockChip(family="v5e")]
+    manager = MockManager(chips=first)
+    info = SliceInfo(manager)
+    assert len(info.get_chips_with_slices_disabled()) == 2
+
+    # Broker re-enumeration shrinks the inventory mid-epoch.
+    manager._chips = [MockChip(family="v5e")]
+    assert len(info.get_chips_with_slices_disabled()) == 1
+
+    # ... and grows it back with slice-bound chips.
+    manager._chips = [
+        MockChip(family="v5e", slice_topologies=["2x2"]) for _ in range(4)
+    ]
+    assert len(info.get_chips_with_slices_enabled()) == 4
+
+
+def test_slice_info_same_list_probes_each_chip_once():
+    """The memo still holds for a stable list: is_slice_enabled is real
+    device I/O on a libtpu backend, so repeated map reads must not
+    re-probe."""
+    from gpu_feature_discovery_tpu.topology import SliceInfo
+
+    chips = [MockChip(family="v5e") for _ in range(3)]
+    info = SliceInfo(MockManager(chips=chips))
+    info.get_chips_map()
+    info.get_chips_with_slices_enabled()
+    info.any_slice_enabled_chip_is_empty()
+    for chip in chips:
+        assert chip.calls["is_slice_enabled"] == 1
