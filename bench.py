@@ -720,6 +720,81 @@ def main() -> int:
         file=sys.stderr,
     )
 
+    # Slice aggregation cost (ISSUE 7): one leader poll round over the
+    # live /peer/snapshot endpoints of 3 serving peers (a 4-worker
+    # slice) + the aggregation itself — exactly what the slice label
+    # source pays per cycle on the leader. The claim under test is that
+    # a full poll round is far below the sleep interval (it runs
+    # offloaded under the per-labeler deadline, so it could never block
+    # the cycle anyway — but it must also never dominate it). Threshold
+    # headroom is ~3 orders of magnitude, so a plain median is stable
+    # on a loaded host.
+    from gpu_feature_discovery_tpu.config.flags import DEFAULT_SLEEP_INTERVAL
+    from gpu_feature_discovery_tpu.peering import SliceCoordinator
+
+    slice_workers = 4
+    peer_servers = []
+    peer_ports = []
+    try:
+        for peer_id in range(1, slice_workers):
+            serving = SliceCoordinator(
+                peer_id,
+                [f"w{i}" for i in range(slice_workers)],
+                default_port=1,
+                peer_timeout=2.0,
+            )
+            serving.publish_local(
+                {
+                    "google.com/tpu.count": "4",
+                    "google.com/tpu.chips.healthy": "4",
+                    "google.com/tpu.chips.sick": "0",
+                },
+                "full",
+            )
+            server = IntrospectionServer(
+                obs_metrics.REGISTRY,
+                IntrospectionState(60.0),
+                addr="127.0.0.1",
+                port=0,
+                peer_snapshot=serving.snapshot_payload,
+            )
+            server.start()
+            peer_servers.append(server)
+            peer_ports.append(server.port)
+        leader = SliceCoordinator(
+            0,
+            ["127.0.0.1:1"] + [f"127.0.0.1:{p}" for p in peer_ports],
+            default_port=1,
+            peer_timeout=2.0,
+        )
+        # The serving coordinators answer with THEIR worker-id derived
+        # from the w0..w3 list above; the leader's hostname list must
+        # agree, so index 0 (itself) carries a placeholder port it never
+        # polls.
+        slice_iters = max(
+            5, int(os.environ.get("TFD_BENCH_SLICE_ITERS", "21"))
+        )
+        slice_ms = []
+        leader.labels()  # warm the sockets/JSON path outside the samples
+        for _ in range(slice_iters):
+            t0 = time.perf_counter()
+            slice_cycle = leader.labels()
+            slice_ms.append((time.perf_counter() - t0) * 1e3)
+        assert dict(slice_cycle)[
+            "google.com/tpu.slice.healthy-hosts"
+        ] == str(slice_workers), slice_cycle
+    finally:
+        for server in peer_servers:
+            server.close()
+    slice_aggregation_ms = round(statistics.median(slice_ms), 3)
+    print(
+        f"bench: slice aggregation (leader poll round over "
+        f"{slice_workers - 1} live peers + aggregate) "
+        f"p50={slice_aggregation_ms}ms over {slice_iters} rounds "
+        f"(sleep interval {DEFAULT_SLEEP_INTERVAL * 1e3:.0f}ms)",
+        file=sys.stderr,
+    )
+
     # Per-chip probing acceptance (ISSUE 6): sharded-vs-aggregate probe
     # cycle overhead + straggler false positives over clean cycles, on a
     # hermetic 8-device virtual mesh in a child interpreter (this
@@ -790,6 +865,13 @@ def main() -> int:
                 # in between) — None would mean it never recovered.
                 "recovery_cycles_to_labels": recovery_cycles,
                 "recovery_injected_init_failures": injected_init_failures,
+                # Slice coordination acceptance (ISSUE 7): one leader
+                # poll round over 3 live peer snapshot endpoints + the
+                # aggregation — CI asserts it is far under the sleep
+                # interval it runs once per.
+                "slice_aggregation_ms": slice_aggregation_ms,
+                "slice_workers": slice_workers,
+                "sleep_interval_ms": round(DEFAULT_SLEEP_INTERVAL * 1e3, 3),
                 # Per-chip probing acceptance (ISSUE 6): the mesh-sharded
                 # per-chip probe cycle vs the aggregate-only cycle
                 # (median of per-cycle pair ratios; CI asserts < 15%),

@@ -47,6 +47,7 @@ from gpu_feature_discovery_tpu.lm.labelers import (
     new_label_sources,
 )
 from gpu_feature_discovery_tpu.lm.labels import remove_output_file
+from gpu_feature_discovery_tpu.lm.slice_labeler import new_slice_label_source
 from gpu_feature_discovery_tpu.lm.timestamp import new_timestamp_labeler
 from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
 from gpu_feature_discovery_tpu.pci.pciutil import SysfsGooglePCI
@@ -220,7 +221,9 @@ def start(argv: Optional[list] = None) -> int:
             return 0
 
 
-def start_introspection_server(config: Config, quiet: bool = False):
+def start_introspection_server(
+    config: Config, quiet: bool = False, peer_snapshot=None
+):
     """Bind the obs introspection server for a daemon epoch; returns
     ``(server, state)`` or ``(None, None)``. Oneshot NEVER serves (a
     one-off labeling Job has no probe/scrape consumer and must not open
@@ -250,6 +253,7 @@ def start_introspection_server(config: Config, quiet: bool = False):
             addr=tfd.metrics_addr,
             port=tfd.metrics_port,
             debug_endpoints=bool(tfd.debug_endpoints),
+            peer_snapshot=peer_snapshot,
         )
     except OSError as e:
         if not quiet:
@@ -400,6 +404,7 @@ def run(
     sigs: "queue.SimpleQueue[int]",
     supervisor: Optional[Supervisor] = None,
     process_state: Optional[dict] = None,
+    coordinator=None,
 ) -> bool:
     """run() (main.go:148-210). Returns True to request a config reload
     (SIGHUP), False for clean exit.
@@ -422,6 +427,13 @@ def run(
     is for process (re)starts, and a reload of a healthy daemon must
     not republish its own current labels under a false
     "restored from a previous run" marker.
+
+    ``coordinator`` is an injected peering.SliceCoordinator — the
+    hermetic slice harness runs N daemon loops in ONE process, so slice
+    identity (worker id, hostname list, port) cannot come from the
+    shared os.environ there. None (production) builds one from the
+    config + host env per epoch; coordination off resolves to no
+    coordinator and the strictly node-local cycle.
     """
     output_file = config.flags.tfd.output_file
     oneshot = config.flags.tfd.oneshot
@@ -435,9 +447,22 @@ def run(
     # futures must not survive a SIGHUP reload (same staleness contract as
     # reset_burnin_schedule), and the reload rebuilds run() anyway.
     engine = new_label_engine(config)
+    # Cross-host slice coordination (peering/): daemon epochs only, one
+    # coordinator per epoch (its peer reachability state must not
+    # survive a SIGHUP reload's hostname-list change). Off / oneshot /
+    # single-worker resolve to None and the strictly node-local cycle.
+    if coordinator is None and supervised:
+        from gpu_feature_discovery_tpu.peering import new_slice_coordinator
+
+        coordinator = new_slice_coordinator(config)
+    peer_snapshot = (
+        coordinator.snapshot_payload if coordinator is not None else None
+    )
     # Introspection server (obs/): daemon epochs only, rebound per epoch
     # so a SIGHUP reload picks up new --metrics-* flags.
-    obs_server, obs_state = start_introspection_server(config)
+    obs_server, obs_state = start_introspection_server(
+        config, peer_snapshot=peer_snapshot
+    )
     # Whether THIS epoch has written the output file yet: a failure before
     # the first write must not clobber a previous epoch's still-valid
     # file, but once this epoch owns the file its markers must stay
@@ -494,6 +519,8 @@ def run(
                         flap.observe(restored)
                     if obs_state is not None:
                         obs_state.labels_written(restored, {}, mode="restored")
+                    if coordinator is not None:
+                        coordinator.publish_local(restored, "restored")
         while True:
             # Per-cycle spans only: without the reset, a cached-health
             # cycle would re-report the last probe's cost as current.
@@ -505,7 +532,7 @@ def run(
                 # for the epoch would turn one transient EADDRINUSE into
                 # a kubelet restart loop.
                 obs_server, obs_state = start_introspection_server(
-                    config, quiet=True
+                    config, quiet=True, peer_snapshot=peer_snapshot
                 )
             cycle_mode = "full"
             try:
@@ -521,11 +548,16 @@ def run(
                         # the degraded marker instead of publishing
                         # nothing (a label-less TPU node is
                         # indistinguishable from a non-TPU node).
-                        labels = engine.generate(
-                            degraded_label_sources(
-                                interconnect, config, timestamp=timestamp_labeler
-                            )
+                        sources = degraded_label_sources(
+                            interconnect, config, timestamp=timestamp_labeler
                         )
+                        if coordinator is not None:
+                            # The slice view is about HOST reachability,
+                            # not chip health: a daemon whose backend is
+                            # down keeps polling peers and keeps serving
+                            # its snapshot (mode says how stale it is).
+                            sources.append(new_slice_label_source(coordinator))
+                        labels = engine.generate(sources)
                         labels[DEGRADED_LABEL] = "true"
                     else:
                         # init() happens inside new_label_sources; its
@@ -534,6 +566,12 @@ def run(
                         sources = new_label_sources(
                             current, interconnect, config, timestamp=timestamp_labeler
                         )
+                        if coordinator is not None:
+                            # Merged LAST: the slice family is derived
+                            # from peers and must never override a
+                            # node-local fact (names are disjoint today;
+                            # order makes that a guarantee, not a habit).
+                            sources.append(new_slice_label_source(coordinator))
                         try:
                             labels = engine.generate(sources)
                         finally:
@@ -573,6 +611,11 @@ def run(
                     obs_state.labels_written(
                         labels, engine.last_provenance, mode=cycle_mode
                     )
+                if coordinator is not None:
+                    # What peers see is what the node published — the
+                    # snapshot layer strips markers and the slice family
+                    # itself (peering/snapshot.py).
+                    coordinator.publish_local(labels, cycle_mode)
             except (InitRetriesExhausted, TooManyConsecutiveFailures):
                 raise  # supervision verdicts, not containable faults
             except Exception as e:  # noqa: BLE001 - supervision boundary
@@ -630,6 +673,8 @@ def run(
                                 reserve, {}, mode="reserved"
                             )
                             obs_state.cycle_completed()
+                        if coordinator is not None:
+                            coordinator.publish_local(reserve, "reserved")
                 # The backoff delay replaces the sleep interval for a
                 # failed cycle: sooner than a long interval (retry, don't
                 # idle out 60s on a transient), slower than a short one
@@ -690,6 +735,11 @@ def run(
             # Synchronous close releases the port before a SIGHUP reload
             # rebinds it.
             obs_server.close()
+        if coordinator is not None:
+            # Zero the per-peer gauges: a reload may rebuild the
+            # coordinator with a different hostname list, and a departed
+            # peer must not stay latched unreachable in the registry.
+            coordinator.close()
         # Deferred cleanup (main.go:149-156): a daemon exit removes the
         # label file so stale labels don't outlive the pod; oneshot leaves
         # the file for NFD.

@@ -21,6 +21,8 @@ from gpu_feature_discovery_tpu.config.spec import (
     PROBE_BROKER_MODES,
     PROBE_ISOLATION_AUTO,
     PROBE_ISOLATION_MODES,
+    SLICE_COORDINATION_AUTO,
+    SLICE_COORDINATION_MODES,
     TOPOLOGY_STRATEGIES,
     TOPOLOGY_STRATEGY_NONE,
     parse_bool as _parse_bool,
@@ -75,6 +77,14 @@ DEFAULT_BROKER_MAX_REQUESTS = 0
 # device-profiler timing (tight per-chip spread) operators can raise it
 # toward 0.5.
 DEFAULT_STRAGGLER_THRESHOLD = 0.2
+# Cross-host slice coordination (peering/): per-peer connect/read budget
+# for one /peer/snapshot poll. 2s rides out a GC-paused peer daemon on a
+# loaded host while keeping a full poll round over a 16-worker pod slice
+# well under the default sleep interval even when every peer times out
+# (the engine's per-labeler deadline bounds the round on top, and a
+# deadline miss serves the last-good slice labels, never blocks the
+# node-local path).
+DEFAULT_PEER_TIMEOUT = 2.0
 
 _DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
 _DURATION_UNITS = {
@@ -447,6 +457,34 @@ FLAG_DEFS: List[FlagDef] = [
         getter=lambda c: _f(c).tfd.straggler_threshold,
     ),
     FlagDef(
+        name="slice-coordination",
+        env_vars=("TFD_SLICE_COORDINATION",),
+        parse=str,
+        default=SLICE_COORDINATION_AUTO,
+        help="cross-host slice health coordination (peering/): 'on' "
+        "serves this daemon's label snapshot at /peer/snapshot on the "
+        "introspection server and polls every slice peer each cycle — "
+        "the lowest reachable worker-id publishes slice-scoped labels "
+        "(google.com/tpu.slice.healthy-hosts, slice.degraded, ...); "
+        "'off' reproduces the strictly node-local label output byte for "
+        "byte; 'auto' (default) is on exactly when TPU_WORKER_HOSTNAMES "
+        "names 2+ workers and the introspection server is enabled",
+        setter=lambda c, v: setattr(_f(c).tfd, "slice_coordination", v),
+        getter=lambda c: _f(c).tfd.slice_coordination,
+    ),
+    FlagDef(
+        name="peer-timeout",
+        env_vars=("TFD_PEER_TIMEOUT",),
+        parse=parse_duration,
+        default=DEFAULT_PEER_TIMEOUT,
+        help="with slice coordination on, per-peer connect/read budget "
+        "(Go duration, e.g. 2s) for one /peer/snapshot poll; a peer "
+        "exceeding it counts as a failed poll (two consecutive failures "
+        "confirm the peer unreachable)",
+        setter=lambda c, v: setattr(_f(c).tfd, "peer_timeout", v),
+        getter=lambda c: _f(c).tfd.peer_timeout,
+    ),
+    FlagDef(
         name="state-dir",
         env_vars=("TFD_STATE_DIR",),
         parse=str,
@@ -537,6 +575,12 @@ def new_config(
         raise ConfigError(
             f"invalid probe-broker: {broker!r} "
             f"(want one of {PROBE_BROKER_MODES})"
+        )
+    coordination = config.flags.tfd.slice_coordination
+    if coordination not in SLICE_COORDINATION_MODES:
+        raise ConfigError(
+            f"invalid slice-coordination: {coordination!r} "
+            f"(want one of {SLICE_COORDINATION_MODES})"
         )
     return config
 

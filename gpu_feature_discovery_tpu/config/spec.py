@@ -59,6 +59,20 @@ PROBE_BROKER_MODES = (
     PROBE_BROKER_AUTO,
 )
 
+# Cross-host slice coordination modes (peering/): `on` serves the peer
+# snapshot endpoint and publishes slice-scoped labels; `off` reproduces
+# the strictly node-local label output byte for byte; `auto` (the
+# default) is on exactly when TPU_WORKER_HOSTNAMES names >= 2 workers
+# (a multi-host slice) and the daemon serves the obs HTTP endpoint.
+SLICE_COORDINATION_ON = "on"
+SLICE_COORDINATION_OFF = "off"
+SLICE_COORDINATION_AUTO = "auto"
+SLICE_COORDINATION_MODES = (
+    SLICE_COORDINATION_ON,
+    SLICE_COORDINATION_OFF,
+    SLICE_COORDINATION_AUTO,
+)
+
 
 @dataclass
 class ReplicatedResource:
@@ -168,6 +182,11 @@ class TfdFlags:
     # detection; chip_probes=False reproduces the aggregate-only labels.
     chip_probes: Optional[bool] = None
     straggler_threshold: Optional[float] = None  # fraction of median, (0,1)
+    # Cross-host slice coordination (peering/): every daemon serves its
+    # label snapshot at /peer/snapshot on the obs server; the lowest
+    # reachable worker-id aggregates and publishes slice-scoped labels.
+    slice_coordination: Optional[str] = None  # auto | on | off
+    peer_timeout: Optional[float] = None  # seconds, per-peer connect/read
 
 
 @dataclass
@@ -229,6 +248,8 @@ class Config:
                     "brokerMaxRequests": self.flags.tfd.broker_max_requests,
                     "chipProbes": self.flags.tfd.chip_probes,
                     "stragglerThreshold": self.flags.tfd.straggler_threshold,
+                    "sliceCoordination": self.flags.tfd.slice_coordination,
+                    "peerTimeout": self.flags.tfd.peer_timeout,
                 },
             },
             "sharing": {
@@ -371,6 +392,9 @@ def parse_config_file(path: str) -> Config:
         config.flags.tfd.straggler_threshold = parse_fraction(
             tfd["stragglerThreshold"]
         )
+    config.flags.tfd.slice_coordination = _opt_str(tfd.get("sliceCoordination"))
+    if tfd.get("peerTimeout") is not None:
+        config.flags.tfd.peer_timeout = parse_duration(tfd["peerTimeout"])
 
     config.resources = raw.get("resources", {}) or {}
     config.sharing = Sharing.from_dict(raw.get("sharing", {}) or {})

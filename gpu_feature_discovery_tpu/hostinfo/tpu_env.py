@@ -18,12 +18,15 @@ This module normalizes both into one HostInfo.
 
 from __future__ import annotations
 
+import logging
 import math
 import re
 from dataclasses import dataclass, field
 from typing import Dict, List, Optional, Tuple
 
 from gpu_feature_discovery_tpu.models import parse_accelerator_type
+
+log = logging.getLogger("tfd.hostinfo")
 
 _LINE_RE = re.compile(r"^\s*([A-Za-z0-9_.-]+)\s*:\s*(.*?)\s*$")
 
@@ -104,8 +107,25 @@ def host_info_from_mapping(kv: Dict[str, str]) -> HostInfo:
 
     hostnames = get("TPU_WORKER_HOSTNAMES", "WORKER_HOSTNAMES")
     if hostnames:
-        info.worker_hostnames = [h.strip() for h in hostnames.split(",") if h.strip()]
-        info.worker_count = len(info.worker_hostnames)
+        info.worker_hostnames = parse_worker_hostnames(hostnames)
+        if info.worker_hostnames:
+            info.worker_count = len(info.worker_hostnames)
+    if (
+        info.worker_id is not None
+        and info.worker_hostnames
+        and info.worker_id >= len(info.worker_hostnames)
+    ):
+        # Out-of-range indexing into the hostname list would silently
+        # attribute another worker's hostname to this one (and the peer
+        # layer would poll the wrong set); the id itself stays published
+        # — it is this host's own fact — but the mismatch is loud.
+        log.warning(
+            "worker_id %d is out of range for TPU_WORKER_HOSTNAMES "
+            "(%d entries after cleanup) — hostname list and worker id "
+            "disagree; slice-global facts may be wrong",
+            info.worker_id,
+            len(info.worker_hostnames),
+        )
 
     process_bounds = get("TPU_PROCESS_BOUNDS", "TPU_HOST_BOUNDS", "HOST_BOUNDS")
     if info.worker_count is None and process_bounds:
@@ -126,6 +146,36 @@ def host_info_from_mapping(kv: Dict[str, str]) -> HostInfo:
             info.topology = "x".join(str(p * c) for p, c in zip(pb, cb))
 
     return info
+
+
+def parse_worker_hostnames(raw: str) -> List[str]:
+    """Clean the externally-provided comma-separated hostname list:
+    whitespace stripped, empty entries (trailing/double commas) dropped,
+    duplicates removed with the FIRST occurrence keeping its position —
+    order is load-bearing, it is the worker-id indexing and the peer
+    layer's leader-election order. A duplicate is warned about: two
+    workers sharing one hostname means the env is corrupt and the
+    worker count derived from the list would be inflated."""
+    seen = set()
+    cleaned: List[str] = []
+    duplicates: List[str] = []
+    for entry in raw.split(","):
+        host = entry.strip()
+        if not host:
+            continue
+        if host in seen:
+            duplicates.append(host)
+            continue
+        seen.add(host)
+        cleaned.append(host)
+    if duplicates:
+        log.warning(
+            "TPU_WORKER_HOSTNAMES carries duplicate entries %s; "
+            "keeping first occurrences (%d unique of the raw list)",
+            sorted(set(duplicates)),
+            len(cleaned),
+        )
+    return cleaned
 
 
 def _parse_bounds(bounds: str) -> Optional[Tuple[int, ...]]:

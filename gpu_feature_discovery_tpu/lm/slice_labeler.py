@@ -1,0 +1,80 @@
+"""Slice-scoped health labels from the peer coordination layer.
+
+Everything the node-local labelers publish answers "is THIS node
+schedulable"; a multi-host pod slice is only schedulable as a WHOLE, and
+one dead host silently strands the other workers behind healthy-looking
+node labels. The peering coordinator (peering/coordinator.py) polls every
+slice peer's ``/peer/snapshot`` each cycle; this module turns its
+aggregate view into the ``google.com/tpu.slice.*`` coordination family:
+
+- The **leader** — the lowest worker-id among *reachable* slice members,
+  so leader death fails over deterministically with no election protocol
+  — publishes the aggregate: ``slice.healthy-hosts``,
+  ``slice.total-hosts``, ``slice.degraded``, ``slice.sick-chips`` (the
+  sum of every reachable peer's ``chips.sick``), ``slice.leader`` (its
+  own hostname), and ``slice.role=leader``.
+- **Followers** publish ``slice.role=follower`` plus
+  ``slice.leader-seen=true|false`` — a follower that cannot reach its
+  leader (or any peer at all: the fully-partitioned case) is visible on
+  its own node instead of silently agreeing with labels it never saw.
+
+An unreachable peer degrades the SLICE labels, never the node's own: the
+slice source is one more engine label source, and every node-local label
+is produced exactly as before. The source is offloaded
+(``LabelSource.offload``), so a slow poll round is bounded by the
+engine's per-labeler deadline and served from the last-good cache — the
+node-local label path never blocks on a peer.
+"""
+
+from __future__ import annotations
+
+from gpu_feature_discovery_tpu.lm.engine import LabelSource
+from gpu_feature_discovery_tpu.lm.labels import Labels, label_safe_value
+
+SLICE_ROLE_LABEL = "google.com/tpu.slice.role"
+SLICE_LEADER_LABEL = "google.com/tpu.slice.leader"
+SLICE_LEADER_SEEN_LABEL = "google.com/tpu.slice.leader-seen"
+SLICE_HEALTHY_HOSTS_LABEL = "google.com/tpu.slice.healthy-hosts"
+SLICE_TOTAL_HOSTS_LABEL = "google.com/tpu.slice.total-hosts"
+SLICE_DEGRADED_LABEL = "google.com/tpu.slice.degraded"
+SLICE_SICK_CHIPS_LABEL = "google.com/tpu.slice.sick-chips"
+
+# The whole coordination family, for snapshot stripping: a peer's
+# snapshot must carry its NODE facts, not the slice labels a previous
+# aggregation round derived from other peers — feeding those back in
+# would let one stale aggregate echo around the slice.
+SLICE_COORD_LABELS = (
+    SLICE_ROLE_LABEL,
+    SLICE_LEADER_LABEL,
+    SLICE_LEADER_SEEN_LABEL,
+    SLICE_HEALTHY_HOSTS_LABEL,
+    SLICE_TOTAL_HOSTS_LABEL,
+    SLICE_DEGRADED_LABEL,
+    SLICE_SICK_CHIPS_LABEL,
+)
+
+
+def slice_labels(view) -> Labels:
+    """The label set for one aggregation view (peering SliceView)."""
+    labels = Labels()
+    if view.role == "leader":
+        labels[SLICE_ROLE_LABEL] = "leader"
+        labels[SLICE_LEADER_LABEL] = label_safe_value(view.leader_hostname)
+        labels[SLICE_HEALTHY_HOSTS_LABEL] = str(view.healthy_hosts)
+        labels[SLICE_TOTAL_HOSTS_LABEL] = str(view.total_hosts)
+        labels[SLICE_DEGRADED_LABEL] = "true" if view.degraded else "false"
+        labels[SLICE_SICK_CHIPS_LABEL] = str(view.sick_chips)
+    else:
+        labels[SLICE_ROLE_LABEL] = "follower"
+        labels[SLICE_LEADER_SEEN_LABEL] = (
+            "true" if view.leader_seen else "false"
+        )
+    return labels
+
+
+def new_slice_label_source(coordinator) -> LabelSource:
+    """The coordinator as a named engine source. Offloaded: a poll round
+    does peer HTTP I/O, so it runs on the pool under the per-labeler
+    deadline; a deadline miss serves the last-good slice labels (the
+    engine cache) instead of stalling the node-local sources."""
+    return LabelSource("slice", lambda: coordinator, offload=True)

@@ -162,6 +162,41 @@ STRAGGLER_DETECTED = REGISTRY.counter(
     "probes — the tpu.straggler-chip label).",
 )
 
+# -- cross-host slice coordination (peering/) -------------------------------
+
+PEER_POLLS = REGISTRY.counter(
+    "tfd_peer_polls_total",
+    "Peer /peer/snapshot polls by outcome: ok (valid schema-1 snapshot), "
+    "error (timeout, HTTP failure, junk body, worker-id mismatch — every "
+    "failure shape counts as one miss), or skipped (the round budget ran "
+    "out before this peer; its reachability state is untouched).",
+    labelnames=("outcome",),
+)
+PEER_POLL_DURATION = REGISTRY.histogram(
+    "tfd_peer_poll_duration_seconds",
+    "Round-trip time of each peer snapshot poll, whatever its outcome "
+    "(a timed-out poll contributes its full --peer-timeout budget).",
+)
+PEER_UNREACHABLE = REGISTRY.gauge(
+    "tfd_peer_unreachable",
+    "1 while the named peer is CONFIRMED unreachable (2 consecutive "
+    "failed polls), 0 after any successful poll.",
+    labelnames=("peer",),
+)
+SLICE_DEGRADED = REGISTRY.gauge(
+    "tfd_slice_degraded",
+    "1 while the aggregated slice view counts fewer reachable hosts than "
+    "TPU_WORKER_HOSTNAMES names (the slice.degraded label), else 0.",
+)
+HTTP_ERRORS = REGISTRY.counter(
+    "tfd_http_errors_total",
+    "Introspection endpoint handlers that raised; the response is a 500 "
+    "naming the error class instead of a torn-down connection. Unknown "
+    "request paths collapse into endpoint=\"other\" — the label is never "
+    "client-chosen.",
+    labelnames=("endpoint",),
+)
+
 # -- label engine (lm/engine.py) --------------------------------------------
 
 LABELER_DURATION = REGISTRY.histogram(

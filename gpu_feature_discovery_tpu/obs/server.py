@@ -20,6 +20,15 @@ Endpoint semantics:
 - ``/debug/labels`` — JSON: the last written labels with per-source
   provenance (fresh/stale this cycle, duration, write mode, generation
   counter). Gated by ``--debug-endpoints``.
+- ``/peer/snapshot`` — the slice peer layer's wire surface
+  (peering/snapshot.py): this daemon's marker-stripped label snapshot as
+  versioned JSON. Served only while slice coordination built a
+  coordinator (gated independently of ``--debug-endpoints`` — peers
+  depend on it for correctness); 404 otherwise.
+
+An exception inside any endpoint handler answers 500 with the error
+class name (and counts in ``tfd_http_errors_total{endpoint}``) instead
+of tearing the connection down with no response.
 
 The server is bound by cmd/main.run for daemon epochs only (oneshot
 never serves; ``--metrics-port 0`` disables) and closed at epoch end, so
@@ -127,8 +136,36 @@ class IntrospectionState:
             return json.loads(json.dumps(self._debug))
 
 
+# How long a fault-armed /peer/snapshot handler stalls before answering:
+# comfortably past the default --peer-timeout (2s), so the poller times
+# out and counts the miss long before the reply lands. The sleeping
+# handler occupies one daemon thread, never the server.
+PEER_SLOW_DELAY_S = 5.0
+
+# The server's complete endpoint surface — the only values the
+# tfd_http_errors_total{endpoint} label may take.
+_KNOWN_ENDPOINTS = (
+    "/metrics",
+    "/healthz",
+    "/readyz",
+    "/debug/labels",
+    "/peer/snapshot",
+)
+
+
+def _endpoint_label(path: str) -> str:
+    """Clamp a client-requested path to the known endpoint set: the
+    metric label must never be attacker-chosen (a client minting unique
+    paths would mint unbounded series in the process-global registry —
+    the server listens on 0.0.0.0, hostPort-exposed in the manifests)."""
+    return path if path in _KNOWN_ENDPOINTS else "other"
+
+
 def _make_handler(
-    registry: Registry, state: IntrospectionState, debug_endpoints: bool
+    registry: Registry,
+    state: IntrospectionState,
+    debug_endpoints: bool,
+    peer_snapshot: Optional[Callable[[], Dict[str, Any]]] = None,
 ):
     class _Handler(BaseHTTPRequestHandler):
         # Content-Length is always sent, so keep-alive is safe.
@@ -136,6 +173,25 @@ def _make_handler(
 
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
             path = urlsplit(self.path).path
+            try:
+                self._dispatch(path)
+            except Exception as e:  # noqa: BLE001 - handler containment
+                # A raising handler used to tear the connection down with
+                # no response at all — the scraper saw a protocol error
+                # instead of a status code. Name the error class; the
+                # message may carry internals and stays in the log.
+                metrics.HTTP_ERRORS.labels(endpoint=_endpoint_label(path)).inc()
+                log.warning("handler for %s raised:", path, exc_info=True)
+                try:
+                    self._reply(
+                        500, f"{type(e).__name__}\n".encode()
+                    )
+                except OSError:
+                    # The connection itself is gone (client hung up
+                    # mid-reply); nothing left to answer on.
+                    self.close_connection = True
+
+        def _dispatch(self, path: str):
             if path == "/metrics":
                 self._reply(200, registry.render().encode(), CONTENT_TYPE)
             elif path == "/healthz":
@@ -149,8 +205,44 @@ def _make_handler(
                     state.debug_snapshot(), indent=2, sort_keys=True
                 ).encode()
                 self._reply(200, body + b"\n", "application/json")
+            elif path == "/peer/snapshot" and peer_snapshot is not None:
+                # Gated on the COORDINATOR existing, not on
+                # --debug-endpoints: peers depend on this endpoint for
+                # correctness, debug introspection is an operator
+                # convenience — an operator turning one off must not
+                # silently partition the slice.
+                if self._peer_fault():
+                    return
+                body = json.dumps(
+                    peer_snapshot(), indent=2, sort_keys=True
+                ).encode()
+                self._reply(200, body + b"\n", "application/json")
             else:
                 self._reply(404, b"not found\n")
+
+        def _peer_fault(self) -> bool:
+            """Enact an armed peer.* fault (utils/faults.py): the chaos
+            surface for the SERVING side of the peer layer, consumed in
+            this daemon's process like every behavioral site. Returns
+            True when the normal reply must be skipped."""
+            from gpu_feature_discovery_tpu.utils import faults
+
+            if faults.consume("peer.unreachable"):
+                # Drop the connection with no response at all — the
+                # poller sees the same RemoteDisconnected a dead host's
+                # RST produces.
+                self.close_connection = True
+                return True
+            if faults.consume("peer.junk"):
+                # Answered, but not with a snapshot: exercises the
+                # parse_snapshot rejection path (counts as a miss).
+                self._reply(200, b"not json {", "application/json")
+                return True
+            if faults.consume("peer.slow"):
+                # Stall past the poller's --peer-timeout; the eventual
+                # reply lands on a socket the poller abandoned.
+                time.sleep(PEER_SLOW_DELAY_S)
+            return False
 
         def _reply(self, code: int, body: bytes, ctype: str = "text/plain"):
             self.send_response(code)
@@ -177,9 +269,11 @@ class IntrospectionServer:
         addr: str = "0.0.0.0",
         port: int = 0,
         debug_endpoints: bool = True,
+        peer_snapshot: Optional[Callable[[], Dict[str, Any]]] = None,
     ):
         self._httpd = ThreadingHTTPServer(
-            (addr, port), _make_handler(registry, state, debug_endpoints)
+            (addr, port),
+            _make_handler(registry, state, debug_endpoints, peer_snapshot),
         )
         self._httpd.daemon_threads = True
         self.addr = self._httpd.server_address[0]

@@ -1,0 +1,40 @@
+"""Cross-host slice health coordination (the peer layer).
+
+Each daemon in a multi-host pod slice serves its marker-stripped label
+snapshot as JSON at ``GET /peer/snapshot`` on the existing obs HTTP
+server (peering/snapshot.py); a deterministic leader — the lowest
+worker-id among *reachable* peers — polls every peer each cycle and
+publishes slice-scoped labels from the aggregate
+(peering/coordinator.py + lm/slice_labeler.py). Opt-in via
+``--slice-coordination`` (auto = on when ``TPU_WORKER_HOSTNAMES`` names
+2+ workers and the obs server is enabled). Dependency-free: stdlib HTTP
+on both sides, the same timeout/backoff discipline as sandbox/broker.py.
+"""
+
+from gpu_feature_discovery_tpu.peering.coordinator import (
+    CONFIRM_POLLS,
+    SliceCoordinator,
+    SliceView,
+    new_slice_coordinator,
+)
+from gpu_feature_discovery_tpu.peering.snapshot import (
+    PEER_SCHEMA_VERSION,
+    PEER_SNAPSHOT_PATH,
+    PeerSnapshotError,
+    build_snapshot,
+    parse_snapshot,
+    strip_snapshot_labels,
+)
+
+__all__ = [
+    "CONFIRM_POLLS",
+    "PEER_SCHEMA_VERSION",
+    "PEER_SNAPSHOT_PATH",
+    "PeerSnapshotError",
+    "SliceCoordinator",
+    "SliceView",
+    "build_snapshot",
+    "new_slice_coordinator",
+    "parse_snapshot",
+    "strip_snapshot_labels",
+]

@@ -1,0 +1,515 @@
+"""The slice poller/aggregator: reachability, leadership, aggregation.
+
+One coordinator per config epoch (built beside the engine in
+cmd/main.run). Two independent faces, touched by different threads:
+
+- **Serving** (obs server handler threads): ``publish_local`` is called
+  by the run loop after every label write; ``snapshot_payload`` renders
+  the current snapshot for ``GET /peer/snapshot``. Lock-protected — a
+  peer's poll may land mid-write.
+- **Polling** (one engine pool thread): ``labels()`` — the Labeler
+  protocol — runs one poll round over every peer and returns the
+  slice-scoped label set for this cycle. The engine guarantees a single
+  in-flight submission per source, so peer state needs no lock.
+
+Reachability discipline (the broker's timeout/backoff shape):
+
+- Every poll is bounded by a per-peer connect/read timeout
+  (``--peer-timeout``); one round costs at most
+  ``(workers - 1) x timeout`` and runs under the engine's per-labeler
+  deadline, which serves last-good slice labels on a miss — the
+  node-local label path never waits on a peer.
+- A peer is confirmed UNREACHABLE only after ``CONFIRM_POLLS``
+  consecutive failed polls (the StragglerDetector's 2-consecutive
+  confirmation): one missed poll — a GC pause, a dropped packet — never
+  flaps ``slice.degraded``. One successful poll clears it immediately
+  (degrade slowly, recover fast — sandbox/flap.py's asymmetry). The
+  grace is for ESTABLISHED peers only: a peer this epoch has never
+  successfully reached counts down on its first miss — trust is earned
+  by a poll, never presumed, so a partitioned node's fresh epoch (a
+  restart, a SIGHUP reload rebuilding the coordinator) cannot spend its
+  first confirmation window advertising a fully-healthy slice it has
+  never actually seen.
+- Confirmed-dead peers are re-polled under capped jittered backoff
+  (utils/retry.BackoffPolicy) instead of paying a full timeout every
+  cycle against a host that stays dark.
+- One poll round is bounded by ``round_budget`` wall-clock on top of the
+  per-peer timeout: peers the budget cannot reach this round are SKIPPED
+  — no poll, no state change, counted as ``outcome="skipped"`` — so a
+  wide slice of slow-but-answering peers can never pin the slice source
+  past the engine's per-labeler deadline cycle after cycle (a stale
+  slice source would suppress the supervisor's state persistence, which
+  a peer problem must never do).
+
+Leadership is derived, not elected: the slice member with the LOWEST
+worker-id among the reachable set leads and publishes the aggregate.
+Leader death needs no protocol — after the confirmation window every
+survivor computes the same new minimum. A daemon that can reach NO peer
+at all never claims leadership (``all peers down`` is overwhelmingly a
+local partition, not a slice where every other host died): it publishes
+``slice.role=follower`` + ``slice.leader-seen=false`` so the partition
+is visible on its own node without poisoning the slice aggregate.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import urllib.error
+import urllib.request
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional
+
+from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.lm.slice_labeler import slice_labels
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+from gpu_feature_discovery_tpu.peering.snapshot import (
+    MAX_SNAPSHOT_BYTES,
+    PEER_SNAPSHOT_PATH,
+    PeerSnapshotError,
+    build_snapshot,
+    parse_snapshot,
+)
+from gpu_feature_discovery_tpu.utils.retry import BackoffPolicy
+
+log = logging.getLogger("tfd.peering")
+
+# Consecutive failed polls before a peer counts as unreachable — the
+# same 2-consecutive confirmation the straggler detector uses
+# (lm/health.STRAGGLER_CONFIRM_PROBES): a verdict that moves labels
+# must survive one repetition.
+CONFIRM_POLLS = 2
+
+# Backoff schedule for re-polling a CONFIRMED-dead peer: base one cycle
+# of patience, capped well under the default sleep interval so a healed
+# peer is noticed within a few cycles even on a long-interval daemon.
+PEER_BACKOFF_BASE_S = 1.0
+PEER_BACKOFF_CAP_S = 30.0
+
+
+@dataclass
+class PeerEndpoint:
+    """One slice peer's address. ``hostname`` is the raw
+    TPU_WORKER_HOSTNAMES entry (the identity peers are known by);
+    ``host``/``port`` is where its obs server answers — an entry may
+    carry an explicit ``:port`` (the hermetic harness runs N daemons on
+    one address), otherwise every peer is assumed to serve on this
+    daemon's own metrics port."""
+
+    worker_id: int
+    hostname: str
+    host: str
+    port: int
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}{PEER_SNAPSHOT_PATH}"
+
+
+def _split_host_port(entry: str, default_port: int) -> "tuple[str, int]":
+    host, sep, port = entry.rpartition(":")
+    if sep and port.isdigit():
+        return host, int(port)
+    return entry, default_port
+
+
+@dataclass
+class _PeerState:
+    consecutive_failures: int = 0
+    ever_reached: bool = False
+    last_snapshot: Optional[Dict[str, Any]] = None
+    next_attempt: float = 0.0
+    backoff_attempt: int = 0
+    backoff: BackoffPolicy = field(
+        default_factory=lambda: BackoffPolicy(
+            base=PEER_BACKOFF_BASE_S, cap=PEER_BACKOFF_CAP_S
+        )
+    )
+
+    @property
+    def confirmed_down(self) -> bool:
+        if not self.ever_reached:
+            # No confirmation grace for a peer this epoch has never
+            # seen: the 2-poll window exists to ride out a transient
+            # blip in an ESTABLISHED conversation, not to let a fresh
+            # (possibly partitioned) epoch presume the slice healthy.
+            return self.consecutive_failures >= 1
+        return self.consecutive_failures >= CONFIRM_POLLS
+
+
+@dataclass(frozen=True)
+class SliceView:
+    """One aggregation round's verdict (lm/slice_labeler.slice_labels
+    renders it)."""
+
+    role: str                    # "leader" | "follower"
+    leader_hostname: str
+    leader_seen: bool
+    healthy_hosts: int
+    total_hosts: int
+    degraded: bool
+    sick_chips: int
+
+
+class SliceCoordinator:
+    """See module docstring. Implements the Labeler protocol —
+    ``labels()`` is one poll round + aggregation."""
+
+    def __init__(
+        self,
+        worker_id: int,
+        hostnames: List[str],
+        default_port: int,
+        peer_timeout: float,
+        round_budget: Optional[float] = None,
+        clock: Callable[[], float] = time.monotonic,
+        backoff_factory: Optional[Callable[[], BackoffPolicy]] = None,
+    ):
+        if not 0 <= worker_id < len(hostnames):
+            raise ValueError(
+                f"worker_id {worker_id} out of range for "
+                f"{len(hostnames)} hostnames"
+            )
+        self.worker_id = worker_id
+        self.hostname = _split_host_port(hostnames[worker_id], default_port)[0]
+        self.total_hosts = len(hostnames)
+        self.peer_timeout = float(peer_timeout)
+        # None = unbounded round (the hermetic harness's tiny slices);
+        # production (new_slice_coordinator) always bounds it under the
+        # engine's per-labeler deadline.
+        self.round_budget = (
+            float(round_budget) if round_budget is not None else None
+        )
+        self._clock = clock
+        self._round_offset = 0
+        self._peers: List[PeerEndpoint] = []
+        self._peer_state: Dict[int, _PeerState] = {}
+        for i, entry in enumerate(hostnames):
+            if i == self.worker_id:
+                continue
+            host, port = _split_host_port(entry, default_port)
+            self._peers.append(PeerEndpoint(i, entry, host, port))
+            state = _PeerState()
+            if backoff_factory is not None:
+                state.backoff = backoff_factory()
+            self._peer_state[i] = state
+        # Serving-side state (handler threads read, run loop writes).
+        self._lock = threading.Lock()
+        self._local_labels: Dict[str, str] = {}
+        self._local_mode: Optional[str] = None
+        self._generation = 0
+
+    # -- serving side (obs server) ----------------------------------------
+
+    def publish_local(self, labels: Dict[str, str], mode: str) -> None:
+        """The run loop wrote a label file: refresh what peers see. Every
+        write counts — a degraded or re-served set is still this node's
+        honest current answer (its mode says how stale it may be)."""
+        with self._lock:
+            self._generation += 1
+            self._local_labels = dict(labels)
+            self._local_mode = mode
+
+    def snapshot_payload(self) -> Dict[str, Any]:
+        with self._lock:
+            labels = dict(self._local_labels)
+            mode = self._local_mode
+            generation = self._generation
+        return build_snapshot(
+            self.worker_id, self.hostname, labels, generation, mode
+        )
+
+    # -- polling side (engine pool thread) --------------------------------
+
+    def labels(self) -> Labels:
+        self.poll_once()
+        return slice_labels(self.view())
+
+    def poll_once(self) -> None:
+        """One poll round: every peer not inside a confirmed-down backoff
+        window gets one GET bounded by the per-peer timeout AND the
+        remaining round budget. A peer the budget cannot reach is
+        skipped with its state UNTOUCHED — "not polled" is neither a
+        miss nor a success.
+
+        The round starts one peer further along the list each time:
+        budget skips always land on whoever the rotation currently puts
+        last, so a head-of-list run of slow-but-answering peers (each
+        just under the per-peer timeout, never confirmed down) cannot
+        starve the tail forever — a never-polled peer has no failures,
+        counts reachable, and a dead host behind it would stay invisible
+        indefinitely."""
+        round_started = time.perf_counter()
+        offset = self._round_offset % len(self._peers) if self._peers else 0
+        self._round_offset += 1
+        for peer in self._peers[offset:] + self._peers[:offset]:
+            state = self._peer_state[peer.worker_id]
+            now = self._clock()
+            if state.confirmed_down and now < state.next_attempt:
+                continue  # backoff window still closed; stays down
+            timeout = self.peer_timeout
+            if self.round_budget is not None:
+                remaining = self.round_budget - (
+                    time.perf_counter() - round_started
+                )
+                if remaining <= 0.05:
+                    obs_metrics.PEER_POLLS.labels(outcome="skipped").inc()
+                    log.warning(
+                        "round budget %.3fs spent; skipping poll of peer "
+                        "%s (worker %d) this round",
+                        self.round_budget,
+                        peer.hostname,
+                        peer.worker_id,
+                    )
+                    continue
+                timeout = min(timeout, remaining)
+            started = time.perf_counter()
+            try:
+                snapshot = self._fetch(peer, timeout)
+                if snapshot["worker_id"] != peer.worker_id:
+                    # Answered, but it is not who the hostname list says
+                    # lives there (a stale DNS entry pointing at another
+                    # worker): trusting it would double-count that
+                    # worker's chips.
+                    raise PeerSnapshotError(
+                        f"peer claims worker_id {snapshot['worker_id']}, "
+                        f"expected {peer.worker_id}"
+                    )
+            except Exception as e:  # noqa: BLE001 - any failure = one miss
+                obs_metrics.PEER_POLLS.labels(outcome="error").inc()
+                self._poll_failed(peer, state, e)
+            else:
+                obs_metrics.PEER_POLLS.labels(outcome="ok").inc()
+                self._poll_succeeded(peer, state, snapshot)
+            finally:
+                obs_metrics.PEER_POLL_DURATION.observe(
+                    time.perf_counter() - started
+                )
+
+    def _fetch(self, peer: PeerEndpoint, timeout: float) -> Dict[str, Any]:
+        # stdlib only, same as the obs server's own consumers; the
+        # timeout bounds connect AND each read.
+        with urllib.request.urlopen(peer.url, timeout=timeout) as resp:
+            if resp.status != 200:
+                raise PeerSnapshotError(f"HTTP {resp.status}")
+            body = resp.read(MAX_SNAPSHOT_BYTES + 1)
+        return parse_snapshot(body)
+
+    def _poll_succeeded(
+        self, peer: PeerEndpoint, state: _PeerState, snapshot: Dict[str, Any]
+    ) -> None:
+        if state.confirmed_down:
+            log.info(
+                "peer %s (worker %d) reachable again",
+                peer.hostname,
+                peer.worker_id,
+            )
+        state.consecutive_failures = 0
+        state.backoff_attempt = 0
+        state.next_attempt = 0.0
+        state.ever_reached = True
+        state.last_snapshot = snapshot
+        obs_metrics.PEER_UNREACHABLE.labels(peer=peer.hostname).set(0)
+
+    def _poll_failed(
+        self, peer: PeerEndpoint, state: _PeerState, error: BaseException
+    ) -> None:
+        state.consecutive_failures += 1
+        if state.confirmed_down:
+            obs_metrics.PEER_UNREACHABLE.labels(peer=peer.hostname).set(1)
+            delay = state.backoff.delay(min(state.backoff_attempt, 63))
+            state.backoff_attempt += 1
+            state.next_attempt = self._clock() + delay
+            if state.consecutive_failures == CONFIRM_POLLS:
+                log.warning(
+                    "peer %s (worker %d) confirmed unreachable after %d "
+                    "consecutive failed polls (%s); re-polling under "
+                    "backoff",
+                    peer.hostname,
+                    peer.worker_id,
+                    state.consecutive_failures,
+                    error,
+                )
+        else:
+            log.info(
+                "poll of peer %s (worker %d) failed (%d/%d before "
+                "confirmation): %s",
+                peer.hostname,
+                peer.worker_id,
+                state.consecutive_failures,
+                CONFIRM_POLLS,
+                error,
+            )
+
+    # -- aggregation -------------------------------------------------------
+
+    def view(self) -> SliceView:
+        reachable_peers = [
+            p for p in self._peers
+            if not self._peer_state[p.worker_id].confirmed_down
+        ]
+        healthy = 1 + len(reachable_peers)  # self is always reachable
+        degraded = healthy < self.total_hosts
+        # Deliberately THIS node's reachability view, not the leader's
+        # published verdict: on the leader the gauge mirrors the
+        # slice.degraded label; on a follower it surfaces an asymmetric
+        # partition (follower cannot reach a peer the leader can) that
+        # no label would show (docs/observability.md).
+        obs_metrics.SLICE_DEGRADED.set(1 if degraded else 0)
+        if not reachable_peers and self.total_hosts > 1:
+            # Fully partitioned: every peer confirmed dark. Never claim
+            # to lead a slice this node cannot see (module docstring).
+            return SliceView(
+                role="follower",
+                leader_hostname="",
+                leader_seen=False,
+                healthy_hosts=healthy,
+                total_hosts=self.total_hosts,
+                degraded=True,
+                sick_chips=0,
+            )
+        leader_peer = min(
+            reachable_peers, key=lambda p: p.worker_id, default=None
+        )
+        if leader_peer is None or self.worker_id < leader_peer.worker_id:
+            return SliceView(
+                role="leader",
+                leader_hostname=self.hostname,
+                leader_seen=True,
+                healthy_hosts=healthy,
+                total_hosts=self.total_hosts,
+                degraded=degraded,
+                sick_chips=self._sum_sick_chips(reachable_peers),
+            )
+        leader_state = self._peer_state[leader_peer.worker_id]
+        return SliceView(
+            role="follower",
+            leader_hostname=leader_peer.hostname,
+            # leader-seen is a gating label (docs/labels.md), so it gets
+            # the same 2-consecutive confirmation as everything else: an
+            # ESTABLISHED leader stays seen through a single missed poll
+            # (the leader is still in the reachable set until confirmed
+            # down, at which point leadership re-derives or the
+            # full-partition branch above reports leader-seen=false).
+            # Only a leader this epoch has never successfully polled is
+            # unseen from the start — trust is earned, never presumed.
+            leader_seen=leader_state.ever_reached,
+            healthy_hosts=healthy,
+            total_hosts=self.total_hosts,
+            degraded=degraded,
+            sick_chips=0,
+        )
+
+    def _sum_sick_chips(self, reachable_peers: List[PeerEndpoint]) -> int:
+        total = _sick_from(self.snapshot_payload())
+        for peer in reachable_peers:
+            snapshot = self._peer_state[peer.worker_id].last_snapshot
+            if snapshot is not None:
+                total += _sick_from(snapshot)
+        return total
+
+    def close(self) -> None:
+        """Epoch end: zero this coordinator's gauges in the
+        process-global registry. A SIGHUP reload may rebuild the
+        coordinator with a CHANGED hostname list (or none at all) —
+        without the reset, a peer no longer in the slice would stay
+        latched at tfd_peer_unreachable=1 forever and send an operator
+        chasing a host that left the slice."""
+        for peer in self._peers:
+            obs_metrics.PEER_UNREACHABLE.labels(peer=peer.hostname).set(0)
+        obs_metrics.SLICE_DEGRADED.set(0)
+
+
+def _sick_from(snapshot: Dict[str, Any]) -> int:
+    sick = snapshot.get("chips", {}).get("sick")
+    return sick if isinstance(sick, int) and not isinstance(sick, bool) else 0
+
+
+def new_slice_coordinator(config, host_info=None) -> Optional[SliceCoordinator]:
+    """Coordinator from the daemon config, or None when coordination is
+    off/unavailable. ``auto`` resolves to ON exactly when the host's
+    TPU_WORKER_HOSTNAMES names 2+ workers AND the obs server will serve
+    (daemon mode, --metrics-port != 0) — peers poll /peer/snapshot on
+    that server, so a serverless daemon has nothing to coordinate with.
+    Forced ``on`` that cannot run (oneshot, no server, no slice facts)
+    degrades to off with a warning rather than failing the daemon."""
+    from gpu_feature_discovery_tpu.config.flags import (
+        DEFAULT_LABELER_TIMEOUT,
+        DEFAULT_PEER_TIMEOUT,
+    )
+    from gpu_feature_discovery_tpu.config.spec import (
+        SLICE_COORDINATION_AUTO,
+        SLICE_COORDINATION_OFF,
+        SLICE_COORDINATION_ON,
+    )
+
+    tfd = config.flags.tfd
+    mode = tfd.slice_coordination or SLICE_COORDINATION_AUTO
+    if mode == SLICE_COORDINATION_OFF:
+        return None
+    forced = mode == SLICE_COORDINATION_ON
+    if tfd.oneshot or not tfd.metrics_port:
+        if forced:
+            log.warning(
+                "slice-coordination=on needs the introspection server "
+                "(daemon mode, --metrics-port != 0); running node-local"
+            )
+        return None
+    if host_info is None:
+        from gpu_feature_discovery_tpu.hostinfo.provider import (
+            discover_host_info_gated,
+        )
+
+        host_info = discover_host_info_gated()
+    hostnames = list(host_info.worker_hostnames) if host_info else []
+    worker_id = host_info.worker_id if host_info else None
+    if len(hostnames) < 2:
+        if forced:
+            log.warning(
+                "slice-coordination=on but TPU_WORKER_HOSTNAMES names "
+                "%d worker(s); running node-local",
+                len(hostnames),
+            )
+        return None
+    if worker_id is None or not 0 <= worker_id < len(hostnames):
+        # auto on a real slice should coordinate; a missing/out-of-range
+        # worker id means the env is corrupt (tpu_env.py already warned
+        # on the range case) — coordination would poll the wrong set.
+        log.warning(
+            "slice coordination disabled: worker_id %r does not index "
+            "the %d-entry hostname list",
+            worker_id,
+            len(hostnames),
+        )
+        return None
+    timeout = (
+        tfd.peer_timeout
+        if tfd.peer_timeout is not None
+        else DEFAULT_PEER_TIMEOUT
+    )
+    labeler_timeout = (
+        tfd.labeler_timeout
+        if tfd.labeler_timeout is not None
+        else DEFAULT_LABELER_TIMEOUT
+    )
+    coordinator = SliceCoordinator(
+        worker_id=worker_id,
+        hostnames=hostnames,
+        default_port=tfd.metrics_port,
+        peer_timeout=timeout,
+        # The whole round must land under the engine's per-labeler
+        # deadline: a deadline miss marks the cycle's sources stale,
+        # which suppresses the supervisor's state persistence — a slow
+        # SLICE must never cost the NODE that. 0.8 leaves headroom for
+        # aggregation + the engine's own dispatch.
+        round_budget=0.8 * labeler_timeout,
+    )
+    log.info(
+        "slice coordination on: worker %d of %d (%s), peer timeout %.3fs",
+        worker_id,
+        len(hostnames),
+        coordinator.hostname,
+        timeout,
+    )
+    return coordinator

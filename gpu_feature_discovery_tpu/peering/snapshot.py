@@ -1,0 +1,150 @@
+"""The peer snapshot wire schema (``GET /peer/snapshot``).
+
+Versioned JSON, one document per poll — the peer layer's entire wire
+surface. The schema is deliberately tiny and forward-rejecting: a peer
+answering with a different ``schema`` is treated exactly like an
+unreachable peer (a mixed-version fleet mid-rollout degrades the slice
+labels, it never mis-aggregates), and every field the aggregator reads
+is validated on parse so one corrupt peer cannot poison the leader.
+
+Document shape (schema 1)::
+
+    {
+      "schema": 1,
+      "worker_id": 3,
+      "hostname": "w3",
+      "generation": 17,          # this epoch's label-write counter
+      "mode": "full",            # full | degraded | reserved | restored
+      "labels": {"google.com/tpu.count": "4", ...},
+      "chips": {"healthy": 4, "sick": 0}   # values null when unprobed
+    }
+
+``labels`` is the daemon's last WRITTEN label set, marker-stripped
+(status markers describe the serving cycle, not the inventory) and with
+the ``slice.*`` coordination family removed — a snapshot must carry the
+node's own facts, never slice labels a previous aggregation derived from
+other peers. ``chips`` pre-extracts the per-chip health verdict
+(lm/health.py ``chips.healthy``/``chips.sick``) so the leader's
+sick-chip sum does not re-parse label text.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, Optional
+
+PEER_SCHEMA_VERSION = 1
+PEER_SNAPSHOT_PATH = "/peer/snapshot"
+
+# Snapshot documents are small (a label set is ~1-2 KiB); anything
+# larger is junk or an attack surface, same discipline as the broker's
+# MAX_FRAME_BYTES oversize rejection.
+MAX_SNAPSHOT_BYTES = 256 * 1024
+
+
+class PeerSnapshotError(ValueError):
+    """A peer answered, but not with a valid schema-1 snapshot — counted
+    as a failed poll, exactly like not answering at all."""
+
+
+def strip_snapshot_labels(labels: Dict[str, str]) -> Dict[str, str]:
+    """The snapshot view of a written label set: status markers out
+    (they describe the cycle that wrote them — cmd/supervisor.py
+    ``_strip_markers`` rationale), the slice coordination family out
+    (see module docstring)."""
+    # Deferred: cmd imports peering (the daemon wires the coordinator),
+    # so a module-level import here would be a layering cycle.
+    from gpu_feature_discovery_tpu.cmd.supervisor import (
+        DEGRADED_LABEL,
+        RESTORED_LABEL,
+        UNHEALTHY_CYCLES_LABEL,
+    )
+    from gpu_feature_discovery_tpu.lm.engine import STALE_SOURCES_LABEL
+    from gpu_feature_discovery_tpu.lm.slice_labeler import SLICE_COORD_LABELS
+    from gpu_feature_discovery_tpu.sandbox.flap import FLAPPING_LABEL
+
+    dropped = {
+        DEGRADED_LABEL,
+        RESTORED_LABEL,
+        UNHEALTHY_CYCLES_LABEL,
+        STALE_SOURCES_LABEL,
+        FLAPPING_LABEL,
+        *SLICE_COORD_LABELS,
+    }
+    return {k: str(v) for k, v in labels.items() if k not in dropped}
+
+
+def _chip_verdict(labels: Dict[str, str]) -> Dict[str, Optional[int]]:
+    from gpu_feature_discovery_tpu.lm.health import CHIPS_HEALTHY, CHIPS_SICK
+
+    out: Dict[str, Optional[int]] = {}
+    for key, label in (("healthy", CHIPS_HEALTHY), ("sick", CHIPS_SICK)):
+        raw = labels.get(label)
+        try:
+            out[key] = int(raw) if raw is not None else None
+        except (TypeError, ValueError):
+            out[key] = None
+    return out
+
+
+def build_snapshot(
+    worker_id: int,
+    hostname: str,
+    labels: Dict[str, str],
+    generation: int,
+    mode: Optional[str],
+) -> Dict[str, Any]:
+    stripped = strip_snapshot_labels(labels)
+    return {
+        "schema": PEER_SCHEMA_VERSION,
+        "worker_id": int(worker_id),
+        "hostname": str(hostname),
+        "generation": int(generation),
+        "mode": mode,
+        "labels": stripped,
+        "chips": _chip_verdict(stripped),
+    }
+
+
+def parse_snapshot(body: bytes) -> Dict[str, Any]:
+    """Validate one polled snapshot body; raises PeerSnapshotError on
+    anything the aggregator cannot trust."""
+    if len(body) > MAX_SNAPSHOT_BYTES:
+        raise PeerSnapshotError(
+            f"snapshot body {len(body)} bytes exceeds {MAX_SNAPSHOT_BYTES}"
+        )
+    try:
+        doc = json.loads(body.decode("utf-8"))
+    except (UnicodeDecodeError, json.JSONDecodeError) as e:
+        raise PeerSnapshotError(f"snapshot is not JSON: {e}") from e
+    if not isinstance(doc, dict):
+        raise PeerSnapshotError(
+            f"snapshot must be an object, got {type(doc).__name__}"
+        )
+    schema = doc.get("schema")
+    if schema != PEER_SCHEMA_VERSION:
+        raise PeerSnapshotError(
+            f"unsupported snapshot schema {schema!r} "
+            f"(want {PEER_SCHEMA_VERSION})"
+        )
+    worker_id = doc.get("worker_id")
+    if not isinstance(worker_id, int) or isinstance(worker_id, bool) or worker_id < 0:
+        raise PeerSnapshotError(f"bad worker_id {worker_id!r}")
+    labels = doc.get("labels")
+    if not isinstance(labels, dict) or not all(
+        isinstance(k, str) and isinstance(v, str) for k, v in labels.items()
+    ):
+        raise PeerSnapshotError("labels must map str -> str")
+    generation = doc.get("generation")
+    if not isinstance(generation, int) or isinstance(generation, bool):
+        raise PeerSnapshotError(f"bad generation {generation!r}")
+    chips = doc.get("chips")
+    if not isinstance(chips, dict):
+        raise PeerSnapshotError("chips must be an object")
+    for key in ("healthy", "sick"):
+        value = chips.get(key)
+        if value is not None and (
+            not isinstance(value, int) or isinstance(value, bool)
+        ):
+            raise PeerSnapshotError(f"bad chips.{key} {value!r}")
+    return doc

@@ -49,8 +49,22 @@ Instrumented sites:
                        one probe (SLOW_CHIP_FACTOR) — the straggler-
                        detection path (tpu.straggler-chip); confirmation
                        takes 2 consecutive probes, so arm 2 shots
+    peer.unreachable   slice coordination (peering/): this daemon's
+                       /peer/snapshot handler drops the connection with
+                       no response on the next N polls — pollers see the
+                       same RemoteDisconnected a dead host produces;
+                       confirmation takes 2 consecutive misses, so arm 2+
+                       shots to flip slice.degraded
+    peer.slow          the snapshot handler stalls past --peer-timeout
+                       before answering (the poll-timeout miss path)
+    peer.junk          the snapshot handler answers 200 with a non-JSON
+                       body (the parse-rejection miss path)
 
-The ``probe.*``, ``broker.*`` and ``chip.*`` sites are BEHAVIORAL: the
+The ``probe.*``, ``broker.*``, ``chip.*`` and ``peer.*`` sites are
+BEHAVIORAL. The ``peer.*`` family is consumed AND enacted in the SERVING
+daemon's obs handler (obs/server.py) — the injection lives where the
+misbehavior lives, and the polling side exercises its real network-error
+paths against it. The rest are consumed parent-side: the
 driver consumes them with ``consume()`` (countdown without raising) in
 the PARENT process and enacts the behavior in/around the forked child —
 a child-side countdown would decrement only the child's fork-copied

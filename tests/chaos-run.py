@@ -18,6 +18,15 @@ Usage::
     TFD_FAULT_SPEC='pjrt_init:fail:2' python tests/chaos-run.py
     python tests/chaos-run.py --spec 'write:raise:OSError,generate:raise:RuntimeError'
 
+``slice:<scenario>`` specs are not fault injections but multi-daemon
+chaos: they run a 4-worker in-process slice (tests/slice_fixture.py
+SliceHarness, real HTTP between the daemons) and kill one member —
+``slice:peer-unreachable`` kills a follower and asserts the leader
+converges to ``slice.healthy-hosts=3`` / ``slice.degraded=true`` with
+every survivor's node-local labels untouched; ``slice:leader-failover``
+kills the leader and asserts the next-lowest worker promotes itself and
+publishes fresh slice labels within 2 poll intervals.
+
 Runs hermetically on CPU (mock backend, no metadata) in well under 10s;
 tests/test_chaos.py executes the same entry point in-process for every
 matrix row, so the CI job and the unit suite cannot drift.
@@ -46,14 +55,78 @@ def read_labels(path):
         return {}
 
 
-def _free_port():
-    import socket
+def run_slice_chaos(scenario, workdir, timeout_s=None):
+    """One multi-daemon slice chaos scenario (module docstring): a
+    4-worker hermetic slice with one member killed mid-run. The label
+    names are read through the package (never retyped) so the scenario
+    and the daemon cannot drift."""
+    from slice_fixture import SliceHarness, non_coord_lines
 
-    s = socket.socket()
-    s.bind(("127.0.0.1", 0))
-    port = s.getsockname()[1]
-    s.close()
-    return port
+    from gpu_feature_discovery_tpu.lm.slice_labeler import (
+        SLICE_DEGRADED_LABEL,
+        SLICE_HEALTHY_HOSTS_LABEL,
+        SLICE_LEADER_SEEN_LABEL,
+        SLICE_ROLE_LABEL,
+    )
+
+    victims = {"peer-unreachable": 3, "leader-failover": 0}
+    if scenario not in victims:
+        raise ValueError(f"unknown slice chaos scenario {scenario!r}")
+    budget = timeout_s or 30.0
+    # Generous vs the 0.05s poll/sleep interval: the contract under test
+    # is convergence and label containment; the 2-poll-interval promotion
+    # bound is pinned deterministically in tests/test_peering.py.
+    sleep_interval = "0.05s"
+    started = time.monotonic()
+    harness = SliceHarness(
+        workdir, workers=4, sleep_interval=sleep_interval
+    ).start()
+
+    def node_local(worker):
+        return non_coord_lines(worker.raw_output())
+
+    try:
+        harness.wait_for(
+            lambda s: (
+                s[0].get(SLICE_ROLE_LABEL) == "leader"
+                and s[0].get(SLICE_HEALTHY_HOSTS_LABEL) == "4"
+                and all(
+                    s[i].get(SLICE_LEADER_SEEN_LABEL) == "true"
+                    for i in (1, 2, 3)
+                )
+            ),
+            timeout=budget,
+            what="healthy 4-worker slice",
+        )
+        victim = victims[scenario]
+        survivors = [w for w in harness.workers if w.worker_id != victim]
+        before = {w.worker_id: node_local(w) for w in survivors}
+        harness.stop_worker(victim)
+        new_leader = 1 if scenario == "leader-failover" else 0
+        converged = harness.wait_for(
+            lambda s: (
+                s[new_leader].get(SLICE_ROLE_LABEL) == "leader"
+                and s[new_leader].get(SLICE_HEALTHY_HOSTS_LABEL) == "3"
+                and s[new_leader].get(SLICE_DEGRADED_LABEL) == "true"
+            ),
+            timeout=budget,
+            what=f"slice convergence after killing worker {victim}",
+        )
+        # A peer dying degrades the SLICE labels only: every survivor's
+        # node-local label set is untouched.
+        for worker in survivors:
+            assert node_local(worker) == before[worker.worker_id], (
+                f"worker {worker.worker_id}'s node-local labels moved "
+                f"when worker {victim} died"
+            )
+    finally:
+        harness.stop()
+    elapsed = time.monotonic() - started
+    return {
+        "spec": f"slice:{scenario}",
+        "converged_s": round(elapsed, 3),
+        "labels": len(converged[new_leader]),
+    }
 
 
 def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
@@ -95,6 +168,12 @@ def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
     from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
     from gpu_feature_discovery_tpu.utils import faults
 
+    if spec.startswith("slice:"):
+        # Multi-daemon slice chaos: no fault spec to arm — the "fault"
+        # is a real daemon death inside the in-process slice.
+        return run_slice_chaos(
+            spec.partition(":")[2], workdir, timeout_s=timeout_s
+        )
     chip_faults = any(
         e.strip().startswith("chip.") for e in spec.split(",") if e.strip()
     )
@@ -155,8 +234,10 @@ def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
         )
     metrics_port = None
     if assert_probe_kills is not None:
+        from slice_fixture import free_port
+
         obs_metrics.reset_for_tests()
-        metrics_port = _free_port()
+        metrics_port = free_port()
         cli_values["metrics-addr"] = "127.0.0.1"
         cli_values["metrics-port"] = str(metrics_port)
     config = new_config(cli_values=cli_values, environ={})

@@ -69,6 +69,15 @@ def prepare_slice_workers(image, backend, manifest_path, hostenv, nodes):
         env[:] = [e for e in env if e["name"] != "TFD_HERMETIC"]
         env.append({"name": "TFD_NO_METADATA", "value": "1"})
         env.append({"name": "TFD_MOCK_PCI", "value": "1"})
+        # This scenario checks coordination-FREE slice-label agreement
+        # (its golden carries no tpu.slice coordination family), and the
+        # hostenv's w0..w7 names do not resolve inside kind — the
+        # manifests' auto-coordination would poll into the void and
+        # publish the partition signature into the golden-checked set.
+        # The coordination path gets its own hermetic acceptance suite
+        # (tests/test_slice.py) and chaos rows (slice:*).
+        env[:] = [e for e in env if e["name"] != "TFD_SLICE_COORDINATION"]
+        env.append({"name": "TFD_SLICE_COORDINATION", "value": "off"})
         for key, value in parse_hostenv(hostenv):
             env.append({"name": key, "value": value})
         env.append({"name": "TPU_WORKER_ID", "value": str(i)})

@@ -1,4 +1,5 @@
-"""The slice-consistency scenario's shared facts — ONE source of truth.
+"""The slice-consistency scenario's shared facts — ONE source of truth —
+plus the hermetic N-daemon slice harness (``SliceHarness``).
 
 Three places deploy "two workers of one v5p-64 slice" and must agree on
 its shape: the kind CI step (.github/workflows/ci.yml, parity-pinned
@@ -6,6 +7,28 @@ against these constants by test_ci_workflow.py), the hermetic twin
 (test_e2e_script.py), and the manifest generator
 (ci-prepare-e2e-manifest.py). Hand-duplicating the env string let the
 twin silently drift from what CI deploys.
+
+``SliceHarness`` runs N REAL supervised daemon loops (cmd/main.run) as
+workers of one slice in THIS process: per-worker output files, state
+dirs, and introspection ports on 127.0.0.1, each labeling from its own
+``mock-worker:v5p-64`` backend and — with coordination on — polling the
+other daemons' live ``/peer/snapshot`` endpoints over real HTTP. Slice
+identity is injected as a built SliceCoordinator (worker id + the
+``127.0.0.1:<port>`` hostname list), because os.environ is shared
+between N in-process daemons and cannot carry per-worker facts.
+"killing" a worker is its real shutdown path: SIGTERM on its signal
+queue closes its obs server, so survivors see the same connection
+refusal a dead host produces. Used by the slice acceptance tests
+(tests/test_slice.py) and the chaos driver's slice scenarios
+(tests/chaos-run.py).
+
+Process-global state the harness must hold still: the obs metrics
+registry and the fault-injection registry are shared by all N daemons
+(the chaos slice rows lean on the latter — an armed ``peer.*`` site
+fires in whichever serving handler polls first); --probe-broker stays
+off and --probe-isolation none, because close_broker()/
+kill_stray_children() at one daemon's epoch end are process-wide and
+would tear down a sibling's machinery mid-cycle.
 """
 
 SLICE_BACKEND = "mock-worker:v5p-64"
@@ -33,3 +56,247 @@ def parse_hostenv(hostenv):
         if key.strip():
             out.append((key.strip(), value.strip()))
     return out
+
+
+# ---------------------------------------------------------------------------
+# the hermetic N-daemon slice harness
+# ---------------------------------------------------------------------------
+
+def free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def non_coord_lines(raw):
+    """A label file's node-local content: every line except the slice
+    coordination family (the only lines the peer layer may move). The
+    acceptance tests (test_slice.py) and the chaos driver's slice
+    scenarios (chaos-run.py) judge "node labels untouched" through this
+    ONE filter, so its idea of the coordination family cannot drift
+    between them."""
+    from gpu_feature_discovery_tpu.lm.slice_labeler import SLICE_COORD_LABELS
+
+    return [
+        line
+        for line in (raw or "").splitlines()
+        if not line.startswith(SLICE_COORD_LABELS)
+    ]
+
+
+class SliceWorker:
+    """One in-process daemon: its run() thread, signal queue, config,
+    and (with coordination on) its injected SliceCoordinator."""
+
+    def __init__(self, worker_id, config, coordinator, interconnect, port):
+        self.worker_id = worker_id
+        self.config = config
+        self.coordinator = coordinator
+        self.interconnect = interconnect
+        self.port = port
+        self.output_file = config.flags.tfd.output_file
+        self.sigs = None
+        self.thread = None
+        self.result = {}
+
+    @property
+    def alive(self):
+        return self.thread is not None and self.thread.is_alive()
+
+    def labels(self):
+        """The worker's current label file as a dict ({} while absent)."""
+        try:
+            with open(self.output_file) as f:
+                return dict(
+                    line.rstrip("\n").split("=", 1) for line in f if "=" in line
+                )
+        except OSError:
+            return {}
+
+    def raw_output(self):
+        try:
+            with open(self.output_file) as f:
+                return f.read()
+        except OSError:
+            return None
+
+
+class SliceHarness:
+    """N supervised daemon loops as one hermetic pod slice (module
+    docstring). ``coordination`` is the --slice-coordination mode every
+    worker runs under; ``hostenv`` (default SLICE_HOSTENV) feeds each
+    worker's static host-info fixture, with TPU_WORKER_ID set per
+    worker — so node-local multihost.* labels match the two-worker kind
+    scenario's and the in-tree goldens apply."""
+
+    def __init__(
+        self,
+        tmp_path,
+        workers=4,
+        accel_type="v5p-64",
+        coordination="on",
+        sleep_interval="0.05s",
+        peer_timeout="0.5s",
+        hostenv=SLICE_HOSTENV,
+    ):
+        import os
+
+        from gpu_feature_discovery_tpu.config import new_config
+        from gpu_feature_discovery_tpu.hostinfo.provider import StaticProvider
+        from gpu_feature_discovery_tpu.hostinfo.tpu_env import (
+            host_info_from_mapping,
+        )
+        from gpu_feature_discovery_tpu.lm.interconnect import (
+            InterconnectLabeler,
+        )
+        from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+        from gpu_feature_discovery_tpu.pci.pciutil import MockGooglePCI
+        from gpu_feature_discovery_tpu.peering import SliceCoordinator
+
+        self.accel_type = accel_type
+        self.workers = []
+        # One shared registry for all N daemons: start each scenario
+        # clean so its assertions read this run's events only.
+        obs_metrics.reset_for_tests()
+        ports = [free_port() for _ in range(workers)]
+        hostnames = [f"127.0.0.1:{p}" for p in ports]
+        base_env = dict(parse_hostenv(hostenv))
+        for i in range(workers):
+            workdir = os.path.join(str(tmp_path), f"worker-{i}")
+            os.makedirs(workdir, exist_ok=True)
+            machine = os.path.join(workdir, "machine-type")
+            with open(machine, "w") as f:
+                f.write("Google Compute Engine\n")
+            config = new_config(
+                cli_values={
+                    "oneshot": False,
+                    "output-file": os.path.join(workdir, "tfd"),
+                    "machine-type-file": machine,
+                    "tpu-topology-strategy": "single",
+                    "sleep-interval": sleep_interval,
+                    "init-backoff-max": "0.02s",
+                    "init-retries": "50",
+                    "max-consecutive-failures": "50",
+                    "metrics-addr": "127.0.0.1",
+                    "metrics-port": str(ports[i]),
+                    "state-dir": os.path.join(workdir, "state"),
+                    # Process-wide sandbox/broker teardown at one
+                    # daemon's epoch end must not hit its siblings
+                    # (module docstring).
+                    "probe-isolation": "none",
+                    "probe-broker": "off",
+                    "slice-coordination": coordination,
+                    "peer-timeout": peer_timeout,
+                },
+                environ={},
+            )
+            coordinator = None
+            if coordination == "on":
+                coordinator = SliceCoordinator(
+                    worker_id=i,
+                    hostnames=hostnames,
+                    default_port=ports[i],
+                    peer_timeout=float(peer_timeout.rstrip("s")),
+                )
+            env = dict(base_env)
+            env["TPU_WORKER_ID"] = str(i)
+            interconnect = InterconnectLabeler(
+                pci=MockGooglePCI(),
+                provider=StaticProvider(host_info_from_mapping(env)),
+            )
+            self.workers.append(
+                SliceWorker(i, config, coordinator, interconnect, ports[i])
+            )
+
+    def start(self):
+        for worker in self.workers:
+            self.start_worker(worker.worker_id)
+        return self
+
+    def start_worker(self, worker_id):
+        import queue
+        import threading
+
+        from gpu_feature_discovery_tpu.cmd.main import run
+        from gpu_feature_discovery_tpu.cmd.supervisor import Supervisor
+        from gpu_feature_discovery_tpu.resource.testing import (
+            new_multihost_worker_manager,
+        )
+
+        worker = self.workers[worker_id]
+        assert not worker.alive, f"worker {worker_id} already running"
+        worker.sigs = queue.Queue()
+        worker.result = {}
+        accel = self.accel_type
+
+        def target():
+            try:
+                worker.result["restart"] = run(
+                    lambda: new_multihost_worker_manager(accel),
+                    worker.interconnect,
+                    worker.config,
+                    worker.sigs,
+                    supervisor=Supervisor(worker.config),
+                    coordinator=worker.coordinator,
+                )
+            except BaseException as e:  # noqa: BLE001 - reported by tests
+                worker.result["error"] = e
+
+        worker.thread = threading.Thread(
+            target=target, name=f"slice-worker-{worker_id}", daemon=True
+        )
+        worker.thread.start()
+        return worker
+
+    def stop_worker(self, worker_id, timeout=10):
+        """The worker's REAL shutdown path — the harness's 'kill a
+        host': SIGTERM drains the loop, closes its obs server (peers
+        now see connection refused), and removes its label file."""
+        import signal
+
+        worker = self.workers[worker_id]
+        if worker.sigs is not None:
+            worker.sigs.put(signal.SIGTERM)
+        if worker.thread is not None:
+            worker.thread.join(timeout=timeout)
+            assert not worker.thread.is_alive(), (
+                f"worker {worker_id} did not honor SIGTERM"
+            )
+        assert "error" not in worker.result, worker.result.get("error")
+
+    def stop(self):
+        for worker in self.workers:
+            if worker.alive:
+                self.stop_worker(worker.worker_id)
+
+    def wait_for(self, predicate, timeout=20, what="condition"):
+        """Poll every worker's label file until ``predicate(labels_by_id)``
+        holds; returns the satisfying snapshot or fails."""
+        import time
+
+        deadline = time.monotonic() + timeout
+        snapshot = {}
+        while time.monotonic() < deadline:
+            snapshot = {w.worker_id: w.labels() for w in self.workers}
+            if predicate(snapshot):
+                return snapshot
+            for worker in self.workers:
+                assert "error" not in worker.result, (
+                    f"worker {worker.worker_id} crashed: "
+                    f"{worker.result['error']!r}"
+                )
+            time.sleep(0.01)
+        raise AssertionError(
+            f"timed out after {timeout}s waiting for {what}; "
+            f"last label files: {snapshot}"
+        )
+
+    def __enter__(self):
+        return self.start()
+
+    def __exit__(self, *exc):
+        self.stop()

@@ -40,6 +40,15 @@ CHAOS_SPECS = [
     # specs (real sharded probe on the 8-device virtual mesh).
     "chip.3.sick:fail:1",
     "chip.2.slow:fail:2",
+    # Multi-daemon slice chaos (peering/): a 4-worker in-process slice
+    # (tests/slice_fixture.py SliceHarness, real HTTP between daemons)
+    # with one member killed mid-run. A dead follower must degrade the
+    # SLICE labels only (leader converges to healthy-hosts=3 /
+    # degraded=true, every survivor's node-local labels untouched); a
+    # dead leader must fail over to the next-lowest reachable worker,
+    # which publishes fresh slice labels.
+    "slice:peer-unreachable",
+    "slice:leader-failover",
 ]
 
 # Per-spec label expectations + convergence budgets beyond the generic
@@ -62,6 +71,10 @@ CHAOS_EXPECTATIONS = {
         "expect_absent": ["google.com/tpu.straggler-chip"],
         "timeout_s": 90.0,
     },
+    # 4 concurrent daemon loops on a small CI host: give startup +
+    # convergence + the 2-poll confirmation window comfortable room.
+    "slice:peer-unreachable": {"timeout_s": 60.0},
+    "slice:leader-failover": {"timeout_s": 60.0},
 }
 
 

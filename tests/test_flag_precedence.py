@@ -46,6 +46,8 @@ FILE_KEYS = {
     "broker-max-requests": ("tfd", "brokerMaxRequests"),
     "chip-probes": ("tfd", "chipProbes"),
     "straggler-threshold": ("tfd", "stragglerThreshold"),
+    "slice-coordination": ("tfd", "sliceCoordination"),
+    "peer-timeout": ("tfd", "peerTimeout"),
 }
 
 # Two distinct valid raw values per flag (a wins the dominance checks).
@@ -64,6 +66,8 @@ VALUE_PAIRS = {
     "probe-broker": ("on", "off"),
     "broker-max-requests": ("5", "9"),
     "straggler-threshold": ("0.3", "0.7"),
+    "slice-coordination": ("on", "off"),
+    "peer-timeout": ("1s", "3s"),
 }
 
 

@@ -158,7 +158,60 @@ def test_default_render_keeps_exec_probe_and_metrics_port():
     assert env["TFD_METRICS_PORT"] == "9101"
     assert env["TFD_METRICS_ADDR"] == "0.0.0.0"
     (port,) = ctr["ports"]
-    assert port == {"name": "metrics", "containerPort": 9101, "protocol": "TCP"}
+    # hostPort rides along by default: slice coordination (default auto)
+    # needs /peer/snapshot reachable at the worker's node address.
+    assert port == {
+        "name": "metrics",
+        "containerPort": 9101,
+        "hostPort": 9101,
+        "protocol": "TCP",
+    }
+
+
+def test_slice_coordination_off_drops_host_port_and_sets_env():
+    ctr = _tfd_daemonset(
+        render_chart(CHART, values_overrides={"slice.coordination": "off"})
+    )
+    (port,) = ctr["ports"]
+    assert "hostPort" not in port
+    env = {e["name"]: e["value"] for e in ctr["env"]}
+    assert env["TFD_SLICE_COORDINATION"] == "off"
+
+
+def test_slice_env_defaults_render():
+    env = {
+        e["name"]: e["value"] for e in _tfd_daemonset(render_chart(CHART))["env"]
+    }
+    assert env["TFD_SLICE_COORDINATION"] == "auto"
+    assert env["TFD_PEER_TIMEOUT"] == "2s"
+
+
+def test_slice_host_port_off_drops_claim_without_touching_coordination():
+    """slice.hostPort=off is the single-host escape hatch: no node port
+    claim (a conflict would leave the pod Pending, and the introspection
+    server would be reachable from the node network for nothing), while
+    the coordination env stays auto."""
+    ctr = _tfd_daemonset(
+        render_chart(CHART, values_overrides={"slice.hostPort": "off"})
+    )
+    (port,) = ctr["ports"]
+    assert "hostPort" not in port
+    env = {e["name"]: e["value"] for e in ctr["env"]}
+    assert env["TFD_SLICE_COORDINATION"] == "auto"
+
+
+def test_slice_host_port_on_forces_claim_with_coordination_off():
+    ctr = _tfd_daemonset(
+        render_chart(
+            CHART,
+            values_overrides={
+                "slice.coordination": "off",
+                "slice.hostPort": "on",
+            },
+        )
+    )
+    (port,) = ctr["ports"]
+    assert port["hostPort"] == 9101
 
 
 def test_http_probes_toggle_switches_both_probes():

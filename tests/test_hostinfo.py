@@ -72,6 +72,64 @@ def test_host_info_falls_back_to_accelerator_type_tables():
     assert info.multi_host
 
 
+def test_worker_hostnames_strip_whitespace():
+    info = host_info_from_mapping(
+        {"TPU_WORKER_HOSTNAMES": " w0 , w1 ,\tw2 "}
+    )
+    assert info.worker_hostnames == ["w0", "w1", "w2"]
+    assert info.worker_count == 3
+
+
+def test_worker_hostnames_drop_empty_entries():
+    # Trailing and doubled commas are exactly what a templated env var
+    # produces when one worker's entry renders empty.
+    info = host_info_from_mapping({"TPU_WORKER_HOSTNAMES": "w0,,w1,,,w2,"})
+    assert info.worker_hostnames == ["w0", "w1", "w2"]
+    assert info.worker_count == 3
+
+
+def test_worker_hostnames_dedupe_preserving_order(caplog):
+    import logging as _logging
+
+    with caplog.at_level(_logging.WARNING, logger="tfd.hostinfo"):
+        info = host_info_from_mapping(
+            {"TPU_WORKER_HOSTNAMES": "w2,w0,w2,w1,w0"}
+        )
+    assert info.worker_hostnames == ["w2", "w0", "w1"]
+    assert info.worker_count == 3
+    assert any("duplicate" in r.message for r in caplog.records)
+
+
+def test_worker_hostnames_all_empty_leaves_count_unset():
+    info = host_info_from_mapping({"TPU_WORKER_HOSTNAMES": ", ,"})
+    assert info.worker_hostnames == []
+    assert info.worker_count is None
+
+
+def test_worker_id_out_of_range_warns(caplog):
+    import logging as _logging
+
+    with caplog.at_level(_logging.WARNING, logger="tfd.hostinfo"):
+        info = host_info_from_mapping(
+            {"TPU_WORKER_ID": "5", "TPU_WORKER_HOSTNAMES": "w0,w1"}
+        )
+    # The id is this host's own fact and stays; the mismatch is loud.
+    assert info.worker_id == 5
+    assert info.worker_count == 2
+    assert any("out of range" in r.message for r in caplog.records)
+
+
+def test_worker_id_in_range_does_not_warn(caplog):
+    import logging as _logging
+
+    with caplog.at_level(_logging.WARNING, logger="tfd.hostinfo"):
+        info = host_info_from_mapping(
+            {"TPU_WORKER_ID": "1", "TPU_WORKER_HOSTNAMES": "w0,w1"}
+        )
+    assert info.worker_id == 1
+    assert not any("out of range" in r.message for r in caplog.records)
+
+
 def test_single_host_is_not_multihost():
     info = HostInfo(accelerator_type="v4-8")
     assert not info.multi_host

@@ -328,6 +328,90 @@ def test_debug_endpoints_flag_gates_debug_labels():
         server.close()
 
 
+def test_handler_exception_answers_500_with_error_class():
+    """A raising endpoint handler used to tear the connection down with
+    no response (the scraper saw a bare protocol error). Poison the
+    debug snapshot with a non-JSON-serializable value: /debug/labels
+    must answer 500 naming the error class, count in
+    tfd_http_errors_total{endpoint}, and leave the server serving."""
+    obs_metrics.reset_for_tests()
+    state = IntrospectionState(60.0)
+    # Provenance carries a raw object; json.dumps inside the handler
+    # raises TypeError — the poisoned-snapshot shape a buggy source
+    # could feed the introspection state.
+    state.labels_written({"a": "b"}, {"device": {"duration_ms": object()}})
+    server = IntrospectionServer(
+        obs_metrics.REGISTRY, state, addr="127.0.0.1", port=0
+    )
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(base + "/debug/labels")
+        assert e.value.code == 500
+        assert e.value.read().decode().strip() == "TypeError"
+        # Contained: the same server keeps answering other endpoints,
+        # and the error is visible in the error counter.
+        code, body, _ = _get(base + "/metrics")
+        assert code == 200
+        assert _sample_value(
+            body, "tfd_http_errors_total", '{endpoint="/debug/labels"}'
+        ) == 1
+        # A second poisoned request counts again (no one-shot latching).
+        with pytest.raises(urllib.error.HTTPError):
+            _get(base + "/debug/labels")
+        _, body, _ = _get(base + "/metrics")
+        assert _sample_value(
+            body, "tfd_http_errors_total", '{endpoint="/debug/labels"}'
+        ) == 2
+    finally:
+        server.close()
+
+
+def test_http_error_endpoint_label_is_never_client_chosen():
+    """The endpoint label must come from the fixed endpoint set, never
+    the request path: a client minting unique paths (each erroring via a
+    mid-reply hangup) would otherwise mint unbounded labeled series in
+    the process-global registry."""
+    from gpu_feature_discovery_tpu.obs.server import _endpoint_label
+
+    for known in (
+        "/metrics", "/healthz", "/readyz", "/debug/labels", "/peer/snapshot"
+    ):
+        assert _endpoint_label(known) == known
+    assert _endpoint_label("/x" * 100) == "other"
+    assert _endpoint_label("/metrics/../../etc") == "other"
+    assert _endpoint_label("") == "other"
+
+
+def test_handler_exception_in_peer_snapshot_answers_500():
+    """The peer wire surface gets the same containment: a raising
+    snapshot callable answers 500 (one failed poll on the peer side),
+    never a torn-down connection."""
+    obs_metrics.reset_for_tests()
+    state = IntrospectionState(60.0)
+
+    def exploding_snapshot():
+        raise RuntimeError("snapshot state torn")
+
+    server = IntrospectionServer(
+        obs_metrics.REGISTRY, state, addr="127.0.0.1", port=0,
+        peer_snapshot=exploding_snapshot,
+    )
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            _get(f"http://127.0.0.1:{server.port}/peer/snapshot")
+        assert e.value.code == 500
+        assert e.value.read().decode().strip() == "RuntimeError"
+        _, body, _ = _get(f"http://127.0.0.1:{server.port}/metrics")
+        assert _sample_value(
+            body, "tfd_http_errors_total", '{endpoint="/peer/snapshot"}'
+        ) == 1
+    finally:
+        server.close()
+
+
 # ---------------------------------------------------------------------------
 # daemon wiring: the oneshot-vs-daemon default split, port 0, bind failure
 # ---------------------------------------------------------------------------

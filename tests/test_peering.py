@@ -1,0 +1,777 @@
+"""Cross-host slice coordination unit tests (peering/ + lm/slice_labeler).
+
+Four layers, all hermetic:
+
+1. Wire schema: build_snapshot/parse_snapshot round-trip, every
+   forward-rejecting validation branch, marker/slice-family stripping.
+2. Coordinator state machine under an injected clock + fetch: the
+   2-consecutive-poll unreachability confirmation (one miss never flaps),
+   recover-fast asymmetry, confirmed-dead backoff windows, and the
+   derived leadership order — including failover to the next-lowest
+   reachable worker and the never-lead-while-fully-partitioned rule.
+3. Config gating (new_slice_coordinator): every off/auto/on resolution.
+4. Live HTTP: a coordinator polling a real IntrospectionServer, plus the
+   peer.* fault sites enacted in the serving handler.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gpu_feature_discovery_tpu.config import new_config
+from gpu_feature_discovery_tpu.lm.slice_labeler import (
+    SLICE_COORD_LABELS,
+    SLICE_DEGRADED_LABEL,
+    SLICE_HEALTHY_HOSTS_LABEL,
+    SLICE_LEADER_LABEL,
+    SLICE_LEADER_SEEN_LABEL,
+    SLICE_ROLE_LABEL,
+    SLICE_SICK_CHIPS_LABEL,
+    SLICE_TOTAL_HOSTS_LABEL,
+    slice_labels,
+)
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+from gpu_feature_discovery_tpu.obs.server import (
+    IntrospectionServer,
+    IntrospectionState,
+)
+from gpu_feature_discovery_tpu.peering import (
+    CONFIRM_POLLS,
+    PeerSnapshotError,
+    SliceCoordinator,
+    build_snapshot,
+    parse_snapshot,
+    strip_snapshot_labels,
+)
+from gpu_feature_discovery_tpu.peering.coordinator import new_slice_coordinator
+from gpu_feature_discovery_tpu.peering.snapshot import MAX_SNAPSHOT_BYTES
+from gpu_feature_discovery_tpu.utils import faults
+from gpu_feature_discovery_tpu.utils.retry import BackoffPolicy
+
+
+# ---------------------------------------------------------------------------
+# wire schema
+# ---------------------------------------------------------------------------
+
+def test_snapshot_roundtrip():
+    doc = build_snapshot(
+        3,
+        "w3",
+        {
+            "google.com/tpu.count": "4",
+            "google.com/tpu.chips.healthy": "3",
+            "google.com/tpu.chips.sick": "1",
+        },
+        generation=17,
+        mode="full",
+    )
+    parsed = parse_snapshot(json.dumps(doc).encode())
+    assert parsed["worker_id"] == 3
+    assert parsed["hostname"] == "w3"
+    assert parsed["generation"] == 17
+    assert parsed["mode"] == "full"
+    assert parsed["labels"]["google.com/tpu.count"] == "4"
+    assert parsed["chips"] == {"healthy": 3, "sick": 1}
+
+
+def test_snapshot_chips_null_when_unprobed():
+    doc = build_snapshot(0, "w0", {"google.com/tpu.count": "4"}, 1, "full")
+    assert doc["chips"] == {"healthy": None, "sick": None}
+
+
+def test_snapshot_strips_markers_and_slice_family():
+    from gpu_feature_discovery_tpu.cmd.supervisor import (
+        DEGRADED_LABEL,
+        RESTORED_LABEL,
+        UNHEALTHY_CYCLES_LABEL,
+    )
+    from gpu_feature_discovery_tpu.lm.engine import STALE_SOURCES_LABEL
+    from gpu_feature_discovery_tpu.sandbox.flap import FLAPPING_LABEL
+
+    labels = {
+        "google.com/tpu.count": "4",
+        DEGRADED_LABEL: "true",
+        RESTORED_LABEL: "true",
+        UNHEALTHY_CYCLES_LABEL: "3",
+        STALE_SOURCES_LABEL: "device",
+        FLAPPING_LABEL: "true",
+    }
+    labels.update({k: "x" for k in SLICE_COORD_LABELS})
+    assert strip_snapshot_labels(labels) == {"google.com/tpu.count": "4"}
+
+
+@pytest.mark.parametrize(
+    "body, why",
+    [
+        (b"not json {", "junk"),
+        (b"[1, 2]", "non-object"),
+        (b'{"schema": 2, "worker_id": 0}', "future schema"),
+        (b'{"worker_id": 0}', "missing schema"),
+        (b'{"schema": 1, "worker_id": "zero"}', "non-int worker_id"),
+        (b'{"schema": 1, "worker_id": true}', "bool worker_id"),
+        (b'{"schema": 1, "worker_id": -1}', "negative worker_id"),
+        (
+            b'{"schema": 1, "worker_id": 0, "labels": {"k": 4},'
+            b' "generation": 1, "chips": {}}',
+            "non-str label value",
+        ),
+        (
+            b'{"schema": 1, "worker_id": 0, "labels": [],'
+            b' "generation": 1, "chips": {}}',
+            "labels not a map",
+        ),
+        (
+            b'{"schema": 1, "worker_id": 0, "labels": {},'
+            b' "generation": "g", "chips": {}}',
+            "bad generation",
+        ),
+        (
+            b'{"schema": 1, "worker_id": 0, "labels": {},'
+            b' "generation": 1, "chips": []}',
+            "chips not an object",
+        ),
+        (
+            b'{"schema": 1, "worker_id": 0, "labels": {},'
+            b' "generation": 1, "chips": {"sick": "1"}}',
+            "non-int chips.sick",
+        ),
+    ],
+)
+def test_parse_snapshot_rejects(body, why):
+    with pytest.raises(PeerSnapshotError):
+        parse_snapshot(body)
+
+
+def test_parse_snapshot_rejects_oversize_body():
+    doc = build_snapshot(0, "w0", {}, 1, "full")
+    doc["labels"] = {"pad": "x" * (MAX_SNAPSHOT_BYTES + 1)}
+    with pytest.raises(PeerSnapshotError, match="exceeds"):
+        parse_snapshot(json.dumps(doc).encode())
+
+
+def test_snapshot_generation_increments_per_publish():
+    coord = SliceCoordinator(0, ["w0", "w1"], default_port=1, peer_timeout=0.1)
+    assert coord.snapshot_payload()["generation"] == 0
+    coord.publish_local({"a": "b"}, "full")
+    coord.publish_local({"a": "c"}, "degraded")
+    doc = coord.snapshot_payload()
+    assert doc["generation"] == 2
+    assert doc["mode"] == "degraded"
+    assert doc["labels"] == {"a": "c"}
+
+
+# ---------------------------------------------------------------------------
+# slice label rendering
+# ---------------------------------------------------------------------------
+
+class _View:
+    def __init__(self, **kw):
+        self.__dict__.update(kw)
+
+
+def test_slice_labels_leader_family():
+    labels = dict(
+        slice_labels(
+            _View(
+                role="leader",
+                leader_hostname="w0",
+                leader_seen=True,
+                healthy_hosts=3,
+                total_hosts=4,
+                degraded=True,
+                sick_chips=2,
+            )
+        )
+    )
+    assert labels == {
+        SLICE_ROLE_LABEL: "leader",
+        SLICE_LEADER_LABEL: "w0",
+        SLICE_HEALTHY_HOSTS_LABEL: "3",
+        SLICE_TOTAL_HOSTS_LABEL: "4",
+        SLICE_DEGRADED_LABEL: "true",
+        SLICE_SICK_CHIPS_LABEL: "2",
+    }
+
+
+def test_slice_labels_follower_is_minimal():
+    labels = dict(
+        slice_labels(
+            _View(
+                role="follower",
+                leader_hostname="w0",
+                leader_seen=False,
+                healthy_hosts=4,
+                total_hosts=4,
+                degraded=False,
+                sick_chips=0,
+            )
+        )
+    )
+    # A follower publishes only role + leader visibility: the aggregate
+    # is the leader's to publish, and two hosts disagreeing about
+    # healthy-hosts would be worse than one authoritative count.
+    assert labels == {
+        SLICE_ROLE_LABEL: "follower",
+        SLICE_LEADER_SEEN_LABEL: "false",
+    }
+
+
+# ---------------------------------------------------------------------------
+# coordinator state machine (injected clock + fetch)
+# ---------------------------------------------------------------------------
+
+class _Clock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def _coordinator(worker_id, n, clock=None, responses=None, round_budget=None):
+    """Coordinator over n workers w0..w{n-1} whose fetches are served
+    from ``responses``: worker_id -> snapshot dict | Exception |
+    callable(timeout) -> snapshot dict."""
+    coord = SliceCoordinator(
+        worker_id,
+        [f"w{i}" for i in range(n)],
+        default_port=1,
+        peer_timeout=0.1,
+        round_budget=round_budget,
+        clock=clock or _Clock(),
+        # Deterministic windows: no jitter, no growth.
+        backoff_factory=lambda: BackoffPolicy(
+            base=5.0, factor=1.0, cap=5.0, jitter=0.0
+        ),
+    )
+    responses = responses if responses is not None else {}
+
+    def fetch(peer, timeout):
+        result = responses.get(peer.worker_id, ConnectionRefusedError("down"))
+        if isinstance(result, BaseException):
+            raise result
+        if callable(result):
+            return result(timeout)
+        return result
+
+    coord._fetch = fetch
+    return coord, responses
+
+
+def _peer_doc(worker_id, sick=0):
+    return build_snapshot(
+        worker_id,
+        f"w{worker_id}",
+        {
+            "google.com/tpu.chips.healthy": str(4 - sick),
+            "google.com/tpu.chips.sick": str(sick),
+        },
+        1,
+        "full",
+    )
+
+
+def test_all_reachable_lowest_id_leads_and_sums_sick_chips():
+    coord, _ = _coordinator(
+        0, 4, responses={i: _peer_doc(i, sick=i % 2) for i in (1, 2, 3)}
+    )
+    coord.publish_local(
+        {"google.com/tpu.chips.healthy": "3", "google.com/tpu.chips.sick": "1"},
+        "full",
+    )
+    labels = dict(coord.labels())
+    assert labels[SLICE_ROLE_LABEL] == "leader"
+    assert labels[SLICE_LEADER_LABEL] == "w0"
+    assert labels[SLICE_HEALTHY_HOSTS_LABEL] == "4"
+    assert labels[SLICE_TOTAL_HOSTS_LABEL] == "4"
+    assert labels[SLICE_DEGRADED_LABEL] == "false"
+    # own 1 + w1 1 + w2 0 + w3 1
+    assert labels[SLICE_SICK_CHIPS_LABEL] == "3"
+
+
+def test_higher_id_follows_and_sees_leader():
+    coord, _ = _coordinator(2, 3, responses={0: _peer_doc(0), 1: _peer_doc(1)})
+    labels = dict(coord.labels())
+    assert labels == {
+        SLICE_ROLE_LABEL: "follower",
+        SLICE_LEADER_SEEN_LABEL: "true",
+    }
+    assert coord.view().leader_hostname == "w0"
+
+
+def test_one_missed_poll_does_not_flap():
+    """CONFIRM_POLLS failed polls confirm; one miss keeps the last
+    verdict — the straggler detector's confirmation discipline."""
+    coord, responses = _coordinator(0, 2, responses={1: _peer_doc(1)})
+    coord.poll_once()
+    assert not coord.view().degraded
+    del responses[1]  # peer goes dark
+    coord.poll_once()  # miss 1 of 2: still reachable
+    view = coord.view()
+    assert view.healthy_hosts == 2 and not view.degraded
+    coord.poll_once()  # miss 2: confirmed
+    view = coord.view()
+    assert view.healthy_hosts == 1 and view.degraded
+    assert CONFIRM_POLLS == 2
+
+
+def test_one_success_recovers_immediately():
+    clock = _Clock()
+    coord, responses = _coordinator(0, 2, clock=clock)
+    for _ in range(CONFIRM_POLLS):
+        coord.poll_once()
+    assert coord.view().degraded
+    responses[1] = _peer_doc(1)
+    clock.now += 10.0  # open the backoff window so the peer is re-polled
+    coord.poll_once()
+    assert not coord.view().degraded
+
+
+def test_confirmed_dead_peer_polls_under_backoff_windows():
+    clock = _Clock()
+    coord, responses = _coordinator(
+        0, 2, clock=clock, responses={1: _peer_doc(1)}
+    )
+    polls = []
+    original = coord._fetch
+
+    def counting_fetch(peer, timeout):
+        polls.append(clock.now)
+        return original(peer, timeout)
+
+    coord._fetch = counting_fetch
+    coord.poll_once()  # establish the peer (trust is earned)
+    del responses[1]
+    for _ in range(CONFIRM_POLLS):
+        coord.poll_once()
+    assert len(polls) == 1 + CONFIRM_POLLS
+    # Confirmed down: a poll inside the (5s, jitterless) window is a
+    # no-op; only once the window opens does the peer pay a probe again.
+    coord.poll_once()
+    assert len(polls) == 1 + CONFIRM_POLLS
+    clock.now += 5.1
+    coord.poll_once()
+    assert len(polls) == 2 + CONFIRM_POLLS
+
+
+def test_never_reached_peer_counts_down_on_first_miss():
+    """The 2-poll confirmation grace is for ESTABLISHED peers only: a
+    fresh epoch (restart, SIGHUP reload) on a partitioned node must not
+    spend its first confirmation window advertising a fully-healthy
+    slice it has never seen."""
+    coord, responses = _coordinator(0, 3, responses={1: _peer_doc(1)})
+    coord.poll_once()  # w1 reached; w2 never reached, 1 miss
+    view = coord.view()
+    assert view.degraded and view.healthy_hosts == 2
+    # Once ESTABLISHED, the same peer gets the full 2-poll grace.
+    responses[2] = _peer_doc(2)
+    clock_state = coord._peer_state[2]
+    clock_state.next_attempt = 0.0  # open the backoff window
+    coord.poll_once()
+    assert not coord.view().degraded
+    del responses[2]
+    coord.poll_once()  # miss 1 of 2: established grace holds
+    assert not coord.view().degraded
+    coord.poll_once()  # miss 2: confirmed
+    assert coord.view().degraded
+
+
+def test_round_budget_skips_peers_without_touching_state():
+    """A poll round is bounded by round_budget wall-clock: peers the
+    budget cannot reach are skipped — no poll, no miss, reachability
+    verdict untouched — so slow-but-answering peers can never pin the
+    slice source past the engine deadline AND a skipped peer is never
+    mistaken for a dead one."""
+    obs_metrics.reset_for_tests()
+
+    def slow_ok(worker_id):
+        def fetch(timeout):
+            time.sleep(0.06)
+            return _peer_doc(worker_id)
+
+        return fetch
+
+    coord, _ = _coordinator(
+        0,
+        4,
+        responses={1: slow_ok(1), 2: slow_ok(2), 3: slow_ok(3)},
+        round_budget=0.1,
+    )
+    coord.poll_once()
+    exposition = obs_metrics.REGISTRY.render()
+    assert 'tfd_peer_polls_total{outcome="skipped"}' in exposition
+    skipped = [
+        i
+        for i in (1, 2, 3)
+        if coord._peer_state[i].last_snapshot is None
+    ]
+    assert skipped, "budget admitted every slow peer — bound not applied"
+    for i in skipped:
+        state = coord._peer_state[i]
+        assert state.consecutive_failures == 0
+        assert not state.confirmed_down
+
+
+def test_round_start_rotates_so_budget_skips_cannot_starve_the_tail():
+    """Fixed iteration order + the round budget would let a head-of-list
+    run of slow-but-answering peers (each under the per-peer timeout, so
+    never confirmed down) starve the tail FOREVER: a never-polled peer
+    has no failures, counts reachable, and a dead host behind the slow
+    run would stay invisible indefinitely. The start index rotates per
+    round, so every peer is polled within a bounded number of rounds."""
+    obs_metrics.reset_for_tests()
+
+    def slow_ok(worker_id):
+        def fetch(timeout):
+            time.sleep(0.06)
+            return _peer_doc(worker_id)
+
+        return fetch
+
+    coord, _ = _coordinator(
+        0,
+        4,
+        responses={1: slow_ok(1), 2: slow_ok(2), 3: slow_ok(3)},
+        round_budget=0.1,  # admits ~1 slow peer per round
+    )
+    for _ in range(4):
+        coord.poll_once()
+    for i in (1, 2, 3):
+        assert coord._peer_state[i].last_snapshot is not None, (
+            f"peer {i} was never polled across 4 rotated rounds"
+        )
+
+
+def test_close_zeroes_the_coordinators_gauges():
+    """Epoch end must unlatch tfd_peer_unreachable/tfd_slice_degraded:
+    a SIGHUP reload can change the hostname list, and a departed peer
+    must not stay reported unreachable forever."""
+    obs_metrics.reset_for_tests()
+    coord, _ = _coordinator(0, 2)
+    coord.poll_once()  # never-reached peer: confirmed on first miss
+    coord.view()
+    exposition = obs_metrics.REGISTRY.render()
+    assert 'tfd_peer_unreachable{peer="w1"} 1' in exposition
+    assert "tfd_slice_degraded 1" in exposition
+    coord.close()
+    exposition = obs_metrics.REGISTRY.render()
+    assert 'tfd_peer_unreachable{peer="w1"} 0' in exposition
+    assert "tfd_slice_degraded 0" in exposition
+
+
+def test_leader_failover_to_next_lowest_reachable():
+    """w1's aggregation: w0 confirmed dead -> w1 is the lowest REACHABLE
+    id and takes over publishing, counting the slice degraded."""
+    coord, responses = _coordinator(
+        1, 4, responses={0: _peer_doc(0), 2: _peer_doc(2), 3: _peer_doc(3)}
+    )
+    coord.poll_once()
+    assert dict(coord.labels())[SLICE_ROLE_LABEL] == "follower"
+    responses[0] = ConnectionRefusedError("w0 died")
+    labels = {}
+    for _ in range(CONFIRM_POLLS):
+        labels = dict(coord.labels())
+    assert labels[SLICE_ROLE_LABEL] == "leader"
+    assert labels[SLICE_LEADER_LABEL] == "w1"
+    assert labels[SLICE_HEALTHY_HOSTS_LABEL] == "3"
+    assert labels[SLICE_DEGRADED_LABEL] == "true"
+
+
+def test_fully_partitioned_node_never_claims_leadership():
+    """Worker 0 with EVERY peer confirmed dark must not publish itself
+    as the leader of a 1-host 'slice' — all-peers-down is a local
+    partition signature, visible via leader-seen=false."""
+    coord, _ = _coordinator(0, 4)
+    for _ in range(CONFIRM_POLLS):
+        coord.poll_once()
+    labels = dict(coord.labels())
+    assert labels == {
+        SLICE_ROLE_LABEL: "follower",
+        SLICE_LEADER_SEEN_LABEL: "false",
+    }
+    view = coord.view()
+    assert view.degraded and view.healthy_hosts == 1
+
+
+def test_established_leader_survives_one_missed_poll():
+    """leader-seen is a gating label (docs/labels.md tells slice-aware
+    schedulers to gate on it), so it gets the same 2-consecutive
+    confirmation as the rest of the slice verdict: one missed poll of an
+    ESTABLISHED leader keeps leader-seen=true — a dropped packet must
+    not churn the label file — and the second (confirming) miss drops
+    the leader from the reachable set, where leadership fails over."""
+    coord, responses = _coordinator(
+        1, 3, responses={0: _peer_doc(0), 2: _peer_doc(2)}
+    )
+    assert dict(coord.labels())[SLICE_LEADER_SEEN_LABEL] == "true"
+    responses[0] = TimeoutError("leader slow")
+    labels = dict(coord.labels())  # miss 1 of 2: no flap
+    assert labels[SLICE_LEADER_SEEN_LABEL] == "true"
+    assert not coord.view().degraded  # not yet confirmed
+    labels = dict(coord.labels())  # miss 2: confirmed; w1 takes over
+    assert labels[SLICE_ROLE_LABEL] == "leader"
+    assert coord.view().degraded
+
+
+def test_unpolled_leader_is_unseen():
+    """The single-miss grace is for ESTABLISHED leaders only: before the
+    first successful poll of the derived leader, leader-seen is false —
+    trust is earned by a poll, never presumed (the fresh-epoch twin of
+    test_never_reached_peer_counts_down_on_first_miss)."""
+    coord, _ = _coordinator(
+        1, 3, responses={0: _peer_doc(0), 2: _peer_doc(2)}
+    )
+    assert coord.view().leader_seen is False
+
+
+def test_wrong_worker_id_in_snapshot_is_a_miss():
+    """A peer answering as somebody else (stale DNS) must count as a
+    failed poll, not poison the aggregate with double-counted chips."""
+    coord, responses = _coordinator(0, 2, responses={1: _peer_doc(0)})
+    for _ in range(CONFIRM_POLLS):
+        coord.poll_once()
+    assert coord.view().degraded
+
+
+def test_worker_id_out_of_range_rejected():
+    with pytest.raises(ValueError, match="out of range"):
+        SliceCoordinator(2, ["w0", "w1"], default_port=1, peer_timeout=0.1)
+
+
+def test_hostname_entries_may_carry_explicit_ports():
+    coord = SliceCoordinator(
+        0,
+        ["127.0.0.1:9001", "127.0.0.1:9002", "bare-host"],
+        default_port=7007,
+        peer_timeout=0.1,
+    )
+    by_id = {p.worker_id: p for p in coord._peers}
+    assert by_id[1].url == "http://127.0.0.1:9002/peer/snapshot"
+    assert by_id[2].url == "http://bare-host:7007/peer/snapshot"
+    assert coord.hostname == "127.0.0.1"
+
+
+# ---------------------------------------------------------------------------
+# config gating (new_slice_coordinator)
+# ---------------------------------------------------------------------------
+
+def _cfg(tmp_path, **cli):
+    values = {
+        "oneshot": False,
+        "output-file": str(tmp_path / "tfd"),
+        "metrics-port": "7007",
+    }
+    values.update(cli)
+    return new_config(cli_values=values, environ={})
+
+
+class _Host:
+    def __init__(self, worker_id, hostnames):
+        self.worker_id = worker_id
+        self.worker_hostnames = hostnames
+
+
+def test_gating_off_mode_returns_none(tmp_path):
+    config = _cfg(tmp_path, **{"slice-coordination": "off"})
+    assert new_slice_coordinator(config, _Host(0, ["w0", "w1"])) is None
+
+
+def test_gating_auto_on_multiworker_slice(tmp_path):
+    coord = new_slice_coordinator(
+        _cfg(tmp_path), _Host(1, ["w0", "w1", "w2"])
+    )
+    assert coord is not None
+    assert coord.worker_id == 1
+    assert coord.total_hosts == 3
+    # Peers default to this daemon's own metrics port.
+    assert all(p.port == 7007 for p in coord._peers)
+
+
+def test_gating_auto_off_single_worker(tmp_path):
+    assert new_slice_coordinator(_cfg(tmp_path), _Host(0, ["w0"])) is None
+
+
+def test_gating_oneshot_never_coordinates(tmp_path):
+    config = _cfg(
+        tmp_path, oneshot=True, **{"slice-coordination": "on"}
+    )
+    assert new_slice_coordinator(config, _Host(0, ["w0", "w1"])) is None
+
+
+def test_gating_no_metrics_port_never_coordinates(tmp_path):
+    config = _cfg(
+        tmp_path, **{"metrics-port": "0", "slice-coordination": "on"}
+    )
+    assert new_slice_coordinator(config, _Host(0, ["w0", "w1"])) is None
+
+
+def test_gating_out_of_range_worker_id_disables(tmp_path, caplog):
+    import logging
+
+    with caplog.at_level(logging.WARNING, logger="tfd.peering"):
+        coord = new_slice_coordinator(_cfg(tmp_path), _Host(5, ["w0", "w1"]))
+    assert coord is None
+    assert any("out of range" in r.message or "does not index" in r.message
+               for r in caplog.records)
+
+
+def test_gating_peer_timeout_flag_reaches_coordinator(tmp_path):
+    config = _cfg(tmp_path, **{"peer-timeout": "0.25s"})
+    coord = new_slice_coordinator(config, _Host(0, ["w0", "w1"]))
+    assert coord.peer_timeout == pytest.approx(0.25)
+
+
+def test_gating_round_budget_rides_under_labeler_deadline(tmp_path):
+    """Production coordinators bound the poll round at 0.8x the engine's
+    per-labeler deadline: a slow slice must never mark the cycle stale
+    (stale suppresses the supervisor's state persistence — a peer
+    problem costing the NODE its machinery)."""
+    coord = new_slice_coordinator(
+        _cfg(tmp_path, **{"labeler-timeout": "5s"}),
+        _Host(0, ["w0", "w1"]),
+    )
+    assert coord.round_budget == pytest.approx(4.0)
+
+
+# ---------------------------------------------------------------------------
+# live HTTP: coordinator against a real obs server (+ fault sites)
+# ---------------------------------------------------------------------------
+
+@pytest.fixture
+def serving_peer():
+    """A real IntrospectionServer serving worker 1's snapshot, plus a
+    worker-0 coordinator whose only peer is that server."""
+    obs_metrics.reset_for_tests()
+    serving = SliceCoordinator(
+        1, ["w0", "w1"], default_port=1, peer_timeout=0.5
+    )
+    serving.publish_local(
+        {
+            "google.com/tpu.count": "4",
+            "google.com/tpu.chips.healthy": "4",
+            "google.com/tpu.chips.sick": "0",
+        },
+        "full",
+    )
+    state = IntrospectionState(60.0)
+    server = IntrospectionServer(
+        obs_metrics.REGISTRY,
+        state,
+        addr="127.0.0.1",
+        port=0,
+        peer_snapshot=serving.snapshot_payload,
+    )
+    server.start()
+    polling = SliceCoordinator(
+        0,
+        [f"127.0.0.1:{server.port + 1}", f"127.0.0.1:{server.port}"],
+        default_port=server.port,
+        peer_timeout=0.5,
+    )
+    try:
+        yield server, serving, polling
+    finally:
+        faults.reset()
+        server.close()
+
+
+def test_live_poll_aggregates_served_snapshot(serving_peer):
+    server, serving, polling = serving_peer
+    labels = dict(polling.labels())
+    assert labels[SLICE_ROLE_LABEL] == "leader"
+    assert labels[SLICE_HEALTHY_HOSTS_LABEL] == "2"
+    assert labels[SLICE_DEGRADED_LABEL] == "false"
+    assert labels[SLICE_SICK_CHIPS_LABEL] == "0"
+    exposition = obs_metrics.REGISTRY.render()
+    assert 'tfd_peer_polls_total{outcome="ok"} 1' in exposition
+
+
+def test_peer_unreachable_fault_degrades_after_confirmation(serving_peer):
+    """peer.unreachable armed in the SERVING handler: the poller pays
+    real RemoteDisconnected errors and confirms after 2 misses."""
+    server, serving, polling = serving_peer
+    polling.poll_once()  # establish the peer: the 2-miss grace is earned
+    faults.load_fault_spec("peer.unreachable:fail:2")
+    polling.poll_once()
+    assert not polling.view().degraded  # miss 1: not confirmed
+    polling.poll_once()
+    assert polling.view().degraded  # miss 2: confirmed
+    exposition = obs_metrics.REGISTRY.render()
+    assert 'tfd_peer_polls_total{outcome="error"} 2' in exposition
+    assert "tfd_slice_degraded 1" in exposition
+
+
+def test_peer_junk_fault_is_a_miss_not_a_crash(serving_peer):
+    server, serving, polling = serving_peer
+    polling.poll_once()  # establish the peer: the 2-miss grace is earned
+    faults.load_fault_spec("peer.junk:fail:2")
+    for _ in range(CONFIRM_POLLS):
+        polling.poll_once()
+    assert polling.view().degraded
+    # Fault budget drained: the next poll recovers immediately.
+    polling._peer_state[1].next_attempt = 0.0
+    polling.poll_once()
+    assert not polling.view().degraded
+
+
+def test_peer_slow_fault_times_out_the_poll(serving_peer):
+    server, serving, polling = serving_peer
+    faults.load_fault_spec("peer.slow:fail:1")
+    started = time.perf_counter()
+    polling.poll_once()
+    elapsed = time.perf_counter() - started
+    state = polling._peer_state[1]
+    assert state.consecutive_failures == 1
+    # The poll paid its timeout budget, not the handler's full stall.
+    assert 0.4 < elapsed < 4.0
+
+
+def test_peer_snapshot_404_without_coordinator():
+    obs_metrics.reset_for_tests()
+    state = IntrospectionState(60.0)
+    server = IntrospectionServer(
+        obs_metrics.REGISTRY, state, addr="127.0.0.1", port=0
+    )
+    server.start()
+    try:
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/peer/snapshot", timeout=5
+            )
+        assert e.value.code == 404
+    finally:
+        server.close()
+
+
+def test_peer_snapshot_served_independently_of_debug_gate(serving_peer):
+    """--debug-endpoints=false must not take the peer wire surface down
+    with it: peers depend on /peer/snapshot for correctness."""
+    server, serving, polling = serving_peer
+    obs_metrics.reset_for_tests()
+    state = IntrospectionState(60.0)
+    gated = IntrospectionServer(
+        obs_metrics.REGISTRY,
+        state,
+        addr="127.0.0.1",
+        port=0,
+        debug_endpoints=False,
+        peer_snapshot=serving.snapshot_payload,
+    )
+    gated.start()
+    try:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{gated.port}/peer/snapshot", timeout=5
+        ) as resp:
+            doc = json.loads(resp.read().decode())
+        assert doc["worker_id"] == 1
+        assert parse_snapshot(json.dumps(doc).encode())["hostname"] == "w1"
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{gated.port}/debug/labels", timeout=5
+            )
+        assert e.value.code == 404
+    finally:
+        gated.close()

@@ -1,0 +1,257 @@
+"""Slice coordination acceptance tests (ISSUE 7) on the hermetic
+N-daemon harness (tests/slice_fixture.SliceHarness): N REAL supervised
+daemon loops in this process, each serving /peer/snapshot on 127.0.0.1
+and polling the others over real HTTP.
+
+The acceptance scenarios:
+
+- 4-worker slice, leader killed: worker 1 (the next-lowest reachable id)
+  promotes itself and publishes fresh slice.* labels; its node-local
+  labels never move.
+- 4-worker slice, follower killed: the leader flips slice.degraded=true
+  / slice.healthy-hosts=3 after the 2-poll confirmation while every
+  surviving node's own label file stays byte-unchanged (followers:
+  the whole file; the leader: everything but the coordination family).
+- --slice-coordination=off reproduces the strictly node-local output —
+  the pinned two-worker golden, with zero coordination labels, and
+  byte-identical (modulo the per-epoch timestamp) to a coordination-free
+  control run.
+"""
+
+import queue
+from pathlib import Path
+
+from golden_utils import check_labels, load_golden_regexs
+from slice_fixture import (
+    SLICE_HOSTENV,
+    SliceHarness,
+    non_coord_lines,
+    parse_hostenv,
+)
+
+from gpu_feature_discovery_tpu.lm.slice_labeler import (
+    SLICE_COORD_LABELS,
+    SLICE_DEGRADED_LABEL,
+    SLICE_HEALTHY_HOSTS_LABEL,
+    SLICE_LEADER_LABEL,
+    SLICE_LEADER_SEEN_LABEL,
+    SLICE_ROLE_LABEL,
+    SLICE_SICK_CHIPS_LABEL,
+    SLICE_TOTAL_HOSTS_LABEL,
+)
+
+HERE = Path(__file__).parent
+TWO_WORKER_GOLDEN = HERE / "expected-output-v5p-64-two-worker.txt"
+
+
+def _converged(n):
+    """Predicate: worker 0 leads a fully-healthy n-worker slice and every
+    follower sees it."""
+
+    def check(snapshot):
+        leader = snapshot.get(0, {})
+        if leader.get(SLICE_ROLE_LABEL) != "leader":
+            return False
+        if leader.get(SLICE_HEALTHY_HOSTS_LABEL) != str(n):
+            return False
+        if leader.get(SLICE_DEGRADED_LABEL) != "false":
+            return False
+        return all(
+            snapshot.get(i, {}).get(SLICE_ROLE_LABEL) == "follower"
+            and snapshot.get(i, {}).get(SLICE_LEADER_SEEN_LABEL) == "true"
+            for i in range(1, n)
+        )
+
+    return check
+
+
+def test_two_worker_slice_golden_with_coordination_labels(tmp_path):
+    """The two-worker kind scenario's expected outputs hold on the
+    harness with coordination ON: node-local lines match the in-tree
+    golden exactly, and the coordination family rides on top (worker 0
+    leads, worker 1 follows)."""
+    with SliceHarness(tmp_path, workers=2) as harness:
+        snapshot = harness.wait_for(
+            _converged(2), what="2-worker slice convergence"
+        )
+        golden = load_golden_regexs(TWO_WORKER_GOLDEN)
+        for worker in harness.workers:
+            lines = non_coord_lines(worker.raw_output())
+            assert check_labels(golden, lines), (
+                f"worker {worker.worker_id} node-local labels drifted "
+                f"from the two-worker golden"
+            )
+        leader, follower = snapshot[0], snapshot[1]
+        assert leader[SLICE_LEADER_LABEL] == "127.0.0.1"
+        assert leader[SLICE_TOTAL_HOSTS_LABEL] == "2"
+        assert leader[SLICE_SICK_CHIPS_LABEL] == "0"
+        assert SLICE_HEALTHY_HOSTS_LABEL not in follower  # leader-only
+        # Both publish distinct worker ids (the kind scenario's own
+        # consistency check), now from ONE process.
+        assert {
+            snapshot[i]["google.com/tpu.multihost.worker-id"] for i in (0, 1)
+        } == {"0", "1"}
+
+
+def test_leader_kill_promotes_next_lowest_worker(tmp_path):
+    """Acceptance: killing the leader daemon promotes worker 1, which
+    publishes fresh slice.* labels counting the dead leader out; its
+    own node-local labels never move."""
+    with SliceHarness(tmp_path, workers=4) as harness:
+        harness.wait_for(_converged(4), what="4-worker slice convergence")
+        w1_local_before = non_coord_lines(harness.workers[1].raw_output())
+        harness.stop_worker(0)
+
+        def promoted(snapshot):
+            w1 = snapshot.get(1, {})
+            return (
+                w1.get(SLICE_ROLE_LABEL) == "leader"
+                and w1.get(SLICE_HEALTHY_HOSTS_LABEL) == "3"
+                and w1.get(SLICE_DEGRADED_LABEL) == "true"
+            )
+
+        snapshot = harness.wait_for(promoted, what="worker 1 promotion")
+        assert snapshot[1][SLICE_LEADER_LABEL] == "127.0.0.1"
+        assert snapshot[1][SLICE_TOTAL_HOSTS_LABEL] == "4"
+        # The surviving followers re-anchor on the NEW leader.
+        for i in (2, 3):
+            assert snapshot[i][SLICE_ROLE_LABEL] == "follower"
+        harness.wait_for(
+            lambda s: all(
+                s[i].get(SLICE_LEADER_SEEN_LABEL) == "true" for i in (2, 3)
+            ),
+            what="followers seeing the new leader",
+        )
+        # Promotion moved ONLY the coordination family on worker 1.
+        assert (
+            non_coord_lines(harness.workers[1].raw_output())
+            == w1_local_before
+        )
+
+
+def test_follower_kill_degrades_slice_labels_only(tmp_path):
+    """Acceptance: killing one follower flips slice.degraded=true /
+    slice.healthy-hosts=3 on the leader after the 2-poll confirmation,
+    while every surviving node's own label file stays byte-unchanged
+    (the leader's, modulo the coordination family it republishes)."""
+    with SliceHarness(tmp_path, workers=4) as harness:
+        harness.wait_for(_converged(4), what="4-worker slice convergence")
+        follower_files_before = {
+            i: harness.workers[i].raw_output() for i in (1, 2)
+        }
+        leader_local_before = non_coord_lines(
+            harness.workers[0].raw_output()
+        )
+        harness.stop_worker(3)
+
+        def degraded(snapshot):
+            leader = snapshot.get(0, {})
+            return (
+                leader.get(SLICE_DEGRADED_LABEL) == "true"
+                and leader.get(SLICE_HEALTHY_HOSTS_LABEL) == "3"
+            )
+
+        snapshot = harness.wait_for(degraded, what="slice degradation")
+        assert snapshot[0][SLICE_ROLE_LABEL] == "leader"
+        # Surviving followers' files: BYTE-unchanged — their role and
+        # leader visibility did not move, and a peer dying must never
+        # touch another node's own labels.
+        for i, before in follower_files_before.items():
+            assert harness.workers[i].raw_output() == before, (
+                f"follower {i}'s label file moved on a peer death"
+            )
+        assert (
+            non_coord_lines(harness.workers[0].raw_output())
+            == leader_local_before
+        ), "leader's node-local labels moved on a peer death"
+
+
+def test_surviving_worker_fully_partitioned_never_leads(tmp_path):
+    """2-worker slice, leader killed: the survivor can reach NO peer, so
+    it must NOT crown itself leader of a 1-host 'slice' — it reports
+    follower + leader-seen=false (the partition signature)."""
+    with SliceHarness(tmp_path, workers=2) as harness:
+        harness.wait_for(_converged(2), what="2-worker slice convergence")
+        harness.stop_worker(0)
+
+        def partitioned(snapshot):
+            w1 = snapshot.get(1, {})
+            return (
+                w1.get(SLICE_ROLE_LABEL) == "follower"
+                and w1.get(SLICE_LEADER_SEEN_LABEL) == "false"
+            )
+
+        snapshot = harness.wait_for(partitioned, what="partition visibility")
+        assert SLICE_HEALTHY_HOSTS_LABEL not in snapshot[1]
+
+
+def test_coordination_off_reproduces_node_local_output(tmp_path):
+    """Acceptance: --slice-coordination=off reproduces today's strictly
+    node-local label output — the pinned two-worker golden with zero
+    coordination labels, byte-identical (modulo the per-epoch timestamp
+    value) to a coordination-free oneshot control over the same
+    fixtures."""
+    from gpu_feature_discovery_tpu.cmd.main import run
+    from gpu_feature_discovery_tpu.config import new_config
+    from gpu_feature_discovery_tpu.resource.testing import (
+        new_multihost_worker_manager,
+    )
+
+    golden = load_golden_regexs(TWO_WORKER_GOLDEN)
+    with SliceHarness(tmp_path, workers=2, coordination="off") as harness:
+        harness.wait_for(
+            lambda s: all("google.com/tpu.count" in s.get(i, {}) for i in (0, 1)),
+            what="node-local labels",
+        )
+        outputs = {w.worker_id: w.raw_output() for w in harness.workers}
+        interconnect0 = harness.workers[0].interconnect
+    for worker_id, raw in outputs.items():
+        lines = [l for l in raw.splitlines() if l]
+        assert check_labels(golden, lines), (
+            f"worker {worker_id} off-mode output drifted from the golden"
+        )
+        assert not any(l.startswith(SLICE_COORD_LABELS) for l in lines)
+
+    # Control: the pre-peering path — a oneshot run over the SAME
+    # fixtures with no coordinator anywhere near it.
+    control_out = tmp_path / "control-tfd"
+    machine = tmp_path / "machine-type"
+    machine.write_text("Google Compute Engine\n")
+    control_config = new_config(
+        cli_values={
+            "oneshot": True,
+            "output-file": str(control_out),
+            "machine-type-file": str(machine),
+            "tpu-topology-strategy": "single",
+        },
+        environ={},
+    )
+    assert (
+        run(
+            new_multihost_worker_manager("v5p-64"),
+            interconnect0,
+            control_config,
+            queue.Queue(),
+        )
+        is False
+    )
+
+    def _no_timestamp(raw):
+        return [
+            l
+            for l in raw.splitlines()
+            if l and not l.startswith("google.com/tfd.timestamp=")
+        ]
+
+    assert _no_timestamp(outputs[0]) == _no_timestamp(
+        control_out.read_text()
+    ), "off-mode daemon output is not byte-identical to the control"
+
+
+def test_harness_hostenv_matches_kind_scenario():
+    """The harness derives its per-worker host facts from the SAME
+    SLICE_HOSTENV constant the kind CI step deploys — drift between the
+    in-process slice and the cluster scenario fails here."""
+    env = dict(parse_hostenv(SLICE_HOSTENV))
+    assert env["TPU_ACCELERATOR_TYPE"] == "v5p-64"
+    assert len(env["TPU_WORKER_HOSTNAMES"].split(",")) == 8
