@@ -193,11 +193,22 @@ def start(argv: Optional[list] = None) -> int:
 
             log.info("Start running")
             if config.flags.tfd.oneshot:
-                # Oneshot keeps the reference's eager factory + strict
-                # error-to-exit parity: a one-off labeling Job should
-                # fail loudly, not linger degraded.
-                manager = factory.new_manager(config)
-                restart = run(manager, interconnect, config, sigs)
+                from gpu_feature_discovery_tpu.resource import (
+                    registry as backend_registry,
+                )
+
+                if backend_registry.multi_backend_tokens(config):
+                    # Multi-backend oneshot: acquisition happens inside
+                    # run()'s registry branch, strict (any backend's
+                    # init error fails the Job loudly — no per-family
+                    # degradation for a one-off labeling Job).
+                    restart = run(None, interconnect, config, sigs)
+                else:
+                    # Oneshot keeps the reference's eager factory +
+                    # strict error-to-exit parity: a one-off labeling
+                    # Job should fail loudly, not linger degraded.
+                    manager = factory.new_manager(config)
+                    restart = run(manager, interconnect, config, sigs)
             else:
                 # Daemon mode is supervised: the manager is built (and
                 # rebuilt after faults) INSIDE the cycle loop, so init
@@ -398,7 +409,7 @@ def _wait_for_signal(
 
 
 def run(
-    manager: Union[Manager, Callable[[], Manager]],
+    manager: Optional[Union[Manager, Callable[[], Manager]]],
     interconnect: Labeler,
     config: Config,
     sigs: "queue.SimpleQueue[int]",
@@ -413,6 +424,9 @@ def run(
     embedders, the oneshot path) or a zero-arg factory callable — the
     supervised daemon path, where the backend is (re)built inside the
     cycle loop so init failures turn into degraded cycles, not exits.
+    With a non-auto ``--backends`` list (the multi-backend registry
+    cycle) it is ignored entirely — acquisition is per backend, inside
+    the cycle — and oneshot callers may pass None.
 
     Daemon mode (non-oneshot) runs SUPERVISED (cmd/supervisor.py): a
     failing cycle re-serves last-good labels with the unhealthy-cycles
@@ -443,6 +457,19 @@ def run(
     supervised = not oneshot
     if supervised and supervisor is None:
         supervisor = Supervisor(config)
+    # Multi-backend registry cycle (resource/registry.py): an explicit
+    # non-auto --backends list runs EVERY named backend through the same
+    # engine pipeline with per-backend init supervision; the classic
+    # single-manager path (TFD_BACKEND forced, or --backends=auto) keeps
+    # ``manager``/``make_manager`` and stays byte-identical.
+    from gpu_feature_discovery_tpu.resource import registry as backend_registry
+
+    backend_tokens = backend_registry.multi_backend_tokens(config)
+    backend_set = (
+        backend_registry.BackendSet(backend_tokens, config)
+        if backend_tokens
+        else None
+    )
     # One engine per config epoch: its last-good cache and straggler
     # futures must not survive a SIGHUP reload (same staleness contract as
     # reset_burnin_schedule), and the reload rebuilds run() anyway.
@@ -451,7 +478,12 @@ def run(
     # coordinator per epoch (its peer reachability state must not
     # survive a SIGHUP reload's hostname-list change). Off / oneshot /
     # single-worker resolve to None and the strictly node-local cycle.
-    if coordinator is None and supervised:
+    if coordinator is None and supervised and (
+        backend_set is None or backend_set.has_family("tpu")
+    ):
+        # Slice coordination publishes google.com/tpu.slice.* — a
+        # tpu-family fact; a daemon labeling only gpu/cpu families must
+        # not claim slice membership.
         from gpu_feature_discovery_tpu.peering import new_slice_coordinator
 
         coordinator = new_slice_coordinator(config)
@@ -537,46 +569,97 @@ def run(
             cycle_mode = "full"
             try:
                 with timed("labelgen.total"):
-                    if current is None and make_manager is not None:
+                    if backend_set is not None:
+                        # Registry cycle: per-backend acquisition with
+                        # per-family degradation. One sick backend
+                        # contributes no sources and gets ONLY its own
+                        # family's degraded marker; the others publish
+                        # fresh through the same engine pass.
+                        from gpu_feature_discovery_tpu.lm.labelers import (
+                            multi_backend_label_sources,
+                        )
+                        from gpu_feature_discovery_tpu.lm.pjrt_family import (
+                            FAMILY_DEGRADED_LABELS,
+                        )
+
+                        sources, down_families = multi_backend_label_sources(
+                            backend_set,
+                            interconnect,
+                            config,
+                            timestamp=timestamp_labeler,
+                            strict=not supervised,
+                        )
                         if supervised:
-                            current = supervisor.acquire_manager(make_manager)
-                        else:
-                            current = make_manager()
-                    if current is None and make_manager is not None:
-                        cycle_mode = "degraded"
-                        # Backend down: publish the non-device facts plus
-                        # the degraded marker instead of publishing
-                        # nothing (a label-less TPU node is
-                        # indistinguishable from a non-TPU node).
-                        sources = degraded_label_sources(
-                            interconnect, config, timestamp=timestamp_labeler
-                        )
+                            # Fail-fast only with NOTHING left to
+                            # publish: every backend down past its
+                            # retry budget under --fail-on-init-error.
+                            backend_set.check_escalation()
                         if coordinator is not None:
-                            # The slice view is about HOST reachability,
-                            # not chip health: a daemon whose backend is
-                            # down keeps polling peers and keeps serving
-                            # its snapshot (mode says how stale it is).
-                            sources.append(new_slice_label_source(coordinator))
-                        labels = engine.generate(sources)
-                        labels[DEGRADED_LABEL] = "true"
-                    else:
-                        # init() happens inside new_label_sources; its
-                        # errors propagate before shutdown is owed
-                        # (eager-path parity).
-                        sources = new_label_sources(
-                            current, interconnect, config, timestamp=timestamp_labeler
-                        )
-                        if coordinator is not None:
-                            # Merged LAST: the slice family is derived
-                            # from peers and must never override a
-                            # node-local fact (names are disjoint today;
-                            # order makes that a guarantee, not a habit).
                             sources.append(new_slice_label_source(coordinator))
                         try:
                             labels = engine.generate(sources)
                         finally:
-                            with timed("tpu.shutdown"):
-                                current.shutdown()
+                            for rt in backend_set.runtimes:
+                                if rt.manager is not None:
+                                    with timed(f"{rt.family}.shutdown"):
+                                        rt.manager.shutdown()
+                        for family in down_families:
+                            labels[FAMILY_DEGRADED_LABELS[family]] = "true"
+                        obs_metrics.DEGRADED.set(1 if down_families else 0)
+                        if down_families:
+                            cycle_mode = "degraded"
+                    else:
+                        if current is None and make_manager is not None:
+                            if supervised:
+                                current = supervisor.acquire_manager(
+                                    make_manager
+                                )
+                            else:
+                                current = make_manager()
+                        if current is None and make_manager is not None:
+                            cycle_mode = "degraded"
+                            # Backend down: publish the non-device facts
+                            # plus the degraded marker instead of
+                            # publishing nothing (a label-less TPU node
+                            # is indistinguishable from a non-TPU node).
+                            sources = degraded_label_sources(
+                                interconnect, config, timestamp=timestamp_labeler
+                            )
+                            if coordinator is not None:
+                                # The slice view is about HOST
+                                # reachability, not chip health: a daemon
+                                # whose backend is down keeps polling
+                                # peers and keeps serving its snapshot
+                                # (mode says how stale it is).
+                                sources.append(
+                                    new_slice_label_source(coordinator)
+                                )
+                            labels = engine.generate(sources)
+                            labels[DEGRADED_LABEL] = "true"
+                        else:
+                            # init() happens inside new_label_sources;
+                            # its errors propagate before shutdown is
+                            # owed (eager-path parity).
+                            sources = new_label_sources(
+                                current,
+                                interconnect,
+                                config,
+                                timestamp=timestamp_labeler,
+                            )
+                            if coordinator is not None:
+                                # Merged LAST: the slice family is
+                                # derived from peers and must never
+                                # override a node-local fact (names are
+                                # disjoint today; order makes that a
+                                # guarantee, not a habit).
+                                sources.append(
+                                    new_slice_label_source(coordinator)
+                                )
+                            try:
+                                labels = engine.generate(sources)
+                            finally:
+                                with timed("tpu.shutdown"):
+                                    current.shutdown()
 
                 if len(labels) <= 1:
                     log.warning("no labels generated from any source")
@@ -622,6 +705,12 @@ def run(
                 if not supervised:
                     raise
                 delay = supervisor.cycle_failed(e)  # raises at the bound
+                if backend_set is not None:
+                    # Any enabled backend may be the broken part: release
+                    # them all so the next cycle re-acquires (the
+                    # same one-bad-cycle-must-not-hold-the-chip rationale
+                    # as the classic branch below).
+                    backend_set.release_all()
                 if make_manager is not None:
                     # The backend may be the broken part; next cycle goes
                     # back through acquisition (and degraded mode). Release

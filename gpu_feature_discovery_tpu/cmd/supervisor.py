@@ -266,6 +266,9 @@ class Supervisor:
         what is true at re-serve time — a tfd.degraded captured while the
         backend was down must not resurface after it recovered."""
         from gpu_feature_discovery_tpu.lm.engine import STALE_SOURCES_LABEL
+        from gpu_feature_discovery_tpu.lm.pjrt_family import (
+            FAMILY_DEGRADED_LABELS,
+        )
         from gpu_feature_discovery_tpu.sandbox.flap import FLAPPING_LABEL
 
         cleaned = Labels(labels)
@@ -275,6 +278,9 @@ class Supervisor:
             RESTORED_LABEL,
             STALE_SOURCES_LABEL,
             FLAPPING_LABEL,
+            # Per-family degraded markers (the multi-backend registry
+            # cycle): same one-cycle-truth contract as DEGRADED_LABEL.
+            *FAMILY_DEGRADED_LABELS.values(),
         ):
             cleaned.pop(marker, None)
         return cleaned
@@ -303,14 +309,19 @@ class Supervisor:
             self._restored = False
             obs_metrics.RESTORED.set(0)
             log.info("first live full cycle completed; %s cleared", RESTORED_LABEL)
-        if self._state_store is not None and "google.com/tpu.count" in remembered:
+        from gpu_feature_discovery_tpu.lm.pjrt_family import FAMILY_COUNT_KEYS
+
+        if self._state_store is not None and any(
+            key in remembered for key in FAMILY_COUNT_KEYS.values()
+        ):
             # Only device-carrying sets are worth restoring — and a
             # device-LESS "full" cycle (the factory's fallback-to-null
             # on a TPU node whose backends all failed enumerates zero
             # chips without erroring) must never clobber a previously
             # persisted inventory: restoring a stripped set after the
             # next restart is the exact failure the store exists to
-            # prevent.
+            # prevent. Any backend family's count key qualifies — a
+            # cpu-only registry daemon persists its inventory too.
             self._state_store.save(remembered)
 
     def cycle_failed(self, error: BaseException) -> float:

@@ -485,6 +485,22 @@ FLAG_DEFS: List[FlagDef] = [
         getter=lambda c: _f(c).tfd.peer_timeout,
     ),
     FlagDef(
+        name="backends",
+        env_vars=("TFD_BACKENDS",),
+        parse=str,
+        default="auto",
+        help="comma-separated backend registry tokens to run through the "
+        "labeler pipeline, one per label family (resource/registry.py): "
+        "'auto' (default) is the classic TPU-first autodetect, "
+        "byte-identical to the pre-registry daemon; e.g. 'tpu,gpu,cpu' "
+        "labels a heterogeneous node with google.com/tpu.*, "
+        "nvidia.com/gpu.* and node.features/cpu.* families from one "
+        "daemon. TFD_BACKEND (singular) still forces a single "
+        "tpu-family backend and overrides this entirely",
+        setter=lambda c, v: setattr(_f(c).tfd, "backends", v),
+        getter=lambda c: _f(c).tfd.backends,
+    ),
+    FlagDef(
         name="state-dir",
         env_vars=("TFD_STATE_DIR",),
         parse=str,
@@ -582,6 +598,14 @@ def new_config(
             f"invalid slice-coordination: {coordination!r} "
             f"(want one of {SLICE_COORDINATION_MODES})"
         )
+    # Deferred import: config is a leaf layer below resource; the
+    # registry import runs only at validation time, never at module
+    # import, so the layer map stays acyclic.
+    from gpu_feature_discovery_tpu.resource.registry import (
+        parse_backends_value,
+    )
+
+    parse_backends_value(config.flags.tfd.backends or "auto")
     return config
 
 

@@ -187,6 +187,10 @@ class TfdFlags:
     # reachable worker-id aggregates and publishes slice-scoped labels.
     slice_coordination: Optional[str] = None  # auto | on | off
     peer_timeout: Optional[float] = None  # seconds, per-peer connect/read
+    # Multi-backend registry (resource/registry.py): comma-separated
+    # backend tokens, one per label family ("auto" = the classic
+    # TPU-first autodetect, byte-identical to the pre-registry daemon).
+    backends: Optional[str] = None  # e.g. "tpu,gpu,cpu" | "auto"
 
 
 @dataclass
@@ -250,6 +254,7 @@ class Config:
                     "stragglerThreshold": self.flags.tfd.straggler_threshold,
                     "sliceCoordination": self.flags.tfd.slice_coordination,
                     "peerTimeout": self.flags.tfd.peer_timeout,
+                    "backends": self.flags.tfd.backends,
                 },
             },
             "sharing": {
@@ -395,6 +400,7 @@ def parse_config_file(path: str) -> Config:
     config.flags.tfd.slice_coordination = _opt_str(tfd.get("sliceCoordination"))
     if tfd.get("peerTimeout") is not None:
         config.flags.tfd.peer_timeout = parse_duration(tfd["peerTimeout"])
+    config.flags.tfd.backends = _opt_str(tfd.get("backends"))
 
     config.resources = raw.get("resources", {}) or {}
     config.sharing = Sharing.from_dict(raw.get("sharing", {}) or {})

@@ -101,12 +101,20 @@ class LabelSource:
     instead of abandoning a live worker thread — the leak the thread-only
     deadline could never fix, because a thread blocked inside native code
     cannot be interrupted from Python. Sources without it keep the
-    abandon-and-harvest behavior."""
+    abandon-and-harvest behavior.
+
+    ``group`` names the backend family a source belongs to in the
+    multi-backend registry cycle (resource/registry.py): "" (node-local
+    and classic single-backend sources) or a family name like "gpu".
+    The engine treats grouped sources exactly like ungrouped ones — the
+    group rides into ``last_provenance`` so /debug/labels can attribute
+    every source to its backend."""
 
     name: str
     produce: Callable[[], Labeler]
     offload: bool = True
     cancel: Optional[Callable[[], None]] = None
+    group: str = ""
 
     def run(self) -> Labels:
         from gpu_feature_discovery_tpu.utils.faults import maybe_inject
@@ -389,10 +397,13 @@ class LabelEngine:
         out: Dict[str, Dict[str, object]] = {}
         for src in sources:
             elapsed = stages.get(f"labeler.{src.name}")
-            out[src.name] = {
+            entry: Dict[str, object] = {
                 "status": "stale" if src.name in stale_set else "fresh",
                 "duration_ms": round(elapsed * 1e3, 3) if elapsed is not None else None,
             }
+            if src.group:
+                entry["backend"] = src.group
+            out[src.name] = entry
         return out
 
     def _run_source(self, src: LabelSource) -> Labels:

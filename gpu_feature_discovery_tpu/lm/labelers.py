@@ -66,6 +66,75 @@ def new_label_sources(
     return sources
 
 
+def multi_backend_label_sources(
+    backend_set,
+    interconnect: Optional[Labeler],
+    config: Config,
+    timestamp: Optional[Labeler] = None,
+    strict: bool = False,
+) -> tuple:
+    """The registry cycle's source list (resource/registry.py
+    BackendSet): every enabled backend's family group through the SAME
+    engine pipeline, in a fixed overall order —
+
+        timestamp, [per-backend groups in --backends order], interconnect
+
+    — where the tpu family group is EXACTLY the classic device-backed
+    list (lm/tpu.tpu_label_sources), so ``--backends=<one tpu token>``
+    reproduces the single-backend output byte for byte, and the gpu/cpu
+    groups are the collision-guarded family sources
+    (lm/pjrt_family.pjrt_family_sources). The timestamp and the
+    host-interconnect labeler are NODE-level TPU-namespace facts: the
+    interconnect (and the machine-type fallback while the tpu backend is
+    down) only publish when the tpu family is enabled — a
+    ``--backends=cpu`` node must carry zero ``google.com/tpu.*`` labels.
+
+    Returns ``(sources, down_families)``: a backend whose acquisition is
+    failing contributes NO device sources this cycle and its family name
+    lands in ``down_families`` — the caller publishes that family's
+    degraded marker (lm/pjrt_family.FAMILY_DEGRADED_LABELS) while every
+    other family keeps publishing fresh. ``strict`` (oneshot) propagates
+    acquisition errors instead (reference error-to-exit parity)."""
+    from gpu_feature_discovery_tpu.lm.machine_type import new_machine_type_labeler
+    from gpu_feature_discovery_tpu.lm.pjrt_family import pjrt_family_sources
+    from gpu_feature_discovery_tpu.utils.timing import timed
+
+    sources: List[LabelSource] = []
+    down: List[str] = []
+    if timestamp is not None:
+        ts = timestamp
+        sources.append(LabelSource("timestamp", lambda: ts, offload=False))
+    for rt in backend_set.runtimes:
+        manager = rt.acquire(strict=strict)
+        if rt.family == "tpu":
+            if manager is not None:
+                with timed("tpu.init"):
+                    manager.init()
+                sources.extend(tpu_label_sources(manager, config))
+            else:
+                down.append(rt.family)
+                # Degraded tpu family: the DMI machine type is liftable
+                # out of the chip gate (degraded_label_sources rationale)
+                # — a wedged PJRT says nothing about the DMI file.
+                machine_type_file = config.flags.tfd.machine_type_file
+                sources.append(
+                    LabelSource(
+                        "machine-type",
+                        lambda: new_machine_type_labeler(machine_type_file),
+                        offload=False,
+                    )
+                )
+        else:
+            if manager is not None:
+                sources.extend(pjrt_family_sources(manager, rt.family, config))
+            else:
+                down.append(rt.family)
+    if backend_set.has_family("tpu"):
+        ic = interconnect if interconnect is not None else Empty()
+        sources.append(LabelSource("interconnect", lambda: ic))
+    return sources, down
+
+
 def degraded_label_sources(
     interconnect: Optional[Labeler],
     config: Config,

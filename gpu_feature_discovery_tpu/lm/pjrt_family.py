@@ -1,0 +1,152 @@
+"""Per-family label pipelines for the non-TPU registry backends.
+
+The multi-backend registry (resource/registry.py) runs every enabled
+backend through the SAME labeler pipeline (lm/engine.py) and merges the
+results into one feature file. Each backend family owns a disjoint key
+namespace:
+
+    tpu   google.com/*            (the incumbent pipeline, unchanged)
+    gpu   nvidia.com/gpu.*        (the reference GFD's own family)
+    cpu   node.features/cpu.*
+
+This module defines the gpu/cpu family sources — product/count/replicas/
+memory straight off the Manager seam (the reference's
+``nvidia.com/gpu.count``/``gpu.product``/``gpu.memory`` shape) plus the
+driver/runtime version facts the generic PJRT manager reports — and the
+cross-family key-collision guard: every non-TPU family source is wrapped
+so it can only emit keys inside its own namespace. A rogue provider
+emitting e.g. ``google.com/tpu.count`` from the gpu family is dropped
+with a warning instead of silently overriding another family's fact.
+Combined with the resolver's one-token-per-family rule
+(registry.parse_backends_value) this makes cross-family collisions
+structurally impossible, not just unlikely.
+
+The per-family degraded markers mirror the supervisor's
+``google.com/tpu.tfd.degraded`` semantics: while a backend cannot init,
+ONLY its family carries the marker — the other families keep publishing
+fresh labels (the multi-backend acceptance contract).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Dict, List, Tuple
+
+from gpu_feature_discovery_tpu.config.spec import Config
+from gpu_feature_discovery_tpu.lm.engine import LabelSource
+from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.lm.resource_labeler import ResourceLabeler
+from gpu_feature_discovery_tpu.resource.types import Manager
+from gpu_feature_discovery_tpu.utils.logging import warn_once
+
+log = logging.getLogger("tfd.lm")
+
+# Extended-resource name each non-TPU family labels under (the
+# ResourceLabeler key factory turns these into <resource>.<suffix>).
+FAMILY_RESOURCES: Dict[str, str] = {
+    "gpu": "nvidia.com/gpu",
+    "cpu": "node.features/cpu",
+}
+
+# Key namespaces a family may emit into — the collision guard's
+# allowlist. The tpu entry covers google.com/tpu.*, google.com/tpu-<topo>
+# mixed-strategy resources, and the daemon-level google.com/tfd.* marks.
+FAMILY_NAMESPACES: Dict[str, Tuple[str, ...]] = {
+    "tpu": ("google.com/",),
+    "gpu": ("nvidia.com/gpu.",),
+    "cpu": ("node.features/cpu.",),
+}
+
+# Published while the named family's backend cannot init. The tpu entry
+# IS the supervisor's DEGRADED_LABEL (cmd/supervisor.py — pinned equal by
+# tests/test_registry.py so the two spellings cannot drift).
+FAMILY_DEGRADED_LABELS: Dict[str, str] = {
+    "tpu": "google.com/tpu.tfd.degraded",
+    "gpu": "nvidia.com/gpu.tfd.degraded",
+    "cpu": "node.features/cpu.tfd.degraded",
+}
+
+# The device-carrying key per family: the supervisor persists last-good
+# state only for sets that inventory at least one device family
+# (cmd/supervisor.py cycle_succeeded).
+FAMILY_COUNT_KEYS: Dict[str, str] = {
+    "tpu": "google.com/tpu.count",
+    "gpu": "nvidia.com/gpu.count",
+    "cpu": "node.features/cpu.count",
+}
+
+
+def family_guard(family: str, labels: Labels) -> Labels:
+    """Drop (with a once-per-key warning) every label outside the
+    family's own namespace — the cross-family key-collision guard."""
+    allowed = FAMILY_NAMESPACES.get(family)
+    if not allowed:
+        return labels
+    out = Labels()
+    for key, value in labels.items():
+        if key.startswith(allowed):
+            out[key] = value
+        else:
+            warn_once(
+                log,
+                f"family-collision:{family}:{key}",
+                "backend family %r emitted out-of-namespace label %r; "
+                "dropped (cross-family key-collision guard)",
+                family,
+                key,
+            )
+    return out
+
+
+def _family_device_labels(manager: Manager, family: str, config: Config) -> Labels:
+    """The family's device label set off the initialized Manager:
+    version facts, then product/count/replicas/memory — the generic-PJRT
+    analog of lm/tpu._device_labels, one source because it is all cheap
+    dict math against the held backend."""
+    from gpu_feature_discovery_tpu.lm.versions import version_labels_for
+
+    resource = FAMILY_RESOURCES[family]
+    chips = manager.get_chips()
+    if not chips:
+        return Labels()
+    labels = version_labels_for(manager, resource)
+    names = sorted({c.get_name() for c in chips})
+    if len(names) > 1:
+        log.warning(
+            "Multiple %s device models detected: %s", family, names
+        )
+    rl = ResourceLabeler(resource, config.sharing)
+    labels.update(rl.base_labels(len(chips), chips[0].get_name()))
+    memory_mb = chips[0].get_total_memory_mb()
+    if memory_mb:
+        labels.update(rl.single("memory", memory_mb))
+    return labels
+
+
+def pjrt_family_sources(
+    manager: Manager, family: str, config: Config
+) -> List[LabelSource]:
+    """The family's label sources in merge order, chip-gated like the
+    TPU sources (zero devices → nothing published). Calls
+    ``manager.init()`` (idempotent; the acquisition already ran it) so
+    the engine source group sees the same init-before-sources contract
+    as lm/labelers.new_label_sources. The re-check is deliberately not
+    a timed span: it is a held-client no-op on every cycle after the
+    first, and the registry's per-cycle overhead budget
+    (bench multi_backend_cycle_overhead_pct) counts every microsecond
+    a second family adds."""
+    manager.init()
+    if not manager.get_chips():
+        return []
+    return [
+        # In-memory math against the already-initialized backend, so
+        # inline like the tpu device source (engine offload rationale).
+        LabelSource(
+            f"device@{family}",
+            lambda: family_guard(
+                family, _family_device_labels(manager, family, config)
+            ),
+            offload=False,
+            group=family,
+        ),
+    ]

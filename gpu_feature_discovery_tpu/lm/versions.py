@@ -21,10 +21,13 @@ RUNTIME_MINOR = "google.com/tpu.runtime.minor"
 SLICE_CAPABLE = "google.com/tpu.slice.capable"
 
 
-def new_version_labeler(manager: Manager) -> Labels:
-    """libtpu "X.Y[.Z]" → driver.major/minor/rev; PJRT (major, minor) →
-    runtime.major/minor (nvml.go:75-106 semantics, including the 2-or-3
-    component version format check)."""
+def version_labels_for(manager: Manager, resource: str) -> Labels:
+    """driver "X.Y[.Z]" → <resource>.driver.major/minor/rev; runtime
+    (major, minor) → <resource>.runtime.major/minor (nvml.go:75-106
+    semantics, including the 2-or-3 component version format check).
+    ONE format policy for every backend family: the TPU labeler below
+    and the gpu/cpu registry families (lm/pjrt_family.py) are instances
+    of this function, so the accepted grammar cannot drift per family."""
     driver_version = manager.get_driver_version()
     parts = driver_version.split(".")
     if len(parts) < 2 or len(parts) > 3:
@@ -35,13 +38,19 @@ def new_version_labeler(manager: Manager) -> Labels:
     runtime_major, runtime_minor = manager.get_runtime_version()
     return Labels(
         {
-            DRIVER_MAJOR: parts[0],
-            DRIVER_MINOR: parts[1],
-            DRIVER_REV: parts[2] if len(parts) > 2 else "",
-            RUNTIME_MAJOR: str(runtime_major),
-            RUNTIME_MINOR: str(runtime_minor),
+            f"{resource}.driver.major": parts[0],
+            f"{resource}.driver.minor": parts[1],
+            f"{resource}.driver.rev": parts[2] if len(parts) > 2 else "",
+            f"{resource}.runtime.major": str(runtime_major),
+            f"{resource}.runtime.minor": str(runtime_minor),
         }
     )
+
+
+def new_version_labeler(manager: Manager) -> Labels:
+    """The google.com/tpu instance: libtpu version as the driver, PJRT
+    C API as the runtime."""
+    return version_labels_for(manager, "google.com/tpu")
 
 
 def new_slice_capability_labeler(manager: Manager) -> Labeler:

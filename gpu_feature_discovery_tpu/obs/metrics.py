@@ -75,7 +75,28 @@ BACKEND_INIT_BACKOFF = REGISTRY.gauge(
 DEGRADED = REGISTRY.gauge(
     "tfd_degraded",
     "1 while the device backend is failing init and degraded labels are "
-    "being published (the tfd.degraded marker), else 0.",
+    "being published (the tfd.degraded marker), else 0. In the "
+    "multi-backend registry cycle: 1 while ANY enabled backend family "
+    "is down (tfd_backend_up has the per-family detail).",
+)
+
+# -- multi-backend registry (resource/registry.py, --backends) ---------------
+
+BACKEND_UP = REGISTRY.gauge(
+    "tfd_backend_up",
+    "Per enabled backend family in the multi-backend registry cycle: 1 "
+    "while the family's backend is acquired and its labels publish "
+    "fresh, 0 while it is down (only that family's labels degrade). "
+    "Absent entirely on the classic single-backend path.",
+    labelnames=("backend",),
+)
+BACKEND_INITS = REGISTRY.counter(
+    "tfd_backend_inits_total",
+    "Per-backend init attempts in the multi-backend registry cycle, by "
+    "outcome (ok | error). The classic path's un-labeled "
+    "tfd_backend_init_attempts_total/failures_total keep counting in "
+    "both modes.",
+    labelnames=("backend", "outcome"),
 )
 
 # -- probe sandbox + restart/flap resilience (sandbox/) ---------------------

@@ -96,12 +96,29 @@ class _Metric:
         raise NotImplementedError
 
     def labels(self, **labelvalues: str):
-        if set(labelvalues) != set(self.labelnames):
+        try:
+            key = tuple(str(labelvalues[ln]) for ln in self.labelnames)
+        except KeyError:
+            raise ValueError(
+                f"{self.name}: got labels {sorted(labelvalues)}, "
+                f"want {sorted(self.labelnames)}"
+            ) from None
+        if len(labelvalues) != len(self.labelnames):
             raise ValueError(
                 f"{self.name}: got labels {sorted(labelvalues)}, "
                 f"want {sorted(self.labelnames)}"
             )
-        key = tuple(str(labelvalues[ln]) for ln in self.labelnames)
+        # Lock-free fast path for an existing child: children are only
+        # ever ADDED (always under the lock below; _reset is test-only
+        # between scrapes), and a GIL dict read is atomic, so the
+        # hot-path cost per labeled sample is one dict probe instead of
+        # a lock round-trip + two set allocations — this runs several
+        # times per labeling cycle (stage spans, labeler histograms,
+        # cycle counters) and the multi-backend registry multiplies the
+        # per-cycle call count by the enabled-backend count.
+        child = self._children.get(key)
+        if child is not None:
+            return child
         with self._lock:
             child = self._children.get(key)
             if child is None:

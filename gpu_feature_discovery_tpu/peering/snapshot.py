@@ -60,6 +60,9 @@ def strip_snapshot_labels(labels: Dict[str, str]) -> Dict[str, str]:
         UNHEALTHY_CYCLES_LABEL,
     )
     from gpu_feature_discovery_tpu.lm.engine import STALE_SOURCES_LABEL
+    from gpu_feature_discovery_tpu.lm.pjrt_family import (
+        FAMILY_DEGRADED_LABELS,
+    )
     from gpu_feature_discovery_tpu.lm.slice_labeler import SLICE_COORD_LABELS
     from gpu_feature_discovery_tpu.sandbox.flap import FLAPPING_LABEL
 
@@ -69,6 +72,9 @@ def strip_snapshot_labels(labels: Dict[str, str]) -> Dict[str, str]:
         UNHEALTHY_CYCLES_LABEL,
         STALE_SOURCES_LABEL,
         FLAPPING_LABEL,
+        # Per-family degraded markers (multi-backend registry): same
+        # cycle-description rationale as DEGRADED_LABEL.
+        *FAMILY_DEGRADED_LABELS.values(),
         *SLICE_COORD_LABELS,
     }
     return {k: str(v) for k, v in labels.items() if k not in dropped}

@@ -2,16 +2,20 @@
 
 Reference: internal/resource/factory.go:27-73 — probe the platform, pick
 the manager, and wrap it with the fallback decorator unless
---fail-on-init-error. The TPU probe chain (extended by the JAX/PJRT and
-native-shim backends) is:
+--fail-on-init-error. Selection dispatches through the backend REGISTRY
+(resource/registry.py, where every formerly-hardwired branch is a
+pluggable provider); the behavior is the pre-registry chain exactly:
 
-1. ``TFD_BACKEND`` env override — explicit backend selection; ``mock:<type>``
-   variants exist for integration tests on CPU-only machines (the reference
-   achieves the same with its mock-NVML container tests).
+1. ``TFD_BACKEND`` env override — explicit tpu-family backend selection;
+   ``mock:<type>`` variants exist for integration tests on CPU-only
+   machines (the reference achieves the same with its mock-NVML
+   container tests). gpu/cpu-family providers are NOT selectable here —
+   they label a different namespace and run through the registry cycle
+   (``--backends``, cmd/main.run).
 2. libtpu present (native shim dlopen probe, or TPU chips on the PCI bus,
    or a TPU VM metadata environment) → PJRT/JAX-backed manager, then the
    native C-API enumeration (opt-in via --native-enumeration), then the
-   metadata inventory.
+   metadata inventory (``autodetect_manager``).
 3. Otherwise → Null manager (non-TPU node: no labels).
 """
 
@@ -70,90 +74,47 @@ def with_config(manager: Manager, config: Config) -> Manager:
     return FallbackToNullOnInitError(manager)
 
 
-def _mock_backend(accel_type: str) -> Manager:
-    from gpu_feature_discovery_tpu.resource.testing import new_single_host_manager
-
-    return new_single_host_manager(accel_type)
-
-
-def _mock_slice_backend(accel_type: str) -> Manager:
-    from gpu_feature_discovery_tpu.resource.testing import new_uniform_slice_manager
-
-    return new_uniform_slice_manager(accel_type)
-
-
-def _mock_worker_backend(accel_type: str) -> Manager:
-    """``mock-worker:<accel_type>`` — one worker of a multi-host slice
-    (only this host's chips, bound to the full slice topology)."""
-    from gpu_feature_discovery_tpu.resource.testing import (
-        new_multihost_worker_manager,
-    )
-
-    return new_multihost_worker_manager(accel_type)
-
-
-def _mock_mixed_backend(spec: str) -> Manager:
-    """``mock-mixed:<family>[:<topo>,<topo>,...]`` — one chip per listed
-    slice topology (defaults to the builder's heterogeneous set)."""
-    from gpu_feature_discovery_tpu.resource.testing import new_mixed_slice_manager
-
-    family, _, topos = spec.partition(":")
-    if topos:
-        return new_mixed_slice_manager(
-            family, topologies=[[t] for t in topos.split(",") if t]
-        )
-    return new_mixed_slice_manager(family)
-
-
 def _get_manager(config: Config) -> Manager:
+    """TFD_BACKEND dispatch through the backend registry
+    (resource/registry.py): every branch of the old hardwired if/elif
+    chain is a registered provider now, so embedders can plug backends
+    in beneath this seam. Pre-registry behavior is preserved exactly:
+
+    - an unset/``auto`` value (and any unrecognized token) falls through
+      to the TPU autodetect chain;
+    - only tpu-family tokens are honored here — ``TFD_BACKEND`` is the
+      forced SINGLE-backend override and the classic path labels into
+      the TPU namespace, so a gpu/cpu family token would mislabel; those
+      families are selected via ``--backends``/``TFD_BACKENDS`` and run
+      through the registry cycle (cmd/main.run).
+    """
+    from gpu_feature_discovery_tpu.resource import registry
+
     backend = os.environ.get(BACKEND_ENV, "auto").strip().lower()
-
-    if backend.startswith("mock:"):
-        accel = backend.split(":", 1)[1]
-        log.info("Using mock manager (%s)", accel)
-        return _mock_backend(accel)
-    if backend.startswith("mock-slice:"):
-        accel = backend.split(":", 1)[1]
-        log.info("Using mock uniform-slice manager (%s)", accel)
-        return _mock_slice_backend(accel)
-    if backend.startswith("mock-worker:"):
-        accel = backend.split(":", 1)[1]
-        log.info("Using mock multi-host worker manager (%s)", accel)
-        return _mock_worker_backend(accel)
-    if backend.startswith("mock-mixed:"):
-        family = backend.split(":", 1)[1]
-        log.info("Using mock mixed-slice manager (%s)", family)
-        return _mock_mixed_backend(family)
-    if backend == "null":
-        log.info("Using null manager (forced)")
-        return NullManager()
-    if backend in ("jax", "pjrt"):
-        manager = _try_jax_manager(config)
-        if manager is None:
-            raise RuntimeError("TFD_BACKEND=jax requested but jax backend unavailable")
-        return manager
-    if backend == "native":
-        # Forced selection bypasses the opt-in flag: naming the backend IS
-        # the opt-in (the operator typed it knowing it seizes the chip).
-        manager = _try_native_manager(config, forced=True)
-        if manager is None:
-            raise RuntimeError(
-                "TFD_BACKEND=native requested but native enumeration unavailable"
+    provider = registry.provider_for(backend)
+    if provider is None:
+        if backend != "auto":
+            log.warning(
+                "unrecognized %s=%r; falling through to autodetect",
+                BACKEND_ENV,
+                backend,
             )
-        log.info("Using native (PJRT C API) manager (forced)")
-        return manager
-    if backend in ("hostinfo", "metadata"):
-        # Eager availability check: a forced backend must fail loudly at
-        # factory time (matching TFD_BACKEND=jax), not be silently swapped
-        # for null by the fallback wrapper.
-        manager = _try_hostinfo_manager(config)
-        if manager is None:
-            raise RuntimeError(
-                "TFD_BACKEND=hostinfo requested but no TPU VM metadata available"
-            )
-        log.info("Using hostinfo (metadata) manager (forced)")
-        return manager
+        return autodetect_manager(config)
+    if provider.family != registry.FAMILY_TPU:
+        log.warning(
+            "%s=%r names a %s-family backend; %s forces a single TPU-"
+            "namespace backend — use TFD_BACKENDS/--backends for gpu/cpu "
+            "families. Falling through to autodetect.",
+            BACKEND_ENV,
+            backend,
+            provider.family,
+            BACKEND_ENV,
+        )
+        return autodetect_manager(config)
+    return provider.build(config, backend)
 
+
+def autodetect_manager(config: Config) -> Manager:
     # Auto detection: PJRT first, metadata-derived inventory second, null
     # last — the hasNVML -> isTegra -> null chain (factory.go:54-73) with
     # TPU probes.
@@ -199,8 +160,11 @@ def _detect_tpu_platform(config: Config) -> tuple:
 
         if SysfsGooglePCI().devices():
             return True, "Google PCI functions present on /sys/bus/pci"
-    except Exception:  # noqa: BLE001 - absence of sysfs is a non-TPU signal
-        pass
+    except Exception as e:  # noqa: BLE001 - absence of sysfs is a non-TPU signal
+        # Still log it: "no sysfs" and "broken sysfs" (permissions, a
+        # malformed vendor file) are different diagnoses, and a silently
+        # swallowed scan error makes a mislabeled node undebuggable.
+        log.debug("TPU PCI platform probe unavailable: %s", e)
 
     env = os.environ
     if env.get("TPU_ACCELERATOR_TYPE") or env.get("TPU_WORKER_ID"):

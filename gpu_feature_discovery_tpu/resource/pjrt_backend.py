@@ -1,0 +1,235 @@
+"""Generic PJRT device manager, parameterized by platform.
+
+The JaxManager (resource/jax_backend.py) is TPU-shaped: slice binding,
+ChipSpec back-fill, libtpu version facts. But the enumeration it is built
+on — ``jax.local_devices(backend=<platform>)`` over the in-process PJRT
+client — works for ANY platform the installed PJRT plugins expose. This
+manager reuses exactly that enumeration shape for the ``gpu`` and ``cpu``
+registry backends (resource/registry.py): devices become plain
+slice-less :class:`PjrtChip` entries, the driver version is the jaxlib
+(XLA runtime) distribution version, and the runtime version is parsed
+from the backend's ``platform_version`` the same way JaxManager does.
+
+Like JaxManager, the PJRT client is created once on first ``init()`` and
+held; ``shutdown()`` is a no-op (per-cycle labeling stays O(label math)).
+Unlike the TPU path there is no slice topology to resolve and no spec
+table to back-fill: attributes PJRT does not expose are simply absent
+from the label family (lm/pjrt_family.py publishes only what the
+platform reports).
+
+``StaticPjrtManager`` is the hardware-free fixture the ``mock-gpu:<n>`` /
+``mock-cpu:<n>`` registry tokens build — deterministic device facts for
+the per-backend golden suite, mirroring resource/testing.py's mock
+driver/runtime constants so mixed tpu+gpu mock runs share one version
+vocabulary.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from typing import List, Optional, Tuple
+
+from gpu_feature_discovery_tpu.config.spec import Config
+from gpu_feature_discovery_tpu.lm.labels import label_safe_value
+from gpu_feature_discovery_tpu.resource.types import Chip, Manager, ResourceError
+
+log = logging.getLogger("tfd.resource")
+
+
+class PjrtChip(Chip):
+    """One enumerated PJRT device of a non-TPU platform: no slice
+    machinery (is_slice_* answer False/empty the way a non-MIG GPU does
+    in the reference), name from the device kind, memory from the
+    runtime when it reports one."""
+
+    def __init__(self, name: str, memory_mb: int):
+        self._name = name
+        self._memory_mb = memory_mb
+
+    def is_slice_enabled(self) -> bool:
+        return False
+
+    def is_slice_capable(self) -> bool:
+        return False
+
+    def get_slices(self) -> List[Chip]:
+        return []
+
+    def get_attributes(self):
+        raise ResourceError("get_attributes only supported for slice partitions")
+
+    def get_name(self) -> str:
+        return self._name
+
+    def get_total_memory_mb(self) -> int:
+        return self._memory_mb
+
+    def get_parent_chip(self) -> Chip:
+        raise ResourceError("get_parent_chip only supported for slice partitions")
+
+    def get_generation(self) -> Tuple[int, int]:
+        return (0, 0)
+
+
+class PjrtManager(Manager):
+    """Platform-parameterized PJRT enumeration (``gpu``/``cpu`` registry
+    backends). The label family it feeds is chosen by the registry
+    provider's family, not by this class — the same Manager seam the TPU
+    backends plug into (resource/types.py)."""
+
+    def __init__(self, config: Config, platform: str):
+        self._config = config
+        self.platform = platform
+        self._devices: Optional[list] = None
+        self._chips: List[Chip] = []
+
+    def init(self) -> None:
+        if self._devices is not None:
+            return
+        try:
+            devices = _enumerate_pjrt_devices(self.platform)
+        except Exception as e:  # noqa: BLE001 - backend init failures funnel
+            raise ResourceError(
+                f"failed to initialize PJRT {self.platform} client: {e}"
+            ) from e
+        if not devices:
+            raise ResourceError(
+                f"PJRT client reports no {self.platform} devices"
+            )
+        self._devices = devices
+        # Built once per init: the devices are held for the manager's
+        # lifetime, so per-cycle get_chips() must stay O(copy) — the
+        # multi-backend cycle calls it twice per cycle per family (the
+        # chip gate + the label math) and the registry's cycle-overhead
+        # budget is a fraction of a sub-millisecond engine pass.
+        self._chips = [
+            PjrtChip(
+                label_safe_value(
+                    (str(getattr(d, "device_kind", self.platform))
+                     or self.platform).lower(),
+                    fallback=self.platform,
+                ),
+                _memory_mb(d),
+            )
+            for d in devices
+        ]
+
+    def shutdown(self) -> None:
+        # Same lifecycle as JaxManager: the client is held for the
+        # process lifetime; per-cycle shutdown must stay free.
+        pass
+
+    def release(self) -> None:
+        self._devices = None
+        self._chips = []
+
+    def get_chips(self) -> List[Chip]:
+        return list(self._chips)
+
+    def get_driver_version(self) -> str:
+        """jaxlib (XLA runtime) distribution version — the closest
+        driver-version analog a generic PJRT platform has (the TPU
+        manager's libtpu walk does not apply off-TPU)."""
+        try:
+            import jaxlib
+
+            return jaxlib.version.__version__
+        except Exception as e:  # noqa: BLE001
+            raise ResourceError(
+                f"cannot determine PJRT runtime distribution version: {e}"
+            ) from e
+
+    def get_runtime_version(self) -> Tuple[int, int]:
+        """(major, minor) from the live backend's platform_version,
+        falling back to the jaxlib version — JaxManager's parse, applied
+        to this platform's backend."""
+        try:
+            import jax.extend.backend as jax_backend
+
+            backend = jax_backend.get_backend(self.platform)
+            pv = str(getattr(backend, "platform_version", ""))
+            m = re.search(r"(\d+)\.(\d+)", pv)
+            if m:
+                return (int(m.group(1)), int(m.group(2)))
+        except Exception:  # noqa: BLE001
+            pass
+        try:
+            import jaxlib
+
+            major, minor = jaxlib.version.__version__.split(".")[:2]
+            return (int(major), int(minor))
+        except Exception as e:  # noqa: BLE001
+            raise ResourceError(
+                f"cannot determine PJRT runtime version: {e}"
+            ) from e
+
+
+def _enumerate_pjrt_devices(platform: str) -> list:
+    """Local PJRT devices for one platform. Module-level so tests can
+    monkeypatch the enumeration without the platform's hardware (the
+    jax_backend._enumerate_tpu_devices pattern)."""
+    import jax
+
+    return jax.local_devices(backend=platform)
+
+
+def _memory_mb(device) -> int:
+    """Live memory size when the runtime exposes it, else 0 (the label
+    family then omits the memory key — no spec table to back-fill
+    off-TPU)."""
+    try:
+        stats = device.memory_stats()
+        limit = stats.get("bytes_limit") or stats.get("bytes_reservable_limit")
+        if limit:
+            return int(limit) // (1024 * 1024)
+    except Exception:  # noqa: BLE001 - memory_stats unsupported on some kinds
+        pass
+    return 0
+
+
+class StaticPjrtManager(Manager):
+    """Deterministic PJRT-shaped fixture for the ``mock-gpu:<n>`` /
+    ``mock-cpu:<n>`` registry tokens: the per-backend golden suite and
+    the multi-backend chaos/e2e rows need gpu/cpu inventories that do
+    not depend on the host. Version constants mirror
+    resource/testing.py's mock manager."""
+
+    MOCK_DRIVER_VERSION = "1.9.0"
+    MOCK_RUNTIME_VERSION = (0, 51)
+
+    def __init__(self, platform: str, product: str, count: int,
+                 memory_mb: int):
+        self.platform = platform
+        self._product = product
+        self._count = count
+        self._memory_mb = memory_mb
+        self._initialized = False
+        self._chips = [
+            PjrtChip(product, memory_mb) for _ in range(count)
+        ]
+
+    @classmethod
+    def mock_gpu(cls, count: int) -> "StaticPjrtManager":
+        return cls("gpu", "mock-gpu", count, memory_mb=16384)
+
+    @classmethod
+    def mock_cpu(cls, count: int) -> "StaticPjrtManager":
+        return cls("cpu", "mock-cpu", count, memory_mb=0)
+
+    def init(self) -> None:
+        self._initialized = True
+
+    def shutdown(self) -> None:
+        pass
+
+    def get_chips(self) -> List[Chip]:
+        if not self._initialized:
+            return []
+        return list(self._chips)
+
+    def get_driver_version(self) -> str:
+        return self.MOCK_DRIVER_VERSION
+
+    def get_runtime_version(self) -> Tuple[int, int]:
+        return self.MOCK_RUNTIME_VERSION

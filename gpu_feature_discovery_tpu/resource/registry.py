@@ -1,0 +1,631 @@
+"""Multi-backend PJRT registry: the pluggable backend-provider table.
+
+The reference GFD is hardwired to one device family (NVML →
+``nvidia.com/gpu.*``); our backend seam already speaks PJRT, and the same
+plugin discovery that finds TPUs can enumerate GPU and CPU backends. This
+module replaces the factory's hardwired if/elif selection chain
+(resource/factory.py) with an ORDERED, pluggable registry of backend
+providers, and adds the multi-backend resolution the ``--backends`` flag
+(env ``TFD_BACKENDS``) selects from:
+
+- Every backend the factory used to hardwire — the TPU autodetect chain,
+  the forced ``jax``/``native``/``hostinfo``/``null`` selections, and the
+  hardware-free ``mock*`` fixtures — is re-registered here as a provider
+  in the ``tpu`` label family. ``factory._get_manager`` is now a thin
+  dispatch through :func:`select_backend_manager`, so ``TFD_BACKEND``
+  behaves byte-identically to the pre-registry chain.
+- New ``gpu`` and ``cpu`` providers enumerate their platform through the
+  generic PJRT manager (resource/pjrt_backend.py) and emit their own
+  label families (``nvidia.com/gpu.*``, ``node.features/cpu.*`` —
+  lm/pjrt_family.py), with ``mock-gpu:<n>`` / ``mock-cpu:<n>`` fixtures
+  for hardware-free tests.
+- :func:`multi_backend_tokens` resolves what the daemon should run:
+  ``TFD_BACKEND`` (the original env override) keeps working as a FORCED
+  single-backend selection that routes through the classic single-manager
+  path; otherwise ``--backends`` names one token per family and the
+  daemon runs every named backend through the same labeler pipeline
+  (cmd/main.run's registry branch), merging the families into one
+  feature file. ``--backends=auto`` (the default) resolves to the classic
+  path, preserving today's TPU-first autodetect byte for byte.
+
+Per-backend robustness (``BackendSet``/``BackendRuntime``): each enabled
+backend gets its own init retry state under capped jittered backoff, its
+own ``pjrt_init.<family>`` fault site, its own ``tfd_backend_up{backend}``
+gauge and ``tfd_backend_inits_total{backend,outcome}`` counters, and its
+own sandbox/broker isolation (the probe child and the persistent broker
+worker are keyed by backend token — sandbox/probe.py, sandbox/broker.py).
+One sick backend degrades only its own label family: the others keep
+publishing fresh.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from dataclasses import dataclass
+from typing import Callable, Dict, List, Optional
+
+from gpu_feature_discovery_tpu.config.spec import Config, ConfigError
+from gpu_feature_discovery_tpu.resource.types import Manager
+
+log = logging.getLogger("tfd.resource")
+
+BACKENDS_ENV = "TFD_BACKENDS"
+
+# Label families a provider can emit into. Every provider belongs to
+# exactly one; the resolver admits at most one token per family, which is
+# what makes the cross-family key-collision guard (lm/pjrt_family.py)
+# structural rather than probabilistic.
+FAMILY_TPU = "tpu"
+FAMILY_GPU = "gpu"
+FAMILY_CPU = "cpu"
+FAMILIES = (FAMILY_TPU, FAMILY_GPU, FAMILY_CPU)
+
+
+@dataclass(frozen=True)
+class BackendProvider:
+    """One registered backend: how to build its Manager and which label
+    family its output belongs to. ``prefix`` providers match tokens of
+    the form ``<name><arg>`` (``mock:v4-8`` → the ``mock:`` provider with
+    the full token passed through); exact providers match the token
+    verbatim."""
+
+    name: str                                   # token, or token prefix ending in ":"
+    family: str                                 # tpu | gpu | cpu
+    build: Callable[[Config, str], Manager]     # (config, full token) -> Manager
+    prefix: bool = False
+    doc: str = ""
+    # Optional parse-time token validation (ConfigError on a bad arg) so
+    # a typo'd --backends entry fails at config load, not first cycle.
+    validate: Optional[Callable[[str], None]] = None
+
+
+# Ordered: iteration order is documentation order (docs/configuration.md
+# drift guard walks it), and prefix providers are tried in registration
+# order so a longer prefix must be registered before a shorter one that
+# would shadow it.
+_PROVIDERS: "Dict[str, BackendProvider]" = {}
+
+
+def register(provider: BackendProvider) -> None:
+    """Add (or replace) a provider. Embedders may register their own
+    backends before the daemon starts; in-tree providers register at
+    import time below."""
+    _PROVIDERS[provider.name] = provider
+
+
+def providers() -> List[BackendProvider]:
+    return list(_PROVIDERS.values())
+
+
+def provider_for(token: str) -> Optional[BackendProvider]:
+    """Resolve one backend token to its provider; None when nothing
+    matches (the factory then falls through to the autodetect chain,
+    preserving the pre-registry behavior for unrecognized TFD_BACKEND
+    values, while ``--backends`` rejects unknown tokens loudly)."""
+    token = token.strip().lower()
+    p = _PROVIDERS.get(token)
+    if p is not None and not p.prefix:
+        return p
+    for p in _PROVIDERS.values():
+        if not p.prefix:
+            continue
+        if p.name.endswith(":"):
+            if token.startswith(p.name):
+                return p
+        elif token == p.name or token.startswith(p.name + ":"):
+            # A colon-less prefix provider (mock-gpu) matches itself or
+            # itself-plus-arg, never a longer unrelated token
+            # (mock-gpux must be an unknown-token error, not 1 device).
+            return p
+    return None
+
+
+def backend_spec_tokens() -> List[str]:
+    """Every accepted token / token-prefix, for the docs drift guard
+    (tests/test_docs.py): ``docs/configuration.md`` must name each."""
+    return [p.name for p in _PROVIDERS.values()]
+
+
+# ---------------------------------------------------------------------------
+# provider builders
+# ---------------------------------------------------------------------------
+
+def _arg(token: str) -> str:
+    return token.split(":", 1)[1] if ":" in token else ""
+
+
+def _build_auto(config: Config, token: str) -> Manager:
+    from gpu_feature_discovery_tpu.resource import factory
+
+    return factory.autodetect_manager(config)
+
+
+def _build_jax(config: Config, token: str) -> Manager:
+    from gpu_feature_discovery_tpu.resource import factory
+
+    manager = factory._try_jax_manager(config)
+    if manager is None:
+        raise RuntimeError(
+            f"backend {token!r} requested but jax backend unavailable"
+        )
+    return manager
+
+
+def _build_native(config: Config, token: str) -> Manager:
+    from gpu_feature_discovery_tpu.resource import factory
+
+    # Forced selection bypasses the opt-in flag: naming the backend IS
+    # the opt-in (the operator typed it knowing it seizes the chip).
+    manager = factory._try_native_manager(config, forced=True)
+    if manager is None:
+        raise RuntimeError(
+            f"backend {token!r} requested but native enumeration unavailable"
+        )
+    log.info("Using native (PJRT C API) manager (forced)")
+    return manager
+
+
+def _build_hostinfo(config: Config, token: str) -> Manager:
+    from gpu_feature_discovery_tpu.resource import factory
+
+    # Eager availability check: a forced backend must fail loudly at
+    # selection time, not be silently swapped for null by the fallback
+    # wrapper.
+    manager = factory._try_hostinfo_manager(config)
+    if manager is None:
+        raise RuntimeError(
+            f"backend {token!r} requested but no TPU VM metadata available"
+        )
+    log.info("Using hostinfo (metadata) manager (forced)")
+    return manager
+
+
+def _build_null(config: Config, token: str) -> Manager:
+    from gpu_feature_discovery_tpu.resource.null import NullManager
+
+    log.info("Using null manager (forced)")
+    return NullManager()
+
+
+def _build_mock(config: Config, token: str) -> Manager:
+    from gpu_feature_discovery_tpu.resource.testing import (
+        new_single_host_manager,
+    )
+
+    accel = _arg(token)
+    log.info("Using mock manager (%s)", accel)
+    return new_single_host_manager(accel)
+
+
+def _build_mock_slice(config: Config, token: str) -> Manager:
+    from gpu_feature_discovery_tpu.resource.testing import (
+        new_uniform_slice_manager,
+    )
+
+    accel = _arg(token)
+    log.info("Using mock uniform-slice manager (%s)", accel)
+    return new_uniform_slice_manager(accel)
+
+
+def _build_mock_worker(config: Config, token: str) -> Manager:
+    """``mock-worker:<accel_type>`` — one worker of a multi-host slice
+    (only this host's chips, bound to the full slice topology)."""
+    from gpu_feature_discovery_tpu.resource.testing import (
+        new_multihost_worker_manager,
+    )
+
+    accel = _arg(token)
+    log.info("Using mock multi-host worker manager (%s)", accel)
+    return new_multihost_worker_manager(accel)
+
+
+def _build_mock_mixed(config: Config, token: str) -> Manager:
+    """``mock-mixed:<family>[:<topo>,<topo>,...]`` — one chip per listed
+    slice topology (defaults to the builder's heterogeneous set)."""
+    from gpu_feature_discovery_tpu.resource.testing import (
+        new_mixed_slice_manager,
+    )
+
+    spec = _arg(token)
+    log.info("Using mock mixed-slice manager (%s)", spec)
+    family, _, topos = spec.partition(":")
+    if topos:
+        return new_mixed_slice_manager(
+            family, topologies=[[t] for t in topos.split(",") if t]
+        )
+    return new_mixed_slice_manager(family)
+
+
+def _build_pjrt_gpu(config: Config, token: str) -> Manager:
+    from gpu_feature_discovery_tpu.resource.pjrt_backend import PjrtManager
+
+    log.info("Using generic PJRT manager (platform gpu)")
+    return PjrtManager(config, platform="gpu")
+
+
+def _build_pjrt_cpu(config: Config, token: str) -> Manager:
+    from gpu_feature_discovery_tpu.resource.pjrt_backend import PjrtManager
+
+    log.info("Using generic PJRT manager (platform cpu)")
+    return PjrtManager(config, platform="cpu")
+
+
+def _mock_count(token: str, default: int = 1) -> int:
+    arg = _arg(token)
+    if not arg:
+        return default
+    try:
+        n = int(arg)
+    except ValueError as e:
+        raise ConfigError(f"invalid mock device count in {token!r}") from e
+    if n < 1:
+        raise ConfigError(f"mock device count must be >= 1 in {token!r}")
+    return n
+
+
+def _build_mock_gpu(config: Config, token: str) -> Manager:
+    from gpu_feature_discovery_tpu.resource.pjrt_backend import (
+        StaticPjrtManager,
+    )
+
+    count = _mock_count(token)
+    log.info("Using mock PJRT gpu manager (%d devices)", count)
+    return StaticPjrtManager.mock_gpu(count)
+
+
+def _build_mock_cpu(config: Config, token: str) -> Manager:
+    from gpu_feature_discovery_tpu.resource.pjrt_backend import (
+        StaticPjrtManager,
+    )
+
+    count = _mock_count(token)
+    log.info("Using mock PJRT cpu manager (%d devices)", count)
+    return StaticPjrtManager.mock_cpu(count)
+
+
+def _register_in_tree_providers() -> None:
+    for p in (
+        BackendProvider(
+            "auto", FAMILY_TPU, _build_auto,
+            doc="TPU-first autodetect: PJRT (jax) → native → hostinfo → null",
+        ),
+        BackendProvider(
+            "tpu", FAMILY_TPU, _build_auto,
+            doc="the TPU autodetect chain, named explicitly",
+        ),
+        BackendProvider("jax", FAMILY_TPU, _build_jax,
+                        doc="force the PJRT (jax) TPU manager"),
+        BackendProvider("pjrt", FAMILY_TPU, _build_jax,
+                        doc="alias of jax"),
+        BackendProvider("native", FAMILY_TPU, _build_native,
+                        doc="force the native PJRT C-API enumeration"),
+        BackendProvider("hostinfo", FAMILY_TPU, _build_hostinfo,
+                        doc="force the TPU VM metadata inventory"),
+        BackendProvider("metadata", FAMILY_TPU, _build_hostinfo,
+                        doc="alias of hostinfo"),
+        BackendProvider("null", FAMILY_TPU, _build_null,
+                        doc="no devices, no labels"),
+        BackendProvider("mock:", FAMILY_TPU, _build_mock, prefix=True,
+                        doc="mock:<type> — single-host mock, e.g. mock:v4-8"),
+        BackendProvider("mock-slice:", FAMILY_TPU, _build_mock_slice,
+                        prefix=True,
+                        doc="mock-slice:<type> — uniform slice mock"),
+        BackendProvider("mock-worker:", FAMILY_TPU, _build_mock_worker,
+                        prefix=True,
+                        doc="mock-worker:<type> — one multi-host worker"),
+        BackendProvider("mock-mixed:", FAMILY_TPU, _build_mock_mixed,
+                        prefix=True,
+                        doc="mock-mixed:<family>[:<topo>,...] — mixed slices"),
+        BackendProvider("gpu", FAMILY_GPU, _build_pjrt_gpu,
+                        doc="generic PJRT gpu platform → nvidia.com/gpu.*"),
+        BackendProvider("cpu", FAMILY_CPU, _build_pjrt_cpu,
+                        doc="generic PJRT cpu platform → node.features/cpu.*"),
+        BackendProvider("mock-gpu", FAMILY_GPU, _build_mock_gpu, prefix=True,
+                        doc="mock-gpu[:<n>] — n static gpu devices",
+                        validate=lambda token: _mock_count(token) and None),
+        BackendProvider("mock-cpu", FAMILY_CPU, _build_mock_cpu, prefix=True,
+                        doc="mock-cpu[:<n>] — n static cpu devices",
+                        validate=lambda token: _mock_count(token) and None),
+    ):
+        register(p)
+
+
+_register_in_tree_providers()
+
+
+# ---------------------------------------------------------------------------
+# selection entry points (what factory.py and the sandbox children call)
+# ---------------------------------------------------------------------------
+
+def select_backend_manager(config: Config, token: str) -> Manager:
+    """Build the Manager for one backend token WITHOUT the ``pjrt_init``
+    fault site or the init-attempt metric — the probe sandbox and the
+    broker worker run this inside their forked children after firing the
+    site/metric in the parent, where that state lives (the
+    factory.select_manager contract, generalized per backend)."""
+    provider = provider_for(token)
+    if provider is None:
+        raise ConfigError(f"unknown backend {token!r}")
+    return provider.build(config, token.strip().lower())
+
+
+def new_backend_manager(config: Config, token: str) -> Manager:
+    """The metric/fault-site-bearing acquisition analog of
+    ``factory.new_manager(wrap_fallback=False)`` for one registry token:
+    used by the in-process (isolation ``none``) acquisition path of the
+    multi-backend cycle."""
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+    from gpu_feature_discovery_tpu.utils.faults import maybe_inject
+
+    obs_metrics.BACKEND_INIT_ATTEMPTS.inc()
+    maybe_inject("pjrt_init")
+    return select_backend_manager(config, token)
+
+
+# ---------------------------------------------------------------------------
+# --backends resolution
+# ---------------------------------------------------------------------------
+
+def parse_backends_value(raw: str) -> List[str]:
+    """Validate one ``--backends`` value into an ordered token list:
+    comma-separated, deduplicated preserving order, every token known to
+    the registry, at most one token per label family (two same-family
+    backends would fight over one key namespace — the collision guard's
+    structural precondition). ``auto`` counts as the tpu family."""
+    tokens: List[str] = []
+    for part in str(raw).split(","):
+        token = part.strip().lower()
+        if token and token not in tokens:
+            tokens.append(token)
+    if not tokens:
+        raise ConfigError("empty --backends value")
+    seen_families: Dict[str, str] = {}
+    for token in tokens:
+        provider = provider_for(token)
+        if provider is None:
+            raise ConfigError(
+                f"unknown backend {token!r} in --backends "
+                f"(known: {', '.join(backend_spec_tokens())})"
+            )
+        if provider.validate is not None:
+            provider.validate(token)
+        other = seen_families.get(provider.family)
+        if other is not None:
+            raise ConfigError(
+                f"--backends names two {provider.family}-family backends "
+                f"({other!r}, {token!r}); one backend per label family"
+            )
+        seen_families[provider.family] = token
+    return tokens
+
+
+def resolved_backends_value(config: Config) -> str:
+    tfd = config.flags.tfd
+    return getattr(tfd, "backends", None) or "auto"
+
+
+def multi_backend_tokens(
+    config: Config, environ: Optional[Dict[str, str]] = None
+) -> Optional[List[str]]:
+    """The token list the registry cycle should run, or None for the
+    classic single-manager path. Precedence:
+
+    1. ``TFD_BACKEND`` (the original forced override) wins outright and
+       keeps the classic path — its grammar is the factory's, including
+       unknown-token fall-through to autodetect.
+    2. ``--backends`` / ``TFD_BACKENDS`` / config-file ``backends``
+       (CLI > env > file, resolved by the flag layer) select the
+       registry cycle — unless the list is exactly ``auto``, which IS
+       the classic path (byte-identical by construction).
+    """
+    env = environ if environ is not None else os.environ
+    from gpu_feature_discovery_tpu.resource.factory import BACKEND_ENV
+
+    if env.get(BACKEND_ENV, "").strip():
+        return None
+    tokens = parse_backends_value(resolved_backends_value(config))
+    if tokens == ["auto"]:
+        return None
+    return tokens
+
+
+# ---------------------------------------------------------------------------
+# per-backend supervision (the multi-backend cycle's acquisition state)
+# ---------------------------------------------------------------------------
+
+class BackendRuntime:
+    """One enabled backend's cross-cycle state: the held manager, the
+    init retry/backoff bookkeeping, and the per-backend metrics. The
+    acquisition unit mirrors cmd/main._build_manager — sandbox
+    isolation and the persistent broker apply per backend, keyed by
+    token — and failures degrade ONLY this backend's family.
+
+    The retry machinery deliberately MIRRORS Supervisor.acquire_manager
+    (cmd/supervisor.py — same BackoffPolicy construction, window check,
+    attempt clamp) with per-family instead of global observability and
+    no claim on the un-labeled backoff gauge (N independent backoffs
+    have no one truthful value). A change to either site's retry
+    accounting must be weighed against the other."""
+
+    def __init__(self, token: str, config: Config,
+                 clock: Callable[[], float] = time.monotonic):
+        from gpu_feature_discovery_tpu.cmd.supervisor import BACKOFF_BASE_S
+        from gpu_feature_discovery_tpu.config.flags import (
+            DEFAULT_INIT_BACKOFF_MAX,
+            DEFAULT_INIT_RETRIES,
+        )
+        from gpu_feature_discovery_tpu.utils.retry import BackoffPolicy
+
+        provider = provider_for(token)
+        if provider is None:
+            raise ConfigError(f"unknown backend {token!r}")
+        self.token = token
+        self.family = provider.family
+        self._config = config
+        self._clock = clock
+        tfd = config.flags.tfd
+        self._init_retries = (
+            tfd.init_retries
+            if tfd.init_retries is not None
+            else DEFAULT_INIT_RETRIES
+        )
+        backoff_cap = (
+            tfd.init_backoff_max
+            if tfd.init_backoff_max is not None
+            else DEFAULT_INIT_BACKOFF_MAX
+        )
+        self._policy = BackoffPolicy(
+            base=min(BACKOFF_BASE_S, backoff_cap), cap=backoff_cap
+        )
+        self.manager: Optional[Manager] = None
+        self.failures = 0
+        self._next_attempt = 0.0
+        from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+        # Armed-but-unprobed reads 0, not "series absent" (the
+        # supervisor's gauge-priming contract, per backend).
+        obs_metrics.BACKEND_UP.labels(backend=self.family).set(0)
+
+    @property
+    def down(self) -> bool:
+        return self.manager is None and self.failures > 0
+
+    @property
+    def exhausted(self) -> bool:
+        return self.failures >= self._init_retries
+
+    def acquire(self, strict: bool = False) -> Optional[Manager]:
+        """One bounded acquisition attempt (no-op while a manager is
+        held or the backoff window is closed). ``strict`` (oneshot)
+        propagates the failure instead of entering degraded state."""
+        from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+        from gpu_feature_discovery_tpu.utils.faults import maybe_inject
+
+        if self.manager is not None:
+            return self.manager
+        now = self._clock()
+        if not strict and self.failures and now < self._next_attempt:
+            return None
+        try:
+            maybe_inject(f"pjrt_init.{self.family}")
+            manager = self._build()
+        except Exception as e:  # noqa: BLE001 - per-backend supervision boundary
+            if strict:
+                raise
+            self.failures += 1
+            # The un-labeled classic counter keeps counting in registry
+            # mode too (docs/observability.md row): dashboards alerting
+            # on tfd_backend_init_failures_total must see a per-family
+            # outage, not read healthy while only the labeled series
+            # moves. (The un-labeled backoff GAUGE stays supervisor-
+            # owned: with several backends backing off independently a
+            # single gauge has no one truthful value.)
+            obs_metrics.BACKEND_INIT_FAILURES.inc()
+            obs_metrics.BACKEND_INITS.labels(
+                backend=self.family, outcome="error"
+            ).inc()
+            obs_metrics.BACKEND_UP.labels(backend=self.family).set(0)
+            delay = self._policy.delay(min(self.failures - 1, 63))
+            self._next_attempt = now + delay
+            log.warning(
+                "backend %s (%s family) init attempt %d failed: %s; "
+                "next attempt in %.3fs — only the %s label family is "
+                "degraded",
+                self.token, self.family, self.failures, e, delay, self.family,
+            )
+            log.debug("backend %s init traceback:", self.token, exc_info=True)
+            return None
+        if self.failures:
+            obs_metrics.BACKEND_INIT_RECOVERIES.inc()
+            log.info(
+                "backend %s (%s family) recovered after %d failed attempts",
+                self.token, self.family, self.failures,
+            )
+        self.failures = 0
+        self._next_attempt = 0.0
+        obs_metrics.BACKEND_INITS.labels(
+            backend=self.family, outcome="ok"
+        ).inc()
+        obs_metrics.BACKEND_UP.labels(backend=self.family).set(1)
+        self.manager = manager
+        return manager
+
+    def _build(self) -> Manager:
+        """The isolation-aware acquisition unit for THIS backend —
+        cmd/main._build_manager generalized: the broker worker and the
+        snapshot probe child are keyed by backend token, so a hang in
+        one family's native stack can never take another family's
+        acquisition down with it."""
+        from gpu_feature_discovery_tpu import sandbox
+        from gpu_feature_discovery_tpu.config.flags import (
+            DEFAULT_PROBE_TIMEOUT,
+        )
+
+        config = self._config
+        if sandbox.isolation_mode(config) == "subprocess":
+            if sandbox.broker_enabled(config):
+                return sandbox.acquire_broker_manager(
+                    config, backend=self.token
+                )
+            tfd = config.flags.tfd
+            timeout = (
+                tfd.probe_timeout
+                if tfd.probe_timeout is not None
+                else DEFAULT_PROBE_TIMEOUT
+            )
+            return sandbox.acquire_snapshot_manager(
+                config, timeout, backend=self.token
+            )
+        manager = new_backend_manager(config, self.token)
+        manager.init()
+        return manager
+
+    def release(self) -> None:
+        """Drop the held manager (cycle failure containment: the next
+        cycle re-acquires). shutdown() is idempotent across backends."""
+        if self.manager is None:
+            return
+        try:
+            self.manager.shutdown()
+        except Exception:  # noqa: BLE001 - already on the failure path
+            log.debug("shutdown of backend %s:", self.token, exc_info=True)
+        self.manager = None
+
+
+class BackendSet:
+    """The multi-backend cycle's acquisition roster: one BackendRuntime
+    per ``--backends`` token, in flag order."""
+
+    def __init__(self, tokens: List[str], config: Config,
+                 clock: Callable[[], float] = time.monotonic):
+        self._config = config
+        self.runtimes = [BackendRuntime(t, config, clock=clock) for t in tokens]
+
+    def has_family(self, family: str) -> bool:
+        return any(rt.family == family for rt in self.runtimes)
+
+    def check_escalation(self) -> None:
+        """InitRetriesExhausted only when EVERY enabled backend is down
+        past its retry budget under --fail-on-init-error=true: one sick
+        backend family must never take a node's healthy families with
+        it, but a daemon with nothing left to publish honors fail-fast."""
+        from gpu_feature_discovery_tpu.cmd.supervisor import (
+            InitRetriesExhausted,
+        )
+
+        if not bool(self._config.flags.fail_on_init_error):
+            return
+        if all(rt.down and rt.exhausted for rt in self.runtimes):
+            raise InitRetriesExhausted(
+                "every enabled backend failed init past --init-retries: "
+                + ", ".join(
+                    f"{rt.token}({rt.failures} failures)"
+                    for rt in self.runtimes
+                )
+            )
+
+    def release_all(self) -> None:
+        for rt in self.runtimes:
+            rt.release()

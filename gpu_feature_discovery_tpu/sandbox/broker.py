@@ -57,7 +57,7 @@ import sys
 import tempfile
 import threading
 import time
-from typing import Optional, Tuple
+from typing import Dict, Optional, Tuple
 
 from gpu_feature_discovery_tpu.resource.types import Manager, ResourceError
 from gpu_feature_discovery_tpu.sandbox.probe import (
@@ -290,15 +290,24 @@ def _child_prewarm(chip_lock: threading.Lock, per_chip: bool = True) -> None:
         log.debug("broker kernel pre-warm failed:", exc_info=True)
 
 
-def _child_main(req_r: int, resp_w: int, config) -> None:
+def _child_main(req_r: int, resp_w: int, config, backend=None) -> None:
     """The worker body: select + init the backend ONCE, report ready,
     then serve requests until EOF or a shutdown request. Never returns —
     every path leaves through os._exit (no atexit, no pytest finalizers,
-    same contract as the one-shot probe child)."""
-    from gpu_feature_discovery_tpu.resource import factory
+    same contract as the one-shot probe child).
+
+    ``backend`` keys the worker to one registry token (the multi-backend
+    cycle): the child then builds exactly that provider instead of the
+    TFD_BACKEND-driven factory chain. Only tpu-family workers pre-warm
+    the burn-in kernels — the health probe is a TPU pipeline and a
+    gpu/cpu worker compiling TPU probe geometry would be pure waste."""
+    from gpu_feature_discovery_tpu.resource import factory, registry
 
     try:
-        manager = factory.select_manager(config)
+        if backend is None:
+            manager = factory.select_manager(config)
+        else:
+            manager = registry.select_backend_manager(config, backend)
         manager.init()
     except BaseException as e:  # noqa: BLE001 - shipped to the parent
         try:
@@ -320,7 +329,12 @@ def _child_main(req_r: int, resp_w: int, config) -> None:
     # would double-seize the device.
     chip_lock = threading.Lock()
     health_probe = _HealthProbe(chip_lock)
-    if config.flags.tfd.with_burnin:
+    if backend is None:
+        tpu_worker = True
+    else:
+        provider = registry.provider_for(backend)
+        tpu_worker = provider is not None and provider.family == registry.FAMILY_TPU
+    if config.flags.tfd.with_burnin and tpu_worker:
         from gpu_feature_discovery_tpu.lm.health import _chip_probe_opts
 
         threading.Thread(
@@ -401,7 +415,7 @@ class BrokerClient:
     pid lock so a deadline-escalation cancel can fire while a request is
     blocked mid-read."""
 
-    def __init__(self, config):
+    def __init__(self, config, backend=None):
         from gpu_feature_discovery_tpu.config.flags import (
             DEFAULT_INIT_BACKOFF_MAX,
             DEFAULT_PROBE_TIMEOUT,
@@ -410,6 +424,9 @@ class BrokerClient:
 
         tfd = config.flags.tfd
         self._config = config
+        # Registry token this worker is keyed to (resource/registry.py);
+        # None = the classic TFD_BACKEND-driven selection.
+        self._backend = backend
         self._timeout_s = (
             tfd.probe_timeout
             if tfd.probe_timeout is not None
@@ -542,7 +559,7 @@ class BrokerClient:
                     # still dump through faulthandler.
                     signal.signal(signal.SIGSEGV, signal.SIG_DFL)
                     os.kill(os.getpid(), signal.SIGSEGV)
-                _child_main(req_r, resp_w, self._config)
+                _child_main(req_r, resp_w, self._config, self._backend)
             except BaseException:  # noqa: BLE001 - never unwind into pytest
                 pass
             finally:
@@ -1001,34 +1018,42 @@ def broker_enabled(config) -> bool:
 
 
 _active_lock = threading.Lock()
-_active: Optional[BrokerClient] = None
+# Active broker clients keyed by backend registry token (None = the
+# classic TFD_BACKEND-driven worker). The multi-backend cycle
+# (resource/registry.py) runs one long-lived worker PER enabled backend,
+# so a hang-kill or crash-respawn in one family's worker never touches
+# another family's held client.
+_active: Dict[Optional[str], BrokerClient] = {}
 
 
-def get_broker(config) -> BrokerClient:
-    """The process's active broker client, created on first use. One per
-    config epoch: ``close_broker()`` (run()'s finally) retires it, so a
-    SIGHUP reload builds a fresh worker under the new config."""
-    global _active
+def get_broker(config, backend=None) -> BrokerClient:
+    """The process's active broker client for one backend key, created
+    on first use. One per config epoch and backend: ``close_broker()``
+    (run()'s finally) retires them all, so a SIGHUP reload builds fresh
+    workers under the new config."""
     with _active_lock:
-        if _active is None:
-            _active = BrokerClient(config)
-        return _active
+        client = _active.get(backend)
+        if client is None:
+            client = BrokerClient(config, backend=backend)
+            _active[backend] = client
+        return client
 
 
 def close_broker() -> None:
-    """Epoch teardown: gracefully retire the active broker (no-op when
+    """Epoch teardown: gracefully retire every active broker (no-op when
     none exists). Runs BEFORE the stray-child sweep in run()'s finally —
     the sweep exemption covers the window in between."""
-    global _active
     with _active_lock:
-        client, _active = _active, None
-    if client is not None:
+        clients = list(_active.values())
+        _active.clear()
+    for client in clients:
         client.close()
 
 
-def acquire_broker_manager(config) -> Manager:
-    """The broker-path acquisition unit (cmd/main._build_manager): ensure
-    the worker is up (spawn = the one PJRT init, with the pjrt_init fault
-    site and init-attempt metric) and wrap a fresh snapshot. With a live
-    worker this is one RPC — no fork, no init."""
-    return BrokerManager(get_broker(config))
+def acquire_broker_manager(config, backend=None) -> Manager:
+    """The broker-path acquisition unit (cmd/main._build_manager and the
+    per-backend registry runtime): ensure the keyed worker is up (spawn
+    = the one PJRT init, with the pjrt_init fault site and init-attempt
+    metric) and wrap a fresh snapshot. With a live worker this is one
+    RPC — no fork, no init."""
+    return BrokerManager(get_broker(config, backend=backend))

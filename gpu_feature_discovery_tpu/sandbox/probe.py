@@ -430,7 +430,9 @@ def probe_device_snapshot(manager: Manager, timeout_s: float) -> DeviceSnapshot:
     return _run_snapshot_probe(_snapshot, timeout_s)
 
 
-def acquire_snapshot_manager(config, timeout_s: float) -> "Manager":
+def acquire_snapshot_manager(
+    config, timeout_s: float, backend: Optional[str] = None
+) -> "Manager":
     """The supervised daemon's sandboxed acquisition unit: backend
     SELECTION + init + enumeration all inside one forked child, a
     SnapshotManager over the result in the parent.
@@ -442,9 +444,15 @@ def acquire_snapshot_manager(config, timeout_s: float) -> "Manager":
     unkillable native call the sandbox exists to contain. Only the
     ``pjrt_init`` fault site and the init-attempt metric fire in the
     parent, where their countdown/registry state lives (a child-side
-    countdown decrements fork-copied memory and re-fires forever)."""
+    countdown decrements fork-copied memory and re-fires forever).
+
+    ``backend`` keys the probe to one registry token (the multi-backend
+    cycle, resource/registry.py): the child then selects exactly that
+    provider instead of the TFD_BACKEND-driven factory chain, so each
+    enabled backend gets its own killable probe child and one family's
+    native hang can never block another family's acquisition."""
     from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
-    from gpu_feature_discovery_tpu.resource import factory
+    from gpu_feature_discovery_tpu.resource import factory, registry
     from gpu_feature_discovery_tpu.sandbox.snapshot import SnapshotManager
     from gpu_feature_discovery_tpu.utils import faults
 
@@ -452,7 +460,10 @@ def acquire_snapshot_manager(config, timeout_s: float) -> "Manager":
     faults.maybe_inject("pjrt_init")
 
     def _select_and_snapshot() -> dict:
-        manager = factory.select_manager(config)
+        if backend is None:
+            manager = factory.select_manager(config)
+        else:
+            manager = registry.select_backend_manager(config, backend)
         manager.init()
         return DeviceSnapshot.from_manager(manager).to_dict()
 

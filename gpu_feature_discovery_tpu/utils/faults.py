@@ -25,6 +25,12 @@ an infinity.
 Instrumented sites:
 
     pjrt_init          resource.factory.new_manager (backend construction)
+    pjrt_init.<family> one backend family's acquisition in the
+                       multi-backend registry cycle (--backends):
+                       tpu | gpu | cpu — fails ONLY that family's
+                       acquisition, so its labels degrade while the
+                       other enabled families keep publishing fresh
+                       (resource/registry.py BackendRuntime.acquire)
     generate           lm.engine.LabelEngine.generate (cycle entry)
     labeler.<name>     lm.engine.LabelSource.run (one named labeler)
     write              lm.labels.Labels.write_to_file

@@ -131,7 +131,8 @@ def run_slice_chaos(scenario, workdir, timeout_s=None):
 
 def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
               assert_probe_kills=None, expect_transient=None,
-              expect_final=None, expect_absent=None, timeout_s=None):
+              expect_final=None, expect_absent=None, timeout_s=None,
+              backends=None, require_always=None):
     """Execute one chaos scenario; returns a result dict (raises
     AssertionError on contract violations).
 
@@ -155,7 +156,19 @@ def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
     8-device virtual CPU mesh under TFD_BURNIN_ALLOW_CPU, at the small
     TFD_BURNIN_GEOMETRY), against the 8-chip mock so the chip inventory
     matches the mesh. Slower than the marker rows (XLA compiles the
-    sharded programs), hence their larger ``timeout_s``."""
+    sharded programs), hence their larger ``timeout_s``.
+
+    ``backends`` runs the MULTI-BACKEND registry cycle instead of the
+    TFD_BACKEND single path (resource/registry.py): full = every
+    enabled family's count key present, clean additionally requires
+    every per-family ``<family>.tfd.degraded`` marker gone. The
+    acquisition runs in-process (--probe-isolation=none) so the real
+    cpu backend's jax never computes in a forked child of this
+    jax-capable process; the per-backend broker keying has its own
+    hermetic suite (tests/test_registry.py). ``require_always``
+    ("key=value" strings) must hold in EVERY non-empty label-file
+    observation — the multi-backend row pins the healthy family
+    publishing continuously while its sibling is degraded."""
     import gpu_feature_discovery_tpu.cmd.main as cmd_main
     from gpu_feature_discovery_tpu.cmd.main import run
     from gpu_feature_discovery_tpu.cmd.supervisor import (
@@ -232,6 +245,24 @@ def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
                 "labeler-timeout": "60s",
             }
         )
+    degraded_markers = [DEGRADED_LABEL, UNHEALTHY_CYCLES_LABEL]
+    full_keys = ["google.com/tpu.count"]
+    if backends:
+        from gpu_feature_discovery_tpu.lm.pjrt_family import (
+            FAMILY_COUNT_KEYS,
+            FAMILY_DEGRADED_LABELS,
+        )
+        from gpu_feature_discovery_tpu.resource import registry
+
+        cli_values["backends"] = backends
+        cli_values["probe-isolation"] = "none"
+        families = [
+            registry.provider_for(t.strip()).family
+            for t in backends.split(",")
+            if t.strip()
+        ]
+        full_keys = [FAMILY_COUNT_KEYS[f] for f in families]
+        degraded_markers.extend(FAMILY_DEGRADED_LABELS[f] for f in families)
     metrics_port = None
     if assert_probe_kills is not None:
         from slice_fixture import free_port
@@ -242,7 +273,12 @@ def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
         cli_values["metrics-port"] = str(metrics_port)
     config = new_config(cli_values=cli_values, environ={})
     saved_backend = os.environ.get("TFD_BACKEND")
-    os.environ["TFD_BACKEND"] = backend
+    if backends:
+        # The registry cycle resolves --backends only while TFD_BACKEND
+        # (the forced single-backend override) is unset.
+        os.environ.pop("TFD_BACKEND", None)
+    else:
+        os.environ["TFD_BACKEND"] = backend
     if not chip_faults:
         faults.load_fault_spec(spec)
     # chip.* specs arm AFTER the daemon's first probe has published
@@ -271,6 +307,7 @@ def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
     expect_transient = list(expect_transient or [])
     final_pairs = [e.partition("=")[::2] for e in (expect_final or [])]
     expect_absent = list(expect_absent or [])
+    always_pairs = [e.partition("=")[::2] for e in (require_always or [])]
 
     t = threading.Thread(target=target)
     started = time.monotonic()
@@ -296,11 +333,14 @@ def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
                     key, _, value = exp.partition("=")
                     if labels.get(key) == value:
                         seen_transient.add(exp)
-                full = "google.com/tpu.count" in labels
-                clean = (
-                    DEGRADED_LABEL not in labels
-                    and UNHEALTHY_CYCLES_LABEL not in labels
-                )
+                for key, value in always_pairs:
+                    assert labels.get(key) == value, (
+                        f"require-always {key}={value} violated: "
+                        f"{labels.get(key)!r} (a degraded sibling family "
+                        f"must not interrupt a healthy one)"
+                    )
+                full = all(k in labels for k in full_keys)
+                clean = not any(m in labels for m in degraded_markers)
                 extras_ok = (
                     len(seen_transient) == len(expect_transient)
                     and all(labels.get(k) == v for k, v in final_pairs)
@@ -450,6 +490,24 @@ def main(argv=None):
         help="convergence budget in seconds (default 8; chip-fault rows "
         "default to 60 — the sharded probe pays XLA compiles)",
     )
+    parser.add_argument(
+        "--backends",
+        default=None,
+        help="run the multi-backend registry cycle with this --backends "
+        "value instead of the TFD_BACKEND single path (e.g. "
+        "'mock-gpu:2,cpu'); full/clean convergence then covers every "
+        "enabled family's count key and degraded marker",
+    )
+    parser.add_argument(
+        "--require-always",
+        action="append",
+        default=None,
+        metavar="KEY=VALUE",
+        help="label that must hold in EVERY non-empty label-file "
+        "observation (repeatable; the multi-backend row pins the "
+        "healthy family publishing continuously while its sibling is "
+        "degraded)",
+    )
     args = parser.parse_args(argv)
     if not args.spec:
         parser.error("no fault spec: pass --spec or set TFD_FAULT_SPEC")
@@ -467,6 +525,8 @@ def main(argv=None):
             expect_final=args.expect_final,
             expect_absent=args.expect_absent,
             timeout_s=args.timeout,
+            backends=args.backends,
+            require_always=args.require_always,
         )
     print(
         f"chaos: spec={result['spec']!r} converged in {result['converged_s']}s "

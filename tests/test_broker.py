@@ -519,7 +519,7 @@ def test_probe_broker_off_restores_fork_per_acquisition(tmp_path, monkeypatch):
     assert obs_metrics.BROKER_REQUESTS.value() == 0
     assert obs_metrics.BROKER_RESPAWNS.value() == 0
     assert obs_metrics.BROKER_UP.value() == 0
-    assert sandbox.broker._active is None, (
+    assert not sandbox.broker._active, (
         "--probe-broker=off must never instantiate a broker client"
     )
     on_bytes = daemon_output("on", "on")

@@ -49,6 +49,12 @@ CHAOS_SPECS = [
     # which publishes fresh slice labels.
     "slice:peer-unreachable",
     "slice:leader-failover",
+    # Multi-backend registry (resource/registry.py, --backends): an
+    # injected pjrt_init failure on ONE backend family must degrade only
+    # that family's labels (its <family>.tfd.degraded marker) while the
+    # OTHER enabled family keeps publishing fresh in every observation,
+    # then converge with both families full and clean.
+    "pjrt_init.cpu:fail:2",
 ]
 
 # Per-spec label expectations + convergence budgets beyond the generic
@@ -75,6 +81,16 @@ CHAOS_EXPECTATIONS = {
     # convergence + the 2-poll confirmation window comfortable room.
     "slice:peer-unreachable": {"timeout_s": 60.0},
     "slice:leader-failover": {"timeout_s": 60.0},
+    # The multi-backend row: the REAL cpu backend (jax cpu platform)
+    # plus a mock gpu family; first cpu acquisition may pay the jax
+    # import, hence the larger budget.
+    "pjrt_init.cpu:fail:2": {
+        "backends": "mock-gpu:2,cpu",
+        "require_always": ["nvidia.com/gpu.count=2"],
+        "expect_transient": ["node.features/cpu.tfd.degraded=true"],
+        "expect_absent": ["node.features/cpu.tfd.degraded"],
+        "timeout_s": 60.0,
+    },
 }
 
 

@@ -152,25 +152,65 @@ def test_labels_doc_covers_emitted_label_families():
     # major/minor`, `tpu.slice.chips/hosts/memory`): expand every
     # backticked slash-run into its member keys before matching.
     documented = set()
-    for token in re.findall(
-        r"`google\.com/([a-zA-Z0-9./_<>-]+)`", doc
-    ):
+
+    def expand(token):
+        """Expand one backticked doc row into its member keys. A sibling
+        replaces trailing components of the previous key; how many is
+        ambiguous in prose (`topology.x/y/z/ici.links`: `y` replaces one
+        of `topology.x`, `ici.links` replaces two of `topology.z`), so
+        admit every depth — over-generation cannot produce false
+        failures in a coverage check."""
         parts = token.split("/")
         prev = parts[0]
         documented.add(prev)
         for sibling in parts[1:]:
-            # A sibling replaces trailing components of the previous key;
-            # how many is ambiguous in prose (`topology.x/y/z/ici.links`:
-            # `y` replaces one of `topology.x`, `ici.links` replaces two
-            # of `topology.z`), so admit every depth — over-generation
-            # cannot produce false failures in a coverage check.
             comps = prev.split(".")
             for depth in range(1, len(comps)):
                 documented.add(".".join(comps[:-depth] + [sibling]))
             prev = ".".join(comps[:-1] + [sibling])
+
+    for token in re.findall(
+        r"`google\.com/([a-zA-Z0-9./_<>-]+)`", doc
+    ):
+        expand(token)
+    # Non-TPU family rows (ISSUE 8 multi-backend registry) keep their
+    # family prefix: the goldens pin them fully qualified. The family
+    # prefix's slash is structural, not a sibling separator — re-join it
+    # after the expansion split.
+    for prefix, token in re.findall(
+        r"`(nvidia\.com|node\.features)/([a-zA-Z0-9./_<>-]+)`", doc
+    ):
+        before = set(documented)
+        expand(token)
+        documented.update(
+            f"{prefix}/{key}" for key in documented - before
+        )
     missing = sorted(
         fam
         for fam in _golden_label_keys()
         if not any(d == fam or d.startswith(fam + ".") for d in documented)
     )
     assert not missing, f"label families undocumented in labels.md: {missing}"
+
+
+def test_configuration_doc_covers_every_backend_token():
+    """The TFD_BACKEND / --backends grammar in docs/configuration.md
+    must track the registry's accepted tokens BOTH ways (ISSUE 8
+    satellite: the table had drifted from the factory's accepted
+    prefixes): every registered provider token appears in the doc, and
+    every backend-ish token the doc names resolves in the registry."""
+    from gpu_feature_discovery_tpu.resource import registry
+
+    doc = read("configuration.md")
+    for name in registry.backend_spec_tokens():
+        base = name.rstrip(":")
+        assert re.search(rf"`{re.escape(base)}[`:\[]", doc), (
+            f"backend token {name!r} undocumented in configuration.md"
+        )
+    # Inverse: every mock-family token the doc spells with an argument
+    # grammar must resolve to a provider (a doc row for a removed
+    # variant fails here).
+    for match in re.findall(r"`(mock[a-z-]*):<", doc):
+        assert registry.provider_for(f"{match}:v4-8") is not None or (
+            registry.provider_for(f"{match}:2") is not None
+        ), f"doc names backend prefix {match!r} the registry rejects"

@@ -96,3 +96,34 @@ def test_fallback_wrapper_applied_iff_not_fail_on_init(monkeypatch):
     assert isinstance(wrapped, FallbackToNullOnInitError)
     bare = factory.new_manager(cfg(**{"fail-on-init-error": "true"}))
     assert not isinstance(bare, FallbackToNullOnInitError)
+
+
+def test_pci_probe_failure_logged_at_debug(monkeypatch, caplog):
+    """ISSUE 8 satellite: _detect_tpu_platform's PCI probe used to
+    swallow ALL exceptions silently — a broken sysfs (permissions, a
+    malformed vendor file) was indistinguishable from a non-TPU node.
+    The exception must land in the debug log so the mislabel is
+    diagnosable."""
+    import logging
+
+    from gpu_feature_discovery_tpu.pci import pciutil
+    from gpu_feature_discovery_tpu.native import shim
+
+    class _Probed:
+        found = False
+        source = ""
+
+    monkeypatch.setattr(shim, "probe_libtpu", lambda path=None: _Probed())
+
+    class _BrokenPCI:
+        def devices(self):
+            raise PermissionError("sysfs scan denied")
+
+    monkeypatch.setattr(pciutil, "SysfsGooglePCI", _BrokenPCI)
+    monkeypatch.delenv("TPU_ACCELERATOR_TYPE", raising=False)
+    monkeypatch.delenv("TPU_WORKER_ID", raising=False)
+    with caplog.at_level(logging.DEBUG, logger="tfd.resource"):
+        has_tpu, reason = factory._detect_tpu_platform(cfg())
+    assert has_tpu is False
+    assert "TPU PCI platform probe unavailable" in caplog.text
+    assert "sysfs scan denied" in caplog.text

@@ -48,6 +48,7 @@ FILE_KEYS = {
     "straggler-threshold": ("tfd", "stragglerThreshold"),
     "slice-coordination": ("tfd", "sliceCoordination"),
     "peer-timeout": ("tfd", "peerTimeout"),
+    "backends": ("tfd", "backends"),
 }
 
 # Two distinct valid raw values per flag (a wins the dominance checks).
@@ -68,6 +69,9 @@ VALUE_PAIRS = {
     "straggler-threshold": ("0.3", "0.7"),
     "slice-coordination": ("on", "off"),
     "peer-timeout": ("1s", "3s"),
+    # Registry tokens (resource/registry.py): values must parse, so the
+    # generic "/value-a" str fallback does not apply.
+    "backends": ("tpu,cpu", "cpu"),
 }
 
 

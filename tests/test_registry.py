@@ -1,0 +1,489 @@
+"""Multi-backend PJRT registry (resource/registry.py, ISSUE 8).
+
+Four contracts:
+
+1. **Golden suite per backend**: the tpu mock shapes × existing
+   strategies through ``--backends`` are BYTE-identical to the classic
+   ``TFD_BACKEND`` path; the gpu/cpu mock shapes match their own golden
+   regex files and are strategy-invariant (the topology strategy is a
+   TPU-family concept).
+2. **Precedence sweep**: ``TFD_BACKEND`` (forced single-backend) beats
+   ``--backends``/``TFD_BACKENDS``; ``auto`` resolves to the classic
+   path; unknown tokens and same-family pairs are hard ConfigErrors.
+3. **Full-daemon cpu-only acceptance**: ``--backends=cpu`` runs the
+   supervised daemon path (engine, supervisor, obs) and publishes
+   ``node.features/cpu.*`` with ZERO ``google.com/tpu.*`` labels;
+   ``tfd_backend_up{backend="cpu"}`` scrapes 1.
+4. **Per-family degradation**: an injected ``pjrt_init.<family>``
+   failure degrades ONLY that family's labels (its ``tfd.degraded``
+   marker) while the other enabled family keeps publishing fresh, and
+   converges once the fault budget drains — with the broker keyed per
+   backend (two live workers).
+"""
+
+import queue
+import signal
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from gpu_feature_discovery_tpu.cmd import main as cmd_main
+from gpu_feature_discovery_tpu.cmd.supervisor import (
+    DEGRADED_LABEL,
+    Supervisor,
+)
+from gpu_feature_discovery_tpu.config import new_config
+from gpu_feature_discovery_tpu.config.spec import ConfigError
+from gpu_feature_discovery_tpu.lm.labeler import Empty
+from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.lm.pjrt_family import (
+    FAMILY_COUNT_KEYS,
+    FAMILY_DEGRADED_LABELS,
+    FAMILY_NAMESPACES,
+    family_guard,
+)
+from gpu_feature_discovery_tpu.resource import factory, registry
+from gpu_feature_discovery_tpu.utils import faults
+
+from test_daemon import check_result
+
+GOLDEN_DIR = Path(__file__).parent
+
+
+# ---------------------------------------------------------------------------
+# harness
+# ---------------------------------------------------------------------------
+
+def _oneshot(tmp_path, monkeypatch, backends=None, env_backend=None,
+             strategy="none", name="tfd", **extra_cli):
+    machine = tmp_path / "machine-type"
+    machine.write_text("Google Compute Engine\n")
+    out = tmp_path / name
+    cli = {
+        "oneshot": True,
+        "machine-type-file": str(machine),
+        "output-file": str(out),
+        "tpu-topology-strategy": strategy,
+    }
+    if backends is not None:
+        cli["backends"] = backends
+    cli.update(extra_cli)
+    config = new_config(cli_values=cli, environ={})
+    monkeypatch.delenv(factory.BACKEND_ENV, raising=False)
+    if env_backend is not None:
+        monkeypatch.setenv(factory.BACKEND_ENV, env_backend)
+    if registry.multi_backend_tokens(config):
+        restart = cmd_main.run(None, Empty(), config, queue.Queue())
+    else:
+        restart = cmd_main.run(
+            factory.new_manager(config), Empty(), config, queue.Queue()
+        )
+    assert restart is False
+    return out.read_text()
+
+
+def _read_labels(path):
+    try:
+        with open(path) as f:
+            return dict(
+                line.strip().split("=", 1) for line in f if "=" in line
+            )
+    except OSError:
+        return {}
+
+
+def _run_daemon(config, sigs, result):
+    def target():
+        try:
+            result["restart"] = cmd_main.run(
+                lambda: cmd_main._build_manager(config),
+                Empty(),
+                config,
+                sigs,
+                supervisor=Supervisor(config),
+            )
+        except BaseException as e:  # noqa: BLE001 - surfaced by the test
+            result["error"] = e
+
+    t = threading.Thread(target=target, daemon=True)
+    t.start()
+    return t
+
+
+def _stop_daemon(t, sigs, result):
+    sigs.put(signal.SIGTERM)
+    t.join(timeout=10)
+    assert not t.is_alive(), "daemon did not honor SIGTERM"
+    assert "error" not in result, result.get("error")
+
+
+def _wait_until(fn, timeout=8.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if fn():
+            return True
+        time.sleep(0.005)
+    return False
+
+
+# ---------------------------------------------------------------------------
+# 1. golden suite per backend
+# ---------------------------------------------------------------------------
+
+TPU_SHAPES = [
+    ("mock:v4-8", "none"),
+    ("mock:v5e-8", "none"),
+    ("mock-slice:v4-8", "single"),
+    ("mock-worker:v5p-64", "single"),
+    ("mock-mixed:v5e", "mixed"),
+]
+
+
+@pytest.mark.parametrize("token,strategy", TPU_SHAPES,
+                         ids=[t for t, _ in TPU_SHAPES])
+def test_backends_tpu_token_byte_identical_to_classic(
+    tmp_path, monkeypatch, token, strategy
+):
+    """--backends=<tpu token> through the registry cycle must reproduce
+    the classic TFD_BACKEND single-manager output BYTE for byte —
+    the acceptance criterion pinning that the registry seam adds
+    nothing and loses nothing on the incumbent path. (--no-timestamp so
+    a second-boundary between the two runs cannot fake a diff.)"""
+    via_registry = _oneshot(
+        tmp_path, monkeypatch, backends=token, strategy=strategy,
+        name="tfd-registry", **{"no-timestamp": True},
+    )
+    classic = _oneshot(
+        tmp_path, monkeypatch, env_backend=token, strategy=strategy,
+        name="tfd-classic", **{"no-timestamp": True},
+    )
+    assert via_registry == classic
+
+
+@pytest.mark.parametrize("strategy", ["none", "single", "mixed"])
+def test_gpu_mock_golden(tmp_path, monkeypatch, strategy):
+    """The gpu family's mock shape against its golden regex file, under
+    every existing strategy — the strategy engine is a TPU-family
+    concept, so the gpu family's output is strategy-invariant."""
+    out = tmp_path / "tfd"
+    _oneshot(tmp_path, monkeypatch, backends="mock-gpu:2", strategy=strategy)
+    check_result(out, "expected-output-gpu-mock.txt")
+
+
+@pytest.mark.parametrize("strategy", ["none", "single", "mixed"])
+def test_cpu_mock_golden(tmp_path, monkeypatch, strategy):
+    out = tmp_path / "tfd"
+    _oneshot(tmp_path, monkeypatch, backends="mock-cpu:4", strategy=strategy)
+    check_result(out, "expected-output-cpu-mock.txt")
+
+
+def test_three_family_merge_is_namespace_disjoint(tmp_path, monkeypatch):
+    """tpu + gpu + cpu in one cycle: every family's labels present, every
+    key inside exactly one family namespace, no cross-family override."""
+    text = _oneshot(
+        tmp_path, monkeypatch, backends="mock:v4-8,mock-gpu:2,mock-cpu:4"
+    )
+    labels = dict(l.split("=", 1) for l in text.splitlines() if "=" in l)
+    assert labels["google.com/tpu.count"] == "4"
+    assert labels["nvidia.com/gpu.count"] == "2"
+    assert labels["node.features/cpu.count"] == "4"
+    for key in labels:
+        owners = [
+            fam
+            for fam, prefixes in FAMILY_NAMESPACES.items()
+            if key.startswith(prefixes)
+        ]
+        assert len(owners) == 1, f"{key} owned by {owners}"
+
+
+# ---------------------------------------------------------------------------
+# 2. precedence sweep (--backends vs TFD_BACKEND vs auto)
+# ---------------------------------------------------------------------------
+
+def test_tfd_backend_overrides_backends(tmp_path, monkeypatch):
+    """TFD_BACKEND keeps working as the forced single-backend override:
+    with both set, the classic tpu-family path wins outright and no
+    gpu/cpu family labels appear."""
+    text = _oneshot(
+        tmp_path, monkeypatch, backends="mock-gpu:2,mock-cpu:4",
+        env_backend="mock:v4-8",
+    )
+    labels = dict(l.split("=", 1) for l in text.splitlines() if "=" in l)
+    assert labels["google.com/tpu.count"] == "4"
+    assert not any(k.startswith(("nvidia.com/", "node.features/"))
+                   for k in labels)
+
+
+def test_backends_auto_is_classic_path(monkeypatch):
+    """--backends=auto (the default) resolves to the classic
+    single-manager path — multi_backend_tokens answers None both unset
+    and explicitly set to auto."""
+    monkeypatch.delenv(factory.BACKEND_ENV, raising=False)
+    assert registry.multi_backend_tokens(
+        new_config(cli_values={}, environ={}), environ={}
+    ) is None
+    assert registry.multi_backend_tokens(
+        new_config(cli_values={"backends": "auto"}, environ={}), environ={}
+    ) is None
+
+
+def test_backends_env_alias_resolves(monkeypatch):
+    config = new_config(
+        cli_values={}, environ={"TFD_BACKENDS": "mock-cpu:2"}
+    )
+    monkeypatch.delenv(factory.BACKEND_ENV, raising=False)
+    assert registry.multi_backend_tokens(config, environ={}) == ["mock-cpu:2"]
+
+
+def test_unknown_backend_token_is_config_error():
+    with pytest.raises(ConfigError, match="unknown backend"):
+        new_config(cli_values={"backends": "banana"}, environ={})
+
+
+def test_two_same_family_tokens_rejected():
+    with pytest.raises(ConfigError, match="one backend per label family"):
+        new_config(cli_values={"backends": "tpu,mock:v4-8"}, environ={})
+    with pytest.raises(ConfigError, match="one backend per label family"):
+        new_config(cli_values={"backends": "gpu,mock-gpu:2"}, environ={})
+
+
+def test_bad_mock_count_rejected():
+    with pytest.raises(ConfigError, match="mock device count|invalid"):
+        new_config(cli_values={"backends": "mock-gpu:zero"}, environ={})
+    # A near-miss token must be an unknown-token error, not 1 device.
+    with pytest.raises(ConfigError, match="unknown backend"):
+        new_config(cli_values={"backends": "mock-gpux"}, environ={})
+
+
+def test_tfd_backend_gpu_family_token_falls_to_autodetect(monkeypatch):
+    """TFD_BACKEND=cpu must NOT select the cpu provider through the
+    classic single path (it would mislabel the TPU namespace from a cpu
+    manager): it falls through to autodetect with a warning, preserving
+    pre-registry behavior."""
+    from gpu_feature_discovery_tpu.resource.null import NullManager
+
+    monkeypatch.setenv(factory.BACKEND_ENV, "cpu")
+    monkeypatch.setattr(
+        factory, "_detect_tpu_platform", lambda config: (False, "patched")
+    )
+    manager = factory._get_manager(new_config(cli_values={}, environ={}))
+    assert isinstance(manager, NullManager)
+
+
+# ---------------------------------------------------------------------------
+# 3. cpu-only full-daemon acceptance (engine, supervisor, obs)
+# ---------------------------------------------------------------------------
+
+def test_cpu_only_daemon_publishes_cpu_family_with_zero_tpu_labels(
+    tmp_path, monkeypatch
+):
+    """ACCEPTANCE: --backends=cpu on a CPU-only machine publishes
+    node.features/cpu.* through the full supervised daemon path with
+    zero TPU labels, and the obs server scrapes
+    tfd_backend_up{backend="cpu"} == 1. The REAL jax cpu platform is
+    enumerated (the virtual 8-device mesh pinned by conftest), in
+    process (--probe-isolation=none keeps jax out of forked children
+    under pytest)."""
+    import urllib.request
+
+    from slice_fixture import free_port
+
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.reset_for_tests()
+    monkeypatch.delenv(factory.BACKEND_ENV, raising=False)
+    machine = tmp_path / "machine-type"
+    machine.write_text("Google Compute Engine\n")
+    out = tmp_path / "tfd"
+    port = free_port()
+    config = new_config(
+        cli_values={
+            "oneshot": False,
+            "machine-type-file": str(machine),
+            "output-file": str(out),
+            "backends": "cpu",
+            "sleep-interval": "0.01s",
+            "probe-isolation": "none",
+            "metrics-addr": "127.0.0.1",
+            "metrics-port": str(port),
+        },
+        environ={},
+    )
+    sigs, result = queue.Queue(), {}
+    t = _run_daemon(config, sigs, result)
+    try:
+        assert _wait_until(
+            lambda: FAMILY_COUNT_KEYS["cpu"] in _read_labels(out)
+        ), f"cpu labels never appeared: {_read_labels(out)}"
+        labels = _read_labels(out)
+        assert int(labels[FAMILY_COUNT_KEYS["cpu"]]) >= 1
+        assert labels["node.features/cpu.product"]
+        tpu_keys = [k for k in labels if k.startswith("google.com/tpu.")]
+        assert not tpu_keys, f"cpu-only daemon leaked TPU labels: {tpu_keys}"
+        # The node-level liveness stamp still publishes.
+        assert "google.com/tfd.timestamp" in labels
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/metrics", timeout=5
+        ) as resp:
+            exposition = resp.read().decode()
+        assert 'tfd_backend_up{backend="cpu"} 1' in exposition
+    finally:
+        _stop_daemon(t, sigs, result)
+
+
+# ---------------------------------------------------------------------------
+# 4. per-family degradation + broker keying
+# ---------------------------------------------------------------------------
+
+def test_one_backend_fault_degrades_only_its_family(tmp_path, monkeypatch):
+    """ACCEPTANCE: injected pjrt_init failure on one backend degrades
+    only that family's labels while the other backend's family keeps
+    publishing fresh — then converges once the budget drains. Runs at
+    the daemon defaults (probe isolation subprocess + broker on), so
+    the acquisition goes through per-backend broker workers."""
+    from gpu_feature_discovery_tpu import sandbox
+
+    monkeypatch.delenv(factory.BACKEND_ENV, raising=False)
+    machine = tmp_path / "machine-type"
+    machine.write_text("Google Compute Engine\n")
+    out = tmp_path / "tfd"
+    config = new_config(
+        cli_values={
+            "oneshot": False,
+            "machine-type-file": str(machine),
+            "output-file": str(out),
+            "backends": "mock-gpu:2,mock-cpu:4",
+            "sleep-interval": "0.01s",
+            "init-backoff-max": "0.02s",
+            "metrics-port": "0",
+        },
+        environ={},
+    )
+    faults.load_fault_spec("pjrt_init.cpu:fail:2")
+    sigs, result = queue.Queue(), {}
+    t = _run_daemon(config, sigs, result)
+    cpu_marker = FAMILY_DEGRADED_LABELS["cpu"]
+    gpu_held = []
+    try:
+        def saw_degraded():
+            labels = _read_labels(out)
+            if labels.get(cpu_marker) == "true":
+                gpu_held.append("nvidia.com/gpu.count" in labels)
+                return True
+            return False
+
+        assert _wait_until(saw_degraded), "cpu family never degraded"
+        assert all(gpu_held), (
+            "gpu family stopped publishing while cpu was degraded"
+        )
+
+        def converged():
+            labels = _read_labels(out)
+            return (
+                FAMILY_COUNT_KEYS["cpu"] in labels
+                and cpu_marker not in labels
+                and FAMILY_COUNT_KEYS["gpu"] in labels
+            )
+
+        assert _wait_until(converged), (
+            f"cpu family never recovered: {_read_labels(out)}"
+        )
+        labels = _read_labels(out)
+        # No cross-family or node-level degraded markers survive.
+        assert DEGRADED_LABEL not in labels
+        assert FAMILY_DEGRADED_LABELS["gpu"] not in labels
+        # Broker keyed per backend: one live worker per enabled token.
+        assert _wait_until(
+            lambda: len(sandbox.broker._active) == 2, timeout=2.0
+        ), f"expected 2 keyed broker clients, have {list(sandbox.broker._active)}"
+    finally:
+        _stop_daemon(t, sigs, result)
+        faults.reset()
+    # Epoch teardown retired every keyed worker.
+    assert not sandbox.broker._active
+
+
+def test_escalation_only_when_every_backend_down(tmp_path, monkeypatch):
+    """--fail-on-init-error: one exhausted family never exits the
+    daemon; ALL families exhausted raises InitRetriesExhausted."""
+    from gpu_feature_discovery_tpu.cmd.supervisor import InitRetriesExhausted
+
+    monkeypatch.delenv(factory.BACKEND_ENV, raising=False)
+    config = new_config(
+        cli_values={
+            "backends": "mock-gpu:2,mock-cpu:4",
+            "init-retries": "2",
+            "fail-on-init-error": "true",
+        },
+        environ={},
+    )
+    clock = [0.0]
+    bs = registry.BackendSet(
+        ["mock-gpu:2", "mock-cpu:4"], config, clock=lambda: clock[0]
+    )
+    faults.load_fault_spec("pjrt_init.cpu:fail:99")
+    try:
+        for _ in range(3):
+            for rt in bs.runtimes:
+                rt.acquire()
+            clock[0] += 1000.0
+        # cpu exhausted, gpu healthy: no escalation.
+        bs.check_escalation()
+        cpu_rt = next(rt for rt in bs.runtimes if rt.family == "cpu")
+        assert cpu_rt.down and cpu_rt.exhausted
+        # Now the gpu family breaks too.
+        faults.reset()
+        faults.load_fault_spec("pjrt_init.gpu:fail:99,pjrt_init.cpu:fail:99")
+        bs.release_all()
+        for _ in range(3):
+            for rt in bs.runtimes:
+                rt.acquire()
+            clock[0] += 1000.0
+        with pytest.raises(InitRetriesExhausted):
+            bs.check_escalation()
+    finally:
+        faults.reset()
+
+
+# ---------------------------------------------------------------------------
+# collision guard + constant pins
+# ---------------------------------------------------------------------------
+
+def test_family_guard_drops_out_of_namespace_keys(caplog):
+    import logging
+
+    from gpu_feature_discovery_tpu.utils.logging import reset_warn_once
+
+    reset_warn_once()
+    rogue = Labels(
+        {
+            "nvidia.com/gpu.count": "2",
+            "google.com/tpu.count": "8",   # cross-family collision
+            "feature.node/other": "x",
+        }
+    )
+    with caplog.at_level(logging.WARNING, logger="tfd.lm"):
+        guarded = family_guard("gpu", rogue)
+    assert dict(guarded) == {"nvidia.com/gpu.count": "2"}
+    assert "cross-family key-collision guard" in caplog.text
+
+
+def test_family_degraded_label_matches_supervisor_constant():
+    """The tpu family marker and the supervisor's DEGRADED_LABEL are the
+    same key spelled in two modules; this pin stops them drifting."""
+    assert FAMILY_DEGRADED_LABELS["tpu"] == DEGRADED_LABEL
+
+
+def test_registry_tokens_cover_factory_grammar():
+    """Every spelled-out token the pre-registry factory accepted resolves
+    to a tpu-family provider — the re-registration completeness pin."""
+    for token in (
+        "auto", "jax", "pjrt", "native", "hostinfo", "metadata", "null",
+        "mock:v4-8", "mock-slice:v4-8", "mock-worker:v5p-64",
+        "mock-mixed:v5e", "mock-mixed:v5e:2x2,2x4",
+    ):
+        provider = registry.provider_for(token)
+        assert provider is not None, token
+        assert provider.family == registry.FAMILY_TPU, token

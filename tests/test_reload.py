@@ -203,7 +203,7 @@ def test_sighup_closes_broker_gracefully_no_respawn_storm(tmp_path, monkeypatch)
         "gracefully (the respawn storm the sweep exemption prevents)"
     )
     assert obs_metrics.BROKER_UP.value() == 0, "final epoch left the worker up"
-    assert broker_mod._active is None, "close_broker() skipped at epoch end"
+    assert not broker_mod._active, "close_broker() skipped at epoch end"
     # No worker outlived the process's epochs: no zombies, no strays.
     out = subprocess.run(
         ["ps", "--ppid", str(os.getpid()), "-o", "stat="],
