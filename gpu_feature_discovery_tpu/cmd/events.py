@@ -1,0 +1,466 @@
+"""Typed event queue + the event-driven reconcile wait primitive.
+
+The reference GFD shape — generate → atomic write → fixed sleep — makes
+every fault the daemon can *detect* (a dead broker worker, a sick chip,
+a dead peer, a changed config file) invisible for up to a full sleep
+interval, and a hot fleet must choose between over-probing and lagging.
+This module replaces the sleep with a blocking wait on ONE typed event
+queue (``--reconcile=event``, the supervised-daemon default via
+``auto``):
+
+- **Producers** post :class:`Event`\\ s: the OS signal watcher (via
+  :class:`SignalForwarder` — the ``SimpleQueue[int]`` of
+  ``cmd/main.new_os_watcher`` becomes one producer among several), the
+  broker-worker death watcher (``sandbox/broker.py`` posts
+  ``WORKER_DIED`` the moment the long-lived worker exits), the
+  config-file stat watcher (:class:`ConfigFileWatcher` posts
+  ``CONFIG_CHANGED`` — reload is no longer SIGHUP-only), the run loop's
+  own :class:`DeltaTracker` (``HEALTH_DELTA`` on a per-chip verdict or
+  ``chips.sick`` change, ``PEER_DELTA`` on a slice-membership change),
+  and the obs server's authenticated ``POST /probe`` endpoint
+  (``PROBE_REQUEST`` — scrape-triggered refresh).
+- **The wait** (:meth:`ReconcileLoop.wait_for_wake`) blocks with a
+  deadline equal to the demoted interval (``--max-staleness``, default =
+  ``--sleep-interval``); the deadline expiring IS a wake
+  (``STALENESS_BOUND``), so the interval survives as a guarantee instead
+  of a cadence.
+- **Coalescing**: after the first event, a debounce window
+  (``--reconcile-debounce``) absorbs the rest of the burst, and a
+  token-bucket storm guard (``--max-probe-rate``, small fixed burst)
+  defers wakes beyond the rate until a token frees up — one cycle
+  satisfies the whole burst. Absorbed events are COUNTED
+  (``tfd_reconcile_coalesced_total``), never dropped silently, and the
+  staleness deadline always dominates the guard (a starved bucket can
+  delay an event-driven cycle, never the bound).
+- **Decisions preempt**: a forwarded SIGHUP/SIGTERM or a
+  ``CONFIG_CHANGED`` returns restart/shutdown immediately from ANY wait
+  — including the failed-cycle backoff wait
+  (:meth:`ReconcileLoop.wait_backoff`), which under ``interval`` mode is
+  serviced by the signal queue directly.
+
+``--reconcile=interval`` bypasses everything here: ``cmd/main.run``
+keeps the reference's ``_check_signal``/``_wait_for_signal`` path byte
+for byte, and nothing in this module is even constructed.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import queue
+import signal
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Callable, List, Optional, Tuple
+
+from gpu_feature_discovery_tpu.config.flags import (
+    DEFAULT_MAX_PROBE_RATE,
+    DEFAULT_RECONCILE_DEBOUNCE,
+)
+from gpu_feature_discovery_tpu.config.spec import (
+    RECONCILE_AUTO,
+    RECONCILE_EVENT,
+    RECONCILE_INTERVAL,
+)
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+log = logging.getLogger("tfd.events")
+
+# Wake reasons — the tfd_reconcile_wakes_total{reason} vocabulary.
+REASON_SIGNAL = "signal"
+REASON_WORKER_DIED = "worker_died"
+REASON_CONFIG_CHANGED = "config_changed"
+REASON_HEALTH_DELTA = "health_delta"
+REASON_PEER_DELTA = "peer_delta"
+REASON_PROBE_REQUEST = "probe_request"
+REASON_STALENESS_BOUND = "staleness_bound"
+
+# Token-bucket burst allowance: a short legitimate burst (worker died +
+# health delta + a scrape-triggered probe) runs its cycles back to back;
+# anything past it drains at --max-probe-rate. Fixed, not a flag — the
+# rate is the contract, the burst is a comfort margin.
+PROBE_BURST = 3.0
+
+# How often the config-file watcher re-stats the file. One second keeps
+# reload latency human-scale while costing one stat()/s.
+CONFIG_POLL_S = 1.0
+
+
+def resolve_reconcile_mode(config) -> str:
+    """``--reconcile`` resolved to interval|event. ``auto`` (the default)
+    is event for the supervised daemon and interval for oneshot — a
+    one-off labeling Job has no wait to replace."""
+    tfd = config.flags.tfd
+    mode = tfd.reconcile or RECONCILE_AUTO
+    if mode != RECONCILE_AUTO:
+        return mode
+    return RECONCILE_INTERVAL if tfd.oneshot else RECONCILE_EVENT
+
+
+@dataclass(frozen=True)
+class Event:
+    """One reconcile event. ``ts`` is the post time (monotonic) — the
+    start of the wake-to-labels latency the histogram measures."""
+
+    reason: str
+    detail: str = ""
+    signum: Optional[int] = None
+    ts: float = field(default_factory=time.monotonic)
+
+
+@dataclass(frozen=True)
+class Wake:
+    """One wait's outcome: ``decision`` is ``"restart"``/``"shutdown"``
+    (preempting the cycle) or None (run a cycle for ``reasons``).
+    ``first_ts`` is the triggering event's post time (the staleness wake
+    uses the wake itself); ``coalesced`` counts the extra events this
+    wake absorbed."""
+
+    decision: Optional[str]
+    reasons: Tuple[str, ...]
+    first_ts: float
+    coalesced: int = 0
+
+
+class EventQueue:
+    """The one queue every producer posts into. SimpleQueue, NOT
+    queue.Queue, for the same reason as the signal watcher
+    (cmd/main.new_os_watcher): ``put`` must stay reentrant so a future
+    signal-handler producer can never deadlock the loop."""
+
+    def __init__(self):
+        self._q: "queue.SimpleQueue[Event]" = queue.SimpleQueue()
+
+    def post(self, event: Event) -> None:
+        self._q.put(event)
+
+    def get(self, timeout: Optional[float]) -> Optional[Event]:
+        """One event, or None when ``timeout`` (seconds, may be 0)
+        expires."""
+        try:
+            if timeout is None or timeout <= 0:
+                return self._q.get_nowait()
+            return self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+
+    def get_nowait(self) -> Optional[Event]:
+        return self.get(None)
+
+
+# Sentinel the forwarder's stop() injects into the OS signal queue; a
+# plain object so it can never collide with a signal number.
+_STOP = object()
+
+
+class SignalForwarder:
+    """Drains the OS signal queue into the event queue, making the
+    signal watcher one producer among several. Event mode only — under
+    ``interval`` the run loop reads the signal queue directly, so the
+    forwarder must not exist to steal from it.
+
+    ``stop()`` re-injects any signal events still pending on the dying
+    epoch's queue back into the OS signal queue: a SIGTERM that raced
+    the epoch boundary must be serviced by the NEXT reader, not dropped
+    with the old queue."""
+
+    def __init__(self, sigs, events: EventQueue):
+        self._sigs = sigs
+        self._events = events
+        self._stopping = False
+        self._thread = threading.Thread(
+            target=self._run, name="tfd-signal-forwarder", daemon=True
+        )
+
+    def start(self) -> "SignalForwarder":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while True:
+            signum = self._sigs.get()
+            if signum is _STOP:
+                if self._stopping:
+                    return
+                continue  # a stale sentinel from a previous epoch
+            self._events.post(Event(REASON_SIGNAL, signum=signum))
+
+    def stop(self) -> None:
+        self._stopping = True
+        self._sigs.put(_STOP)
+        self._thread.join(timeout=5)
+        while True:
+            event = self._events.get_nowait()
+            if event is None:
+                return
+            if event.reason == REASON_SIGNAL:
+                self._sigs.put(event.signum)
+
+
+class ConfigFileWatcher:
+    """Posts ``CONFIG_CHANGED`` when the config file's (mtime, size,
+    inode) signature moves — config reload is no longer SIGHUP-only. One
+    shot per watcher: the reload rebuilds the epoch (and a fresh
+    watcher) anyway, so a single changed file can never storm the
+    queue."""
+
+    def __init__(
+        self, path: str, events: EventQueue, poll_s: Optional[float] = None
+    ):
+        self._path = path
+        self._events = events
+        self._poll_s = poll_s if poll_s is not None else CONFIG_POLL_S
+        self._stop = threading.Event()
+        self._signature = self._stat()
+        self._thread = threading.Thread(
+            target=self._run, name="tfd-config-watcher", daemon=True
+        )
+
+    def _stat(self):
+        try:
+            st = os.stat(self._path)
+        except OSError:
+            # Missing/unreadable counts as a signature too: the file
+            # REAPPEARING (a configmap remount) is a change.
+            return None
+        return (st.st_mtime_ns, st.st_size, st.st_ino)
+
+    def start(self) -> "ConfigFileWatcher":
+        self._thread.start()
+        return self
+
+    def _run(self) -> None:
+        while not self._stop.wait(self._poll_s):
+            current = self._stat()
+            if current != self._signature:
+                self._signature = current
+                log.info("config file %s changed; requesting reload",
+                         self._path)
+                self._events.post(
+                    Event(REASON_CONFIG_CHANGED, detail=self._path)
+                )
+                return
+
+    def stop(self) -> None:
+        self._stop.set()
+        self._thread.join(timeout=5)
+
+
+# Labels whose movement means the node's HEALTH VERDICT changed — the
+# aggregate and per-chip ok flags, the reduced inventory, the straggler
+# verdict (lm/health.py). Deliberately NOT the whole health family:
+# measurement labels (matmul-tflops, hbm-gbps, probe-ms — which by
+# design appears on fresh probes and is stripped from cached
+# republishes) jitter between probes while the verdicts hold, and a
+# delta producer keyed on them would wake a spurious cycle after every
+# burn-in probe with nothing actually changed.
+HEALTH_DELTA_EXACT = frozenset(
+    (
+        "google.com/tpu.health.ok",
+        "google.com/tpu.health.ici.ok",
+        "google.com/tpu.chips.healthy",
+        "google.com/tpu.chips.sick",
+        "google.com/tpu.straggler-chip",
+    )
+)
+# chip.<i>.ok — the per-chip quarantine verdicts; the per-chip rate
+# labels (chip.<i>.tflops / chip.<i>.hbm-gbps) are measurements and
+# excluded for the same reason as the aggregates.
+_CHIP_OK_PREFIX = "google.com/tpu.chip."
+_CHIP_OK_SUFFIX = ".ok"
+
+
+def health_subset(labels) -> dict:
+    """The verdict-class projection of one cycle's labels."""
+    return {
+        k: v
+        for k, v in labels.items()
+        if k in HEALTH_DELTA_EXACT
+        or (k.startswith(_CHIP_OK_PREFIX) and k.endswith(_CHIP_OK_SUFFIX))
+    }
+
+
+class DeltaTracker:
+    """The run loop's own producers: posts ``HEALTH_DELTA`` when the
+    health projection of the published labels moves between cycles, and
+    ``PEER_DELTA`` when the slice coordinator's reachable-membership
+    fingerprint moves between polls. The FIRST observation only sets the
+    baseline (a fresh epoch's first cycle defines the picture, it does
+    not chase it)."""
+
+    def __init__(self, events: EventQueue):
+        self._events = events
+        self._health: Optional[dict] = None
+        self._peers = None
+
+    def observe_labels(self, labels) -> None:
+        subset = health_subset(labels)
+        if self._health is not None and subset != self._health:
+            changed = [
+                k for k in set(subset) | set(self._health)
+                if subset.get(k) != self._health.get(k)
+            ]
+            self._events.post(
+                Event(
+                    REASON_HEALTH_DELTA,
+                    detail=",".join(sorted(changed)[:4]),
+                )
+            )
+        self._health = subset
+
+    def observe_peers(self, membership) -> None:
+        """``membership`` is the coordinator's reachable-peer fingerprint
+        (None before its first poll round completes)."""
+        if membership is None:
+            return
+        if self._peers is not None and membership != self._peers:
+            self._events.post(
+                Event(REASON_PEER_DELTA, detail=str(sorted(membership)))
+            )
+        self._peers = membership
+
+
+class ReconcileLoop:
+    """The wait primitive: blocks on the queue with the staleness
+    deadline, debounces bursts, and rate-limits event-driven cycles.
+    Single-consumer (the run loop); producers are free-threaded."""
+
+    def __init__(
+        self,
+        events: EventQueue,
+        max_staleness: float,
+        debounce: float = DEFAULT_RECONCILE_DEBOUNCE,
+        max_probe_rate: float = DEFAULT_MAX_PROBE_RATE,
+        burst: float = PROBE_BURST,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._events = events
+        self._max_staleness = max(float(max_staleness), 0.001)
+        self._debounce = max(float(debounce), 0.0)
+        self._rate = float(max_probe_rate)
+        self._burst = max(float(burst), 1.0)
+        self._clock = clock
+        self._tokens = self._burst
+        self._last_refill = clock()
+
+    # -- token bucket ------------------------------------------------------
+
+    def _refill(self) -> None:
+        now = self._clock()
+        self._tokens = min(
+            self._burst, self._tokens + (now - self._last_refill) * self._rate
+        )
+        self._last_refill = now
+
+    # -- decisions ---------------------------------------------------------
+
+    def _decision_for(self, event: Event) -> Optional[str]:
+        """Restart/shutdown verdict for decision-class events (signals,
+        config change); None for ordinary wake events. Mirrors
+        cmd/main._check_signal's vocabulary exactly."""
+        if event.reason == REASON_SIGNAL:
+            obs_metrics.RECONCILE_WAKES.labels(reason=REASON_SIGNAL).inc()
+            if event.signum == signal.SIGHUP:
+                log.info("Received SIGHUP, restarting.")
+                return "restart"
+            log.info("Received signal %s, shutting down.", event.signum)
+            return "shutdown"
+        if event.reason == REASON_CONFIG_CHANGED:
+            obs_metrics.RECONCILE_WAKES.labels(
+                reason=REASON_CONFIG_CHANGED
+            ).inc()
+            log.info("Config file changed, restarting.")
+            return "restart"
+        return None
+
+    # -- the waits ---------------------------------------------------------
+
+    def wait_for_wake(self) -> Wake:
+        """Block until the next cycle is due: an event (debounced,
+        rate-limited), a decision (immediately), or the staleness bound.
+        Never blocks past ``--max-staleness`` + the debounce window."""
+        deadline = self._clock() + self._max_staleness
+        first = self._events.get(deadline - self._clock())
+        if first is None:
+            obs_metrics.RECONCILE_WAKES.labels(
+                reason=REASON_STALENESS_BOUND
+            ).inc()
+            return Wake(None, (REASON_STALENESS_BOUND,), self._clock())
+        decision = self._decision_for(first)
+        if decision is not None:
+            return Wake(decision, (first.reason,), first.ts)
+
+        reasons: List[str] = [first.reason]
+        coalesced = 0
+
+        def _absorb(event: Event) -> None:
+            nonlocal coalesced
+            coalesced += 1
+            obs_metrics.RECONCILE_COALESCED.inc()
+            if event.reason not in reasons:
+                reasons.append(event.reason)
+
+        # Debounce: wait out the rest of the burst so N rapid events
+        # become one cycle. Bounded by the window alone — it is small
+        # against the staleness bound by construction.
+        debounce_end = self._clock() + self._debounce
+        while True:
+            remaining = debounce_end - self._clock()
+            if remaining <= 0:
+                break
+            event = self._events.get(remaining)
+            if event is None:
+                break
+            decision = self._decision_for(event)
+            if decision is not None:
+                return Wake(decision, tuple(reasons), first.ts, coalesced)
+            _absorb(event)
+
+        # Storm guard: an event-driven cycle needs a token; while the
+        # bucket is dry, keep absorbing the storm — but the staleness
+        # deadline dominates (the bound is a guarantee, the guard is
+        # pacing).
+        while True:
+            self._refill()
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                break
+            now = self._clock()
+            if now >= deadline:
+                reasons.append(REASON_STALENESS_BOUND)
+                break
+            wait = min((1.0 - self._tokens) / self._rate, deadline - now)
+            event = self._events.get(wait)
+            if event is not None:
+                decision = self._decision_for(event)
+                if decision is not None:
+                    return Wake(decision, tuple(reasons), first.ts, coalesced)
+                _absorb(event)
+
+        obs_metrics.RECONCILE_WAKES.labels(reason=first.reason).inc()
+        if coalesced:
+            log.debug(
+                "reconcile wake %s coalesced %d event(s)", reasons, coalesced
+            )
+        return Wake(None, tuple(reasons), first.ts, coalesced)
+
+    def wait_backoff(self, delay: float) -> Optional[str]:
+        """The failed-cycle retry wait (and any other bounded pause the
+        loop owes): sleeps up to ``delay`` seconds, returning a decision
+        IMMEDIATELY on a forwarded signal or config change — a SIGTERM
+        during a supervisor backoff must never wait the backoff out.
+        Ordinary events are absorbed (counted coalesced): the retry
+        cycle that follows the backoff satisfies them."""
+        deadline = self._clock() + delay
+        while True:
+            remaining = deadline - self._clock()
+            if remaining <= 0:
+                return None
+            event = self._events.get(remaining)
+            if event is None:
+                return None
+            decision = self._decision_for(event)
+            if decision is not None:
+                return decision
+            obs_metrics.RECONCILE_COALESCED.inc()

@@ -220,6 +220,7 @@ def start(argv: Optional[list] = None) -> int:
                     sigs,
                     supervisor=Supervisor(config),
                     process_state=process_state,
+                    config_file=config_file,
                 )
         except Exception as e:  # noqa: BLE001 - match reference error-to-exit
             log.error("Error: %s", e)
@@ -233,7 +234,7 @@ def start(argv: Optional[list] = None) -> int:
 
 
 def start_introspection_server(
-    config: Config, quiet: bool = False, peer_snapshot=None
+    config: Config, quiet: bool = False, peer_snapshot=None, probe_request=None
 ):
     """Bind the obs introspection server for a daemon epoch; returns
     ``(server, state)`` or ``(None, None)``. Oneshot NEVER serves (a
@@ -265,6 +266,8 @@ def start_introspection_server(
             port=tfd.metrics_port,
             debug_endpoints=bool(tfd.debug_endpoints),
             peer_snapshot=peer_snapshot,
+            probe_request=probe_request,
+            probe_token=tfd.probe_token or "",
         )
     except OSError as e:
         if not quiet:
@@ -416,6 +419,7 @@ def run(
     supervisor: Optional[Supervisor] = None,
     process_state: Optional[dict] = None,
     coordinator=None,
+    config_file: Optional[str] = None,
 ) -> bool:
     """run() (main.go:148-210). Returns True to request a config reload
     (SIGHUP), False for clean exit.
@@ -448,6 +452,22 @@ def run(
     shared os.environ there. None (production) builds one from the
     config + host env per epoch; coordination off resolves to no
     coordinator and the strictly node-local cycle.
+
+    ``config_file`` is the path the config was loaded from (start()
+    passes it); under ``--reconcile=event`` a stat watcher on it posts
+    CONFIG_CHANGED so a changed file reloads the epoch without waiting
+    for a SIGHUP.
+
+    Reconcile shape (cmd/events.py): ``--reconcile=event`` (the
+    supervised-daemon default via ``auto``) blocks the loop on a typed
+    event queue — signals, broker-worker death, config change, health
+    deltas, peer-membership deltas, POST /probe — with
+    ``--max-staleness`` (default = the sleep interval) as the timeout
+    wake, a ``--reconcile-debounce`` coalescing window, and the
+    ``--max-probe-rate`` token bucket as the storm guard.
+    ``--reconcile=interval`` keeps the reference's check-signal +
+    sleep-interval loop byte for byte; none of the event machinery is
+    constructed.
     """
     output_file = config.flags.tfd.output_file
     oneshot = config.flags.tfd.oneshot
@@ -490,10 +510,78 @@ def run(
     peer_snapshot = (
         coordinator.snapshot_payload if coordinator is not None else None
     )
+    # Event-driven reconcile loop (cmd/events.py): --reconcile=event (the
+    # supervised-daemon default via auto) blocks on the typed event queue
+    # instead of sleeping the interval; interval mode constructs NONE of
+    # this and keeps the reference loop byte for byte.
+    from gpu_feature_discovery_tpu.cmd import events as reconcile_events
+    from gpu_feature_discovery_tpu import sandbox as tfd_sandbox
+
+    event_loop = None
+    events_q = None
+    forwarder = None
+    config_watcher = None
+    delta_tracker = None
+    probe_request = None
+    if supervised and (
+        reconcile_events.resolve_reconcile_mode(config)
+        == reconcile_events.RECONCILE_EVENT
+    ):
+        from gpu_feature_discovery_tpu.config.flags import (
+            DEFAULT_MAX_PROBE_RATE,
+            DEFAULT_RECONCILE_DEBOUNCE,
+        )
+
+        tfd = config.flags.tfd
+        events_q = reconcile_events.EventQueue()
+        event_loop = reconcile_events.ReconcileLoop(
+            events_q,
+            # 0 (the default) demotes --sleep-interval to the staleness
+            # bound: one interval flag, one meaning in both modes.
+            max_staleness=tfd.max_staleness or sleep_interval,
+            debounce=(
+                tfd.reconcile_debounce
+                if tfd.reconcile_debounce is not None
+                else DEFAULT_RECONCILE_DEBOUNCE
+            ),
+            max_probe_rate=tfd.max_probe_rate or DEFAULT_MAX_PROBE_RATE,
+        )
+        delta_tracker = reconcile_events.DeltaTracker(events_q)
+        # The signal watcher becomes one producer among several; under
+        # interval mode the loop reads ``sigs`` directly, so the
+        # forwarder must not exist to steal from it.
+        forwarder = reconcile_events.SignalForwarder(sigs, events_q).start()
+        if config_file:
+            config_watcher = reconcile_events.ConfigFileWatcher(
+                config_file, events_q
+            ).start()
+
+        def probe_request():
+            events_q.post(
+                reconcile_events.Event(reconcile_events.REASON_PROBE_REQUEST)
+            )
+
+    if supervised:
+        # Broker-worker death watch (sandbox/broker.py): the reaper-side
+        # thread marks a dead worker dead AT DEATH TIME — so the next
+        # acquisition respawns instead of failing a cycle on a dead pipe
+        # — in BOTH reconcile modes; event mode additionally wakes the
+        # loop with WORKER_DIED.
+        if events_q is not None:
+            def _on_worker_death(backend, detail=""):
+                events_q.post(
+                    reconcile_events.Event(
+                        reconcile_events.REASON_WORKER_DIED,
+                        detail=detail or str(backend or ""),
+                    )
+                )
+        else:
+            _on_worker_death = None
+        tfd_sandbox.set_broker_death_watch(True, listener=_on_worker_death)
     # Introspection server (obs/): daemon epochs only, rebound per epoch
     # so a SIGHUP reload picks up new --metrics-* flags.
     obs_server, obs_state = start_introspection_server(
-        config, peer_snapshot=peer_snapshot
+        config, peer_snapshot=peer_snapshot, probe_request=probe_request
     )
     # Whether THIS epoch has written the output file yet: a failure before
     # the first write must not clobber a previous epoch's still-valid
@@ -553,6 +641,10 @@ def run(
                         obs_state.labels_written(restored, {}, mode="restored")
                     if coordinator is not None:
                         coordinator.publish_local(restored, "restored")
+        # When the cycle about to run was triggered by an event wake,
+        # this carries the triggering event's post time into the cycle so
+        # tfd_wake_to_labels_seconds measures event -> label write.
+        wake_first_ts: Optional[float] = None
         while True:
             # Per-cycle spans only: without the reset, a cached-health
             # cycle would re-report the last probe's cost as current.
@@ -564,7 +656,10 @@ def run(
                 # for the epoch would turn one transient EADDRINUSE into
                 # a kubelet restart loop.
                 obs_server, obs_state = start_introspection_server(
-                    config, quiet=True, peer_snapshot=peer_snapshot
+                    config,
+                    quiet=True,
+                    peer_snapshot=peer_snapshot,
+                    probe_request=probe_request,
                 )
             cycle_mode = "full"
             try:
@@ -690,6 +785,22 @@ def run(
                 labels.write_to_file(output_file)
                 wrote_this_epoch = True
                 obs_metrics.CYCLES_TOTAL.labels(outcome=cycle_mode).inc()
+                if event_loop is not None:
+                    if wake_first_ts is not None:
+                        obs_metrics.WAKE_TO_LABELS.observe(
+                            time.monotonic() - wake_first_ts
+                        )
+                        wake_first_ts = None
+                    # The loop's own producers: a moved health verdict or
+                    # slice membership wakes a prompt follow-up cycle
+                    # (rate-guarded) instead of aging a sleep interval.
+                    delta_tracker.observe_labels(labels)
+                    if coordinator is not None:
+                        delta_tracker.observe_peers(
+                            getattr(
+                                coordinator, "membership_token", lambda: None
+                            )()
+                        )
                 if obs_state is not None:
                     obs_state.labels_written(
                         labels, engine.last_provenance, mode=cycle_mode
@@ -769,11 +880,24 @@ def run(
                 # idle out 60s on a transient), slower than a short one
                 # once failures streak (back off, don't hot-loop).
                 log.info("retrying failed cycle in %.3fs", delay)
-                decision = _wait_for_signal(sigs, delay)
+                if event_loop is None:
+                    decision = _wait_for_signal(sigs, delay)
+                else:
+                    # Event mode: signals live on the EVENT queue now
+                    # (the forwarder owns ``sigs``), so the backoff must
+                    # wait through the same primitive — a SIGTERM during
+                    # a supervisor backoff interrupts immediately instead
+                    # of waiting the backoff out. Ordinary events are
+                    # coalesced into the retry cycle that follows.
+                    decision = event_loop.wait_backoff(delay)
                 if decision == "restart":
                     return True
                 if decision == "shutdown":
                     return False
+                # The retry cycle is backoff-paced, not event-triggered:
+                # a stale wake timestamp must not feed the latency
+                # histogram.
+                wake_first_ts = None
                 continue
             else:
                 if supervised:
@@ -791,18 +915,49 @@ def run(
             if oneshot:
                 return False
 
-            # Phase boundary: a signal that arrived DURING a long cycle
-            # (burn-in probe, straggling labeler) is honored now instead
-            # of waiting out the full sleep interval on top.
-            decision = _check_signal(sigs)
-            if decision is None:
-                log.info("Sleeping for %ss", sleep_interval)
-                decision = _wait_for_signal(sigs, sleep_interval)
+            if event_loop is None:
+                # Phase boundary: a signal that arrived DURING a long
+                # cycle (burn-in probe, straggling labeler) is honored
+                # now instead of waiting out the full sleep interval on
+                # top.
+                decision = _check_signal(sigs)
+                if decision is None:
+                    log.info("Sleeping for %ss", sleep_interval)
+                    decision = _wait_for_signal(sigs, sleep_interval)
+            else:
+                # Event mode: the wait IS the phase boundary — a signal
+                # forwarded during the cycle is already queued and comes
+                # back as the wake's decision.
+                wake = event_loop.wait_for_wake()
+                decision = wake.decision
+                if decision is None:
+                    wake_first_ts = wake.first_ts
+                    log.info(
+                        "reconcile wake: %s%s",
+                        "+".join(wake.reasons),
+                        (
+                            f" ({wake.coalesced} coalesced)"
+                            if wake.coalesced
+                            else ""
+                        ),
+                    )
             if decision == "restart":
                 return True
             if decision == "shutdown":
                 return False
     finally:
+        # Event machinery first: once the forwarder stops, signals land
+        # back on ``sigs`` for the next epoch's reader (stop() re-injects
+        # any already-forwarded signal events — a SIGTERM racing the
+        # epoch boundary is serviced, never dropped with the old queue).
+        if forwarder is not None:
+            forwarder.stop()
+        if config_watcher is not None:
+            config_watcher.stop()
+        # Epoch-scoped like the listener it carries: a stale watcher
+        # firing into a dead epoch's queue would be a silent no-op, but
+        # clearing is cheaper than reasoning about it.
+        tfd_sandbox.set_broker_death_watch(False)
         engine.close()
         # The broker worker is epoch-scoped: a SIGHUP reload must close
         # it GRACEFULLY (shutdown RPC, SIGKILL fallback) so the next

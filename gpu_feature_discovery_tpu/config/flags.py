@@ -21,6 +21,8 @@ from gpu_feature_discovery_tpu.config.spec import (
     PROBE_BROKER_MODES,
     PROBE_ISOLATION_AUTO,
     PROBE_ISOLATION_MODES,
+    RECONCILE_AUTO,
+    RECONCILE_MODES,
     SLICE_COORDINATION_AUTO,
     SLICE_COORDINATION_MODES,
     TOPOLOGY_STRATEGIES,
@@ -29,6 +31,7 @@ from gpu_feature_discovery_tpu.config.spec import (
     parse_config_file,
     parse_fraction as _parse_fraction,
     parse_nonneg_int as _parse_nonneg_int,
+    parse_positive_float as _parse_positive_float,
     parse_positive_int as _parse_positive_int,
 )
 
@@ -85,6 +88,16 @@ DEFAULT_STRAGGLER_THRESHOLD = 0.2
 # deadline miss serves the last-good slice labels, never blocks the
 # node-local path).
 DEFAULT_PEER_TIMEOUT = 2.0
+# Event-driven reconcile loop (cmd/events.py): the staleness bound
+# defaults to the sleep interval (0 = "track --sleep-interval", so the
+# interval flag keeps one meaning in both modes); the debounce window
+# collapses an event burst into one cycle; the token bucket caps
+# event-driven cycles at max-probe-rate per second (with a small fixed
+# burst allowance) so a flapping producer can never turn the daemon into
+# a probe storm.
+DEFAULT_MAX_STALENESS = 0.0
+DEFAULT_RECONCILE_DEBOUNCE = 0.5
+DEFAULT_MAX_PROBE_RATE = 1.0
 
 _DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
 _DURATION_UNITS = {
@@ -501,6 +514,69 @@ FLAG_DEFS: List[FlagDef] = [
         getter=lambda c: _f(c).tfd.backends,
     ),
     FlagDef(
+        name="reconcile",
+        env_vars=("TFD_RECONCILE",),
+        parse=str,
+        default=RECONCILE_AUTO,
+        help="daemon reconcile loop shape (cmd/events.py): 'event' blocks "
+        "on a typed event queue — broker-worker death, config-file "
+        "change, health deltas, peer-membership deltas, authenticated "
+        "POST /probe — with --max-staleness as the fallback bound; "
+        "'interval' reproduces the fixed generate->write->sleep loop "
+        "byte for byte; 'auto' (default) is event for the supervised "
+        "daemon and interval for oneshot",
+        setter=lambda c, v: setattr(_f(c).tfd, "reconcile", v),
+        getter=lambda c: _f(c).tfd.reconcile,
+    ),
+    FlagDef(
+        name="max-staleness",
+        env_vars=("TFD_MAX_STALENESS",),
+        parse=parse_duration,
+        default=DEFAULT_MAX_STALENESS,
+        help="with --reconcile=event, the longest the daemon may go "
+        "without a labeling cycle when no event arrives (Go duration); "
+        "0 (default) tracks --sleep-interval — the interval demoted "
+        "from a fixed sleep to a staleness bound",
+        setter=lambda c, v: setattr(_f(c).tfd, "max_staleness", v),
+        getter=lambda c: _f(c).tfd.max_staleness,
+    ),
+    FlagDef(
+        name="reconcile-debounce",
+        env_vars=("TFD_RECONCILE_DEBOUNCE",),
+        parse=parse_duration,
+        default=DEFAULT_RECONCILE_DEBOUNCE,
+        help="with --reconcile=event, how long a wake waits for the rest "
+        "of an event burst before running the cycle (Go duration); "
+        "events landing inside the window are coalesced into ONE cycle "
+        "and counted in tfd_reconcile_coalesced_total",
+        setter=lambda c, v: setattr(_f(c).tfd, "reconcile_debounce", v),
+        getter=lambda c: _f(c).tfd.reconcile_debounce,
+    ),
+    FlagDef(
+        name="max-probe-rate",
+        env_vars=("TFD_MAX_PROBE_RATE",),
+        parse=_parse_positive_float,
+        default=DEFAULT_MAX_PROBE_RATE,
+        help="with --reconcile=event, token-bucket cap on EVENT-driven "
+        "labeling cycles per second (small fixed burst allowance; "
+        "staleness-bound cycles are not charged); wakes beyond the rate "
+        "are deferred and coalesced, never dropped",
+        setter=lambda c, v: setattr(_f(c).tfd, "max_probe_rate", v),
+        getter=lambda c: _f(c).tfd.max_probe_rate,
+    ),
+    FlagDef(
+        name="probe-token",
+        env_vars=("TFD_PROBE_TOKEN",),
+        parse=str,
+        default="",
+        help="with --reconcile=event, shared secret authenticating "
+        "POST /probe on the introspection server (scrape-triggered "
+        "on-demand refresh); empty (default) answers 403 — the endpoint "
+        "never works unauthenticated",
+        setter=lambda c, v: setattr(_f(c).tfd, "probe_token", v),
+        getter=lambda c: _f(c).tfd.probe_token,
+    ),
+    FlagDef(
         name="state-dir",
         env_vars=("TFD_STATE_DIR",),
         parse=str,
@@ -591,6 +667,12 @@ def new_config(
         raise ConfigError(
             f"invalid probe-broker: {broker!r} "
             f"(want one of {PROBE_BROKER_MODES})"
+        )
+    reconcile = config.flags.tfd.reconcile
+    if reconcile not in RECONCILE_MODES:
+        raise ConfigError(
+            f"invalid reconcile: {reconcile!r} "
+            f"(want one of {RECONCILE_MODES})"
         )
     coordination = config.flags.tfd.slice_coordination
     if coordination not in SLICE_COORDINATION_MODES:

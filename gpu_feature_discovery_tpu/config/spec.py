@@ -59,6 +59,22 @@ PROBE_BROKER_MODES = (
     PROBE_BROKER_AUTO,
 )
 
+# Reconcile-loop modes (cmd/events.py): `event` blocks the daemon loop on
+# a typed event queue (signals, broker-worker death, config-file change,
+# health deltas, peer-membership deltas, authenticated POST /probe) with
+# the sleep interval demoted to a max-staleness bound; `interval`
+# reproduces the reference's generate -> write -> fixed-sleep loop byte
+# for byte; `auto` (the default) is event for the supervised daemon and
+# interval for oneshot.
+RECONCILE_INTERVAL = "interval"
+RECONCILE_EVENT = "event"
+RECONCILE_AUTO = "auto"
+RECONCILE_MODES = (
+    RECONCILE_INTERVAL,
+    RECONCILE_EVENT,
+    RECONCILE_AUTO,
+)
+
 # Cross-host slice coordination modes (peering/): `on` serves the peer
 # snapshot endpoint and publishes slice-scoped labels; `off` reproduces
 # the strictly node-local label output byte for byte; `auto` (the
@@ -191,6 +207,15 @@ class TfdFlags:
     # backend tokens, one per label family ("auto" = the classic
     # TPU-first autodetect, byte-identical to the pre-registry daemon).
     backends: Optional[str] = None  # e.g. "tpu,gpu,cpu" | "auto"
+    # Event-driven reconcile loop (cmd/events.py): the daemon blocks on a
+    # typed event queue instead of a fixed sleep; the interval becomes a
+    # max-staleness bound, event bursts are debounced into one cycle, and
+    # a token bucket caps the event-driven probe rate.
+    reconcile: Optional[str] = None  # interval | event | auto
+    max_staleness: Optional[float] = None  # seconds; 0 = --sleep-interval
+    reconcile_debounce: Optional[float] = None  # seconds
+    max_probe_rate: Optional[float] = None  # event-driven cycles per second
+    probe_token: Optional[str] = None  # "" = POST /probe disabled
 
 
 @dataclass
@@ -255,6 +280,19 @@ class Config:
                     "sliceCoordination": self.flags.tfd.slice_coordination,
                     "peerTimeout": self.flags.tfd.peer_timeout,
                     "backends": self.flags.tfd.backends,
+                    "reconcile": self.flags.tfd.reconcile,
+                    "maxStaleness": self.flags.tfd.max_staleness,
+                    "reconcileDebounce": self.flags.tfd.reconcile_debounce,
+                    "maxProbeRate": self.flags.tfd.max_probe_rate,
+                    # The POST /probe shared secret: to_dict() feeds the
+                    # startup config dump (logged at INFO every epoch),
+                    # so the value must never appear — only whether one
+                    # is configured.
+                    "probeToken": (
+                        "<redacted>"
+                        if self.flags.tfd.probe_token
+                        else self.flags.tfd.probe_token
+                    ),
                 },
             },
             "sharing": {
@@ -308,6 +346,19 @@ def parse_nonneg_int(value: Any) -> int:
     if n < 0:
         raise ConfigError(f"value must be >= 0: {value!r}")
     return n
+
+
+def parse_positive_float(value: Any) -> float:
+    """Strict positive-float parsing (the token-bucket refill rate: 0
+    would never grant a token — the staleness bound alone would cycle —
+    so it is a config error, not a tuning choice)."""
+    try:
+        f = float(str(value).strip())
+    except ValueError as e:
+        raise ConfigError(f"invalid number: {value!r}") from e
+    if f <= 0.0:
+        raise ConfigError(f"value must be > 0: {value!r}")
+    return f
 
 
 def parse_fraction(value: Any) -> float:
@@ -401,6 +452,18 @@ def parse_config_file(path: str) -> Config:
     if tfd.get("peerTimeout") is not None:
         config.flags.tfd.peer_timeout = parse_duration(tfd["peerTimeout"])
     config.flags.tfd.backends = _opt_str(tfd.get("backends"))
+    config.flags.tfd.reconcile = _opt_str(tfd.get("reconcile"))
+    if tfd.get("maxStaleness") is not None:
+        config.flags.tfd.max_staleness = parse_duration(tfd["maxStaleness"])
+    if tfd.get("reconcileDebounce") is not None:
+        config.flags.tfd.reconcile_debounce = parse_duration(
+            tfd["reconcileDebounce"]
+        )
+    if tfd.get("maxProbeRate") is not None:
+        config.flags.tfd.max_probe_rate = parse_positive_float(
+            tfd["maxProbeRate"]
+        )
+    config.flags.tfd.probe_token = _opt_str(tfd.get("probeToken"))
 
     config.resources = raw.get("resources", {}) or {}
     config.sharing = Sharing.from_dict(raw.get("sharing", {}) or {})

@@ -53,6 +53,32 @@ LAST_CYCLE_COMPLETED = REGISTRY.gauge(
     "re-served) — the same event that touches the heartbeat file.",
 )
 
+# -- event-driven reconcile loop (cmd/events.py, --reconcile) ----------------
+
+RECONCILE_WAKES = REGISTRY.counter(
+    "tfd_reconcile_wakes_total",
+    "Event-loop wakes by reason: signal, worker_died (broker worker "
+    "death), config_changed, health_delta, peer_delta, probe_request "
+    "(POST /probe), staleness_bound (--max-staleness expired with no "
+    "event). One wake per cycle decision; the events a wake absorbed "
+    "beyond the first are in tfd_reconcile_coalesced_total.",
+    labelnames=("reason",),
+)
+RECONCILE_COALESCED = REGISTRY.counter(
+    "tfd_reconcile_coalesced_total",
+    "Events absorbed into an already-pending wake — the debounce window, "
+    "the token-bucket deferral, and the failed-cycle backoff wait all "
+    "coalesce bursts into one cycle; suppressed wakes are counted here, "
+    "never dropped silently.",
+)
+WAKE_TO_LABELS = REGISTRY.histogram(
+    "tfd_wake_to_labels_seconds",
+    "Latency from the wake-triggering event to the cycle's label write "
+    "(for staleness-bound wakes, from the wake itself) — the bound the "
+    "event loop exists to shrink: label latency tracks event "
+    "propagation, not the sleep interval.",
+)
+
 # -- backend init / degraded mode (resource/factory.py, cmd/supervisor.py) --
 
 BACKEND_INIT_ATTEMPTS = REGISTRY.counter(

@@ -25,6 +25,13 @@ Endpoint semantics:
   versioned JSON. Served only while slice coordination built a
   coordinator (gated independently of ``--debug-endpoints`` — peers
   depend on it for correctness); 404 otherwise.
+- ``POST /probe`` — on-demand reconcile wake (``--reconcile=event``,
+  cmd/events.py): authenticated by the ``--probe-token`` shared secret
+  (``X-TFD-Probe-Token`` header or ``Authorization: Bearer``), answers
+  202 and posts a PROBE_REQUEST event the loop debounces and
+  rate-guards like any other wake. 404 without an event loop, 403
+  without a configured token (never unauthenticated — the server is
+  node-network exposed), 401 on a mismatch.
 
 An exception inside any endpoint handler answers 500 with the error
 class name (and counts in ``tfd_http_errors_total{endpoint}``) instead
@@ -37,6 +44,7 @@ a SIGHUP reload rebinds cleanly.
 
 from __future__ import annotations
 
+import hmac
 import json
 import logging
 import threading
@@ -150,7 +158,12 @@ _KNOWN_ENDPOINTS = (
     "/readyz",
     "/debug/labels",
     "/peer/snapshot",
+    "/probe",
 )
+
+# Largest POST /probe body the handler drains to keep the keep-alive
+# connection parseable; anything bigger closes the connection instead.
+_MAX_PROBE_BODY = 65536
 
 
 def _endpoint_label(path: str) -> str:
@@ -166,6 +179,8 @@ def _make_handler(
     state: IntrospectionState,
     debug_endpoints: bool,
     peer_snapshot: Optional[Callable[[], Dict[str, Any]]] = None,
+    probe_request: Optional[Callable[[], None]] = None,
+    probe_token: str = "",
 ):
     class _Handler(BaseHTTPRequestHandler):
         # Content-Length is always sent, so keep-alive is safe.
@@ -190,6 +205,65 @@ def _make_handler(
                     # The connection itself is gone (client hung up
                     # mid-reply); nothing left to answer on.
                     self.close_connection = True
+
+        def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
+            path = urlsplit(self.path).path
+            try:
+                self._dispatch_post(path)
+            except Exception as e:  # noqa: BLE001 - handler containment
+                metrics.HTTP_ERRORS.labels(endpoint=_endpoint_label(path)).inc()
+                log.warning("handler for POST %s raised:", path, exc_info=True)
+                try:
+                    self._reply(500, f"{type(e).__name__}\n".encode())
+                except OSError:
+                    self.close_connection = True
+
+        def _dispatch_post(self, path: str):
+            if path != "/probe" or probe_request is None:
+                # The hook only exists under --reconcile=event (daemon
+                # mode): without an event loop there is nothing a probe
+                # request could wake.
+                self._drain_body()
+                self._reply(404, b"not found\n")
+                return
+            self._drain_body()
+            if not probe_token:
+                # No token configured = endpoint OFF. The server listens
+                # on 0.0.0.0 (hostPort-exposed in the manifests): an
+                # unauthenticated probe trigger would hand the node
+                # network a free probe-storm lever, so the endpoint never
+                # works without the shared secret.
+                self._reply(
+                    403, b"probe endpoint disabled: --probe-token not set\n"
+                )
+                return
+            provided = self.headers.get("X-TFD-Probe-Token", "")
+            auth = self.headers.get("Authorization", "")
+            if not provided and auth.startswith("Bearer "):
+                provided = auth[len("Bearer "):]
+            if not hmac.compare_digest(
+                provided.encode(), probe_token.encode()
+            ):
+                self._reply(401, b"unauthorized\n")
+                return
+            probe_request()
+            # 202: the refresh is QUEUED — the reconcile loop debounces
+            # and rate-guards it like any other wake; the label file is
+            # the result surface.
+            self._reply(202, b"probe scheduled\n")
+
+        def _drain_body(self):
+            """Consume the request body so keep-alive framing survives;
+            an oversized body closes the connection instead."""
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+            except ValueError:
+                length = 0
+            if length > _MAX_PROBE_BODY:
+                self.close_connection = True
+                length = 0
+            if length:
+                self.rfile.read(length)
 
         def _dispatch(self, path: str):
             if path == "/metrics":
@@ -270,10 +344,19 @@ class IntrospectionServer:
         port: int = 0,
         debug_endpoints: bool = True,
         peer_snapshot: Optional[Callable[[], Dict[str, Any]]] = None,
+        probe_request: Optional[Callable[[], None]] = None,
+        probe_token: str = "",
     ):
         self._httpd = ThreadingHTTPServer(
             (addr, port),
-            _make_handler(registry, state, debug_endpoints, peer_snapshot),
+            _make_handler(
+                registry,
+                state,
+                debug_endpoints,
+                peer_snapshot,
+                probe_request=probe_request,
+                probe_token=probe_token,
+            ),
         )
         self._httpd.daemon_threads = True
         self.addr = self._httpd.server_address[0]

@@ -199,6 +199,12 @@ class SliceCoordinator:
         self._local_labels: Dict[str, str] = {}
         self._local_mode: Optional[str] = None
         self._generation = 0
+        # Reachable-membership fingerprint as of the last completed poll
+        # round; read by the run loop's peer-delta producer
+        # (cmd/events.DeltaTracker) from the main thread while the NEXT
+        # round may already be polling on the engine thread — hence
+        # stored under the serving lock, not read from _peer_state.
+        self._membership: Optional[frozenset] = None
 
     # -- serving side (obs server) ----------------------------------------
 
@@ -286,6 +292,21 @@ class SliceCoordinator:
                 obs_metrics.PEER_POLL_DURATION.observe(
                     time.perf_counter() - started
                 )
+        token = frozenset(
+            p.worker_id
+            for p in self._peers
+            if not self._peer_state[p.worker_id].confirmed_down
+        )
+        with self._lock:
+            self._membership = token
+
+    def membership_token(self) -> Optional[frozenset]:
+        """Reachable-peer fingerprint as of the last poll round (None
+        before the first round completes). A moved fingerprint is the
+        run loop's PEER_DELTA wake: slice labels re-derive on the next
+        cycle instead of aging a sleep interval."""
+        with self._lock:
+            return self._membership
 
     def _fetch(self, peer: PeerEndpoint, timeout: float) -> Dict[str, Any]:
         # stdlib only, same as the obs server's own consumers; the

@@ -88,6 +88,46 @@ MAX_FRAME_BYTES = 32 << 20
 # request before escalating to SIGKILL.
 GRACEFUL_CLOSE_S = 2.0
 
+# ---------------------------------------------------------------------------
+# worker death watch (the daemon loop's reaper-side producer)
+# ---------------------------------------------------------------------------
+# Worker death used to be discovered only on the NEXT RPC: the dead pipe
+# failed a whole labeling cycle, and recovery waited out a supervisor
+# backoff on top. With the watch enabled (cmd/main.run enables it for
+# every supervised epoch, in BOTH reconcile modes), a reaper-side thread
+# blocks in waitid(WNOWAIT) on the live worker and — the moment it exits
+# uncommanded — marks the client dead AT DEATH TIME, so the next
+# acquisition respawns and SERVES instead of failing a cycle first. The
+# optional listener is the event loop's WORKER_DIED producer
+# (cmd/events.py): under --reconcile=event the death itself wakes a
+# cycle, bounding kill-to-fresh-labels by event propagation instead of
+# the sleep interval.
+#
+# Deliberately OFF for direct BrokerClient embedders (tests, bench): the
+# proactive reap changes how a death surfaces (respawn-and-serve vs a
+# BrokerCrash on the next request), and that is the daemon loop's
+# contract to opt into, not a library default.
+
+_watch_lock = threading.Lock()
+_watch_enabled = False
+_death_listener = None
+
+
+def set_broker_death_watch(enabled, listener=None):
+    """Enable/disable the death watch for workers spawned from now on
+    (cmd/main.run: enabled per supervised epoch, cleared in its finally).
+    ``listener(backend, signame)`` is called — outside every broker lock
+    — after a death was observed and the client marked dead."""
+    global _watch_enabled, _death_listener
+    with _watch_lock:
+        _watch_enabled = bool(enabled)
+        _death_listener = listener if enabled else None
+
+
+def _death_watch_state():
+    with _watch_lock:
+        return _watch_enabled, _death_listener
+
 
 class BrokerError(ProbeError):
     """The broker could not serve the request (worker dead/unspawnable)."""
@@ -672,6 +712,14 @@ class BrokerClient:
             duration * 1e3,
             " (respawn)" if respawn else "",
         )
+        watch_enabled, _ = _death_watch_state()
+        if watch_enabled and hasattr(os, "waitid"):
+            threading.Thread(
+                target=self._watch_worker,
+                args=(pid,),
+                name="tfd-broker-death-watch",
+                daemon=True,
+            ).start()
 
     def _spawn_failed(self, now: float) -> None:
         self._spawn_failures += 1
@@ -719,6 +767,57 @@ class BrokerClient:
                 pass
         self._stderr_path = None
         obs_metrics.BROKER_UP.set(0)
+
+    # -- death watch -------------------------------------------------------
+
+    def _watch_worker(self, pid: int) -> None:
+        """Reaper-side death watch: block until the worker exits, leaving
+        it reapable (WNOWAIT — the observing path still owns the reap and
+        its status classification), then notice the death. ChildProcess-
+        Error means someone else already reaped it — a request, a close,
+        a recycle — and _notice_death's pid check makes the notice a
+        no-op either way."""
+        try:
+            os.waitid(os.P_PID, pid, os.WEXITED | os.WNOWAIT)
+        except (ChildProcessError, OSError):
+            pass
+        self._notice_death(pid)
+
+    def _notice_death(self, pid: int) -> None:
+        """A worker exited UNCOMMANDED between requests: observe it now —
+        kill/reap through the registry discipline, mark the client dead —
+        so the respawn clock starts at death time, not at next use: the
+        next acquisition goes straight to a spawn and the cycle SERVES,
+        instead of failing on a dead pipe and waiting out a supervisor
+        backoff first. Serialized under the request lock, so a death a
+        request is concurrently observing (or a graceful close/recycle,
+        which both hold the lock) wins the race and this is a no-op."""
+        from gpu_feature_discovery_tpu import sandbox
+        from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+        with self._lock:
+            with self._pid_lock:
+                if self._pid != pid:
+                    return
+            sandbox.probe.kill_if_live(pid)
+            status = self._reap(pid)
+            self._mark_dead()
+            signame = ""
+            if status is not None and os.WIFSIGNALED(status):
+                obs_metrics.PROBE_CRASHES.inc()
+                signame = signal.Signals(os.WTERMSIG(status)).name
+        log.warning(
+            "broker worker %d died%s between requests; marked dead "
+            "(respawn on next acquisition)",
+            pid,
+            f" to {signame}" if signame else "",
+        )
+        _, listener = _death_watch_state()
+        if listener is not None:
+            # Outside every broker lock: the listener posts into the
+            # reconcile event queue and must never be able to deadlock
+            # against an in-flight request.
+            listener(self._backend, signame)
 
     # -- the RPC ----------------------------------------------------------
 

@@ -27,6 +27,15 @@ every survivor's node-local labels untouched; ``slice:leader-failover``
 kills the leader and asserts the next-lowest worker promotes itself and
 publishes fresh slice labels within 2 poll intervals.
 
+``reconcile:broker-death`` is likewise not a fault spec: it SIGKILLs the
+long-lived broker worker of an EVENT-mode daemon whose sleep interval is
+pinned at 60s — only the WORKER_DIED wake (cmd/events.py) can explain a
+prompt recovery — and asserts fresh full labels (a completed full cycle
+against a respawned worker) within 2x ``--probe-timeout`` of the kill,
+with zero failed cycles (the death watch marks the client dead at death
+time, so the wake's cycle respawns and SERVES instead of failing on a
+dead pipe first).
+
 Runs hermetically on CPU (mock backend, no metadata) in well under 10s;
 tests/test_chaos.py executes the same entry point in-process for every
 matrix row, so the CI job and the unit suite cannot drift.
@@ -129,6 +138,133 @@ def run_slice_chaos(scenario, workdir, timeout_s=None):
     }
 
 
+def run_reconcile_chaos(scenario, workdir, timeout_s=None):
+    """reconcile:broker-death (module docstring): kill the broker worker
+    under a 60s sleep interval; the event path must recover within 2x
+    --probe-timeout. Runs the REAL supervised loop with the real broker;
+    metrics are read in-process (the driver and the daemon share the
+    registry), so the evidence is the same tfd_* series an operator
+    would scrape."""
+    import gpu_feature_discovery_tpu.cmd.main as cmd_main
+    from gpu_feature_discovery_tpu import sandbox
+    from gpu_feature_discovery_tpu.cmd.main import run
+    from gpu_feature_discovery_tpu.cmd.supervisor import Supervisor
+    from gpu_feature_discovery_tpu.config import new_config
+    from gpu_feature_discovery_tpu.lm.labeler import Empty
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+    if scenario != "broker-death":
+        raise ValueError(f"unknown reconcile chaos scenario {scenario!r}")
+    probe_timeout_s = 2.0
+    budget = timeout_s or 30.0
+    machine = os.path.join(workdir, "machine-type")
+    with open(machine, "w") as f:
+        f.write("Google Compute Engine\n")
+    out = os.path.join(workdir, "tfd")
+    obs_metrics.reset_for_tests()
+    config = new_config(
+        cli_values={
+            "oneshot": False,
+            "output-file": out,
+            "machine-type-file": machine,
+            # The whole point: the interval alone could NOT recover in
+            # budget — only the WORKER_DIED wake explains the latency.
+            "sleep-interval": "60s",
+            "reconcile": "event",
+            "reconcile-debounce": "0.05s",
+            "probe-timeout": f"{probe_timeout_s}s",
+            "init-backoff-max": "0.02s",
+            "metrics-port": "0",
+        },
+        environ={},
+    )
+    saved_backend = os.environ.get("TFD_BACKEND")
+    os.environ["TFD_BACKEND"] = "mock:v4-8"
+    sigs = queue.Queue()
+    result = {}
+
+    def target():
+        try:
+            result["restart"] = run(
+                lambda: cmd_main._build_manager(config),
+                Empty(),
+                config,
+                sigs,
+                supervisor=Supervisor(config),
+            )
+        except BaseException as e:  # noqa: BLE001 - reported as violation
+            result["error"] = e
+
+    t = threading.Thread(target=target)
+    started = time.monotonic()
+    t.start()
+    try:
+        deadline = started + budget
+
+        def full_cycles():
+            return obs_metrics.CYCLES_TOTAL.value(outcome="full")
+
+        while time.monotonic() < deadline and (
+            full_cycles() < 1 or obs_metrics.BROKER_UP.value() != 1
+        ):
+            time.sleep(POLL_S)
+        assert full_cycles() >= 1, (
+            f"daemon never served a full cycle: {result.get('error')!r}"
+        )
+        full_before = full_cycles()
+        client = sandbox.get_broker(config)
+        pid = client.pid
+        assert pid is not None, "no live broker worker to kill"
+        t_kill = time.monotonic()
+        os.kill(pid, signal.SIGKILL)
+        recovery_budget = 2 * probe_timeout_s
+        while time.monotonic() - t_kill < recovery_budget:
+            if (
+                full_cycles() > full_before
+                and obs_metrics.BROKER_UP.value() == 1
+            ):
+                break
+            time.sleep(POLL_S)
+        elapsed_kill = time.monotonic() - t_kill
+        assert (
+            full_cycles() > full_before
+            and obs_metrics.BROKER_UP.value() == 1
+        ), (
+            f"no fresh full cycle within 2x probe-timeout "
+            f"({recovery_budget:.1f}s) of the worker kill"
+        )
+        assert obs_metrics.RECONCILE_WAKES.value(reason="worker_died") >= 1, (
+            "recovery happened without a WORKER_DIED wake — the 60s "
+            "interval cannot explain it, so what did?"
+        )
+        assert obs_metrics.BROKER_RESPAWNS.value() >= 1
+        # The death watch observed the kill between requests: the wake's
+        # cycle respawned and SERVED — no failed cycle, no reserve.
+        assert obs_metrics.CYCLES_TOTAL.value(outcome="failed") == 0, (
+            "the kill cost a failed cycle — death was discovered on the "
+            "RPC, not by the watch"
+        )
+        labels = read_labels(out)
+        assert "google.com/tpu.count" in labels, labels
+        assert "error" not in result, result.get("error")
+        assert t.is_alive(), "daemon loop ended without error or signal"
+    finally:
+        sigs.put(signal.SIGTERM)
+        t.join(timeout=10)
+        if saved_backend is None:
+            os.environ.pop("TFD_BACKEND", None)
+        else:
+            os.environ["TFD_BACKEND"] = saved_backend
+    assert not t.is_alive(), "daemon did not honor SIGTERM"
+    assert result.get("restart") is False
+    assert not os.path.exists(out), "clean shutdown must remove the file"
+    return {
+        "spec": f"reconcile:{scenario}",
+        "converged_s": round(elapsed_kill, 3),
+        "labels": len(labels),
+    }
+
+
 def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
               assert_probe_kills=None, expect_transient=None,
               expect_final=None, expect_absent=None, timeout_s=None,
@@ -185,6 +321,13 @@ def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
         # Multi-daemon slice chaos: no fault spec to arm — the "fault"
         # is a real daemon death inside the in-process slice.
         return run_slice_chaos(
+            spec.partition(":")[2], workdir, timeout_s=timeout_s
+        )
+    if spec.startswith("reconcile:"):
+        # Event-loop chaos: the "fault" is a real SIGKILL of the broker
+        # worker; the contract is wake-driven recovery, not fault-spec
+        # convergence.
+        return run_reconcile_chaos(
             spec.partition(":")[2], workdir, timeout_s=timeout_s
         )
     chip_faults = any(

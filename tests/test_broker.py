@@ -701,3 +701,90 @@ def test_kill_child_reaches_worker_mid_spawn(tmp_path, monkeypatch):
         )
     finally:
         client.close()
+
+
+# ---------------------------------------------------------------------------
+# the death watch (ISSUE 9 satellite: respawn clock starts at death time)
+# ---------------------------------------------------------------------------
+
+def test_death_watch_marks_dead_at_death_time_and_respawn_serves(
+    tmp_path, monkeypatch
+):
+    """With the watch on (the daemon loop enables it for every supervised
+    epoch, in BOTH reconcile modes), an uncommanded worker death is
+    observed AT DEATH TIME: the client marks itself dead with no RPC
+    having failed, so the next acquisition respawns and SERVES — the
+    earlier respawn the satellite pins — instead of raising BrokerCrash
+    into a failed cycle first."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    obs_metrics.reset_for_tests()
+    deaths = []
+    sandbox.set_broker_death_watch(
+        True, listener=lambda backend, signame: deaths.append(signame)
+    )
+    client = BrokerClient(cfg(tmp_path))
+    try:
+        assert client.ping()
+        pid = client.pid
+        os.kill(pid, signal.SIGKILL)
+        assert wait_until(lambda: not client.alive, timeout=5), (
+            "death watch never marked the client dead"
+        )
+        assert not _pid_alive(pid), "watcher must reap the dead worker"
+        # The listener fires outside the broker locks, a hair after the
+        # alive flip — wait for it rather than racing it.
+        assert wait_until(lambda: deaths, timeout=5), "listener never fired"
+        assert deaths == ["SIGKILL"], deaths
+        # The respawn clock started at death time: this use goes straight
+        # to a spawn and serves (no BrokerCrash, no failed acquisition).
+        assert client.ping()
+        assert client.pid != pid
+        assert obs_metrics.BROKER_RESPAWNS.value() == 1
+    finally:
+        sandbox.set_broker_death_watch(False)
+        client.close()
+
+
+def test_death_watch_ignores_graceful_close_and_recycle(tmp_path, monkeypatch):
+    """Commanded exits are not deaths: neither a graceful close nor a
+    --broker-max-requests recycle may fire the listener (a listener-fired
+    WORKER_DIED would wake a pointless cycle on every SIGHUP reload)."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    deaths = []
+    sandbox.set_broker_death_watch(
+        True, listener=lambda backend, signame: deaths.append(signame)
+    )
+    client = BrokerClient(cfg(tmp_path, **{"broker-max-requests": "1"}))
+    try:
+        assert client.ping()  # served 1 -> recycled at the cap
+        assert client.ping()  # fresh worker, recycled again
+        client.close()
+        time.sleep(0.3)  # give a misfiring watcher time to surface
+        assert deaths == [], (
+            f"graceful close/recycle fired the death listener: {deaths}"
+        )
+    finally:
+        sandbox.set_broker_death_watch(False)
+        client.close()
+
+
+def test_death_watch_off_keeps_the_discover_on_next_rpc_contract(
+    tmp_path, monkeypatch
+):
+    """Direct embedders (watch off, the library default) keep the PR 5
+    behavior byte for byte: the death is discovered on the next RPC as a
+    BrokerCrash (test_broker_worker_dies_to_sigterm_not_parent_queue pins
+    the full shape); the watch is strictly opt-in."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    client = BrokerClient(cfg(tmp_path))
+    try:
+        assert client.ping()
+        pid = client.pid
+        os.kill(pid, signal.SIGKILL)
+        time.sleep(0.3)  # a (wrongly) armed watcher would reap in here
+        assert client.alive, "watch off: death must NOT be pre-observed"
+        with pytest.raises(BrokerCrash):
+            client.ping()
+        assert client.ping()  # and the next use respawns
+    finally:
+        client.close()

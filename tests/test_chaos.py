@@ -55,6 +55,14 @@ CHAOS_SPECS = [
     # OTHER enabled family keeps publishing fresh in every observation,
     # then converge with both families full and clean.
     "pjrt_init.cpu:fail:2",
+    # Event-driven reconcile loop (cmd/events.py, --reconcile): SIGKILL
+    # the long-lived broker worker of an event-mode daemon whose sleep
+    # interval is pinned at 60s — only the WORKER_DIED wake can explain
+    # the recovery — and assert fresh full labels within 2x
+    # --probe-timeout of the kill, with ZERO failed cycles (the death
+    # watch marks the client dead at death time, so the wake's cycle
+    # respawns and serves instead of failing on a dead pipe first).
+    "reconcile:broker-death",
 ]
 
 # Per-spec label expectations + convergence budgets beyond the generic
@@ -91,6 +99,10 @@ CHAOS_EXPECTATIONS = {
         "expect_absent": ["node.features/cpu.tfd.degraded"],
         "timeout_s": 60.0,
     },
+    # Startup (first full cycle + broker spawn) can be slow on a loaded
+    # host; the kill-to-recovery bound itself is 2x probe-timeout and
+    # asserted INSIDE the driver, not via this budget.
+    "reconcile:broker-death": {"timeout_s": 30.0},
 }
 
 

@@ -2,6 +2,8 @@
 versioned file parsing, feature gating. Mirrors the semantics of the vendored
 config/v1 spec the reference relies on (SURVEY.md section 2.4)."""
 
+import json
+
 import pytest
 
 from gpu_feature_discovery_tpu.config import new_config, parse_duration
@@ -189,3 +191,19 @@ def test_env_flag_strict_parse_or_error(monkeypatch):
     monkeypatch.setenv("TFD_HERMETIC", "fals")
     with pytest.raises(ConfigError):
         _env_flag("TFD_HERMETIC")
+
+
+def test_config_to_dict_redacts_probe_token():
+    """to_dict() feeds the startup config dump (logged at INFO every
+    epoch): the POST /probe shared secret must never appear in it —
+    only whether one is configured."""
+    cfg = new_config(environ={"TFD_PROBE_TOKEN": "s3cret"})
+    dumped = json.dumps(cfg.to_dict())
+    assert "s3cret" not in dumped
+    assert cfg.to_dict()["flags"]["tfd"]["probeToken"] == "<redacted>"
+    # The live flag value is untouched — only the dump redacts.
+    assert cfg.flags.tfd.probe_token == "s3cret"
+    # Unset stays honest (empty, not pretend-redacted).
+    assert (
+        new_config(environ={}).to_dict()["flags"]["tfd"]["probeToken"] == ""
+    )

@@ -245,3 +245,92 @@ def test_healthy_manager_ignores_fail_flag(tmp_path, strategy):
     manager = factory.with_config(new_uniform_slice_manager("v4-8"), config)
     out = run_oneshot(manager, config)
     assert "google.com/tpu.count" in Path(out).read_text()
+
+
+# ---------------------------------------------------------------------------
+# reconcile modes (ISSUE 9): interval byte-for-byte, event same labels
+# ---------------------------------------------------------------------------
+
+def _daemon_labels(tmp_path, monkeypatch, tag, **cli):
+    """Run the supervised daemon loop for one cycle and return the label
+    file's raw lines (read while the daemon is alive — exit removes the
+    file)."""
+    import queue as _queue
+
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    out = tmp_path / f"tfd-{tag}"
+    values = {"sleep-interval": "30s", "output-file": str(out),
+              "metrics-port": "0"}
+    values.update(cli)
+    config = cfg_for(tmp_path, oneshot=False, **values)
+    from gpu_feature_discovery_tpu.cmd import main as cmd_main
+    from gpu_feature_discovery_tpu.cmd.supervisor import Supervisor
+
+    sigs = _queue.Queue()
+    result = {}
+
+    def target():
+        result["restart"] = run(
+            lambda: cmd_main._build_manager(config),
+            Empty(),
+            config,
+            sigs,
+            supervisor=Supervisor(config),
+        )
+
+    t = threading.Thread(target=target)
+    t.start()
+    deadline = time.time() + 15
+    while time.time() < deadline and not os.path.exists(out):
+        time.sleep(0.005)
+    assert os.path.exists(out), "daemon never wrote the label file"
+    lines = sorted(
+        l for l in Path(out).read_text().splitlines() if l.strip()
+    )
+    sigs.put(signal.SIGTERM)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert result["restart"] is False
+    return lines, out
+
+
+def test_reconcile_interval_reproduces_the_reference_loop(
+    tmp_path, monkeypatch
+):
+    """--reconcile=interval is the reference daemon byte for byte: the
+    golden label set, AND none of the event machinery is even
+    constructed (a forwarder would steal from the signal queue the
+    interval loop reads directly)."""
+    from gpu_feature_discovery_tpu.cmd import events as reconcile_events
+
+    def _boom(*a, **k):
+        raise AssertionError(
+            "interval mode must not construct the event machinery"
+        )
+
+    monkeypatch.setattr(reconcile_events, "SignalForwarder", _boom)
+    monkeypatch.setattr(reconcile_events, "ReconcileLoop", _boom)
+    lines, out = _daemon_labels(
+        tmp_path, monkeypatch, "interval", reconcile="interval"
+    )
+    golden = tmp_path / "interval-golden"
+    golden.write_text("\n".join(lines) + "\n")
+    check_result(golden, "expected-output.txt")
+
+
+def test_reconcile_event_publishes_the_same_labels(tmp_path, monkeypatch):
+    """The event loop changes WHEN cycles run, never WHAT they publish:
+    the default daemon (auto -> event) matches the same golden, and the
+    non-timestamp label set is identical to interval mode's."""
+    event_lines, _ = _daemon_labels(tmp_path, monkeypatch, "event")
+    interval_lines, _ = _daemon_labels(
+        tmp_path, monkeypatch, "interval2", reconcile="interval"
+    )
+
+    def no_ts(lines):
+        return [l for l in lines if not l.startswith("google.com/tfd.timestamp")]
+
+    assert no_ts(event_lines) == no_ts(interval_lines)
+    golden = tmp_path / "event-golden"
+    golden.write_text("\n".join(event_lines) + "\n")
+    check_result(golden, "expected-output.txt")

@@ -1,0 +1,603 @@
+"""Event-driven reconcile loop tests (ISSUE 9, cmd/events.py).
+
+Layers of evidence, all hermetic on CPU:
+
+1. The wait primitive (ReconcileLoop): staleness-bound wake on an idle
+   queue, debounce coalescing (N rapid events = ONE cycle, the rest
+   counted in tfd_reconcile_coalesced_total), token-bucket storm-guard
+   deferral with the staleness bound dominating, signal/config-change
+   preemption from every wait including the failed-cycle backoff.
+2. The producers: SignalForwarder (signals become one producer among
+   several, with epoch-boundary re-injection), ConfigFileWatcher
+   (CONFIG_CHANGED — reload is no longer SIGHUP-only), DeltaTracker
+   (HEALTH_DELTA / PEER_DELTA, baseline-first semantics).
+3. The daemon integration: POST /probe wakes a cycle against a 60s
+   sleep interval; a changing health verdict wakes follow-up cycles; a
+   changed config file reloads the epoch; SIGTERM during a supervisor
+   BACKOFF wait interrupts immediately (the satellite pin — under event
+   mode the forwarder owns the signal queue, so a backoff serviced by
+   _wait_for_signal would wait the backoff out).
+"""
+
+import queue
+import signal
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+import gpu_feature_discovery_tpu.cmd.main as cmd_main
+from gpu_feature_discovery_tpu.cmd import events as ev
+from gpu_feature_discovery_tpu.cmd.main import run
+from gpu_feature_discovery_tpu.cmd.supervisor import Supervisor
+from gpu_feature_discovery_tpu.config import new_config
+from gpu_feature_discovery_tpu.lm.labeler import Empty
+from gpu_feature_discovery_tpu.lm.labels import Labels
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+from gpu_feature_discovery_tpu.utils import faults
+
+
+@pytest.fixture(autouse=True)
+def _clean_metrics_and_faults():
+    obs_metrics.reset_for_tests()
+    faults.reset()
+    yield
+    faults.reset()
+
+
+def make_loop(events=None, **kw):
+    events = events if events is not None else ev.EventQueue()
+    defaults = dict(max_staleness=5.0, debounce=0.02, max_probe_rate=1000.0)
+    defaults.update(kw)
+    return events, ev.ReconcileLoop(events, **defaults)
+
+
+# ---------------------------------------------------------------------------
+# mode resolution
+# ---------------------------------------------------------------------------
+
+def _cfg_values(**cli):
+    values = {"oneshot": False}
+    values.update(cli)
+    return new_config(cli_values=values, environ={})
+
+
+def test_auto_resolves_event_for_daemon_interval_for_oneshot():
+    assert ev.resolve_reconcile_mode(_cfg_values()) == "event"
+    assert ev.resolve_reconcile_mode(_cfg_values(oneshot=True)) == "interval"
+
+
+def test_forced_mode_wins():
+    assert ev.resolve_reconcile_mode(_cfg_values(reconcile="interval")) == (
+        "interval"
+    )
+    assert ev.resolve_reconcile_mode(_cfg_values(reconcile="event")) == "event"
+
+
+# ---------------------------------------------------------------------------
+# the wait primitive
+# ---------------------------------------------------------------------------
+
+def test_idle_queue_wakes_at_the_staleness_bound():
+    _, loop = make_loop(max_staleness=0.05)
+    t0 = time.monotonic()
+    wake = loop.wait_for_wake()
+    elapsed = time.monotonic() - t0
+    assert wake.decision is None
+    assert wake.reasons == (ev.REASON_STALENESS_BOUND,)
+    assert 0.04 <= elapsed < 3.0
+    assert obs_metrics.RECONCILE_WAKES.value(reason="staleness_bound") == 1
+
+
+def test_event_storm_in_one_debounce_window_is_one_cycle():
+    """The coalescing satellite: N rapid HEALTH_DELTA/PROBE_REQUEST
+    events inside one debounce window produce exactly ONE wake, and
+    tfd_reconcile_coalesced_total accounts for the rest."""
+    events, loop = make_loop(debounce=0.1)
+    n = 10
+    for i in range(n):
+        events.post(
+            ev.Event(
+                ev.REASON_HEALTH_DELTA
+                if i % 2
+                else ev.REASON_PROBE_REQUEST
+            )
+        )
+    wake = loop.wait_for_wake()
+    assert wake.decision is None
+    assert wake.coalesced == n - 1
+    assert set(wake.reasons) == {
+        ev.REASON_HEALTH_DELTA, ev.REASON_PROBE_REQUEST
+    }
+    assert obs_metrics.RECONCILE_COALESCED.value() == n - 1
+    # ONE wake, attributed to the first event's reason.
+    assert obs_metrics.RECONCILE_WAKES.value(reason="probe_request") == 1
+    assert obs_metrics.RECONCILE_WAKES.value(reason="health_delta") == 0
+    # Nothing left behind: the next wait is a clean staleness bound.
+    _, fast = make_loop(events=events, max_staleness=0.03)
+    assert fast.wait_for_wake().reasons == (ev.REASON_STALENESS_BOUND,)
+
+
+def test_signal_preempts_immediately_and_maps_like_check_signal():
+    events, loop = make_loop(max_staleness=30.0)
+    events.post(ev.Event(ev.REASON_SIGNAL, signum=signal.SIGTERM))
+    t0 = time.monotonic()
+    assert loop.wait_for_wake().decision == "shutdown"
+    assert time.monotonic() - t0 < 5.0
+    events.post(ev.Event(ev.REASON_SIGNAL, signum=signal.SIGHUP))
+    assert loop.wait_for_wake().decision == "restart"
+    events.post(ev.Event(ev.REASON_CONFIG_CHANGED))
+    assert loop.wait_for_wake().decision == "restart"
+
+
+def test_signal_inside_the_debounce_window_preempts_the_cycle():
+    events, loop = make_loop(debounce=10.0, max_staleness=30.0)
+    events.post(ev.Event(ev.REASON_PROBE_REQUEST))
+    events.post(ev.Event(ev.REASON_SIGNAL, signum=signal.SIGTERM))
+    t0 = time.monotonic()
+    assert loop.wait_for_wake().decision == "shutdown"
+    assert time.monotonic() - t0 < 5.0
+
+
+def test_token_bucket_defers_wakes_beyond_the_rate():
+    """Storm guard: with the burst spent, an event-driven wake waits for
+    the next token — deferred and coalesced, never dropped."""
+    events, loop = make_loop(
+        debounce=0.0, max_staleness=30.0, max_probe_rate=5.0, burst=1.0
+    )
+    events.post(ev.Event(ev.REASON_PROBE_REQUEST))
+    t0 = time.monotonic()
+    assert loop.wait_for_wake().decision is None  # spends the one token
+    first = time.monotonic() - t0
+    assert first < 3.0
+    events.post(ev.Event(ev.REASON_PROBE_REQUEST))
+    t0 = time.monotonic()
+    wake = loop.wait_for_wake()
+    deferred = time.monotonic() - t0
+    assert wake.decision is None
+    # One token refills in 1/5 s; generous lower margin for clock grain.
+    assert deferred >= 0.1, f"storm guard did not defer ({deferred:.3f}s)"
+
+
+def test_staleness_bound_dominates_a_dry_bucket():
+    """The interval is a GUARANTEE: a starved token bucket delays an
+    event-driven cycle, never past --max-staleness."""
+    events, loop = make_loop(
+        debounce=0.0, max_staleness=0.3, max_probe_rate=0.01, burst=1.0
+    )
+    events.post(ev.Event(ev.REASON_HEALTH_DELTA))
+    assert loop.wait_for_wake().decision is None  # spends the only token
+    events.post(ev.Event(ev.REASON_HEALTH_DELTA))
+    t0 = time.monotonic()
+    wake = loop.wait_for_wake()
+    elapsed = time.monotonic() - t0
+    assert wake.decision is None
+    assert ev.REASON_STALENESS_BOUND in wake.reasons
+    assert elapsed < 5.0, "a dry bucket must not outwait the bound"
+
+
+def test_wait_backoff_interrupts_on_signal_and_absorbs_events():
+    events, loop = make_loop()
+    # Ordinary events are absorbed (counted), the wait runs out.
+    events.post(ev.Event(ev.REASON_PROBE_REQUEST))
+    t0 = time.monotonic()
+    assert loop.wait_backoff(0.05) is None
+    assert time.monotonic() - t0 >= 0.04
+    assert obs_metrics.RECONCILE_COALESCED.value() == 1
+    # A signal interrupts IMMEDIATELY (the satellite contract).
+    def _late_sigterm():
+        time.sleep(0.05)
+        events.post(ev.Event(ev.REASON_SIGNAL, signum=signal.SIGTERM))
+    threading.Thread(target=_late_sigterm, daemon=True).start()
+    t0 = time.monotonic()
+    assert loop.wait_backoff(30.0) == "shutdown"
+    assert time.monotonic() - t0 < 5.0
+
+
+# ---------------------------------------------------------------------------
+# producers
+# ---------------------------------------------------------------------------
+
+def test_signal_forwarder_forwards_and_reinjects_on_stop():
+    sigs = queue.SimpleQueue()
+    events = ev.EventQueue()
+    forwarder = ev.SignalForwarder(sigs, events).start()
+    sigs.put(signal.SIGTERM)
+    deadline = time.monotonic() + 5
+    event = None
+    while event is None and time.monotonic() < deadline:
+        event = events.get(0.05)
+    assert event is not None and event.signum == signal.SIGTERM
+    # A signal already forwarded into the dying epoch's queue survives
+    # the boundary: stop() re-injects it into the OS queue.
+    sigs.put(signal.SIGHUP)
+    time.sleep(0.05)  # let the forwarder move it into the event queue
+    forwarder.stop()
+    leftovers = []
+    while True:
+        try:
+            leftovers.append(sigs.get_nowait())
+        except queue.Empty:
+            break
+    assert signal.SIGHUP in leftovers
+
+
+def test_config_watcher_posts_config_changed_once(tmp_path):
+    path = tmp_path / "config.yaml"
+    path.write_text("version: v1\n")
+    events = ev.EventQueue()
+    watcher = ev.ConfigFileWatcher(str(path), events, poll_s=0.02)
+    watcher.start()
+    try:
+        time.sleep(0.08)
+        assert events.get_nowait() is None, "unchanged file must not post"
+        path.write_text("version: v1\nflags: {}\n")
+        deadline = time.monotonic() + 5
+        event = None
+        while event is None and time.monotonic() < deadline:
+            event = events.get(0.05)
+        assert event is not None
+        assert event.reason == ev.REASON_CONFIG_CHANGED
+    finally:
+        watcher.stop()
+
+
+def test_delta_tracker_baselines_first_then_posts_on_change():
+    events = ev.EventQueue()
+    tracker = ev.DeltaTracker(events)
+    tracker.observe_labels(
+        Labels({"google.com/tpu.chips.sick": "0", "google.com/tpu.count": "4"})
+    )
+    assert events.get_nowait() is None, "first observation is the baseline"
+    # A non-health key moving is not a health delta.
+    tracker.observe_labels(
+        Labels({"google.com/tpu.chips.sick": "0", "google.com/tpu.count": "8"})
+    )
+    assert events.get_nowait() is None
+    # Measurement labels jitter between probes while the verdicts hold:
+    # probe-ms (fresh-probe-only by design) appearing/landing a new value
+    # and a moved tflops rate are NOT health deltas.
+    tracker.observe_labels(
+        Labels(
+            {
+                "google.com/tpu.chips.sick": "0",
+                "google.com/tpu.count": "8",
+                "google.com/tpu.health.probe-ms": "1234",
+                "google.com/tpu.health.matmul-tflops": "91.2",
+                "google.com/tpu.chip.0.tflops": "91.2",
+            }
+        )
+    )
+    assert events.get_nowait() is None
+    tracker.observe_labels(
+        Labels({"google.com/tpu.chips.sick": "1", "google.com/tpu.count": "8"})
+    )
+    event = events.get_nowait()
+    assert event is not None and event.reason == ev.REASON_HEALTH_DELTA
+    # Peer membership: None (no poll round yet) is ignored; first token
+    # is the baseline; a moved token posts.
+    tracker.observe_peers(None)
+    tracker.observe_peers(frozenset({1, 2, 3}))
+    assert events.get_nowait() is None
+    tracker.observe_peers(frozenset({1, 2}))
+    event = events.get_nowait()
+    assert event is not None and event.reason == ev.REASON_PEER_DELTA
+
+
+# ---------------------------------------------------------------------------
+# daemon integration
+# ---------------------------------------------------------------------------
+
+def _free_port():
+    import socket
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def cfg(tmp_path, **cli):
+    machine = tmp_path / "machine-type"
+    machine.write_text("Google Compute Engine\n")
+    values = {
+        "oneshot": False,
+        "machine-type-file": str(machine),
+        "output-file": str(tmp_path / "tfd"),
+        "metrics-port": "0",
+    }
+    values.update(cli)
+    return new_config(cli_values=values, environ={})
+
+
+def start_daemon(config, interconnect=None, config_file=None):
+    sigs = queue.Queue()
+    result = {}
+
+    def target():
+        try:
+            result["restart"] = run(
+                lambda: cmd_main._build_manager(config),
+                interconnect if interconnect is not None else Empty(),
+                config,
+                sigs,
+                supervisor=Supervisor(config),
+                config_file=config_file,
+            )
+        except BaseException as e:  # noqa: BLE001 - surfaced by the test
+            result["error"] = e
+
+    t = threading.Thread(target=target)
+    t.start()
+    return t, sigs, result
+
+
+def wait_until(pred, timeout=15.0, interval=0.005):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def test_post_probe_wakes_a_cycle_against_a_long_interval(
+    tmp_path, monkeypatch
+):
+    """Scrape-triggered refresh end to end: with the sleep interval at
+    60s, an authenticated POST /probe produces a fresh cycle within the
+    debounce window + event propagation — and a bad token does not."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    port = _free_port()
+    config = cfg(
+        tmp_path,
+        **{
+            "sleep-interval": "60s",
+            "reconcile-debounce": "0.02s",
+            "metrics-addr": "127.0.0.1",
+            "metrics-port": str(port),
+            "probe-token": "sekrit",
+        },
+    )
+    t, sigs, result = start_daemon(config)
+    try:
+        assert wait_until(
+            lambda: obs_metrics.CYCLES_TOTAL.value(outcome="full") >= 1
+        ), result.get("error")
+        before = obs_metrics.CYCLES_TOTAL.value(outcome="full")
+
+        def post(token):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{port}/probe",
+                data=b"",
+                method="POST",
+                headers={"X-TFD-Probe-Token": token} if token else {},
+            )
+            try:
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    return resp.status
+            except urllib.error.HTTPError as e:
+                return e.code
+
+        assert post("wrong") == 401
+        time.sleep(0.2)
+        assert obs_metrics.CYCLES_TOTAL.value(outcome="full") == before, (
+            "an unauthenticated probe must not wake a cycle"
+        )
+        assert post("sekrit") == 202
+        assert wait_until(
+            lambda: obs_metrics.CYCLES_TOTAL.value(outcome="full") > before
+        ), "POST /probe did not wake a cycle"
+        assert obs_metrics.RECONCILE_WAKES.value(reason="probe_request") >= 1
+    finally:
+        sigs.put(signal.SIGTERM)
+        t.join(timeout=10)
+    assert not t.is_alive()
+    assert "error" not in result, result.get("error")
+
+
+class _ChurningHealth:
+    """Interconnect stand-in whose health-projected label moves every
+    cycle — the HEALTH_DELTA producer's trigger."""
+
+    def __init__(self):
+        self.cycles = 0
+
+    def labels(self):
+        self.cycles += 1
+        return Labels({"google.com/tpu.chips.sick": str(self.cycles % 2)})
+
+
+def test_health_delta_wakes_follow_up_cycles(tmp_path, monkeypatch):
+    """A moved per-chip/chips.sick verdict posts HEALTH_DELTA: after one
+    externally-woken cycle exposes the change, follow-up cycles keep
+    coming promptly despite a 60s sleep interval (rate-guarded by
+    --max-probe-rate, so the wake chain is pacing, not a hot loop). The
+    first cycle only BASELINES the health picture — a fresh epoch must
+    not wake itself on its own first verdict — hence the one POST /probe
+    bootstrap."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    churn = _ChurningHealth()
+    port = _free_port()
+    config = cfg(
+        tmp_path,
+        **{
+            "sleep-interval": "60s",
+            "reconcile-debounce": "0.01s",
+            "max-probe-rate": "200",
+            "metrics-addr": "127.0.0.1",
+            "metrics-port": str(port),
+            "probe-token": "sekrit",
+        },
+    )
+    t, sigs, result = start_daemon(config, interconnect=churn)
+    try:
+        assert wait_until(lambda: churn.cycles >= 1), result.get("error")
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/probe",
+            data=b"",
+            method="POST",
+            headers={"X-TFD-Probe-Token": "sekrit"},
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 202
+        # Cycle 2 (probe-woken) moves chips.sick vs the baseline; every
+        # cycle after that is HEALTH_DELTA-woken by its predecessor.
+        assert wait_until(lambda: churn.cycles >= 5, timeout=20), (
+            f"health deltas did not wake follow-up cycles "
+            f"(cycles={churn.cycles}, error={result.get('error')})"
+        )
+        assert obs_metrics.RECONCILE_WAKES.value(reason="health_delta") >= 2
+    finally:
+        sigs.put(signal.SIGTERM)
+        t.join(timeout=10)
+    assert not t.is_alive()
+    assert "error" not in result, result.get("error")
+
+
+def test_config_file_change_reloads_the_epoch(tmp_path, monkeypatch):
+    """CONFIG_CHANGED replaces 'SIGHUP only': a changed config file makes
+    run() return True (the start() loop then reloads) without any
+    signal."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    monkeypatch.setattr(ev, "CONFIG_POLL_S", 0.03)
+    config_path = tmp_path / "config.yaml"
+    config_path.write_text("version: v1\n")
+    config = cfg(tmp_path, **{"sleep-interval": "60s"})
+    t, sigs, result = start_daemon(config, config_file=str(config_path))
+    try:
+        assert wait_until(
+            lambda: obs_metrics.CYCLES_TOTAL.value(outcome="full") >= 1
+        ), result.get("error")
+        config_path.write_text("version: v1\nflags: {}\n")
+        assert wait_until(lambda: not t.is_alive(), timeout=15), (
+            "config change did not end the epoch"
+        )
+    finally:
+        sigs.put(signal.SIGTERM)
+        t.join(timeout=10)
+    assert result.get("restart") is True, result
+    assert (
+        obs_metrics.RECONCILE_WAKES.value(reason="config_changed") == 1
+    )
+
+
+def test_sigterm_interrupts_a_supervisor_backoff_wait(tmp_path, monkeypatch):
+    """The satellite pin: once the failure streak has grown the backoff
+    into tens of seconds, a SIGTERM landing DURING that wait must shut
+    the daemon down immediately — under event mode the forwarder owns
+    the signal queue, so only the event-queue wait primitive can see
+    it."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    faults.load_fault_spec("generate:raise:RuntimeError:99")
+    config = cfg(
+        tmp_path,
+        **{
+            "sleep-interval": "0.01s",
+            "init-backoff-max": "30s",
+            "max-consecutive-failures": "50",
+        },
+    )
+    t, sigs, result = start_daemon(config)
+    try:
+        # After the 5th failure the next retry delay is >= ~14s (base 1s
+        # doubling, jitter >= 0.9x): the loop is parked in the backoff
+        # wait within milliseconds of the 5th failure, and an
+        # un-interrupted shutdown would take that whole delay.
+        assert wait_until(
+            lambda: obs_metrics.CONSECUTIVE_CYCLE_FAILURES.value() >= 5,
+            timeout=30,
+        ), result.get("error")
+        time.sleep(0.3)  # be inside the wait, not mid-cycle
+        t0 = time.monotonic()
+        sigs.put(signal.SIGTERM)
+        t.join(timeout=10)
+        elapsed = time.monotonic() - t0
+        assert not t.is_alive(), "SIGTERM did not interrupt the backoff wait"
+        assert elapsed < 8.0, (
+            f"shutdown took {elapsed:.1f}s — the backoff wait was not "
+            f"interrupted"
+        )
+    finally:
+        faults.reset()
+        if t.is_alive():
+            sigs.put(signal.SIGTERM)
+            t.join(timeout=10)
+    assert result.get("restart") is False
+
+
+# ---------------------------------------------------------------------------
+# interleaving fuzz (deterministic seeds — no hypothesis dependency, so it
+# runs in every environment): never deadlock, never skip the staleness wake
+# ---------------------------------------------------------------------------
+
+def test_reconcile_event_interleavings_never_deadlock():
+    """Arbitrary event interleavings — random reasons, random timing,
+    posted from a concurrent producer — must never deadlock the wait
+    primitive: every wait returns within the staleness bound plus
+    bounded slack, once the storm stops an idle queue still produces the
+    STALENESS_BOUND wake (the interval-as-guarantee contract), and a
+    signal queued behind the storm's tail still preempts."""
+    import random
+
+    for seed in range(8):
+        rng = random.Random(1000 + seed)
+        script = [
+            (
+                rng.choice(
+                    [
+                        ev.REASON_WORKER_DIED,
+                        ev.REASON_HEALTH_DELTA,
+                        ev.REASON_PEER_DELTA,
+                        ev.REASON_PROBE_REQUEST,
+                    ]
+                ),
+                rng.random() * 0.01,
+            )
+            for _ in range(80)
+        ]
+        events = ev.EventQueue()
+        loop = ev.ReconcileLoop(
+            events,
+            max_staleness=0.15,
+            debounce=rng.choice([0.0, 0.005, 0.02]),
+            max_probe_rate=rng.choice([0.5, 5.0, 500.0]),
+        )
+
+        def producer(script=script, events=events):
+            for reason, pause in script:
+                events.post(ev.Event(reason))
+                if pause > 0.005:
+                    time.sleep(pause)
+
+        t = threading.Thread(target=producer, daemon=True)
+        t.start()
+        for _ in range(10):
+            t0 = time.monotonic()
+            wake = loop.wait_for_wake()
+            elapsed = time.monotonic() - t0
+            assert wake.decision is None, (seed, wake)
+            # Bound: staleness + debounce + generous loaded-host slack.
+            assert elapsed < 0.15 + 0.02 + 3.0, (seed, elapsed)
+        t.join(timeout=10)
+        assert not t.is_alive()
+        # Storm over: drain whatever is left (each wait still bounded),
+        # then the idle queue must wake on the staleness bound alone.
+        for _ in range(200):
+            wake = loop.wait_for_wake()
+            assert wake.decision is None
+            if wake.reasons == (ev.REASON_STALENESS_BOUND,):
+                break
+        else:
+            raise AssertionError("staleness-bound wake never came")
+        # And a signal posted behind more storm tail still preempts.
+        events.post(ev.Event(ev.REASON_PROBE_REQUEST))
+        events.post(ev.Event(ev.REASON_SIGNAL, signum=signal.SIGTERM))
+        deadline = time.monotonic() + 10
+        decision = None
+        while decision is None and time.monotonic() < deadline:
+            decision = loop.wait_for_wake().decision
+        assert decision == "shutdown"

@@ -49,6 +49,11 @@ FILE_KEYS = {
     "slice-coordination": ("tfd", "sliceCoordination"),
     "peer-timeout": ("tfd", "peerTimeout"),
     "backends": ("tfd", "backends"),
+    "reconcile": ("tfd", "reconcile"),
+    "max-staleness": ("tfd", "maxStaleness"),
+    "reconcile-debounce": ("tfd", "reconcileDebounce"),
+    "max-probe-rate": ("tfd", "maxProbeRate"),
+    "probe-token": ("tfd", "probeToken"),
 }
 
 # Two distinct valid raw values per flag (a wins the dominance checks).
@@ -72,6 +77,10 @@ VALUE_PAIRS = {
     # Registry tokens (resource/registry.py): values must parse, so the
     # generic "/value-a" str fallback does not apply.
     "backends": ("tpu,cpu", "cpu"),
+    "reconcile": ("interval", "event"),
+    "max-staleness": ("30s", "45s"),
+    "reconcile-debounce": ("0.2s", "0.4s"),
+    "max-probe-rate": ("2", "4"),
 }
 
 

@@ -520,3 +520,4 @@ def test_broker_junk_json_response_clean_error_then_respawn(
                 pass
         client.close()
         broker_mod.close_broker()
+

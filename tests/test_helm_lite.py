@@ -186,6 +186,56 @@ def test_slice_env_defaults_render():
     assert env["TFD_PEER_TIMEOUT"] == "2s"
 
 
+def test_reconcile_env_defaults_render_and_token_is_gated():
+    """The reconcile values map to their TFD_* envs; probeToken renders
+    ONLY when non-empty (an empty-string TFD_PROBE_TOKEN in the pod spec
+    would read as 'configured' to an operator diffing manifests while
+    the daemon still answers 403)."""
+    env = {
+        e["name"]: e["value"] for e in _tfd_daemonset(render_chart(CHART))["env"]
+    }
+    assert env["TFD_RECONCILE"] == "auto"
+    assert env["TFD_MAX_STALENESS"] == "0s"
+    assert env["TFD_RECONCILE_DEBOUNCE"] == "0.5s"
+    assert env["TFD_MAX_PROBE_RATE"] == "1"
+    assert "TFD_PROBE_TOKEN" not in env
+    env = {
+        e["name"]: e["value"]
+        for e in _tfd_daemonset(
+            render_chart(
+                CHART,
+                values_overrides={
+                    "reconcile.mode": "interval",
+                    "reconcile.probeToken": "sekrit",
+                },
+            )
+        )["env"]
+    }
+    assert env["TFD_RECONCILE"] == "interval"
+    assert env["TFD_PROBE_TOKEN"] == "sekrit"
+    # The preferred sourcing: probeTokenSecret renders a secretKeyRef —
+    # the token never lands in the pod spec — and WINS over an inline
+    # probeToken so a stray dev value cannot shadow the Secret.
+    env = {
+        e["name"]: e
+        for e in _tfd_daemonset(
+            render_chart(
+                CHART,
+                values_overrides={
+                    "reconcile.probeToken": "sekrit",
+                    "reconcile.probeTokenSecret.name": "tfd-probe",
+                },
+            )
+        )["env"]
+    }
+    token = env["TFD_PROBE_TOKEN"]
+    assert "value" not in token, "secret-sourced token must not inline"
+    assert token["valueFrom"]["secretKeyRef"] == {
+        "name": "tfd-probe",
+        "key": "token",
+    }
+
+
 def test_slice_host_port_off_drops_claim_without_touching_coordination():
     """slice.hostPort=off is the single-host escape hatch: no node port
     claim (a conflict would leave the pod Pending, and the introspection

@@ -807,3 +807,100 @@ def test_observability_doc_names_no_phantom_metrics():
     }
     unknown = sorted(mentioned - known)
     assert not unknown, f"doc names unregistered metrics: {unknown}"
+
+
+# ---------------------------------------------------------------------------
+# POST /probe (ISSUE 9: on-demand reconcile wake, --probe-token)
+# ---------------------------------------------------------------------------
+
+def _post_probe(port, headers=None, body=b""):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/probe",
+        data=body,
+        method="POST",
+        headers=headers or {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_post_probe_requires_hook_token_and_auth():
+    """The auth ladder: no hook (interval mode / oneshot) = 404; hook but
+    no configured token = 403 (never unauthenticated — the server is
+    node-network exposed); wrong token = 401; right token (header or
+    Bearer) = 202 with the hook fired once per accepted request."""
+    state = IntrospectionState(60.0)
+    fired = []
+
+    # No hook at all: 404, hook never a concern.
+    server = IntrospectionServer(
+        Registry(), state, addr="127.0.0.1", port=0
+    )
+    server.start()
+    try:
+        assert _post_probe(server.port)[0] == 404
+    finally:
+        server.close()
+
+    # Hook present but no token configured: hard 403, hook NOT fired.
+    server = IntrospectionServer(
+        Registry(), state, addr="127.0.0.1", port=0,
+        probe_request=lambda: fired.append(1), probe_token="",
+    )
+    server.start()
+    try:
+        code, body = _post_probe(server.port)
+        assert code == 403 and "probe-token" in body
+        assert fired == []
+    finally:
+        server.close()
+
+    server = IntrospectionServer(
+        Registry(), state, addr="127.0.0.1", port=0,
+        probe_request=lambda: fired.append(1), probe_token="sekrit",
+    )
+    server.start()
+    try:
+        assert _post_probe(server.port)[0] == 401
+        assert _post_probe(
+            server.port, {"X-TFD-Probe-Token": "nope"}
+        )[0] == 401
+        assert fired == []
+        code, body = _post_probe(server.port, {"X-TFD-Probe-Token": "sekrit"})
+        assert code == 202 and "scheduled" in body
+        code, _ = _post_probe(
+            server.port, {"Authorization": "Bearer sekrit"}
+        )
+        assert code == 202
+        assert fired == [1, 1]
+        # GET on /probe is not a wake surface.
+        status, _, _ = _get(f"http://127.0.0.1:{server.port}/probe")
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+    finally:
+        server.close()
+
+
+def test_post_probe_handler_exception_answers_500_and_counts():
+    """The POST dispatch gets the same containment as do_GET: a raising
+    hook answers 500 with the error class and lands in
+    tfd_http_errors_total{endpoint="/probe"}."""
+    obs_metrics.reset_for_tests()
+
+    def broken_hook():
+        raise RuntimeError("boom")
+
+    server = IntrospectionServer(
+        Registry(), IntrospectionState(60.0), addr="127.0.0.1", port=0,
+        probe_request=broken_hook, probe_token="sekrit",
+    )
+    server.start()
+    try:
+        code, body = _post_probe(server.port, {"X-TFD-Probe-Token": "sekrit"})
+        assert code == 500 and "RuntimeError" in body
+        assert obs_metrics.HTTP_ERRORS.value(endpoint="/probe") == 1
+    finally:
+        server.close()
