@@ -146,6 +146,202 @@ def per_chip_child() -> int:
     return 0
 
 
+def coldstart_probe_child(cache_dir: str) -> int:
+    """``bench.py --coldstart-probe <cache_dir>``: one first probing
+    cycle on a hermetic 8-device virtual CPU mesh in its OWN interpreter,
+    with the persistent compilation cache pointed at ``cache_dir``.
+    Prints one JSON object:
+
+      first_probe_compile_ms   time spent in actual XLA backend
+                               compilation during the probe (summed from
+                               jax's own monitoring events) — the
+                               quantity the persistent cache eliminates.
+                               Wall time would conflate tracing/lowering
+                               and kernel execution, which no disk cache
+                               can remove; on a real chip the two
+                               coincide (compile dominates), on the
+                               virtual mesh they do not.
+      first_probe_wall_ms      the probe's wall time, for context.
+
+    The parent runs this twice against ONE cache dir — a cold interpreter
+    then a warm one — so the pair is the two-interpreter cold-vs-warm
+    measurement the CI ratio assertion consumes."""
+    os.environ["TFD_COMPILATION_CACHE_DIR"] = cache_dir
+    # The virtual-CPU probe kernels compile in hundreds of ms each; the
+    # production 0.5 s churn threshold would keep them out of the cache
+    # and the warm run would measure nothing.
+    os.environ["TFD_COMPILATION_CACHE_MIN_COMPILE_S"] = "0"
+    from gpu_feature_discovery_tpu.utils.jaxenv import pin_virtual_cpu_devices
+
+    pin_virtual_cpu_devices(8)
+    import jax
+
+    compile_s = [0.0]
+    try:
+        from jax._src import monitoring
+
+        def _listener(name, duration, **kw):
+            if name == "/jax/core/compile/backend_compile_duration":
+                compile_s[0] += duration
+
+        monitoring.register_event_duration_secs_listener(_listener)
+    except Exception as e:  # noqa: BLE001 - private API; degrade to wall
+        print(f"bench: no jax monitoring ({e}); compile_ms = wall", file=sys.stderr)
+        compile_s = None
+
+    from gpu_feature_discovery_tpu.ops.healthcheck import measure_node_health
+
+    devices = jax.local_devices()
+    t0 = time.perf_counter()
+    report = measure_node_health(
+        size=256, depth=4, iters=1, ici=False, per_chip=True, devices=devices
+    )
+    wall_ms = (time.perf_counter() - t0) * 1e3
+    compile_ms = compile_s[0] * 1e3 if compile_s is not None else wall_ms
+    print(
+        f"bench(coldstart probe child): compile={compile_ms:.1f}ms "
+        f"wall={wall_ms:.1f}ms healthy={report.get('healthy')}",
+        file=sys.stderr,
+    )
+    print(
+        json.dumps(
+            {
+                "first_probe_compile_ms": round(compile_ms, 1),
+                "first_probe_wall_ms": round(wall_ms, 1),
+            }
+        )
+    )
+    return 0
+
+
+def _run_coldstart_phase() -> dict:
+    """Cold-start acceptance (ISSUE 11): two-interpreter cold-vs-warm
+    compile measurement sharing one cache dir, plus restart-to-labels —
+    process spawn to a FULL LIVE label file (no tfd.restored marker) —
+    for real daemon processes restarting against a warm --state-dir on
+    the mock backend. The parent observes the label file itself, so the
+    number includes interpreter start, imports, config load, the restored
+    write, broker spawn, and the first live cycle."""
+    import signal as _signal
+    import subprocess
+
+    base = tempfile.mkdtemp(prefix="tfd-coldstart-")
+    cache_dir = os.path.join(base, "xla-cache")
+    state_dir = os.path.join(base, "state")
+    out_file = os.path.join(base, "tfd")
+    child_env = dict(os.environ)
+    child_env.update(
+        {"TFD_BACKEND": "mock:v4-8", "TFD_NO_METADATA": "1"}
+    )
+
+    def _probe_child():
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__), "--coldstart-probe",
+             cache_dir],
+            capture_output=True, text=True, timeout=600, env=child_env,
+        )
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            raise RuntimeError(f"coldstart probe child exited {proc.returncode}")
+        return json.loads(proc.stdout.strip().splitlines()[-1])
+
+    def _labels_at(path):
+        try:
+            with open(path) as f:
+                return dict(
+                    line.strip().split("=", 1) for line in f if "=" in line
+                )
+        except OSError:
+            return {}
+
+    def _daemon_restart_ms():
+        """Spawn a real daemon process; return ms from spawn to the
+        label file holding full LIVE labels (count present, restored
+        marker gone)."""
+        argv = [
+            sys.executable, "-m", "gpu_feature_discovery_tpu.cmd.main",
+            "--output-file", out_file,
+            "--state-dir", state_dir,
+            "--compilation-cache-dir", cache_dir,
+            "--sleep-interval", "60s",
+            "--metrics-port", "0",
+            "--machine-type-file", os.devnull,
+        ]
+        t0 = time.perf_counter()
+        proc = subprocess.Popen(
+            argv, env=child_env, stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+        try:
+            deadline = time.monotonic() + 60
+            while time.monotonic() < deadline:
+                labels = _labels_at(out_file)
+                if (
+                    labels.get("google.com/tpu.count") == "4"
+                    and "google.com/tpu.tfd.restored" not in labels
+                ):
+                    return (time.perf_counter() - t0) * 1e3
+                if proc.poll() is not None:
+                    raise RuntimeError(
+                        f"coldstart daemon exited {proc.returncode} before "
+                        "serving live labels"
+                    )
+                time.sleep(0.002)
+            raise RuntimeError("coldstart daemon never served live labels")
+        finally:
+            if proc.poll() is None:
+                proc.send_signal(_signal.SIGTERM)
+            try:
+                proc.wait(timeout=10)
+            except subprocess.TimeoutExpired:
+                proc.kill()
+                proc.wait()
+
+    try:
+        cold = _probe_child()          # empty cache: the full XLA compile
+        if not cold["first_probe_compile_ms"] > 0:
+            # A cold first probe that reports ZERO backend-compile time
+            # means the monitoring event never fired (e.g. a jax upgrade
+            # renamed the private event key) — both arms would read 0 and
+            # the CI ratio assertion would pass vacuously. Fail loudly
+            # instead: the None fields below trip the CI assert.
+            raise RuntimeError(
+                "cold probe child reported no XLA backend-compile time "
+                f"({cold}) — jax monitoring event missing; the cold/warm "
+                "ratio would be meaningless"
+            )
+        warm = _probe_child()          # same dir, fresh interpreter
+        restart_cold_ms = _daemon_restart_ms()   # also seeds the state dir
+        restart_runs = max(
+            3, int(os.environ.get("TFD_BENCH_RESTART_RUNS", "3"))
+        )
+        warm_restarts = [_daemon_restart_ms() for _ in range(restart_runs)]
+    except Exception as e:  # noqa: BLE001 - None fields fail CI loudly
+        print(f"bench: coldstart phase failed: {e}", file=sys.stderr)
+        return {
+            "first_probe_compile_ms_cold": None,
+            "first_probe_compile_ms_warm": None,
+            "restart_to_labels_ms": None,
+            "restart_to_labels_runs": 0,
+        }
+    restart_to_labels_ms = round(statistics.median(warm_restarts), 1)
+    print(
+        f"bench: coldstart compile cold={cold['first_probe_compile_ms']}ms "
+        f"warm={warm['first_probe_compile_ms']}ms "
+        f"(walls {cold['first_probe_wall_ms']}/{warm['first_probe_wall_ms']}ms, "
+        f"one shared cache dir, two interpreters); restart-to-live-labels "
+        f"cold-state={restart_cold_ms:.0f}ms warm-state "
+        f"p50={restart_to_labels_ms}ms over {restart_runs} daemon restarts",
+        file=sys.stderr,
+    )
+    return {
+        "first_probe_compile_ms_cold": cold["first_probe_compile_ms"],
+        "first_probe_compile_ms_warm": warm["first_probe_compile_ms"],
+        "restart_to_labels_ms": restart_to_labels_ms,
+        "restart_to_labels_runs": restart_runs,
+    }
+
+
 def _run_per_chip_child() -> dict:
     """Spawn the per-chip child and parse its JSON line; a failure is
     reported as None fields so the CI assertion fails LOUDLY instead of
@@ -176,6 +372,10 @@ def main() -> int:
     logging.basicConfig(stream=sys.stderr, level=logging.WARNING)
     if "--per-chip-child" in sys.argv[1:]:
         return per_chip_child()
+    if "--coldstart-probe" in sys.argv[1:]:
+        return coldstart_probe_child(
+            sys.argv[sys.argv.index("--coldstart-probe") + 1]
+        )
 
     from gpu_feature_discovery_tpu.cmd.main import new_interconnect_labeler
     from gpu_feature_discovery_tpu.config.flags import new_config
@@ -1021,6 +1221,21 @@ def main() -> int:
     else:
         per_chip = _run_per_chip_child()
 
+    # Cold-start acceptance (ISSUE 11): two-interpreter cold-vs-warm
+    # compile sharing one cache dir + restart-to-full-live-labels over
+    # real daemon restarts against a warm state dir. TFD_BENCH_COLDSTART=0
+    # skips the child interpreters for invocations that only read other
+    # fields (the chaos-row bench step).
+    if os.environ.get("TFD_BENCH_COLDSTART", "1") == "0":
+        coldstart = {
+            "first_probe_compile_ms_cold": None,
+            "first_probe_compile_ms_warm": None,
+            "restart_to_labels_ms": None,
+            "restart_to_labels_runs": 0,
+        }
+    else:
+        coldstart = _run_coldstart_phase()
+
     n_labels = len(labels)
     p50 = statistics.median(samples_ms)
     p95 = sorted(samples_ms)[
@@ -1105,6 +1320,20 @@ def main() -> int:
                     "straggler_false_positives"
                 ],
                 "per_chip_clean_cycles": per_chip["per_chip_clean_cycles"],
+                # Cold-start acceptance (ISSUE 11): XLA backend-compile
+                # time of the first probe in a cold vs warm interpreter
+                # sharing one --compilation-cache-dir (CI asserts warm at
+                # least 10x under cold), and process-spawn ->
+                # full-live-label-file over real daemon restarts against
+                # a warm --state-dir (CI asserts p50 < 1000 ms).
+                "first_probe_compile_ms_cold": coldstart[
+                    "first_probe_compile_ms_cold"
+                ],
+                "first_probe_compile_ms_warm": coldstart[
+                    "first_probe_compile_ms_warm"
+                ],
+                "restart_to_labels_ms": coldstart["restart_to_labels_ms"],
+                "restart_to_labels_runs": coldstart["restart_to_labels_runs"],
                 **(
                     {"burnin_cycle_p50_ms": round(burnin_p50, 3)}
                     if burnin_p50 is not None

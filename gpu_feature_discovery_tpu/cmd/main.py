@@ -61,6 +61,30 @@ log = logging.getLogger("tfd")
 
 WATCHED_SIGNALS = (signal.SIGHUP, signal.SIGINT, signal.SIGTERM, signal.SIGQUIT)
 
+# Cold-start accounting (tfd_restart_to_labels_seconds): import time is
+# the closest observable to process start from inside the process — the
+# interpreter+import cost it misses is measured externally by the bench's
+# restart_to_labels_ms, which clocks from the spawn.
+_PROCESS_START = time.monotonic()
+_restart_to_labels_recorded = False
+
+
+def _record_restart_to_labels() -> None:
+    """Set tfd_restart_to_labels_seconds on the process's FIRST full
+    live label write (once — a SIGHUP reload's next full cycle is not a
+    restart)."""
+    global _restart_to_labels_recorded
+    if _restart_to_labels_recorded:
+        return
+    _restart_to_labels_recorded = True
+    obs_metrics.RESTART_TO_LABELS.set(time.monotonic() - _PROCESS_START)
+
+
+def _reset_restart_marker() -> None:
+    """Test isolation only: let the next full cycle record again."""
+    global _restart_to_labels_recorded
+    _restart_to_labels_recorded = False
+
 
 def build_arg_parser() -> argparse.ArgumentParser:
     parser = argparse.ArgumentParser(
@@ -490,6 +514,63 @@ def run(
         if backend_tokens
         else None
     )
+    # Persistent XLA compilation cache (--compilation-cache-dir, default
+    # auto = <state-dir>/xla-cache): resolved per config epoch and
+    # exported through the env so every enable site — broker worker,
+    # in-process probe — points at one directory. The cache is keyed
+    # under it by (driver version, topology), so a libtpu upgrade or a
+    # re-shaped node starts a fresh namespace (utils/jaxenv.py).
+    from gpu_feature_discovery_tpu.config.flags import (
+        resolve_compilation_cache_dir,
+    )
+    from gpu_feature_discovery_tpu.utils import jaxenv
+
+    cache_usable = jaxenv.configure_compilation_cache(
+        resolve_compilation_cache_dir(config)
+    )
+    obs_metrics.COMPILE_CACHE_ENABLED.set(1 if cache_usable else 0)
+    # Whether THIS epoch has written the output file yet: a failure before
+    # the first write must not clobber a previous epoch's still-valid
+    # file, but once this epoch owns the file its markers must stay
+    # current (a reserve may overwrite an earlier reserve).
+    wrote_this_epoch = False
+    # Cold-start ordering (docs/operations.md "Cold start anatomy"): the
+    # persisted snapshot is served FIRST — before the engine, the event
+    # machinery, the obs server, and long before any broker spawn/PJRT
+    # init — so a restart reaches labels-on-disk in milliseconds while
+    # the backend warms concurrently and upgrades them when ready. The
+    # obs-state/coordinator/flap notifications for this write happen
+    # below, once those objects exist.
+    restored_served = None
+    if supervised and not (
+        process_state is not None and process_state.get("live_full_served")
+    ):
+        # Restored last-good state (--state-dir): serve the previous
+        # run's labels on the epoch's VERY FIRST write — before any
+        # backend init is attempted — so a restart during a backend
+        # outage (or a crash-looping native stack) never strips the
+        # node of its device labels while the supervisor retries.
+        # Skipped on reload epochs of a process that already served
+        # live labels (see the process_state contract above).
+        restored = supervisor.restore_last_good()
+        if restored is not None:
+            from gpu_feature_discovery_tpu.cmd.supervisor import (
+                RESTORED_LABEL,
+            )
+
+            restored[RESTORED_LABEL] = "true"
+            try:
+                restored.write_to_file(output_file)
+            except Exception as e:  # noqa: BLE001 - restore is best-effort
+                log.warning("could not serve restored labels: %s", e)
+            else:
+                wrote_this_epoch = True
+                restored_served = restored
+                log.info(
+                    "serving %d restored labels until the first live "
+                    "cycle completes",
+                    len(restored),
+                )
     # One engine per config epoch: its last-good cache and straggler
     # futures must not survive a SIGHUP reload (same staleness contract as
     # reset_burnin_schedule), and the reload rebuilds run() anyway.
@@ -578,16 +659,29 @@ def run(
         else:
             _on_worker_death = None
         tfd_sandbox.set_broker_death_watch(True, listener=_on_worker_death)
+        # Cold-start overlap: start the broker worker's spawn — the fork,
+        # the PJRT init that seizes the chip, the kernel pre-warm riding
+        # the compilation cache — NOW, concurrently with the obs-server
+        # bind and everything below, so the first cycle acquires a live
+        # (or already-spawning) worker instead of paying the spawn on
+        # the label path. Restored labels are already on disk above.
+        # Stood down under fault injection: a pre-spawn would consume an
+        # injected pjrt_init/probe.* shot outside the supervisor's paced
+        # accounting (utils/faults.active docstring).
+        from gpu_feature_discovery_tpu.utils import faults as tfd_faults
+
+        if (
+            backend_set is None
+            and make_manager is not None
+            and tfd_sandbox.broker_enabled(config)
+            and not tfd_faults.active()
+        ):
+            tfd_sandbox.prespawn_broker(config)
     # Introspection server (obs/): daemon epochs only, rebound per epoch
     # so a SIGHUP reload picks up new --metrics-* flags.
     obs_server, obs_state = start_introspection_server(
         config, peer_snapshot=peer_snapshot, probe_request=probe_request
     )
-    # Whether THIS epoch has written the output file yet: a failure before
-    # the first write must not clobber a previous epoch's still-valid
-    # file, but once this epoch owns the file its markers must stay
-    # current (a reserve may overwrite an earlier reserve).
-    wrote_this_epoch = False
     # Anti-flap hysteresis (--flap-window > 1): per-epoch, daemon only —
     # oneshot publishes exactly what it measured.
     flap = None
@@ -603,44 +697,19 @@ def run(
         flap = FlapDamper(window)
     try:
         timestamp_labeler = new_timestamp_labeler(config)
-        if supervised and not (
-            process_state is not None and process_state.get("live_full_served")
-        ):
-            # Restored last-good state (--state-dir): serve the previous
-            # run's labels on the epoch's VERY FIRST write — before any
-            # backend init is attempted — so a restart during a backend
-            # outage (or a crash-looping native stack) never strips the
-            # node of its device labels while the supervisor retries.
-            # Skipped on reload epochs of a process that already served
-            # live labels (see the process_state contract above).
-            restored = supervisor.restore_last_good()
-            if restored is not None:
-                from gpu_feature_discovery_tpu.cmd.supervisor import (
-                    RESTORED_LABEL,
-                )
-
-                restored[RESTORED_LABEL] = "true"
-                try:
-                    restored.write_to_file(output_file)
-                except Exception as e:  # noqa: BLE001 - restore is best-effort
-                    log.warning("could not serve restored labels: %s", e)
-                else:
-                    wrote_this_epoch = True
-                    log.info(
-                        "serving %d restored labels until the first live "
-                        "cycle completes",
-                        len(restored),
-                    )
-                    if flap is not None:
-                        # Seed the damper with the restored baseline so
-                        # the restore->live transition is damped like any
-                        # other (a marginal backend's first enumeration
-                        # must hold the window before shrinking the set).
-                        flap.observe(restored)
-                    if obs_state is not None:
-                        obs_state.labels_written(restored, {}, mode="restored")
-                    if coordinator is not None:
-                        coordinator.publish_local(restored, "restored")
+        if restored_served is not None:
+            # The restored snapshot was written at the very top of run();
+            # now that the consumers exist, tell them what is on disk.
+            if flap is not None:
+                # Seed the damper with the restored baseline so the
+                # restore->live transition is damped like any other (a
+                # marginal backend's first enumeration must hold the
+                # window before shrinking the set).
+                flap.observe(restored_served)
+            if obs_state is not None:
+                obs_state.labels_written(restored_served, {}, mode="restored")
+            if coordinator is not None:
+                coordinator.publish_local(restored_served, "restored")
         # When the cycle about to run was triggered by an event wake,
         # this carries the triggering event's post time into the cycle so
         # tfd_wake_to_labels_seconds measures event -> label write.
@@ -903,12 +972,16 @@ def run(
                 if supervised:
                     supervisor.cycle_succeeded(labels, mode=cycle_mode)
                     supervisor.touch_heartbeat()
-                    if (
-                        cycle_mode == "full"
-                        and process_state is not None
-                        and not supervisor.restored
-                    ):
-                        process_state["live_full_served"] = True
+                    if cycle_mode == "full" and not supervisor.restored:
+                        # First full LIVE labels this process: the
+                        # restart-to-labels clock stops here (restored/
+                        # degraded writes deliberately don't count — the
+                        # metric is "when did live inventory return").
+                        _record_restart_to_labels()
+                        if process_state is not None:
+                            process_state["live_full_served"] = True
+                elif cycle_mode == "full":
+                    _record_restart_to_labels()
                 if obs_state is not None:
                     obs_state.cycle_completed()
 

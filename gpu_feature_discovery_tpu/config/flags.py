@@ -71,6 +71,13 @@ DEFAULT_FLAP_WINDOW = 1
 # lifetime (the default — the worker is stateless between requests, so
 # recycling exists only as a hedge against slow native leaks).
 DEFAULT_BROKER_MAX_REQUESTS = 0
+# Persistent XLA compilation cache (utils/jaxenv.py): "auto" resolves to
+# <state-dir>/xla-cache exactly when --state-dir is configured — the
+# cache then rides the same durable volume the label state does, so a
+# pod restart (or any node sharing the hostPath) finds warm executables.
+# Without a state dir, auto resolves to disabled: the cache's whole value
+# is surviving restarts, and a tmpfs cache would only add churn.
+DEFAULT_COMPILATION_CACHE_DIR = "auto"
 # Straggler detection (lm/health.py): a healthy chip whose throughput
 # falls below this fraction of the healthy-chip median on
 # STRAGGLER_CONFIRM_PROBES consecutive probes is published as
@@ -444,6 +451,21 @@ FLAG_DEFS: List[FlagDef] = [
         getter=lambda c: _f(c).tfd.broker_max_requests,
     ),
     FlagDef(
+        name="compilation-cache-dir",
+        env_vars=("TFD_COMPILATION_CACHE_DIR",),
+        parse=str,
+        default=DEFAULT_COMPILATION_CACHE_DIR,
+        help="base directory for the persistent XLA compilation cache: a "
+        "restarted daemon (or any node sharing the directory) reuses "
+        "compiled probe executables instead of paying the multi-second "
+        "cold compile, namespaced by (driver version, topology) so a "
+        "libtpu upgrade never serves a stale executable; 'auto' "
+        "(default) resolves to <state-dir>/xla-cache when --state-dir "
+        "is set and to disabled otherwise; empty disables",
+        setter=lambda c, v: setattr(_f(c).tfd, "compilation_cache_dir", v),
+        getter=lambda c: _f(c).tfd.compilation_cache_dir,
+    ),
+    FlagDef(
         name="chip-probes",
         env_vars=("TFD_CHIP_PROBES",),
         parse=_parse_bool,
@@ -689,6 +711,23 @@ def new_config(
 
     parse_backends_value(config.flags.tfd.backends or "auto")
     return config
+
+
+def resolve_compilation_cache_dir(config: Config) -> str:
+    """The effective persistent-compilation-cache base directory for this
+    config: '' = disabled, else a path. 'auto' (the default) follows
+    ``--state-dir`` — the cache wants exactly the durability the label
+    state already has (the manifests mount one hostPath for both), and a
+    daemon without persistent state has nowhere worth caching to."""
+    raw = (config.flags.tfd.compilation_cache_dir or "").strip()
+    if raw != DEFAULT_COMPILATION_CACHE_DIR:
+        return raw
+    state_dir = (config.flags.tfd.state_dir or "").strip()
+    if not state_dir:
+        return ""
+    import os
+
+    return os.path.join(state_dir, "xla-cache")
 
 
 def disable_resource_renaming(config: Config, log: Callable[[str], None]) -> None:

@@ -193,6 +193,11 @@ class TfdFlags:
     # broker_max_requests served requests (0 = never).
     probe_broker: Optional[str] = None  # auto | on | off
     broker_max_requests: Optional[int] = None  # 0 = never recycle
+    # Persistent XLA compilation cache (utils/jaxenv.py): base directory
+    # for compiled-executable reuse across daemon restarts, namespaced by
+    # (driver version, topology). "auto" = <state-dir>/xla-cache when
+    # --state-dir is set (riding the same durable volume), "" = disabled.
+    compilation_cache_dir: Optional[str] = None  # auto | "" | path
     # Per-chip fault localization (lm/health.py + ops/healthcheck.py):
     # mesh-sharded burn-in with per-chip verdict labels and straggler
     # detection; chip_probes=False reproduces the aggregate-only labels.
@@ -275,6 +280,7 @@ class Config:
                     "flapWindow": self.flags.tfd.flap_window,
                     "probeBroker": self.flags.tfd.probe_broker,
                     "brokerMaxRequests": self.flags.tfd.broker_max_requests,
+                    "compilationCacheDir": self.flags.tfd.compilation_cache_dir,
                     "chipProbes": self.flags.tfd.chip_probes,
                     "stragglerThreshold": self.flags.tfd.straggler_threshold,
                     "sliceCoordination": self.flags.tfd.slice_coordination,
@@ -443,6 +449,9 @@ def parse_config_file(path: str) -> Config:
         config.flags.tfd.broker_max_requests = parse_nonneg_int(
             tfd["brokerMaxRequests"]
         )
+    config.flags.tfd.compilation_cache_dir = _opt_str(
+        tfd.get("compilationCacheDir")
+    )
     config.flags.tfd.chip_probes = _opt_bool(tfd.get("chipProbes"))
     if tfd.get("stragglerThreshold") is not None:
         config.flags.tfd.straggler_threshold = parse_fraction(

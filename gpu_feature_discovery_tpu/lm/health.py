@@ -514,6 +514,15 @@ def _labels_from_probe(
         report.get("timing"),
         report.get("phases"),
     )
+    compile_ms = (report.get("phases") or {}).get("compile_ms")
+    if compile_ms:
+        # The cold-start figure the persistent compilation cache exists
+        # to shrink: only probes that actually compiled report non-zero
+        # (works for both probe paths — the broker worker ships phases
+        # back in the report, so the parent's registry sees it).
+        from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+        obs_metrics.FIRST_PROBE_COMPILE.set(float(compile_ms) / 1e3)
     peak_tf, peak_hbm = _spec_peaks(manager)
     labels = Labels(
         {

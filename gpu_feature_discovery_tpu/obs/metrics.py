@@ -164,6 +164,25 @@ BROKER_UP = REGISTRY.gauge(
     "1 while a broker worker is live and serving requests, else 0 "
     "(including --probe-broker=off, where no worker ever exists).",
 )
+COMPILE_CACHE_ENABLED = REGISTRY.gauge(
+    "tfd_compile_cache_enabled",
+    "1 while a persistent XLA compilation cache directory is configured "
+    "and usable (--compilation-cache-dir; restarts then reuse compiled "
+    "probe executables instead of paying the cold compile), else 0.",
+)
+FIRST_PROBE_COMPILE = REGISTRY.gauge(
+    "tfd_first_probe_compile_seconds",
+    "Chip-idle XLA compile phase of the most recent probe that actually "
+    "compiled (the first probe per geometry; ~0 on every probe after, "
+    "and on a restart served by a warm compilation cache).",
+)
+RESTART_TO_LABELS = REGISTRY.gauge(
+    "tfd_restart_to_labels_seconds",
+    "Wall time from process start to this process's FIRST full live "
+    "label write (restored/degraded writes excluded) — the cold-start "
+    "figure the compilation cache and the startup overlap exist to "
+    "shrink. Set once per process.",
+)
 STATE_RESTORES = REGISTRY.counter(
     "tfd_state_restores_total",
     "Epoch starts that re-served persisted last-good labels from "

@@ -342,32 +342,37 @@ def warm_probe_kernels_for(devices: tuple, per_chip: bool = True) -> float:
     Non-TPU devices warm only the burn-in + pack kernels: the wall-clock
     probe path they take runs no HBM pallas kernel (compiled
     ``pallas_call`` is TPU-only; hbm_gbps is None on those platforms),
-    so warming it would crash for a kernel no probe will ever run."""
+    so warming it would crash for a kernel no probe will ever run.
+    Geometry follows exactly what ``measure_node_health`` would resolve —
+    including the TFD_BURNIN_GEOMETRY override — on BOTH platforms: a
+    warm at any other geometry would compile kernels no probe runs and
+    leave the first probing cycle paying the real compile anyway."""
     devices = tuple(devices)
     on_tpu = all(d.platform == "tpu" for d in devices)
+    override = _probe_geometry_override()
     if on_tpu:
+        size, depth = override if override is not None else (
+            TPU_PROBE_SIZE, TPU_PROBE_DEPTH
+        )
         return _warm_probe_kernels(
-            devices, TPU_PROBE_SIZE, TPU_PROBE_DEPTH, jnp.bfloat16,
+            devices, size, depth, jnp.bfloat16,
             PROBE_HBM_MIB, per_chip=per_chip,
         )
-    key = (devices, DEFAULT_PROBE_SIZE, DEFAULT_PROBE_DEPTH, "wall")
+    size, depth = override if override is not None else (
+        DEFAULT_PROBE_SIZE, DEFAULT_PROBE_DEPTH
+    )
+    key = (devices, size, depth, "wall")
     if key in _warmed_probe_keys:
         return 0.0
     t0 = time.perf_counter()
     step = _jitted_burnin()
     pack = _jitted_health_pack()
     for d in devices:
-        xb, wsb = _burnin_workspace(
-            d, DEFAULT_PROBE_SIZE, DEFAULT_PROBE_DEPTH, jnp.bfloat16
-        )
+        xb, wsb = _burnin_workspace(d, size, depth, jnp.bfloat16)
         cs, rms = step(xb, wsb)
         jax.block_until_ready(pack(cs, rms, jnp.zeros((), jnp.float32)))
     if per_chip:
-        override = _probe_geometry_override()
-        wsize, wdepth = override if override is not None else (
-            DEFAULT_PROBE_SIZE, DEFAULT_PROBE_DEPTH
-        )
-        _warm_per_chip_kernels(devices, wsize, wdepth, jnp.bfloat16)
+        _warm_per_chip_kernels(devices, size, depth, jnp.bfloat16)
     _warmed_probe_keys.add(key)
     return (time.perf_counter() - t0) * 1e3
 
@@ -920,15 +925,18 @@ def measure_node_health(
     """
     global _device_clock_unavailable, _traced_probe_failures
     t_total = time.perf_counter()
+    if devices is None:
+        devices = jax.local_devices()
     # Standalone callers (bench, tests) reach the probe without going
-    # through JaxManager.init — same cache, same idempotent enable.
+    # through the broker worker's pre-warm — same cache, same idempotent
+    # enable, same (driver version, topology) namespace: the probe is
+    # the one site that always holds devices to derive it from.
     from gpu_feature_discovery_tpu.utils.jaxenv import (
+        cache_namespace,
         enable_persistent_compilation_cache,
     )
 
-    enable_persistent_compilation_cache()
-    if devices is None:
-        devices = jax.local_devices()
+    enable_persistent_compilation_cache(namespace=cache_namespace(devices))
     on_tpu = all(d.platform == "tpu" for d in devices)
     override = _probe_geometry_override()
     if override is not None:

@@ -135,6 +135,14 @@ class JaxManager(Manager):
             raise ResourceError("PJRT client reports no TPU devices")
         self._devices = devices
         self._all_devices = all_devices
+        # Re-point the cache at its (driver version, topology) namespace
+        # now that devices exist to derive one from; the namespace-less
+        # enable above only covers compiles during enumeration itself.
+        from gpu_feature_discovery_tpu.utils.jaxenv import cache_namespace
+
+        enable_persistent_compilation_cache(
+            namespace=cache_namespace(devices)
+        )
         self._slice_topology = self._resolve_slice_topology()
         if self._slice_topology:
             log.info("chips bound into slice topology %s", self._slice_topology)

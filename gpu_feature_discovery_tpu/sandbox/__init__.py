@@ -41,6 +41,7 @@ from gpu_feature_discovery_tpu.sandbox.broker import (
     broker_mode,
     close_broker,
     get_broker,
+    prespawn_broker,
     set_broker_death_watch,
 )
 from gpu_feature_discovery_tpu.sandbox.flap import FLAPPING_LABEL, FlapDamper
@@ -72,6 +73,7 @@ __all__ = [
     "broker_mode",
     "close_broker",
     "get_broker",
+    "prespawn_broker",
     "set_broker_death_watch",
     "FLAPPING_LABEL",
     "FlapDamper",

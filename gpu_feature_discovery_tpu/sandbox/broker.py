@@ -301,24 +301,33 @@ class _HealthProbe:
 
 
 def _child_prewarm(chip_lock: threading.Lock, per_chip: bool = True) -> None:
-    """Warm-start: pre-compile the probe kernels right after init, OFF the
-    label-serving path (a background thread — ``snapshot`` requests serve
-    immediately while this compiles), so the first health cycle no longer
-    eats ``first_probe_compile_ms``. Rides the persistent compilation
-    cache (utils/jaxenv.py) when TFD_COMPILATION_CACHE_DIR is set. Purely
-    an optimization: any failure is swallowed — the first health request
-    then compiles lazily, exactly as before."""
+    """Warm-start: pre-compile the ENTIRE probe kernel set right after
+    init, OFF the label-serving path (a background thread — ``snapshot``
+    requests serve immediately while this compiles), so the first health
+    cycle no longer eats ``first_probe_compile_ms``: the per-device rate
+    kernels, the mesh-sharded verdict program, and (multi-chip TPU) the
+    ICI all-reduce probe, all at the REAL geometry measure_node_health
+    would pick (ops/healthcheck.warm_probe_kernels_for). Rides the
+    persistent compilation cache (utils/jaxenv.py) when a cache dir is
+    configured — enabled HERE, with the namespace derived from the held
+    devices' (driver version, topology), because only the worker ever
+    has a live client to derive it from. Purely an optimization: any
+    failure is swallowed — the first health request then compiles
+    lazily, exactly as before."""
     try:
-        from gpu_feature_discovery_tpu.utils.jaxenv import (
-            enable_persistent_compilation_cache,
-        )
-
-        enable_persistent_compilation_cache()
         from gpu_feature_discovery_tpu.lm.health import _acquire_tpu_devices
 
         devices = _acquire_tpu_devices()
         if devices is None:
             return
+        from gpu_feature_discovery_tpu.utils.jaxenv import (
+            cache_namespace,
+            enable_persistent_compilation_cache,
+        )
+
+        enable_persistent_compilation_cache(
+            namespace=cache_namespace(devices)
+        )
         from gpu_feature_discovery_tpu.ops.healthcheck import (
             warm_probe_kernels_for,
         )
@@ -509,6 +518,12 @@ class BrokerClient:
         self._spawn_failures = 0
         self._next_spawn = 0.0
         self._ever_spawned = False
+        # Set by close(): a pre-spawn that loses the race against epoch
+        # teardown must refuse to fork a worker nobody will ever close —
+        # on hardware an orphaned worker would hold the chip against the
+        # next epoch's init. (Recycle does NOT set this: the worker is
+        # epoch-scoped, the client spans the epoch.)
+        self._closed = False
 
     # -- lifecycle --------------------------------------------------------
 
@@ -1060,11 +1075,48 @@ class BrokerClient:
                 pass
         self._mark_dead()
 
+    def prespawn(self) -> None:
+        """Start the worker — fork + PJRT init + the kernel pre-warm
+        thread — WITHOUT a request attached, so epoch startup can overlap
+        it with serving the restored snapshot (cmd/main.run's cold-start
+        ordering): by the time the first cycle acquires, the worker is
+        up (or mid-spawn, in which case the acquisition queues on the
+        request lock instead of starting from zero). Failures are
+        swallowed: the first cycle's acquisition retries under the
+        supervisor, where init failures have their metrics, backoff, and
+        degraded-mode semantics."""
+        try:
+            with self._lock:
+                if self._closed:
+                    # Epoch teardown won the race: a spawn now would
+                    # orphan a chip-holding worker past close_broker.
+                    return
+                self._ensure_running()
+        except BaseException:  # noqa: BLE001 - supervision owns failures
+            log.debug(
+                "broker pre-spawn failed (first cycle retries under "
+                "supervision):",
+                exc_info=True,
+            )
+
     def close(self) -> None:
         """Retire the broker: graceful shutdown, SIGKILL fallback, reap.
         Idempotent; the daemon loop calls it at epoch end (SIGHUP close)
-        so a reload rebuilds the worker under the new config."""
+        so a reload rebuilds the worker under the new config. A worker
+        still MID-SPAWN (a pre-spawn racing a SIGTERM at epoch start) is
+        SIGKILLed first — its READY read then fails fast and releases
+        the request lock, so teardown never waits out the full
+        --probe-timeout spawn budget behind a wedged PJRT init. (An
+        in-flight REQUEST is not killed: close still queues behind it
+        and retires the worker gracefully, the pre-existing contract.)"""
+        from gpu_feature_discovery_tpu import sandbox
+
+        with self._pid_lock:
+            spawning = self._spawning
+        if spawning is not None:
+            sandbox.probe.kill_if_live(spawning)
         with self._lock:
+            self._closed = True
             self._close_worker_locked()
 
 
@@ -1147,6 +1199,24 @@ def close_broker() -> None:
         _active.clear()
     for client in clients:
         client.close()
+
+
+def prespawn_broker(config, backend=None) -> threading.Thread:
+    """Kick the keyed worker's spawn off in a background thread and
+    return it (cmd/main.run's cold-start overlap: the restored snapshot
+    serves, the obs server binds, and the PJRT init all proceed
+    concurrently — the first cycle then finds the worker up instead of
+    paying the spawn on the label path). The caller must only invoke
+    this when fault injection is inactive (utils/faults.active()): a
+    pre-spawn would consume an injected pjrt_init/probe.* shot outside
+    the supervisor's paced accounting and skew every chaos row's
+    failure arithmetic."""
+    client = get_broker(config, backend=backend)
+    thread = threading.Thread(
+        target=client.prespawn, name="tfd-broker-prespawn", daemon=True
+    )
+    thread.start()
+    return thread
 
 
 def acquire_broker_manager(config, backend=None) -> Manager:

@@ -274,6 +274,16 @@ def reset() -> None:
     _loaded = False
 
 
+def active() -> bool:
+    """True when a fault spec is loaded (any sites, armed or spent).
+    Optimization-only fast paths — the broker pre-spawn that would
+    consume an injected shot outside the supervisor's paced accounting —
+    consult this to stand down under injection, keeping every chaos
+    row's failure arithmetic deterministic."""
+    reg = _ensure_loaded()
+    return reg is not None and bool(reg.sites)
+
+
 def maybe_inject(site: str) -> None:
     """The instrumented-site hook: no-op unless a spec armed ``site``."""
     reg = _ensure_loaded()

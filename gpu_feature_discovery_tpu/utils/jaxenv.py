@@ -8,9 +8,14 @@ query — JAX freezes its backend on init — and must go through
 ``JAX_PLATFORMS`` env var after import.
 
 The persistent compilation cache cuts the burn-in's one-time XLA compile
-across daemon RESTARTS (VERDICT r4 next-round #6): measured on a real
-v5e chip, a warm cache takes the first probe's compile phase from ~8.5 s
-to ~1 s (measured at the TPU probe geometry).
+across daemon RESTARTS (the cold-start pipeline, docs/operations.md
+"Cold start anatomy"): measured on a real v5e chip, a warm cache takes
+the first probe's compile phase from ~8.5 s to ~1 s (measured at the TPU
+probe geometry). The on-disk layout is NAMESPACED by (driver version,
+platform, local topology) — ``cache_namespace`` — so a libtpu upgrade or
+a re-shaped node can never be served a stale executable: a different
+namespace is a different directory, and XLA's own content hashing guards
+within one.
 """
 
 from __future__ import annotations
@@ -23,48 +28,165 @@ log = logging.getLogger("tfd.utils")
 
 _COUNT_FLAG = "--xla_force_host_platform_device_count"
 
+# The daemon-facing knob is ``--compilation-cache-dir`` (config/flags.py);
+# CACHE_DIR_ENV is its env ALIAS and stays operator-owned. The RESOLVED
+# value travels in a DISTINCT internal variable: writing the resolution
+# back into the alias would let a stale epoch outrank the config file on
+# the next SIGHUP reload (env > file precedence in new_config) — the
+# cache could then never be moved or disabled by a reload. Children see
+# the resolved var across fork (broker worker) and exec (bench
+# interpreters); standalone callers may still set the alias directly.
+CACHE_DIR_ENV = "TFD_COMPILATION_CACHE_DIR"
+RESOLVED_CACHE_DIR_ENV = "TFD_RESOLVED_COMPILATION_CACHE_DIR"
+
+# Bench/test knob: compiles cheaper than this many seconds are not
+# persisted (they would churn the directory for no win). The 0.5 s
+# production default keeps trivial kernels out; the cold-start bench sets
+# 0 so the virtual-CPU probe kernels — which compile in hundreds of ms —
+# exercise the same cache the real chip's multi-second compiles do.
+CACHE_MIN_COMPILE_ENV = "TFD_COMPILATION_CACHE_MIN_COMPILE_S"
+DEFAULT_CACHE_MIN_COMPILE_S = 0.5
+
 _cache_enabled = False
-_cache_attempted = False
+# The effective directory the cache is currently pointed at (enabled
+# path) and the set of directories that FAILED to enable. Only failures
+# are memoized per directory — an early call with no dir configured must
+# not disable the cache for the process (a config-file-driven dir can
+# appear after an import-time probe), and a later call with a NEW
+# effective dir (a namespace resolved once devices exist) re-points the
+# cache instead of silently serving the un-namespaced root.
+_cache_dir: str | None = None
+_failed_dirs: set = set()
 
 
-def enable_persistent_compilation_cache(environ=None) -> bool:
+def cache_namespace(devices) -> str:
+    """The cache-key namespace for a device set: one filesystem-safe
+    token from (platform, local topology, driver version), e.g.
+    ``tpu8-v5e-1.2.3`` or ``cpu8-0.4.37``. A driver upgrade or a
+    different chip count lands in a different subdirectory, so a stale
+    executable can never be deserialized across them — the
+    coarse-grained invalidation on top of XLA's own content hashing."""
+    devices = list(devices)
+    platform = getattr(devices[0], "platform", "unknown") if devices else "none"
+    version = ""
+    try:
+        version = str(devices[0].client.platform_version or "")
+    except Exception:  # noqa: BLE001 - any backend without the attribute
+        pass
+    if not version:
+        try:
+            import jax
+
+            version = jax.__version__
+        except Exception:  # noqa: BLE001 - namespace stays coarser
+            version = "unversioned"
+    # platform_version can be a multi-line banner; the first token of the
+    # first line carries the version proper.
+    version = version.strip().splitlines()[0] if version.strip() else "unversioned"
+    raw = f"{platform}{len(devices)}-{version}"
+    return re.sub(r"[^A-Za-z0-9._-]+", "-", raw).strip("-")[:96]
+
+
+def configure_compilation_cache(path: str) -> bool:
+    """Parent-side cache-dir plumbing (cmd/main.run calls it once per
+    config epoch with the resolved ``--compilation-cache-dir``): export
+    the directory through RESOLVED_CACHE_DIR_ENV — never the flag's own
+    alias, which the next reload's config layer must read unpolluted —
+    so every enable site (this process, fork children, exec children)
+    sees one value, and verify it is creatable. Returns whether a usable
+    cache dir is configured; never raises (the cache is an optimization,
+    and an unwritable dir must degrade to cold compile with a warning,
+    never fail a cycle)."""
+    path = (path or "").strip()
+    if not path:
+        os.environ.pop(RESOLVED_CACHE_DIR_ENV, None)
+        return False
+    os.environ[RESOLVED_CACHE_DIR_ENV] = path
+    try:
+        os.makedirs(path, exist_ok=True)
+    except OSError as e:
+        log.warning(
+            "compilation cache dir %s is unusable (%s); restarts will "
+            "pay the full XLA compile",
+            path,
+            e,
+        )
+        return False
+    return True
+
+
+def enable_persistent_compilation_cache(environ=None, namespace: str = "") -> bool:
     """Point XLA's persistent compilation cache at
-    ``$TFD_COMPILATION_CACHE_DIR`` (no-op when unset). Idempotent; safe
-    to call from every jax entry point. Returns whether the cache is on.
+    ``$TFD_COMPILATION_CACHE_DIR[/namespace]`` (no-op when unset).
+    Idempotent; safe to call from every jax entry point. Returns whether
+    the cache is on.
 
-    Trivial sub-half-second compiles are not cached (they would churn the
-    directory for no win) — that threshold is configured FIRST, so a jax
-    build lacking either config key leaves the cache fully off, never
-    half-enabled with default thresholds. A failure to enable —
-    unwritable dir, missing config — must never take down labeling (the
-    cache is an optimization, not a dependency) and is attempted only
-    once per process, not re-failed every probing cycle.
-    """
-    global _cache_enabled, _cache_attempted
+    ``namespace`` (``cache_namespace(devices)``) scopes the on-disk
+    layout by (driver version, topology); callers that hold devices pass
+    it so an upgraded libtpu or a re-shaped node starts a fresh
+    subdirectory. A call with a namespace after an earlier namespace-less
+    enable RE-POINTS the cache — the effective directory, not the call
+    order, is what is memoized.
+
+    Trivial compiles below CACHE_MIN_COMPILE_ENV seconds are not cached
+    (they would churn the directory for no win) — that threshold is
+    configured FIRST, so a jax build lacking either config key leaves the
+    cache fully off, never half-enabled with default thresholds. A
+    failure to enable — unwritable dir, missing config — must never take
+    down labeling (the cache is an optimization, not a dependency): it
+    warns once and is memoized per DIRECTORY, not per process, so a
+    usable dir configured later still enables."""
+    global _cache_enabled, _cache_dir
     env = environ if environ is not None else os.environ
-    path = (env.get("TFD_COMPILATION_CACHE_DIR") or "").strip()
-    if not path or _cache_attempted:
+    path = (env.get(RESOLVED_CACHE_DIR_ENV) or "").strip()
+    if not path:
+        # Standalone fallback (no daemon resolved a dir this process):
+        # honor an operator-set alias directly — except the literal
+        # "auto", which only the config layer can resolve (it needs
+        # --state-dir) and must not become a directory named ./auto.
+        path = (env.get(CACHE_DIR_ENV) or "").strip()
+        if path == "auto":
+            path = ""
+    if not path:
         return _cache_enabled
-    _cache_attempted = True
+    effective = os.path.join(path, namespace) if namespace else path
+    if _cache_enabled and effective == _cache_dir:
+        return True
+    if effective in _failed_dirs:
+        return False
     try:
         import jax
 
-        os.makedirs(path, exist_ok=True)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.5)
-        jax.config.update("jax_compilation_cache_dir", path)
+        os.makedirs(effective, exist_ok=True)
+        min_compile = DEFAULT_CACHE_MIN_COMPILE_S
+        raw_min = (env.get(CACHE_MIN_COMPILE_ENV) or "").strip()
+        if raw_min:
+            min_compile = float(raw_min)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", min_compile
+        )
+        jax.config.update("jax_compilation_cache_dir", effective)
         _cache_enabled = True
-        log.debug("persistent XLA compilation cache enabled at %s", path)
+        _cache_dir = effective
+        log.debug("persistent XLA compilation cache enabled at %s", effective)
     except Exception as e:  # noqa: BLE001 - optimization only, never fatal
-        log.debug("persistent compilation cache unavailable (%s)", e)
+        _failed_dirs.add(effective)
+        log.warning(
+            "persistent compilation cache unavailable at %s (%s); "
+            "continuing with cold compiles",
+            effective,
+            e,
+        )
         return False
     return _cache_enabled
 
 
 def reset_compilation_cache_state() -> None:
-    """Forget the enabled/attempted memo (test isolation only)."""
-    global _cache_enabled, _cache_attempted
+    """Forget the enabled/failed memo (test isolation only)."""
+    global _cache_enabled, _cache_dir
     _cache_enabled = False
-    _cache_attempted = False
+    _cache_dir = None
+    _failed_dirs.clear()
 
 
 def pin_virtual_cpu_devices(n_devices: int) -> None:

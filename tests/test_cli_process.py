@@ -8,6 +8,7 @@ import re
 import signal
 import subprocess
 import sys
+import tempfile
 import time
 from pathlib import Path
 
@@ -29,14 +30,38 @@ def spawn(tmp_path, *args, backend="mock:v4-8", env_extra=None, **popen_kw):
     env["PYTHONPATH"] = str(REPO)
     env["TFD_BACKEND"] = backend
     env.update(env_extra or {})
-    return subprocess.Popen(
+    # File-backed capture, NOT pipes: these tests never drain output
+    # while the daemon runs, and a reload storm's per-epoch config dumps
+    # overflow a 64 KiB pipe buffer — the daemon then blocks inside a
+    # log write and the "wedge" is the harness's, not the daemon's
+    # (reproduced: the identical scenario with stderr routed to a file
+    # drains 30 reloads and exits in ~2 s). A real file never back-
+    # pressures the writer, and reads return everything written so far.
+    stdout_f = tempfile.TemporaryFile()
+    stderr_f = tempfile.TemporaryFile()
+    proc = subprocess.Popen(
         [sys.executable, "-m", "gpu_feature_discovery_tpu", *args],
         env=env,
         cwd=str(tmp_path),
-        stdout=subprocess.PIPE,
-        stderr=subprocess.PIPE,
+        stdout=stdout_f,
+        stderr=stderr_f,
         **popen_kw,
     )
+    proc.stdout = _CapturedOutput(stdout_f)
+    proc.stderr = _CapturedOutput(stderr_f)
+    return proc
+
+
+class _CapturedOutput:
+    """Read-everything view over a child's file-backed output stream.
+    Unlike a drained pipe, repeated ``read()`` calls keep returning the
+    full content — failure diagnostics can re-read."""
+
+    def __init__(self, f):
+        self._f = f
+
+    def read(self):
+        return os.pread(self._f.fileno(), 1 << 24, 0)
 
 
 def wait_for_file(path, timeout=15.0):

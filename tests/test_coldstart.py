@@ -1,0 +1,369 @@
+"""Cold-start pipeline tests (ISSUE 11): the persistent compilation
+cache flag resolution/plumbing, the serve-restored-first startup
+ordering, the broker pre-spawn overlap, and the restart-to-labels
+accounting.
+
+The compile-side numbers (first_probe_compile_ms cold vs warm across two
+interpreters sharing one cache dir) live in bench.py's cold-start phase;
+what is pinned here is the MACHINERY: the resolved directory reaches the
+env transport, the restored snapshot hits disk before any backend init
+completes, and the pre-spawn neither double-counts init attempts nor
+runs under fault injection.
+"""
+
+import os
+import queue
+import signal
+import threading
+import time
+
+import pytest
+
+from gpu_feature_discovery_tpu import sandbox as tfd_sandbox
+from gpu_feature_discovery_tpu.cmd import main as cmd_main
+from gpu_feature_discovery_tpu.cmd.main import run
+from gpu_feature_discovery_tpu.cmd.supervisor import RESTORED_LABEL, Supervisor
+from gpu_feature_discovery_tpu.config import new_config
+from gpu_feature_discovery_tpu.config.flags import (
+    resolve_compilation_cache_dir,
+)
+from gpu_feature_discovery_tpu.lm.labeler import Empty
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+from gpu_feature_discovery_tpu.resource.testing import MockChip, MockManager
+from gpu_feature_discovery_tpu.sandbox import LabelStateStore
+from gpu_feature_discovery_tpu.utils import faults, jaxenv
+
+
+@pytest.fixture(autouse=True)
+def _clean(monkeypatch):
+    faults.reset()
+    monkeypatch.delenv(jaxenv.CACHE_DIR_ENV, raising=False)
+    monkeypatch.delenv(jaxenv.RESOLVED_CACHE_DIR_ENV, raising=False)
+    obs_metrics.reset_for_tests()
+    cmd_main._reset_restart_marker()
+    yield
+    faults.reset()
+    os.environ.pop(jaxenv.CACHE_DIR_ENV, None)
+    os.environ.pop(jaxenv.RESOLVED_CACHE_DIR_ENV, None)
+
+
+def cfg(tmp_path, **cli):
+    machine = tmp_path / "machine-type"
+    machine.write_text("Google Compute Engine\n")
+    values = {
+        "oneshot": False,
+        "machine-type-file": str(machine),
+        "output-file": str(tmp_path / "tfd"),
+        "sleep-interval": "0.01s",
+        "init-backoff-max": "0.02s",
+        "init-retries": "50",
+        "max-consecutive-failures": "50",
+    }
+    values.update(cli)
+    return new_config(cli_values=values, environ={})
+
+
+def labels_at(path):
+    try:
+        with open(path) as f:
+            return dict(line.strip().split("=", 1) for line in f if "=" in line)
+    except OSError:
+        return {}
+
+
+def wait_until(pred, timeout=10.0, interval=0.002):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def start_daemon(config, manager=None, interconnect=None):
+    sigs = queue.Queue()
+    result = {}
+
+    def target():
+        try:
+            result["restart"] = run(
+                manager
+                if manager is not None
+                else (lambda: cmd_main._build_manager(config)),
+                interconnect if interconnect is not None else Empty(),
+                config,
+                sigs,
+                supervisor=Supervisor(config),
+            )
+        except BaseException as e:  # noqa: BLE001 - surfaced by the test
+            result["error"] = e
+
+    t = threading.Thread(target=target)
+    t.start()
+    return t, sigs, result
+
+
+def stop_daemon(t, sigs, result):
+    sigs.put(signal.SIGTERM)
+    t.join(timeout=10)
+    assert not t.is_alive()
+    assert "error" not in result, result.get("error")
+    return result
+
+
+# ---------------------------------------------------------------------------
+# flag resolution + parent-side plumbing
+# ---------------------------------------------------------------------------
+
+def test_resolver_auto_follows_state_dir(tmp_path):
+    config = cfg(tmp_path, **{"state-dir": str(tmp_path / "state")})
+    assert resolve_compilation_cache_dir(config) == str(
+        tmp_path / "state" / "xla-cache"
+    )
+
+
+def test_resolver_auto_without_state_dir_disables(tmp_path):
+    assert resolve_compilation_cache_dir(cfg(tmp_path)) == ""
+
+
+def test_resolver_explicit_path_and_explicit_empty(tmp_path):
+    explicit = cfg(
+        tmp_path,
+        **{
+            "state-dir": str(tmp_path / "state"),
+            "compilation-cache-dir": str(tmp_path / "elsewhere"),
+        },
+    )
+    assert resolve_compilation_cache_dir(explicit) == str(tmp_path / "elsewhere")
+    # Explicit empty disables even with a state dir: the operator's
+    # opt-out must win over the auto default.
+    off = cfg(
+        tmp_path,
+        **{"state-dir": str(tmp_path / "state"), "compilation-cache-dir": ""},
+    )
+    assert resolve_compilation_cache_dir(off) == ""
+
+
+def test_configure_exports_env_and_creates_dir(tmp_path):
+    target = tmp_path / "xla-cache"
+    assert jaxenv.configure_compilation_cache(str(target)) is True
+    assert os.environ[jaxenv.RESOLVED_CACHE_DIR_ENV] == str(target)
+    assert target.is_dir()
+    # Empty clears the transport so children do not inherit a stale dir.
+    assert jaxenv.configure_compilation_cache("") is False
+    assert jaxenv.RESOLVED_CACHE_DIR_ENV not in os.environ
+
+
+def test_resolved_transport_never_pollutes_the_flag_alias(tmp_path):
+    """The resolved dir must travel in its OWN env var: writing it back
+    into TFD_COMPILATION_CACHE_DIR (the flag's env alias) would let a
+    stale epoch outrank the config file on the next SIGHUP reload
+    (env > file precedence) — the cache could then never be moved or
+    disabled by a reload."""
+    assert jaxenv.configure_compilation_cache(str(tmp_path / "epoch1")) is True
+    assert jaxenv.CACHE_DIR_ENV not in os.environ
+    # A reload's config build (env snapshot included) still sees the
+    # flag at its default — the previous epoch's resolution is invisible.
+    reloaded = new_config(cli_values={}, environ=dict(os.environ))
+    assert reloaded.flags.tfd.compilation_cache_dir == "auto"
+
+
+def test_enable_fallback_treats_auto_alias_as_disabled(monkeypatch):
+    """A standalone enable (no daemon resolved a dir) honors an
+    operator-set alias, but the literal 'auto' needs the config layer's
+    --state-dir resolution and must not become a directory named
+    ./auto."""
+    from gpu_feature_discovery_tpu.utils import jaxenv as je
+
+    je.reset_compilation_cache_state()
+    monkeypatch.setenv(je.CACHE_DIR_ENV, "auto")
+    assert je.enable_persistent_compilation_cache() is False
+    assert not os.path.exists("auto")
+
+
+def test_configure_unusable_dir_degrades(tmp_path):
+    blocker = tmp_path / "a-file"
+    blocker.write_text("not a dir")
+    assert jaxenv.configure_compilation_cache(str(blocker / "sub")) is False
+
+
+def test_daemon_epoch_wires_cache_dir_and_gauge(tmp_path, monkeypatch):
+    """run() resolves --compilation-cache-dir per epoch: the directory
+    exists, the env transport carries it (fork children inherit it), and
+    tfd_compile_cache_enabled reports it. Unset (no state dir) keeps
+    current behavior: no env, gauge 0."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    state_dir = str(tmp_path / "state")
+    config = cfg(tmp_path, **{"state-dir": state_dir})
+    out = config.flags.tfd.output_file
+    t, sigs, result = start_daemon(config)
+    try:
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+        )
+        expected = os.path.join(state_dir, "xla-cache")
+        assert os.environ.get(jaxenv.RESOLVED_CACHE_DIR_ENV) == expected
+        assert os.path.isdir(expected)
+        assert obs_metrics.COMPILE_CACHE_ENABLED.value() == 1
+        # The metric lands in the success block just after the write.
+        assert wait_until(
+            lambda: obs_metrics.RESTART_TO_LABELS.value() > 0
+        ), "first full live write must record restart-to-labels"
+    finally:
+        stop_daemon(t, sigs, result)
+
+    obs_metrics.reset_for_tests()
+    cmd_main._reset_restart_marker()
+    os.environ.pop(jaxenv.CACHE_DIR_ENV, None)
+    config2 = cfg(tmp_path)  # no state dir -> auto resolves to disabled
+    t, sigs, result = start_daemon(config2)
+    try:
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+        )
+        assert jaxenv.RESOLVED_CACHE_DIR_ENV not in os.environ
+        assert obs_metrics.COMPILE_CACHE_ENABLED.value() == 0
+    finally:
+        stop_daemon(t, sigs, result)
+
+
+# ---------------------------------------------------------------------------
+# startup ordering: restored snapshot first, backend warms concurrently
+# ---------------------------------------------------------------------------
+
+def test_restored_write_precedes_backend_readiness(tmp_path):
+    """ISSUE 11 acceptance: with a warm --state-dir and a backend whose
+    init is DELAYED, the restored label file is already on disk — marked
+    tfd.restored — when the backend factory is first invoked, and its
+    mtime precedes backend readiness. The restored write must never wait
+    behind broker spawn/PJRT init."""
+    state_dir = str(tmp_path / "state")
+    store = LabelStateStore(state_dir)
+    assert store.save(
+        {"google.com/tpu.count": "4", "google.com/tpu.machine": "gce"}
+    )
+    config = cfg(tmp_path, **{"state-dir": state_dir})
+    out = config.flags.tfd.output_file
+    seen = {}
+
+    def delayed_manager():
+        # Snapshot what is on disk THE MOMENT backend init begins.
+        seen["at_init"] = labels_at(out)
+        seen["mtime_at_init"] = (
+            os.stat(out).st_mtime_ns if os.path.exists(out) else None
+        )
+        time.sleep(0.3)  # a slow PJRT init / broker spawn
+        seen["ready_walltime_ns"] = time.time_ns()
+        return MockManager(chips=[MockChip() for _ in range(4)])
+
+    t, sigs, result = start_daemon(config, manager=delayed_manager)
+    try:
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+            and RESTORED_LABEL not in labels_at(out)
+        ), f"never reached live labels: {labels_at(out)}"
+    finally:
+        stop_daemon(t, sigs, result)
+    assert seen["at_init"].get(RESTORED_LABEL) == "true", (
+        f"restored labels were not on disk when init began: {seen['at_init']}"
+    )
+    assert seen["at_init"].get("google.com/tpu.count") == "4"
+    assert seen["mtime_at_init"] is not None
+    assert seen["mtime_at_init"] < seen["ready_walltime_ns"], (
+        "label file mtime must precede backend readiness"
+    )
+
+
+# ---------------------------------------------------------------------------
+# broker pre-spawn
+# ---------------------------------------------------------------------------
+
+def test_prespawn_is_one_attempt_and_acquisition_reuses(
+    tmp_path, monkeypatch
+):
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    config = cfg(tmp_path)
+    try:
+        thread = tfd_sandbox.prespawn_broker(config)
+        thread.join(timeout=15)
+        client = tfd_sandbox.get_broker(config)
+        assert client.alive, "pre-spawn did not bring the worker up"
+        assert obs_metrics.BACKEND_INIT_ATTEMPTS.value() == 1
+        # The cycle's acquisition is one RPC against the pre-spawned
+        # worker — no second init attempt, no respawn.
+        manager = tfd_sandbox.acquire_broker_manager(config)
+        assert manager.get_chips()
+        assert obs_metrics.BACKEND_INIT_ATTEMPTS.value() == 1
+        assert obs_metrics.BROKER_RESPAWNS.value() == 0
+    finally:
+        tfd_sandbox.close_broker()
+
+
+def test_prespawn_failure_is_contained(tmp_path, monkeypatch):
+    """A pre-spawn that cannot init must swallow the error (supervision
+    owns failures) and leave the client respawn-able. Driven with an
+    injected init fault directly — this unit test IS the paced caller,
+    so the run-loop's stand-down gate does not apply."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    faults.load_fault_spec("pjrt_init:fail:1")
+    config = cfg(tmp_path, **{"init-backoff-max": "0.001s"})
+    try:
+        thread = tfd_sandbox.prespawn_broker(config)
+        thread.join(timeout=15)  # must not raise out of the thread
+        client = tfd_sandbox.get_broker(config)
+        assert not client.alive
+        # The failed spawn opened the (tiny) backoff window; once it
+        # passes, the next acquisition respawns and serves.
+        time.sleep(0.01)
+        manager = tfd_sandbox.acquire_broker_manager(config)
+        assert manager.get_chips()
+    finally:
+        tfd_sandbox.close_broker()
+
+
+def test_prespawn_after_close_refuses_to_spawn(tmp_path, monkeypatch):
+    """A pre-spawn that loses the race against epoch teardown must NOT
+    fork a worker nobody will ever close — on hardware an orphan would
+    hold the chip against the next epoch's init."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    config = cfg(tmp_path)
+    client = tfd_sandbox.get_broker(config)
+    tfd_sandbox.close_broker()
+    client.prespawn()  # the stale thread body, after close
+    assert not client.alive
+    assert obs_metrics.BACKEND_INIT_ATTEMPTS.value() == 0
+
+
+def test_daemon_prespawns_broker_only_without_faults(tmp_path, monkeypatch):
+    """The run-loop gate: pre-spawn fires for a supervised broker epoch,
+    and stands down when a fault spec is loaded (an injected shot must
+    only ever be consumed by the supervisor's paced attempts)."""
+    monkeypatch.setenv("TFD_BACKEND", "mock:v4-8")
+    calls = []
+    real = tfd_sandbox.prespawn_broker
+    monkeypatch.setattr(
+        tfd_sandbox,
+        "prespawn_broker",
+        lambda config, backend=None: calls.append(1) or real(config, backend),
+    )
+    config = cfg(tmp_path)
+    out = config.flags.tfd.output_file
+    t, sigs, result = start_daemon(config)
+    try:
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+        )
+        assert calls == [1], "supervised broker epoch must pre-spawn once"
+    finally:
+        stop_daemon(t, sigs, result)
+
+    calls.clear()
+    faults.load_fault_spec("pjrt_init:fail:1")
+    t, sigs, result = start_daemon(cfg(tmp_path))
+    try:
+        assert wait_until(
+            lambda: labels_at(out).get("google.com/tpu.count") == "4"
+        )
+        assert calls == [], "pre-spawn must stand down under injection"
+    finally:
+        stop_daemon(t, sigs, result)

@@ -44,6 +44,7 @@ FILE_KEYS = {
     "flap-window": ("tfd", "flapWindow"),
     "probe-broker": ("tfd", "probeBroker"),
     "broker-max-requests": ("tfd", "brokerMaxRequests"),
+    "compilation-cache-dir": ("tfd", "compilationCacheDir"),
     "chip-probes": ("tfd", "chipProbes"),
     "straggler-threshold": ("tfd", "stragglerThreshold"),
     "slice-coordination": ("tfd", "sliceCoordination"),

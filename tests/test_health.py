@@ -567,3 +567,34 @@ def test_device_clock_degraded_rates_publish(monkeypatch):
     labels = new_health_labeler(manager, cfg(**{"with-burnin": "true"})).labels()
     assert labels[HEALTH_TFLOPS] == "0"
     assert labels[HEALTH_HBM] == "0"
+
+
+def test_first_probe_compile_metric_fed_from_report_phases(monkeypatch):
+    """ISSUE 11: a probe report carrying a non-zero phases.compile_ms
+    feeds tfd_first_probe_compile_seconds — on the broker path the
+    phases ride the report back to the parent, so this is the seam that
+    makes the compile cost scrapeable wherever the probe ran."""
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.reset_for_tests()
+    _pretend_devices_are_tpus(monkeypatch)
+    _fixed_measure(monkeypatch, {
+        "healthy": True, "tflops": 10.0, "hbm_gbps": 500.0, "ici_ok": None,
+        "timing": "device-profiler",
+        "phases": {"compile_ms": 8500.0, "trace_ms": 1100.0},
+    })
+    manager = MockManager(chips=[MockChip(family="v5e")])
+    labels = new_health_labeler(manager, cfg(**{"with-burnin": "true"})).labels()
+    assert labels[HEALTH_OK] == "true"
+    assert obs_metrics.FIRST_PROBE_COMPILE.value() == pytest.approx(8.5)
+
+    # A warm probe (compile_ms 0 / absent) leaves the last value alone —
+    # the gauge records the most recent probe that actually compiled.
+    _fixed_measure(monkeypatch, {
+        "healthy": True, "tflops": 10.0, "hbm_gbps": 500.0, "ici_ok": None,
+        "timing": "device-profiler", "phases": {"compile_ms": 0.0},
+    })
+    labels = new_health_labeler(
+        manager, cfg(**{"with-burnin": "true", "burnin-interval": "1"})
+    ).labels()
+    assert obs_metrics.FIRST_PROBE_COMPILE.value() == pytest.approx(8.5)

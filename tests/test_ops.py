@@ -135,6 +135,118 @@ def test_compilation_cache_failure_is_nonfatal(tmp_path, monkeypatch):
     assert jaxenv.enable_persistent_compilation_cache() is False
 
 
+def test_compilation_cache_retries_when_dir_appears(tmp_path, monkeypatch):
+    """ISSUE 11 satellite: the once-per-process memo must cover only the
+    FAILURE path, per directory — an early call with no dir configured
+    (or with a broken one) must not disable the cache for the process
+    once a usable dir appears (e.g. a config-file-driven dir resolved
+    after an import-time probe already called enable)."""
+    from gpu_feature_discovery_tpu.utils import jaxenv
+
+    jaxenv.reset_compilation_cache_state()
+    try:
+        # 1) no dir configured: off, but NOT memoized off.
+        monkeypatch.delenv("TFD_COMPILATION_CACHE_DIR", raising=False)
+        assert jaxenv.enable_persistent_compilation_cache() is False
+        # 2) a broken dir: off, memoized off FOR THAT DIRECTORY only.
+        blocker = tmp_path / "a-file"
+        blocker.write_text("not a dir")
+        monkeypatch.setenv("TFD_COMPILATION_CACHE_DIR", str(blocker / "x"))
+        assert jaxenv.enable_persistent_compilation_cache() is False
+        assert jaxenv.enable_persistent_compilation_cache() is False
+        # 3) a usable dir appears: the cache turns ON in the same process.
+        good = tmp_path / "xla-cache"
+        monkeypatch.setenv("TFD_COMPILATION_CACHE_DIR", str(good))
+        assert jaxenv.enable_persistent_compilation_cache() is True
+        import jax
+
+        assert jax.config.jax_compilation_cache_dir == str(good)
+    finally:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", None)
+        jaxenv.reset_compilation_cache_state()
+
+
+def test_compilation_cache_namespace_keys_by_version_and_topology(
+    tmp_path, monkeypatch
+):
+    """ISSUE 11 satellite: the on-disk cache is namespaced by (driver
+    version, platform, topology) — a cache written under one namespace
+    is a DIFFERENT directory under another, so a libtpu upgrade or a
+    re-shaped node can never deserialize a stale executable; and a
+    namespace resolved after an earlier namespace-less enable re-points
+    the cache instead of silently serving the root."""
+    import jax
+
+    from gpu_feature_discovery_tpu.utils import jaxenv
+
+    class FakeClient:
+        def __init__(self, version):
+            self.platform_version = version
+
+    class FakeDevice:
+        def __init__(self, version):
+            self.platform = "tpu"
+            self.client = FakeClient(version)
+
+    old = [FakeDevice("libtpu 1.2.3") for _ in range(4)]
+    new = [FakeDevice("libtpu 1.3.0") for _ in range(4)]
+    reshaped = [FakeDevice("libtpu 1.2.3") for _ in range(8)]
+    ns_old = jaxenv.cache_namespace(old)
+    assert ns_old == jaxenv.cache_namespace(old), "namespace is stable"
+    assert ns_old != jaxenv.cache_namespace(new), "driver upgrade re-keys"
+    assert ns_old != jaxenv.cache_namespace(reshaped), "topology re-keys"
+    assert "/" not in ns_old and ".." not in ns_old
+
+    jaxenv.reset_compilation_cache_state()
+    monkeypatch.setenv("TFD_COMPILATION_CACHE_DIR", str(tmp_path))
+    try:
+        # Namespace-less enable (an import-time entry point)...
+        assert jaxenv.enable_persistent_compilation_cache() is True
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+        # ...is UPGRADED once devices exist to derive the namespace.
+        assert jaxenv.enable_persistent_compilation_cache(
+            namespace=ns_old
+        ) is True
+        dir_old = jax.config.jax_compilation_cache_dir
+        assert dir_old == str(tmp_path / ns_old)
+        # A different namespace points at a disjoint directory: entries
+        # written under the old driver are structurally unreachable.
+        assert jaxenv.enable_persistent_compilation_cache(
+            namespace=jaxenv.cache_namespace(new)
+        ) is True
+        assert jax.config.jax_compilation_cache_dir != dir_old
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        jaxenv.reset_compilation_cache_state()
+
+
+def test_compilation_cache_min_compile_env_knob(tmp_path, monkeypatch):
+    """The bench/test knob: TFD_COMPILATION_CACHE_MIN_COMPILE_S overrides
+    the 0.5 s churn threshold (the cold-start bench sets 0 so the
+    virtual-CPU probe kernels exercise the cache)."""
+    import jax
+
+    from gpu_feature_discovery_tpu.utils import jaxenv
+
+    jaxenv.reset_compilation_cache_state()
+    monkeypatch.setenv("TFD_COMPILATION_CACHE_DIR", str(tmp_path))
+    monkeypatch.setenv("TFD_COMPILATION_CACHE_MIN_COMPILE_S", "0")
+    try:
+        assert jaxenv.enable_persistent_compilation_cache() is True
+        assert (
+            jax.config.jax_persistent_cache_min_compile_time_secs == 0.0
+        )
+    finally:
+        jax.config.update("jax_compilation_cache_dir", None)
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs",
+            jaxenv.DEFAULT_CACHE_MIN_COMPILE_S,
+        )
+        jaxenv.reset_compilation_cache_state()
+
+
 def test_probe_workspace_commits_to_target_device():
     """Multi-chip correctness pin: the probe workspace must be COMMITTED
     to its device — a jit output under jax.default_device is uncommitted,
@@ -188,6 +300,27 @@ def test_jax_manager_release_clears_probe_workspaces():
     assert hc._burnin_workspace.cache_info().currsize == 0
     assert stream_workspace.cache_info().currsize == 0
     assert not hc._warmed_probe_keys
+
+
+def test_warm_probe_kernels_honors_geometry_override(monkeypatch):
+    """ISSUE 11: the broker pre-warm must compile at the geometry the
+    probe will actually use — with TFD_BURNIN_GEOMETRY set, warming at
+    the platform default would compile kernels no probe runs and leave
+    the first probing cycle paying the real compile anyway."""
+    import jax
+
+    from gpu_feature_discovery_tpu.ops import healthcheck as hc
+
+    devices = tuple(jax.local_devices()[:1])
+    monkeypatch.setenv(hc.BURNIN_GEOMETRY_ENV, "128x2")
+    hc.reset_probe_workspaces()
+    try:
+        assert hc.warm_probe_kernels_for(devices) > 0.0
+        assert (devices, 128, 2, "wall") in hc._warmed_probe_keys, (
+            "warm must land on the override geometry's memo key"
+        )
+    finally:
+        hc.reset_probe_workspaces()
 
 
 def test_warm_probe_kernels_for_matches_probe_geometry_and_memoizes():
