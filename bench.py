@@ -1047,7 +1047,7 @@ def main() -> int:
                 IntrospectionState(60.0),
                 addr="127.0.0.1",
                 port=0,
-                peer_snapshot=serving.snapshot_payload,
+                peer_snapshot=serving.snapshot_response,
             )
             server.start()
             peer_servers.append(server)
@@ -1083,6 +1083,108 @@ def main() -> int:
         f"{slice_workers - 1} live peers + aggregate) "
         f"p50={slice_aggregation_ms}ms over {slice_iters} rounds "
         f"(sleep interval {DEFAULT_SLEEP_INTERVAL * 1e3:.0f}ms)",
+        file=sys.stderr,
+    )
+
+    # Coordination-plane scale (ISSUE 12): leader poll rounds at 16 and
+    # 64 simulated peers with dead (timing-out) members in the slice —
+    # the claim under test is that one round costs ~1x the per-peer
+    # timeout, NOT N x: the bounded fan-out pool overlaps the dead
+    # peers' timeouts with each other and with the fast tail. A dead
+    # peer is a bound-but-never-accepting listener, so the poll's
+    # connect lands in the backlog and the read eats the full timeout —
+    # the worst per-peer cost. The dead peers' re-poll backoff is zeroed
+    # so EVERY measured round pays them (steady-state worst case, not
+    # the confirmed-down fast path). 64 peers carry a RUN of 8 dead
+    # members — the motivating storm where the sequential round spends
+    # 8 x timeout before reaching the tail.
+    import socket as _slice_socket
+
+    from gpu_feature_discovery_tpu.utils.retry import (
+        BackoffPolicy as _SliceBackoff,
+    )
+
+    slice_scale_peer_timeout_s = 0.5
+
+    def _measure_scale_round(total_workers, dead_peers):
+        servers, blackholes = [], []
+        leader = None
+        ports = {}
+        names = [f"w{i}" for i in range(total_workers)]
+        try:
+            for peer_id in range(1, total_workers):
+                if peer_id > total_workers - 1 - dead_peers:
+                    sock = _slice_socket.socket()
+                    sock.bind(("127.0.0.1", 0))
+                    sock.listen(16)
+                    blackholes.append(sock)
+                    ports[peer_id] = sock.getsockname()[1]
+                    continue
+                serving = SliceCoordinator(
+                    peer_id, names, default_port=1, peer_timeout=1.0
+                )
+                serving.publish_local(
+                    {
+                        "google.com/tpu.count": "4",
+                        "google.com/tpu.chips.healthy": "4",
+                        "google.com/tpu.chips.sick": "0",
+                    },
+                    "full",
+                )
+                server = IntrospectionServer(
+                    obs_metrics.REGISTRY,
+                    IntrospectionState(60.0),
+                    addr="127.0.0.1",
+                    port=0,
+                    peer_snapshot=serving.snapshot_response,
+                )
+                server.start()
+                servers.append(server)
+                ports[peer_id] = server.port
+            leader = SliceCoordinator(
+                0,
+                ["127.0.0.1:1"]
+                + [f"127.0.0.1:{ports[i]}" for i in range(1, total_workers)],
+                default_port=1,
+                peer_timeout=slice_scale_peer_timeout_s,
+                # Re-poll dead peers every round: the measurement is the
+                # round that PAYS the timeouts, not the backoff skip.
+                backoff_factory=lambda: _SliceBackoff(
+                    base=0.0, factor=1.0, cap=0.0, jitter=0.0
+                ),
+            )
+            iters = max(
+                2, int(os.environ.get("TFD_BENCH_SLICE_SCALE_ITERS", "3"))
+            )
+            leader.poll_once()  # warm connections + confirm the dead
+            rounds_ms = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                leader.poll_once()
+                rounds_ms.append((time.perf_counter() - t0) * 1e3)
+            view = leader.view()
+            assert view.healthy_hosts == total_workers - dead_peers, view
+            return round(statistics.median(rounds_ms), 3)
+        finally:
+            if leader is not None:
+                # In the finally so a failed assertion cannot leak the
+                # fan-out pool, the per-peer connections, or latched
+                # PEER_UNREACHABLE gauges into later bench sections.
+                leader.close()
+            for server in servers:
+                server.close()
+            for sock in blackholes:
+                sock.close()
+
+    slice_aggregation_16_ms = _measure_scale_round(16, dead_peers=1)
+    slice_aggregation_64_ms = _measure_scale_round(64, dead_peers=8)
+    print(
+        f"bench: slice scale rounds (fan-out, peer timeout "
+        f"{slice_scale_peer_timeout_s * 1e3:.0f}ms) 16 peers/1 dead "
+        f"p50={slice_aggregation_16_ms}ms, 64 peers/8 dead "
+        f"p50={slice_aggregation_64_ms}ms "
+        f"(sequential would be >= {1 * 500}ms + tail and "
+        f">= {8 * 500}ms + tail)",
         file=sys.stderr,
     )
 
@@ -1302,6 +1404,16 @@ def main() -> int:
                 # interval it runs once per.
                 "slice_aggregation_ms": slice_aggregation_ms,
                 "slice_workers": slice_workers,
+                # Coordination-plane scale (ISSUE 12): leader poll
+                # rounds over 16 peers (1 timing-out) and 64 peers (a
+                # RUN of 8 timing-out) under the concurrent fan-out —
+                # CI asserts both bounded by ~1x the per-peer timeout
+                # (2x / 2.5x with scheduling headroom), not N x.
+                "slice_aggregation_16_ms": slice_aggregation_16_ms,
+                "slice_aggregation_64_ms": slice_aggregation_64_ms,
+                "slice_scale_peer_timeout_ms": round(
+                    slice_scale_peer_timeout_s * 1e3, 3
+                ),
                 "sleep_interval_ms": round(DEFAULT_SLEEP_INTERVAL * 1e3, 3),
                 # Event-driven reconcile acceptance (ISSUE 9): POST
                 # /probe -> label file mtime change against a 60s sleep

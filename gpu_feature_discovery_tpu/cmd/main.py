@@ -589,7 +589,7 @@ def run(
 
         coordinator = new_slice_coordinator(config)
     peer_snapshot = (
-        coordinator.snapshot_payload if coordinator is not None else None
+        coordinator.snapshot_response if coordinator is not None else None
     )
     # Event-driven reconcile loop (cmd/events.py): --reconcile=event (the
     # supervised-daemon default via auto) blocks on the typed event queue

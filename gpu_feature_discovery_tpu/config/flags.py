@@ -95,6 +95,14 @@ DEFAULT_STRAGGLER_THRESHOLD = 0.2
 # deadline miss serves the last-good slice labels, never blocks the
 # node-local path).
 DEFAULT_PEER_TIMEOUT = 2.0
+# Concurrent peer fan-out (peering/coordinator.py): how many peer polls
+# one round runs at once. 0 = auto, resolving to min(8, peers) — one
+# round then costs ~1x the per-peer timeout per 8 slow peers instead of
+# 1x per slow peer, so a 64-host slice with a run of slow-but-alive
+# members no longer stalls the round for minutes or starves the tail
+# behind the round budget. 1 reproduces the sequential round byte for
+# byte (no pool is constructed at all).
+DEFAULT_PEER_FANOUT = 0
 # Event-driven reconcile loop (cmd/events.py): the staleness bound
 # defaults to the sleep interval (0 = "track --sleep-interval", so the
 # interval flag keeps one meaning in both modes); the debounce window
@@ -518,6 +526,20 @@ FLAG_DEFS: List[FlagDef] = [
         "confirm the peer unreachable)",
         setter=lambda c, v: setattr(_f(c).tfd, "peer_timeout", v),
         getter=lambda c: _f(c).tfd.peer_timeout,
+    ),
+    FlagDef(
+        name="peer-fanout",
+        env_vars=("TFD_PEER_FANOUT",),
+        parse=_parse_nonneg_int,
+        default=DEFAULT_PEER_FANOUT,
+        help="with slice coordination on, how many peer snapshot polls "
+        "one round runs concurrently (bounded pool): 0 (default) is "
+        "auto — min(8, peers) — so one round costs ~1x --peer-timeout "
+        "per 8 slow peers instead of 1x per slow peer; 1 reproduces "
+        "the sequential round byte for byte; values above the peer "
+        "count are capped at it",
+        setter=lambda c, v: setattr(_f(c).tfd, "peer_fanout", v),
+        getter=lambda c: _f(c).tfd.peer_fanout,
     ),
     FlagDef(
         name="backends",

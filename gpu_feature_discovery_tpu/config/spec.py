@@ -208,6 +208,10 @@ class TfdFlags:
     # reachable worker-id aggregates and publishes slice-scoped labels.
     slice_coordination: Optional[str] = None  # auto | on | off
     peer_timeout: Optional[float] = None  # seconds, per-peer connect/read
+    # Bounded concurrent peer fan-out (peering/coordinator.py): how many
+    # peer polls one round runs at once. 0 = auto (min(8, peers));
+    # 1 reproduces the sequential round byte for byte.
+    peer_fanout: Optional[int] = None  # 0 = auto
     # Multi-backend registry (resource/registry.py): comma-separated
     # backend tokens, one per label family ("auto" = the classic
     # TPU-first autodetect, byte-identical to the pre-registry daemon).
@@ -285,6 +289,7 @@ class Config:
                     "stragglerThreshold": self.flags.tfd.straggler_threshold,
                     "sliceCoordination": self.flags.tfd.slice_coordination,
                     "peerTimeout": self.flags.tfd.peer_timeout,
+                    "peerFanout": self.flags.tfd.peer_fanout,
                     "backends": self.flags.tfd.backends,
                     "reconcile": self.flags.tfd.reconcile,
                     "maxStaleness": self.flags.tfd.max_staleness,
@@ -460,6 +465,8 @@ def parse_config_file(path: str) -> Config:
     config.flags.tfd.slice_coordination = _opt_str(tfd.get("sliceCoordination"))
     if tfd.get("peerTimeout") is not None:
         config.flags.tfd.peer_timeout = parse_duration(tfd["peerTimeout"])
+    if tfd.get("peerFanout") is not None:
+        config.flags.tfd.peer_fanout = parse_nonneg_int(tfd["peerFanout"])
     config.flags.tfd.backends = _opt_str(tfd.get("backends"))
     config.flags.tfd.reconcile = _opt_str(tfd.get("reconcile"))
     if tfd.get("maxStaleness") is not None:

@@ -14,8 +14,9 @@ import os
 import shutil
 import sys
 import tempfile
+import threading
 import time
-from typing import TextIO
+from typing import Dict, Optional, TextIO
 
 from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
 
@@ -67,8 +68,13 @@ class Labels(dict):
         file, no rename, mtime untouched. NFD's "local" source re-parses
         the feature file on every change event; the reference renames
         unconditionally every cycle, waking NFD each sleep interval for
-        labels that did not change. Returns are indistinguishable to the
-        caller: the file's contents are the requested labels either way.
+        labels that did not change. Steady-state cycles pay one stat()
+        for that check, not a file read: the last-written bytes are
+        cached per path and compared in memory, with the disk read only
+        on the first cycle of an epoch or after an out-of-band edit
+        (which moves the stat signature and still triggers a rewrite).
+        Returns are indistinguishable to the caller: the file's contents
+        are the requested labels either way.
         """
         from gpu_feature_discovery_tpu.utils.faults import maybe_inject
 
@@ -81,13 +87,80 @@ class Labels(dict):
         buf = io.StringIO()
         self.write_to(buf)
         contents = buf.getvalue().encode()
-        if _file_contents_equal(path, contents):
+        abs_path = os.path.abspath(path)
+        # In-memory churn check first: when this process last wrote (or
+        # verified) exactly these bytes AND the file's stat signature is
+        # unchanged since, the skip needs no disk read at all. The stat
+        # guard keeps the out-of-band contract: any external edit moves
+        # mtime/size/inode, falls through to the disk read below, and —
+        # if the content really differs — triggers a rewrite.
+        if _write_cache_matches(abs_path, contents):
             obs_metrics.LABEL_WRITE_SKIPS.inc()
             return
-        _write_file_atomically(path, contents, OUTPUT_MODE)
+        # First cycle of an epoch (or a touched-but-identical file): one
+        # disk read seeds the cache so later cycles skip it. The stat
+        # signature is captured BEFORE the read — an out-of-band edit
+        # landing after it moves the file off the cached signature, so
+        # the next cycle falls back to the disk read again instead of
+        # trusting a signature that postdates the verification.
+        pre_sig = _stat_signature(abs_path)
+        if pre_sig is not None and _file_contents_equal(path, contents):
+            _write_cache_put(abs_path, contents, pre_sig)
+            obs_metrics.LABEL_WRITE_SKIPS.inc()
+            return
+        sig = _write_file_atomically(path, contents, OUTPUT_MODE)
+        _write_cache_put(abs_path, contents, sig)
         obs_metrics.LABEL_WRITES.inc()
         obs_metrics.LABEL_FILE_BYTES.set(len(contents))
         obs_metrics.LABELS_PUBLISHED.set(len(self))
+
+
+# Last bytes this process wrote (or verified on disk) per absolute
+# output path, with a stat signature that provably describes those bytes
+# (_write_cache_put). The steady-state churn check compares in memory +
+# one stat() instead of re-reading the file every cycle; the signature
+# (mtime_ns, size, inode) is the ConfigFileWatcher's change fingerprint,
+# so an out-of-band edit always falls back to the disk read (and from
+# there to a rewrite).
+_write_cache: Dict[str, "tuple[bytes, tuple]"] = {}
+_write_cache_lock = threading.Lock()
+
+
+def _stat_signature(path: str) -> Optional[tuple]:
+    try:
+        st = os.stat(path)
+    except OSError:
+        return None
+    return (st.st_mtime_ns, st.st_size, st.st_ino)
+
+
+def _write_cache_matches(abs_path: str, contents: bytes) -> bool:
+    with _write_cache_lock:
+        cached = _write_cache.get(abs_path)
+    if cached is None or cached[0] != contents:
+        return False
+    sig = _stat_signature(abs_path)
+    return sig is not None and sig == cached[1]
+
+
+def _write_cache_put(
+    abs_path: str, contents: bytes, sig: Optional[tuple]
+) -> None:
+    # The signature must PROVABLY describe ``contents``: the staged temp
+    # file pre-rename (os.replace preserves inode/size/mtime) or a stat
+    # taken before the verifying read — never a stat taken after the
+    # fact, which an out-of-band writer could have raced, pairing our
+    # bytes with a foreign file and latching its content indefinitely.
+    with _write_cache_lock:
+        if sig is None:
+            _write_cache.pop(abs_path, None)
+        else:
+            _write_cache[abs_path] = (contents, sig)
+
+
+def _write_cache_forget(abs_path: str) -> None:
+    with _write_cache_lock:
+        _write_cache.pop(abs_path, None)
 
 
 def _file_contents_equal(path: str, contents: bytes) -> bool:
@@ -101,7 +174,9 @@ def _file_contents_equal(path: str, contents: bytes) -> bool:
         return False
 
 
-def _write_file_atomically(path: str, contents: bytes, perm: int) -> None:
+def _write_file_atomically(
+    path: str, contents: bytes, perm: int
+) -> Optional[tuple]:
     """Stage into ``<dir>/tfd-tmp`` then rename over the target
     (labels.go:68-114). The staging dir lives on the same filesystem as the
     target so the rename is atomic.
@@ -129,6 +204,10 @@ def _write_file_atomically(path: str, contents: bytes, perm: int) -> None:
             obs_metrics.FSYNC_DURATION.observe(
                 time.perf_counter() - fsync_start
             )
+        # Write-cache signature from the temp file BEFORE the rename
+        # publishes it (rename preserves inode/size/mtime): stat'ing the
+        # target afterwards could race an out-of-band writer.
+        sig = _stat_signature(tmp_name)
         os.replace(tmp_name, abs_path)
     except BaseException:
         try:
@@ -138,6 +217,7 @@ def _write_file_atomically(path: str, contents: bytes, perm: int) -> None:
         raise
     os.chmod(abs_path, perm)
     _fsync_dir(out_dir)
+    return sig
 
 
 def _fsync_dir(dir_path: str) -> None:
@@ -164,6 +244,7 @@ def remove_output_file(path: str) -> None:
     if not path:
         return
     abs_path = os.path.abspath(path)
+    _write_cache_forget(abs_path)
     tmp_dir = os.path.join(os.path.dirname(abs_path), TMP_SUBDIR)
     shutil.rmtree(tmp_dir, ignore_errors=True)
     if os.path.exists(abs_path):

@@ -243,6 +243,35 @@ PEER_POLL_DURATION = REGISTRY.histogram(
     "Round-trip time of each peer snapshot poll, whatever its outcome "
     "(a timed-out poll contributes its full --peer-timeout budget).",
 )
+PEER_FANOUT_INFLIGHT = REGISTRY.gauge(
+    "tfd_peer_fanout_inflight",
+    "Peer polls currently in flight on the coordinator's bounded fan-out "
+    "pool (--peer-fanout); 0 between rounds. A value pinned at the "
+    "fan-out width across scrapes means the round is saturated by slow "
+    "peers and the width (or --peer-timeout) needs raising.",
+)
+PEER_SNAPSHOT_NOT_MODIFIED = REGISTRY.counter(
+    "tfd_peer_snapshot_not_modified_total",
+    "Peer snapshot requests THIS daemon answered 304 Not Modified (the "
+    "poller's If-None-Match matched the cached snapshot ETag): no body, "
+    "no serialization, no JSON parse on either end. On an idle slice "
+    "this should dominate tfd_peer_polls_total across the fleet.",
+)
+PEER_CONNECTION_REUSES = REGISTRY.counter(
+    "tfd_peer_connection_reuses_total",
+    "Peer polls completed over an already-open persistent HTTP "
+    "connection (keep-alive reuse; steady-state polls skip TCP setup). "
+    "A low reuse ratio means peer connections are being torn down "
+    "between rounds — look for flapping peers or an intermediary "
+    "closing idle connections.",
+)
+PEER_SNAPSHOT_SERIALIZATIONS = REGISTRY.counter(
+    "tfd_peer_snapshot_serializations_total",
+    "Times this daemon's peer snapshot was (re-)serialized — once per "
+    "DISTINCT published label set / write mode, never per request "
+    "(/peer/snapshot serves the cached body). Steady growth without "
+    "label churn means something is perturbing the published set.",
+)
 PEER_UNREACHABLE = REGISTRY.gauge(
     "tfd_peer_unreachable",
     "1 while the named peer is CONFIRMED unreachable (2 consecutive "

@@ -22,9 +22,14 @@ Endpoint semantics:
   counter). Gated by ``--debug-endpoints``.
 - ``/peer/snapshot`` — the slice peer layer's wire surface
   (peering/snapshot.py): this daemon's marker-stripped label snapshot as
-  versioned JSON. Served only while slice coordination built a
-  coordinator (gated independently of ``--debug-endpoints`` — peers
-  depend on it for correctness); 404 otherwise.
+  versioned JSON, served from the coordinator's PUBLISH-TIME cache (the
+  body is serialized once per distinct label set, never per request)
+  with a strong ``ETag``; a request whose ``If-None-Match`` matches
+  answers ``304 Not Modified`` with no body at all, so an idle slice's
+  poll round is header exchanges only. Served only while slice
+  coordination built a coordinator (gated independently of
+  ``--debug-endpoints`` — peers depend on it for correctness); 404
+  otherwise.
 - ``POST /probe`` — on-demand reconcile wake (``--reconcile=event``,
   cmd/events.py): authenticated by the ``--probe-token`` shared secret
   (``X-TFD-Probe-Token`` header or ``Authorization: Bearer``), answers
@@ -47,6 +52,7 @@ from __future__ import annotations
 import hmac
 import json
 import logging
+import socket
 import threading
 import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -178,7 +184,7 @@ def _make_handler(
     registry: Registry,
     state: IntrospectionState,
     debug_endpoints: bool,
-    peer_snapshot: Optional[Callable[[], Dict[str, Any]]] = None,
+    peer_snapshot: Optional[Callable[[], "tuple[bytes, str]"]] = None,
     probe_request: Optional[Callable[[], None]] = None,
     probe_token: str = "",
 ):
@@ -287,10 +293,15 @@ def _make_handler(
                 # silently partition the slice.
                 if self._peer_fault():
                     return
-                body = json.dumps(
-                    peer_snapshot(), indent=2, sort_keys=True
-                ).encode()
-                self._reply(200, body + b"\n", "application/json")
+                # The hook (SliceCoordinator.snapshot_response) returns
+                # the body serialized at PUBLISH time plus its strong
+                # ETag — this handler never serializes anything.
+                body, etag = peer_snapshot()
+                if etag and self.headers.get("If-None-Match") == etag:
+                    metrics.PEER_SNAPSHOT_NOT_MODIFIED.inc()
+                    self._reply(304, b"", "application/json", etag=etag)
+                else:
+                    self._reply(200, body, "application/json", etag=etag)
             else:
                 self._reply(404, b"not found\n")
 
@@ -318,10 +329,18 @@ def _make_handler(
                 time.sleep(PEER_SLOW_DELAY_S)
             return False
 
-        def _reply(self, code: int, body: bytes, ctype: str = "text/plain"):
+        def _reply(
+            self,
+            code: int,
+            body: bytes,
+            ctype: str = "text/plain",
+            etag: "Optional[str]" = None,
+        ):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
+            if etag:
+                self.send_header("ETag", etag)
             self.end_headers()
             self.wfile.write(body)
 
@@ -329,6 +348,44 @@ def _make_handler(
             log.debug("introspection: %s", format % args)
 
     return _Handler
+
+
+class _TrackingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that can sever its ESTABLISHED connections.
+
+    ``server_close`` only closes the LISTENER; a keep-alive client (the
+    peer layer keeps one persistent connection per peer) would keep
+    being answered by the still-running daemon handler thread after the
+    server "closed" — a SIGHUP reload's retired epoch ghost-serving its
+    stale snapshot next to the new epoch's server. Daemon handler
+    threads are untracked by ThreadingMixIn, so the server tracks the
+    client sockets itself and shuts them down on close; the blocked
+    handler reads EOF and exits, and the peer's next poll reconnects to
+    whoever owns the port now."""
+
+    def __init__(self, *args, **kwargs):
+        self._clients: "set" = set()
+        self._clients_lock = threading.Lock()
+        super().__init__(*args, **kwargs)
+
+    def process_request(self, request, client_address):
+        with self._clients_lock:
+            self._clients.add(request)
+        super().process_request(request, client_address)
+
+    def shutdown_request(self, request):
+        with self._clients_lock:
+            self._clients.discard(request)
+        super().shutdown_request(request)
+
+    def close_all_connections(self) -> None:
+        with self._clients_lock:
+            clients = list(self._clients)
+        for sock in clients:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass  # already dying; the handler thread reaps it
 
 
 class IntrospectionServer:
@@ -343,11 +400,11 @@ class IntrospectionServer:
         addr: str = "0.0.0.0",
         port: int = 0,
         debug_endpoints: bool = True,
-        peer_snapshot: Optional[Callable[[], Dict[str, Any]]] = None,
+        peer_snapshot: Optional[Callable[[], "tuple[bytes, str]"]] = None,
         probe_request: Optional[Callable[[], None]] = None,
         probe_token: str = "",
     ):
-        self._httpd = ThreadingHTTPServer(
+        self._httpd = _TrackingHTTPServer(
             (addr, port),
             _make_handler(
                 registry,
@@ -374,9 +431,14 @@ class IntrospectionServer:
 
     def close(self) -> None:
         """Stop serving and release the port (synchronous, so a SIGHUP
-        reload can rebind the same address immediately)."""
+        reload can rebind the same address immediately). Established
+        keep-alive connections are severed too — a closed server must
+        actually stop answering, or a retired epoch would ghost-serve
+        its stale peer snapshot to every poller holding a persistent
+        connection (_TrackingHTTPServer docstring)."""
         self._httpd.shutdown()
         self._httpd.server_close()
+        self._httpd.close_all_connections()
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None

@@ -4,21 +4,35 @@ One coordinator per config epoch (built beside the engine in
 cmd/main.run). Two independent faces, touched by different threads:
 
 - **Serving** (obs server handler threads): ``publish_local`` is called
-  by the run loop after every label write; ``snapshot_payload`` renders
-  the current snapshot for ``GET /peer/snapshot``. Lock-protected — a
-  peer's poll may land mid-write.
-- **Polling** (one engine pool thread): ``labels()`` — the Labeler
-  protocol — runs one poll round over every peer and returns the
-  slice-scoped label set for this cycle. The engine guarantees a single
-  in-flight submission per source, so peer state needs no lock.
+  by the run loop after every label write and caches the snapshot body
+  SERIALIZED ONCE per distinct label set, with a strong ETag;
+  ``snapshot_response`` hands that cached ``(body, etag)`` pair to the
+  ``GET /peer/snapshot`` handler, which answers ``304 Not Modified`` to
+  a matching ``If-None-Match``. Lock-protected — a peer's poll may land
+  mid-write.
+- **Polling** (one engine pool thread driving a bounded fan-out pool):
+  ``labels()`` — the Labeler protocol — runs one poll round over every
+  peer and returns the slice-scoped label set for this cycle. The
+  engine guarantees a single in-flight submission per ROUND; inside a
+  round, polls dispatch onto up to ``--peer-fanout`` pool threads, so
+  per-peer state transitions are applied under the serving lock (the
+  run loop's ``membership_token`` reads race an in-flight round).
 
 Reachability discipline (the broker's timeout/backoff shape):
 
 - Every poll is bounded by a per-peer connect/read timeout
-  (``--peer-timeout``); one round costs at most
-  ``(workers - 1) x timeout`` and runs under the engine's per-labeler
-  deadline, which serves last-good slice labels on a miss — the
-  node-local label path never waits on a peer.
+  (``--peer-timeout``) and polls run CONCURRENTLY on the fan-out pool
+  (``--peer-fanout``, default ``min(8, peers)``; ``1`` reproduces the
+  sequential round byte for byte): one round costs ~1x the per-peer
+  timeout per ``fanout`` slow peers instead of 1x per slow peer, and
+  runs under the engine's per-labeler deadline, which serves last-good
+  slice labels on a miss — the node-local label path never waits on a
+  peer. Each peer keeps ONE persistent keep-alive connection (the obs
+  server is HTTP/1.1), reconnecting on failure, so steady-state polls
+  skip TCP setup; the poller sends ``If-None-Match`` and a ``304``
+  short-circuits straight to ``_poll_succeeded`` with the last-parsed
+  snapshot — an idle slice's round is N header exchanges, no bodies,
+  no JSON parsing on either end.
 - A peer is confirmed UNREACHABLE only after ``CONFIRM_POLLS``
   consecutive failed polls (the StragglerDetector's 2-consecutive
   confirmation): one missed poll — a GC pause, a dropped packet — never
@@ -53,11 +67,11 @@ is visible on its own node without poisoning the slice aggregate.
 
 from __future__ import annotations
 
+import http.client
 import logging
 import threading
 import time
-import urllib.error
-import urllib.request
+from concurrent.futures import CancelledError, ThreadPoolExecutor
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional
 
@@ -70,10 +84,30 @@ from gpu_feature_discovery_tpu.peering.snapshot import (
     PeerSnapshotError,
     build_snapshot,
     parse_snapshot,
+    serialize_snapshot,
 )
 from gpu_feature_discovery_tpu.utils.retry import BackoffPolicy
 
 log = logging.getLogger("tfd.peering")
+
+# Widest fan-out the auto default resolves to: 8 concurrent polls keeps
+# a 64-host round at ~8x the fast-poll cost (sub-ms each on reused
+# connections) while a storm of slow peers costs ceil(slow/8) x timeout
+# instead of slow x timeout. Wider helps only slices with more than 8
+# SIMULTANEOUSLY slow-but-alive peers, at the price of idle pool
+# threads on every daemon — operators can raise --peer-fanout for that.
+AUTO_FANOUT_CAP = 8
+
+# Connection-lifecycle failures a REUSED keep-alive connection may see
+# when the server closed it between rounds (peer restart, idle reap):
+# retried once on a fresh connection before anything counts as a miss —
+# reuse must never mint failures a fresh-connection poll would not see.
+_STALE_CONN_ERRORS = (
+    http.client.RemoteDisconnected,
+    http.client.CannotSendRequest,
+    ConnectionResetError,
+    BrokenPipeError,
+)
 
 # Consecutive failed polls before a peer counts as unreachable — the
 # same 2-consecutive confirmation the straggler detector uses
@@ -121,6 +155,11 @@ class _PeerState:
     last_snapshot: Optional[Dict[str, Any]] = None
     next_attempt: float = 0.0
     backoff_attempt: int = 0
+    # Connection-reuse + delta-polling state. Touched only by the single
+    # poll task a round dispatches per peer (rounds never overlap), so
+    # unlike the verdict fields above these need no lock.
+    conn: Optional[http.client.HTTPConnection] = None
+    etag: Optional[str] = None
     backoff: BackoffPolicy = field(
         default_factory=lambda: BackoffPolicy(
             base=PEER_BACKOFF_BASE_S, cap=PEER_BACKOFF_CAP_S
@@ -165,6 +204,7 @@ class SliceCoordinator:
         round_budget: Optional[float] = None,
         clock: Callable[[], float] = time.monotonic,
         backoff_factory: Optional[Callable[[], BackoffPolicy]] = None,
+        fanout: Optional[int] = None,
     ):
         if not 0 <= worker_id < len(hostnames):
             raise ValueError(
@@ -194,11 +234,38 @@ class SliceCoordinator:
             if backoff_factory is not None:
                 state.backoff = backoff_factory()
             self._peer_state[i] = state
+        # Bounded poll fan-out: None/0 = auto (min(AUTO_FANOUT_CAP,
+        # peers)); an explicit width is capped at the peer count (extra
+        # threads could never run) and floored at 1 (the sequential
+        # round, which constructs NO pool at all — pinned).
+        peers = max(1, len(self._peers))
+        self.fanout = (
+            min(AUTO_FANOUT_CAP, peers)
+            if not fanout
+            else max(1, min(int(fanout), peers))
+        )
+        self._pool = (
+            ThreadPoolExecutor(
+                max_workers=self.fanout,
+                thread_name_prefix=f"tfd-peer-poll-w{worker_id}",
+            )
+            if self.fanout > 1
+            else None
+        )
         # Serving-side state (handler threads read, run loop writes).
         self._lock = threading.Lock()
         self._local_labels: Dict[str, str] = {}
         self._local_mode: Optional[str] = None
         self._generation = 0
+        # The serialized snapshot + strong ETag, rendered once per
+        # DISTINCT publish (serialize_snapshot); None until the first
+        # publish or snapshot_response call of the epoch.
+        self._snapshot_body: Optional[bytes] = None
+        self._snapshot_etag: Optional[str] = None
+        # Flipped by close(): an in-flight round abandoned by an epoch
+        # teardown (engine.close does not wait for stragglers) must not
+        # reopen connections the teardown just dropped.
+        self._closed = False
         # Reachable-membership fingerprint as of the last completed poll
         # round; read by the run loop's peer-delta producer
         # (cmd/events.DeltaTracker) from the main thread while the NEXT
@@ -211,11 +278,36 @@ class SliceCoordinator:
     def publish_local(self, labels: Dict[str, str], mode: str) -> None:
         """The run loop wrote a label file: refresh what peers see. Every
         write counts — a degraded or re-served set is still this node's
-        honest current answer (its mode says how stale it may be)."""
+        honest current answer (its mode says how stale it may be).
+
+        Churn-free: re-publishing an UNCHANGED (labels, mode) pair keeps
+        the cached serialized body, its ETag, and the generation counter
+        exactly as they are — that stability is what lets an idle
+        slice's poll round collapse into 304 header exchanges. Only a
+        distinct publish pays the serialization (counted in
+        tfd_peer_snapshot_serializations_total)."""
         with self._lock:
+            if (
+                self._snapshot_body is not None
+                and mode == self._local_mode
+                and labels == self._local_labels
+            ):
+                return
             self._generation += 1
             self._local_labels = dict(labels)
             self._local_mode = mode
+            self._render_snapshot_locked()
+
+    def _render_snapshot_locked(self) -> None:
+        doc = build_snapshot(
+            self.worker_id,
+            self.hostname,
+            self._local_labels,
+            self._generation,
+            self._local_mode,
+        )
+        self._snapshot_body, self._snapshot_etag = serialize_snapshot(doc)
+        obs_metrics.PEER_SNAPSHOT_SERIALIZATIONS.inc()
 
     def snapshot_payload(self) -> Dict[str, Any]:
         with self._lock:
@@ -225,6 +317,18 @@ class SliceCoordinator:
         return build_snapshot(
             self.worker_id, self.hostname, labels, generation, mode
         )
+
+    def snapshot_response(self) -> "tuple[bytes, str]":
+        """The ``GET /peer/snapshot`` serving hook: the cached serialized
+        body + strong ETag. Serialization happened at PUBLISH time, so a
+        request costs a lock round-trip and two attribute reads — the
+        per-request ``json.dumps`` this replaces scaled with poll rate x
+        slice size on every serving daemon. Before the first publish of
+        the epoch the empty snapshot is rendered (and cached) once."""
+        with self._lock:
+            if self._snapshot_body is None:
+                self._render_snapshot_locked()
+            return self._snapshot_body, self._snapshot_etag
 
     # -- polling side (engine pool thread) --------------------------------
 
@@ -239,59 +343,42 @@ class SliceCoordinator:
         skipped with its state UNTOUCHED — "not polled" is neither a
         miss nor a success.
 
+        Polls dispatch in rotated order onto the bounded fan-out pool
+        (``fanout`` == 1 runs the same per-peer body inline — the
+        sequential round, byte for byte) and the round blocks until
+        every dispatched poll finishes, so one round costs ~1x the
+        per-peer timeout per ``fanout`` slow peers instead of 1x per
+        slow peer. The budget is a DISPATCH cutoff: it is checked when a
+        poll actually starts (pool slot acquired), so a budget that runs
+        out mid-round skips exactly the polls that had not started yet.
+
         The round starts one peer further along the list each time:
         budget skips always land on whoever the rotation currently puts
-        last, so a head-of-list run of slow-but-answering peers (each
-        just under the per-peer timeout, never confirmed down) cannot
-        starve the tail forever — a never-polled peer has no failures,
-        counts reachable, and a dead host behind it would stay invisible
-        indefinitely."""
+        last, so a run of slow-but-answering peers wider than the pool
+        (each just under the per-peer timeout, never confirmed down)
+        cannot starve the tail forever — a never-polled peer has no
+        failures, counts reachable, and a dead host behind it would stay
+        invisible indefinitely."""
         round_started = time.perf_counter()
         offset = self._round_offset % len(self._peers) if self._peers else 0
         self._round_offset += 1
-        for peer in self._peers[offset:] + self._peers[:offset]:
-            state = self._peer_state[peer.worker_id]
-            now = self._clock()
-            if state.confirmed_down and now < state.next_attempt:
-                continue  # backoff window still closed; stays down
-            timeout = self.peer_timeout
-            if self.round_budget is not None:
-                remaining = self.round_budget - (
-                    time.perf_counter() - round_started
-                )
-                if remaining <= 0.05:
-                    obs_metrics.PEER_POLLS.labels(outcome="skipped").inc()
-                    log.warning(
-                        "round budget %.3fs spent; skipping poll of peer "
-                        "%s (worker %d) this round",
-                        self.round_budget,
-                        peer.hostname,
-                        peer.worker_id,
-                    )
-                    continue
-                timeout = min(timeout, remaining)
-            started = time.perf_counter()
-            try:
-                snapshot = self._fetch(peer, timeout)
-                if snapshot["worker_id"] != peer.worker_id:
-                    # Answered, but it is not who the hostname list says
-                    # lives there (a stale DNS entry pointing at another
-                    # worker): trusting it would double-count that
-                    # worker's chips.
-                    raise PeerSnapshotError(
-                        f"peer claims worker_id {snapshot['worker_id']}, "
-                        f"expected {peer.worker_id}"
-                    )
-            except Exception as e:  # noqa: BLE001 - any failure = one miss
-                obs_metrics.PEER_POLLS.labels(outcome="error").inc()
-                self._poll_failed(peer, state, e)
-            else:
-                obs_metrics.PEER_POLLS.labels(outcome="ok").inc()
-                self._poll_succeeded(peer, state, snapshot)
-            finally:
-                obs_metrics.PEER_POLL_DURATION.observe(
-                    time.perf_counter() - started
-                )
+        rotated = self._peers[offset:] + self._peers[:offset]
+        if self._pool is None:
+            for peer in rotated:
+                self._poll_peer(peer, round_started)
+        else:
+            futures = [
+                self._pool.submit(self._poll_peer, peer, round_started)
+                for peer in rotated
+            ]
+            for future in futures:
+                try:
+                    future.result()
+                except CancelledError:
+                    # close() cancelled the still-queued polls of a
+                    # round the epoch teardown abandoned; nothing reads
+                    # this round's verdict.
+                    pass
         token = frozenset(
             p.worker_id
             for p in self._peers
@@ -308,18 +395,163 @@ class SliceCoordinator:
         with self._lock:
             return self._membership
 
+    def _poll_peer(self, peer: PeerEndpoint, round_started: float) -> None:
+        """One peer's poll, exactly as the sequential round ran it:
+        backoff-window check, budget cutoff, fetch, then the verdict
+        transition — the last applied under the serving lock, because
+        with fanout > 1 several polls finish concurrently and the run
+        loop's ``membership_token`` reads race the round."""
+        state = self._peer_state[peer.worker_id]
+        now = self._clock()
+        if state.confirmed_down and now < state.next_attempt:
+            return  # backoff window still closed; stays down
+        timeout = self.peer_timeout
+        if self.round_budget is not None:
+            remaining = self.round_budget - (
+                time.perf_counter() - round_started
+            )
+            if remaining <= 0.05:
+                obs_metrics.PEER_POLLS.labels(outcome="skipped").inc()
+                log.warning(
+                    "round budget %.3fs spent; skipping poll of peer "
+                    "%s (worker %d) this round",
+                    self.round_budget,
+                    peer.hostname,
+                    peer.worker_id,
+                )
+                return
+            timeout = min(timeout, remaining)
+        started = time.perf_counter()
+        obs_metrics.PEER_FANOUT_INFLIGHT.inc()
+        try:
+            snapshot = self._fetch(peer, timeout)
+            if snapshot["worker_id"] != peer.worker_id:
+                # Backstop only: the real HTTP path already rejected a
+                # mismatched worker_id inside _request (it must happen
+                # BEFORE the ETag is cached), so on that path this never
+                # fires — it guards injected _fetch hooks (the hermetic
+                # state-machine tests) with the same contract: a peer
+                # answering as somebody else is a miss, never trusted.
+                raise PeerSnapshotError(
+                    f"peer claims worker_id {snapshot['worker_id']}, "
+                    f"expected {peer.worker_id}"
+                )
+        except Exception as e:  # noqa: BLE001 - any failure = one miss
+            obs_metrics.PEER_POLLS.labels(outcome="error").inc()
+            with self._lock:
+                self._poll_failed(peer, state, e)
+        else:
+            obs_metrics.PEER_POLLS.labels(outcome="ok").inc()
+            with self._lock:
+                self._poll_succeeded(peer, state, snapshot)
+        finally:
+            obs_metrics.PEER_FANOUT_INFLIGHT.inc(-1.0)
+            obs_metrics.PEER_POLL_DURATION.observe(
+                time.perf_counter() - started
+            )
+
     def _fetch(self, peer: PeerEndpoint, timeout: float) -> Dict[str, Any]:
-        # stdlib only, same as the obs server's own consumers; the
-        # timeout bounds connect AND each read.
-        with urllib.request.urlopen(peer.url, timeout=timeout) as resp:
-            if resp.status != 200:
-                raise PeerSnapshotError(f"HTTP {resp.status}")
-            body = resp.read(MAX_SNAPSHOT_BYTES + 1)
-        return parse_snapshot(body)
+        """One GET /peer/snapshot over the peer's persistent keep-alive
+        connection (opened on demand; any failure tears it down so the
+        next poll reconnects). A 304 answer returns the last-parsed
+        snapshot unchanged — the caller's success bookkeeping advances
+        exactly as on a full body."""
+        state = self._peer_state[peer.worker_id]
+        reused = state.conn is not None
+        try:
+            try:
+                snapshot = self._request(peer, state, timeout)
+            except _STALE_CONN_ERRORS:
+                if not reused:
+                    raise
+                # The server closed the idle keep-alive connection
+                # between rounds (peer restart, idle reap): that is
+                # connection lifecycle, not peer health — retry ONCE on
+                # a fresh connection before anything counts as a miss.
+                self._drop_connection(state)
+                reused = False
+                snapshot = self._request(peer, state, timeout)
+        except Exception:
+            self._drop_connection(state)
+            raise
+        if reused:
+            obs_metrics.PEER_CONNECTION_REUSES.inc()
+        return snapshot
+
+    def _request(
+        self, peer: PeerEndpoint, state: _PeerState, timeout: float
+    ) -> Dict[str, Any]:
+        with self._lock:
+            # Checked and created UNDER the lock close() flips _closed
+            # under: an abandoned round racing close() either assigns
+            # the connection before the flip (close()'s sweep, which
+            # runs after the flip, drops it) or sees _closed and raises
+            # — a fresh connection can never be opened past the
+            # teardown. The constructor does not connect, so no network
+            # IO happens under the lock.
+            if self._closed:
+                raise PeerSnapshotError("coordinator closed")
+            conn = state.conn
+            if conn is None:
+                conn = http.client.HTTPConnection(
+                    peer.host, peer.port, timeout=timeout
+                )
+                state.conn = conn
+        # The constructor timeout only applies at connect; an
+        # already-open socket must be re-armed per poll (the budget may
+        # have shrunk it below the full --peer-timeout).
+        conn.timeout = timeout
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        headers = {}
+        if state.etag is not None and state.last_snapshot is not None:
+            headers["If-None-Match"] = state.etag
+        conn.request("GET", PEER_SNAPSHOT_PATH, headers=headers)
+        resp = conn.getresponse()
+        if resp.status == 304:
+            resp.read()  # drain (empty) body; the connection stays live
+            if state.last_snapshot is None:
+                # Defensive: If-None-Match is only ever sent alongside a
+                # cached snapshot, so a 304 here means a confused server.
+                raise PeerSnapshotError("304 with no cached snapshot")
+            return state.last_snapshot
+        if resp.status != 200:
+            raise PeerSnapshotError(f"HTTP {resp.status}")
+        body = resp.read(MAX_SNAPSHOT_BYTES + 1)
+        snapshot = parse_snapshot(body)
+        if snapshot["worker_id"] != peer.worker_id:
+            # Validated HERE, before the ETag is cached: a misdirected
+            # peer (stale DNS answering as another worker) whose ETag we
+            # remembered would 304 every later poll — and the 304 path
+            # would replay the OLD valid snapshot past the caller's
+            # worker-id check, counting the impostor reachable forever.
+            raise PeerSnapshotError(
+                f"peer claims worker_id {snapshot['worker_id']}, "
+                f"expected {peer.worker_id}"
+            )
+        etag = resp.getheader("ETag")
+        state.etag = etag if etag else None
+        return snapshot
+
+    @staticmethod
+    def _drop_connection(state: _PeerState) -> None:
+        conn, state.conn = state.conn, None
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
 
     def _poll_succeeded(
         self, peer: PeerEndpoint, state: _PeerState, snapshot: Dict[str, Any]
     ) -> None:
+        if self._closed:
+            # A straggler poll of an abandoned round landing after
+            # close(): its verdict is nobody's input anymore, and
+            # touching the gauges would undo close()'s reset (both
+            # callers hold the lock, so this check and close()'s flip
+            # are serialized).
+            return
         if state.confirmed_down:
             log.info(
                 "peer %s (worker %d) reachable again",
@@ -336,6 +568,13 @@ class SliceCoordinator:
     def _poll_failed(
         self, peer: PeerEndpoint, state: _PeerState, error: BaseException
     ) -> None:
+        if self._closed:
+            # See _poll_succeeded: a straggler poll failing BECAUSE the
+            # teardown closed its socket must not re-latch
+            # tfd_peer_unreachable=1 after close() zeroed it — a peer
+            # gone from the next epoch's hostname list would stay
+            # latched forever.
+            return
         state.consecutive_failures += 1
         if state.confirmed_down:
             obs_metrics.PEER_UNREACHABLE.labels(peer=peer.hostname).set(1)
@@ -431,13 +670,25 @@ class SliceCoordinator:
         return total
 
     def close(self) -> None:
-        """Epoch end: zero this coordinator's gauges in the
+        """Epoch end: retire the fan-out pool and every persistent peer
+        connection, and zero this coordinator's gauges in the
         process-global registry. A SIGHUP reload may rebuild the
         coordinator with a CHANGED hostname list (or none at all) —
         without the reset, a peer no longer in the slice would stay
         latched at tfd_peer_unreachable=1 forever and send an operator
-        chasing a host that left the slice."""
+        chasing a host that left the slice. The pool shutdown does not
+        wait: any in-flight poll is bounded by its socket timeout and
+        its thread dies with it — a slow peer must not stall a reload."""
+        with self._lock:
+            # Under the lock: verdict transitions also run under it, so
+            # any straggler poll either lands before this flip (its
+            # gauge write is zeroed below) or sees _closed and no-ops —
+            # it can never re-latch a gauge after the reset.
+            self._closed = True
+        if self._pool is not None:
+            self._pool.shutdown(wait=False, cancel_futures=True)
         for peer in self._peers:
+            self._drop_connection(self._peer_state[peer.worker_id])
             obs_metrics.PEER_UNREACHABLE.labels(peer=peer.hostname).set(0)
         obs_metrics.SLICE_DEGRADED.set(0)
 
@@ -525,12 +776,17 @@ def new_slice_coordinator(config, host_info=None) -> Optional[SliceCoordinator]:
         # SLICE must never cost the NODE that. 0.8 leaves headroom for
         # aggregation + the engine's own dispatch.
         round_budget=0.8 * labeler_timeout,
+        # 0/None = auto (min(AUTO_FANOUT_CAP, peers)); 1 pins the
+        # sequential round.
+        fanout=tfd.peer_fanout,
     )
     log.info(
-        "slice coordination on: worker %d of %d (%s), peer timeout %.3fs",
+        "slice coordination on: worker %d of %d (%s), peer timeout "
+        "%.3fs, fan-out %d",
         worker_id,
         len(hostnames),
         coordinator.hostname,
         timeout,
+        coordinator.fanout,
     )
     return coordinator

@@ -13,7 +13,10 @@ Document shape (schema 1)::
       "schema": 1,
       "worker_id": 3,
       "hostname": "w3",
-      "generation": 17,          # this epoch's label-write counter
+      "generation": 17,          # this epoch's DISTINCT-snapshot counter
+                                 # (a re-publish of unchanged labels+mode
+                                 # does not advance it — the cached body
+                                 # and ETag stay valid, so idle peers 304)
       "mode": "full",            # full | degraded | reserved | restored
       "labels": {"google.com/tpu.count": "4", ...},
       "chips": {"healthy": 4, "sick": 0}   # values null when unprobed
@@ -30,6 +33,7 @@ sick-chip sum does not re-parse label text.
 
 from __future__ import annotations
 
+import hashlib
 import json
 from typing import Any, Dict, Optional
 
@@ -110,6 +114,18 @@ def build_snapshot(
         "labels": stripped,
         "chips": _chip_verdict(stripped),
     }
+
+
+def serialize_snapshot(doc: Dict[str, Any]) -> "tuple[bytes, str]":
+    """Render one snapshot document to its wire body plus a STRONG ETag
+    (quoted sha256 of the exact bytes). The body format is what the obs
+    server handler historically produced per request (indent=2, sorted
+    keys, trailing newline) — now rendered ONCE per distinct publish and
+    cached, so an idle slice's poll round exchanges headers, not bodies:
+    the poller echoes the ETag in ``If-None-Match`` and the server
+    answers ``304`` without serializing or sending anything."""
+    body = json.dumps(doc, indent=2, sort_keys=True).encode() + b"\n"
+    return body, '"' + hashlib.sha256(body).hexdigest() + '"'
 
 
 def parse_snapshot(body: bytes) -> Dict[str, Any]:

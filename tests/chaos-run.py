@@ -25,7 +25,11 @@ SliceHarness, real HTTP between the daemons) and kill one member —
 converges to ``slice.healthy-hosts=3`` / ``slice.degraded=true`` with
 every survivor's node-local labels untouched; ``slice:leader-failover``
 kills the leader and asserts the next-lowest worker promotes itself and
-publishes fresh slice labels within 2 poll intervals.
+publishes fresh slice labels within 2 poll intervals;
+``slice:slow-peer-storm`` stalls half of a 6-worker slice's serving
+surfaces and asserts the leader's fan-out round stays bounded by ~1x the
+per-peer timeout with no peer skipped for budget and slice labels
+unmoved (run_slow_peer_storm).
 
 ``reconcile:broker-death`` is likewise not a fault spec: it SIGKILLs the
 long-lived broker worker of an EVENT-mode daemon whose sleep interval is
@@ -78,6 +82,8 @@ def run_slice_chaos(scenario, workdir, timeout_s=None):
         SLICE_ROLE_LABEL,
     )
 
+    if scenario == "slow-peer-storm":
+        return run_slow_peer_storm(workdir, timeout_s=timeout_s)
     victims = {"peer-unreachable": 3, "leader-failover": 0}
     if scenario not in victims:
         raise ValueError(f"unknown slice chaos scenario {scenario!r}")
@@ -135,6 +141,122 @@ def run_slice_chaos(scenario, workdir, timeout_s=None):
         "spec": f"slice:{scenario}",
         "converged_s": round(elapsed, 3),
         "labels": len(converged[new_leader]),
+    }
+
+
+def run_slow_peer_storm(workdir, timeout_s=None):
+    """slice:slow-peer-storm (ISSUE 12): the peer.slow behavior armed on
+    HALF of a 6-worker slice (workers 3-5 stall each /peer/snapshot
+    answer 0.4s — scoped per worker via the harness because the fault
+    registry is process-global in the hermetic slice, see SliceHarness),
+    with every coordinator's poll round bounded by a 1.0s budget that a
+    SEQUENTIAL round (3 x 0.4s of slow peers + the fast tail) would
+    overrun, skipping the tail for budget every round. The contract:
+
+      1. the leader's poll round completes within ~1x --peer-timeout
+         (fan-out overlaps the three slow answers);
+      2. NO peer is ever skipped for budget (tfd_peer_polls_total
+         {outcome="skipped"} stays absent across all 6 daemons);
+      3. slice labels stay correct throughout — the slow peers answer
+         inside the timeout, so healthy-hosts stays 6, degraded stays
+         false, and every worker's node-local labels never move."""
+    from slice_fixture import SliceHarness, non_coord_lines
+
+    from gpu_feature_discovery_tpu.lm.slice_labeler import (
+        SLICE_DEGRADED_LABEL,
+        SLICE_HEALTHY_HOSTS_LABEL,
+        SLICE_LEADER_SEEN_LABEL,
+        SLICE_ROLE_LABEL,
+    )
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+    budget = timeout_s or 60.0
+    peer_timeout_s = 1.0
+    slow_delay_s = 0.4
+    started = time.monotonic()
+    harness = SliceHarness(
+        workdir,
+        workers=6,
+        sleep_interval="0.05s",
+        peer_timeout=f"{peer_timeout_s}s",
+        round_budget=1.0,
+        slow_workers=(3, 4, 5),
+        slow_delay_s=slow_delay_s,
+    )
+    # Instrument the leader's poll round BEFORE the daemons start: the
+    # round-duration bound is the scenario's headline assertion.
+    leader_coord = harness.workers[0].coordinator
+    durations = []
+    orig_poll = leader_coord.poll_once
+
+    def timed_poll():
+        t0 = time.perf_counter()
+        orig_poll()
+        durations.append(time.perf_counter() - t0)
+
+    leader_coord.poll_once = timed_poll
+    harness.start()
+
+    def healthy(s):
+        return (
+            s[0].get(SLICE_ROLE_LABEL) == "leader"
+            and s[0].get(SLICE_HEALTHY_HOSTS_LABEL) == "6"
+            and s[0].get(SLICE_DEGRADED_LABEL) == "false"
+            and all(
+                s[i].get(SLICE_LEADER_SEEN_LABEL) == "true"
+                for i in range(1, 6)
+            )
+        )
+
+    try:
+        harness.wait_for(
+            healthy, timeout=budget, what="healthy 6-worker slice"
+        )
+        before = {
+            w.worker_id: non_coord_lines(w.raw_output())
+            for w in harness.workers
+        }
+        rounds_at_converge = len(durations)
+        # Ride out >= 4 more full rounds of the storm.
+        deadline = time.monotonic() + budget
+        while (
+            len(durations) < rounds_at_converge + 4
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.05)
+        storm_rounds = durations[rounds_at_converge:]
+        assert len(storm_rounds) >= 4, (
+            f"leader completed only {len(storm_rounds)} rounds in budget"
+        )
+        worst = max(storm_rounds)
+        assert worst < peer_timeout_s, (
+            f"leader round took {worst:.3f}s — not bounded by ~1x the "
+            f"{peer_timeout_s}s peer timeout (sequential would be "
+            f">= {3 * slow_delay_s:.1f}s)"
+        )
+        assert worst >= slow_delay_s * 0.9, (
+            f"worst round {worst:.3f}s never engaged the slow peers — "
+            "the storm did not arm"
+        )
+        exposition = obs_metrics.REGISTRY.render()
+        assert 'tfd_peer_polls_total{outcome="skipped"}' not in exposition, (
+            "a poll round skipped a peer for budget under fan-out"
+        )
+        final = harness.wait_for(
+            healthy, timeout=budget, what="slice still healthy post-storm"
+        )
+        for worker in harness.workers:
+            assert non_coord_lines(worker.raw_output()) == before[
+                worker.worker_id
+            ], f"worker {worker.worker_id}'s node-local labels moved"
+    finally:
+        harness.stop()
+    elapsed = time.monotonic() - started
+    return {
+        "spec": "slice:slow-peer-storm",
+        "converged_s": round(elapsed, 3),
+        "worst_round_s": round(max(durations[rounds_at_converge:]), 3),
+        "labels": len(final[0]),
     }
 
 

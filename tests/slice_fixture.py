@@ -88,6 +88,20 @@ def non_coord_lines(raw):
     ]
 
 
+def _slowed(hook, delay_s):
+    """Wrap a worker's /peer/snapshot serving hook with a stall — the
+    peer.slow behavior, scoped to one worker (SliceHarness docstring).
+    The sleeping hook occupies one obs-server daemon thread per request,
+    exactly like the fault site's in-handler sleep."""
+    import time
+
+    def slow_hook():
+        time.sleep(delay_s)
+        return hook()
+
+    return slow_hook
+
+
 class SliceWorker:
     """One in-process daemon: its run() thread, signal queue, config,
     and (with coordination on) its injected SliceCoordinator."""
@@ -142,7 +156,21 @@ class SliceHarness:
         sleep_interval="0.05s",
         peer_timeout="0.5s",
         hostenv=SLICE_HOSTENV,
+        peer_fanout=None,
+        round_budget=None,
+        slow_workers=(),
+        slow_delay_s=0.0,
     ):
+        """``slow_workers``/``slow_delay_s`` arm the peer.slow behavior
+        on SPECIFIC workers' serving surfaces (the chaos slow-peer-storm
+        scenario): their /peer/snapshot hook stalls ``slow_delay_s``
+        before answering. Scoped per worker here because the hermetic
+        harness shares one process — the fault registry's peer.slow
+        shots would fire in whichever worker's handler polls first,
+        never "on half of the slice". ``round_budget`` bounds each
+        coordinator's poll round (None = unbounded, the pre-existing
+        harness behavior); ``peer_fanout`` is --peer-fanout (None =
+        auto)."""
         import os
 
         from gpu_feature_discovery_tpu.config import new_config
@@ -201,7 +229,13 @@ class SliceHarness:
                     hostnames=hostnames,
                     default_port=ports[i],
                     peer_timeout=float(peer_timeout.rstrip("s")),
+                    round_budget=round_budget,
+                    fanout=peer_fanout,
                 )
+                if i in slow_workers and slow_delay_s > 0:
+                    coordinator.snapshot_response = _slowed(
+                        coordinator.snapshot_response, slow_delay_s
+                    )
             env = dict(base_env)
             env["TPU_WORKER_ID"] = str(i)
             interconnect = InterconnectLabeler(

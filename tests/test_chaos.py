@@ -49,6 +49,13 @@ CHAOS_SPECS = [
     # which publishes fresh slice labels.
     "slice:peer-unreachable",
     "slice:leader-failover",
+    # Coordination-plane scale (ISSUE 12): the peer.slow behavior armed
+    # on half of a 6-worker slice (scoped per worker — the fault
+    # registry is process-global in the hermetic harness) under a round
+    # budget a sequential round would overrun. The leader's fan-out
+    # round must stay bounded by ~1x --peer-timeout, no peer may be
+    # skipped for budget, and slice labels must not move.
+    "slice:slow-peer-storm",
     # Multi-backend registry (resource/registry.py, --backends): an
     # injected pjrt_init failure on ONE backend family must degrade only
     # that family's labels (its <family>.tfd.degraded marker) while the
@@ -89,6 +96,9 @@ CHAOS_EXPECTATIONS = {
     # convergence + the 2-poll confirmation window comfortable room.
     "slice:peer-unreachable": {"timeout_s": 60.0},
     "slice:leader-failover": {"timeout_s": 60.0},
+    # 6 concurrent daemon loops, each round stalled 0.4s by the slow
+    # half of the slice: startup + >= 4 storm rounds needs room.
+    "slice:slow-peer-storm": {"timeout_s": 60.0},
     # The multi-backend row: the REAL cpu backend (jax cpu platform)
     # plus a mock gpu family; first cpu acquisition may pay the jax
     # import, hence the larger budget.

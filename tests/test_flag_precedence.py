@@ -49,6 +49,7 @@ FILE_KEYS = {
     "straggler-threshold": ("tfd", "stragglerThreshold"),
     "slice-coordination": ("tfd", "sliceCoordination"),
     "peer-timeout": ("tfd", "peerTimeout"),
+    "peer-fanout": ("tfd", "peerFanout"),
     "backends": ("tfd", "backends"),
     "reconcile": ("tfd", "reconcile"),
     "max-staleness": ("tfd", "maxStaleness"),
@@ -75,6 +76,7 @@ VALUE_PAIRS = {
     "straggler-threshold": ("0.3", "0.7"),
     "slice-coordination": ("on", "off"),
     "peer-timeout": ("1s", "3s"),
+    "peer-fanout": ("2", "4"),
     # Registry tokens (resource/registry.py): values must parse, so the
     # generic "/value-a" str fallback does not apply.
     "backends": ("tpu,cpu", "cpu"),

@@ -88,3 +88,74 @@ def test_remove_output_file_cleans_staging(tmp_path):
     remove_output_file(str(out))
     assert not out.exists()
     assert not (tmp_path / "tfd-tmp").exists()
+
+# ---------------------------------------------------------------------------
+# churn-free write cache (ISSUE 12 satellite): steady-state skips compare
+# in memory + one stat() instead of re-reading the file every cycle
+# ---------------------------------------------------------------------------
+
+def test_churn_skip_needs_no_disk_read_after_first_write(tmp_path, monkeypatch):
+    from gpu_feature_discovery_tpu.lm import labels as labels_mod
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+    out = tmp_path / "tfd"
+    Labels({"k": "v"}).write_to_file(str(out))
+
+    def bomb(path, contents):
+        raise AssertionError("steady-state churn check read the disk")
+
+    # The in-memory cache must satisfy the unchanged-content skip without
+    # ever falling through to the disk comparison.
+    monkeypatch.setattr(labels_mod, "_file_contents_equal", bomb)
+    skips_before = obs_metrics.LABEL_WRITE_SKIPS.value()
+    for _ in range(3):
+        Labels({"k": "v"}).write_to_file(str(out))
+    assert obs_metrics.LABEL_WRITE_SKIPS.value() == skips_before + 3
+    assert out.read_text() == "k=v\n"
+
+
+def test_out_of_band_edit_still_triggers_rewrite(tmp_path):
+    """The pinned contract: caching the last-written bytes must not blind
+    the writer to an external edit — the stat signature moves, the disk
+    is consulted, and the divergent content is rewritten."""
+    out = tmp_path / "tfd"
+    Labels({"k": "v"}).write_to_file(str(out))
+    Labels({"k": "v"}).write_to_file(str(out))  # cached skip
+    out.write_text("tampered=true\n")  # out-of-band edit
+    Labels({"k": "v"}).write_to_file(str(out))
+    assert out.read_text() == "k=v\n"
+
+
+def test_out_of_band_touch_with_identical_content_reseeds_the_cache(tmp_path):
+    from gpu_feature_discovery_tpu.lm import labels as labels_mod
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+    out = tmp_path / "tfd"
+    Labels({"k": "v"}).write_to_file(str(out))
+    # An external writer re-writes IDENTICAL bytes (new mtime/inode):
+    # one disk read re-verifies and re-seeds the cache — no rename, and
+    # the cycle after that is back to the in-memory fast path.
+    out.write_text("k=v\n")
+    writes_before = obs_metrics.LABEL_WRITES.value()
+    Labels({"k": "v"}).write_to_file(str(out))
+    assert obs_metrics.LABEL_WRITES.value() == writes_before
+    with pytest.MonkeyPatch.context() as mp:
+        mp.setattr(
+            labels_mod,
+            "_file_contents_equal",
+            lambda *a: (_ for _ in ()).throw(AssertionError("disk read")),
+        )
+        Labels({"k": "v"}).write_to_file(str(out))
+    assert out.read_text() == "k=v\n"
+
+
+def test_remove_output_file_forgets_the_write_cache(tmp_path):
+    from gpu_feature_discovery_tpu.lm import labels as labels_mod
+
+    out = tmp_path / "tfd"
+    Labels({"k": "v"}).write_to_file(str(out))
+    remove_output_file(str(out))
+    assert str(out) not in labels_mod._write_cache
+    # A fresh epoch writes from scratch (first cycle pays the disk path).
+    Labels({"k": "v"}).write_to_file(str(out))
+    assert out.read_text() == "k=v\n"

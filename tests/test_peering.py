@@ -231,10 +231,14 @@ class _Clock:
         return self.now
 
 
-def _coordinator(worker_id, n, clock=None, responses=None, round_budget=None):
+def _coordinator(
+    worker_id, n, clock=None, responses=None, round_budget=None, fanout=1
+):
     """Coordinator over n workers w0..w{n-1} whose fetches are served
     from ``responses``: worker_id -> snapshot dict | Exception |
-    callable(timeout) -> snapshot dict."""
+    callable(timeout) -> snapshot dict. ``fanout`` defaults to 1 — the
+    sequential round — so the state-machine tests stay deterministic;
+    the fan-out tests below pass a width explicitly."""
     coord = SliceCoordinator(
         worker_id,
         [f"w{i}" for i in range(n)],
@@ -246,6 +250,7 @@ def _coordinator(worker_id, n, clock=None, responses=None, round_budget=None):
         backoff_factory=lambda: BackoffPolicy(
             base=5.0, factor=1.0, cap=5.0, jitter=0.0
         ),
+        fanout=fanout,
     )
     responses = responses if responses is not None else {}
 
@@ -662,7 +667,7 @@ def serving_peer():
         state,
         addr="127.0.0.1",
         port=0,
-        peer_snapshot=serving.snapshot_payload,
+        peer_snapshot=serving.snapshot_response,
     )
     server.start()
     polling = SliceCoordinator(
@@ -691,13 +696,17 @@ def test_live_poll_aggregates_served_snapshot(serving_peer):
 
 def test_peer_unreachable_fault_degrades_after_confirmation(serving_peer):
     """peer.unreachable armed in the SERVING handler: the poller pays
-    real RemoteDisconnected errors and confirms after 2 misses."""
+    real RemoteDisconnected errors and confirms after 2 misses. The
+    first miss costs TWO armed shots: the established poller holds a
+    reused keep-alive connection, and a drop there is retried once on a
+    fresh connection (the server closing an idle connection must never
+    mint a miss) — only the fresh-connection drop counts."""
     server, serving, polling = serving_peer
     polling.poll_once()  # establish the peer: the 2-miss grace is earned
-    faults.load_fault_spec("peer.unreachable:fail:2")
-    polling.poll_once()
+    faults.load_fault_spec("peer.unreachable:fail:3")
+    polling.poll_once()  # reused-conn drop + fresh-retry drop: 2 shots
     assert not polling.view().degraded  # miss 1: not confirmed
-    polling.poll_once()
+    polling.poll_once()  # fresh conn (dropped after the miss): 1 shot
     assert polling.view().degraded  # miss 2: confirmed
     exposition = obs_metrics.REGISTRY.render()
     assert 'tfd_peer_polls_total{outcome="error"} 2' in exposition
@@ -758,7 +767,7 @@ def test_peer_snapshot_served_independently_of_debug_gate(serving_peer):
         addr="127.0.0.1",
         port=0,
         debug_endpoints=False,
-        peer_snapshot=serving.snapshot_payload,
+        peer_snapshot=serving.snapshot_response,
     )
     gated.start()
     try:
@@ -775,3 +784,328 @@ def test_peer_snapshot_served_independently_of_debug_gate(serving_peer):
         assert e.value.code == 404
     finally:
         gated.close()
+
+
+# ---------------------------------------------------------------------------
+# concurrent fan-out (ISSUE 12): bounded pool, fairness, race safety
+# ---------------------------------------------------------------------------
+
+def test_fanout_resolution_auto_caps_and_floors():
+    # auto = min(8, peers)
+    assert _coordinator(0, 4, fanout=None)[0].fanout == 3
+    assert _coordinator(0, 12, fanout=0)[0].fanout == 8
+    # explicit width capped at the peer count, floored at 1
+    assert _coordinator(0, 4, fanout=16)[0].fanout == 3
+    assert _coordinator(0, 4, fanout=2)[0].fanout == 2
+    assert _coordinator(0, 4, fanout=1)[0].fanout == 1
+
+
+def test_fanout_one_constructs_no_pool_and_matches_wider_output():
+    """--peer-fanout=1 IS the sequential round: no pool exists at all
+    (the monkeypatch-style pin), and the label output over identical
+    peer answers is byte-for-byte what a wider fan-out produces."""
+    import io
+
+    responses = {i: _peer_doc(i, sick=i % 2) for i in (1, 2, 3)}
+    outputs = {}
+    for width in (1, 4):
+        coord, _ = _coordinator(0, 4, responses=dict(responses), fanout=width)
+        coord.publish_local(
+            {
+                "google.com/tpu.chips.healthy": "3",
+                "google.com/tpu.chips.sick": "1",
+            },
+            "full",
+        )
+        if width == 1:
+            assert coord._pool is None
+        else:
+            assert coord._pool is not None
+        buf = io.StringIO()
+        coord.labels().write_to(buf)
+        outputs[width] = buf.getvalue()
+        coord.close()
+    assert outputs[1] == outputs[4]
+
+
+def test_fanout_round_costs_one_timeout_not_n():
+    """The tentpole: a round over N uniformly slow peers costs ~1x the
+    per-peer delay at full fan-out, not N x — independent of slice
+    size."""
+    delay = 0.1
+    n_workers = 9  # 8 peers
+
+    def slow_ok(worker_id):
+        def fetch(timeout):
+            time.sleep(delay)
+            return _peer_doc(worker_id)
+
+        return fetch
+
+    coord, _ = _coordinator(
+        0,
+        n_workers,
+        responses={i: slow_ok(i) for i in range(1, n_workers)},
+        fanout=8,
+    )
+    started = time.perf_counter()
+    coord.poll_once()
+    elapsed = time.perf_counter() - started
+    coord.close()
+    # 8 concurrent polls of `delay` each: ~1x delay, far under the
+    # sequential 8x. 4x leaves loaded-host headroom while still
+    # distinguishing the shapes.
+    assert elapsed < 4 * delay, f"round took {elapsed:.3f}s"
+    view = coord.view()
+    assert view.healthy_hosts == n_workers and not view.degraded
+
+
+def test_fanout_pool_wide_slow_run_cannot_starve_tail_within_one_round():
+    """Fairness (satellite): with the budget that would force the
+    SEQUENTIAL round to skip the tail behind a run of slow peers, the
+    fan-out round reaches every peer in ONE round — nothing is skipped,
+    nothing starves."""
+    obs_metrics.reset_for_tests()
+    delay = 0.1
+    n_workers = 10  # 9 peers; budget admits ~6 sequential slow polls
+
+    def slow_ok(worker_id):
+        def fetch(timeout):
+            time.sleep(delay)
+            return _peer_doc(worker_id)
+
+        return fetch
+
+    coord, _ = _coordinator(
+        0,
+        n_workers,
+        responses={i: slow_ok(i) for i in range(1, n_workers)},
+        round_budget=0.6,
+        fanout=4,
+    )
+    coord.poll_once()
+    coord.close()
+    for i in range(1, n_workers):
+        assert coord._peer_state[i].last_snapshot is not None, (
+            f"peer {i} starved within the round"
+        )
+    exposition = obs_metrics.REGISTRY.render()
+    assert 'tfd_peer_polls_total{outcome="skipped"}' not in exposition
+
+
+def test_concurrent_round_races_membership_and_failover_safely():
+    """Satellite: poll rounds on the fan-out pool race the run loop's
+    membership_token() reads and a leader failover mid-sequence; state
+    transitions are applied under the serving lock, so readers always
+    see a consistent fingerprint and the failover lands exactly as the
+    sequential round would land it."""
+    import threading
+
+    responses = {i: _peer_doc(i) for i in range(0, 8) if i != 1}
+    coord, responses = _coordinator(1, 8, responses=responses, fanout=7)
+    stop = threading.Event()
+    seen_tokens = []
+    errors = []
+
+    def reader():
+        try:
+            while not stop.is_set():
+                token = coord.membership_token()
+                if token is not None:
+                    seen_tokens.append(token)
+                coord.snapshot_payload()
+        except Exception as e:  # noqa: BLE001 - surfaced below
+            errors.append(e)
+
+    thread = threading.Thread(target=reader, daemon=True)
+    thread.start()
+    try:
+        assert dict(coord.labels())[SLICE_ROLE_LABEL] == "follower"
+        responses[0] = ConnectionRefusedError("leader died")
+        labels = {}
+        for _ in range(CONFIRM_POLLS):
+            labels = dict(coord.labels())
+    finally:
+        stop.set()
+        thread.join(timeout=5)
+        coord.close()
+    assert not errors, errors
+    assert labels[SLICE_ROLE_LABEL] == "leader"
+    assert labels[SLICE_LEADER_LABEL] == "w1"
+    assert labels[SLICE_HEALTHY_HOSTS_LABEL] == "7"
+    # Every observed fingerprint is one of the two consistent states —
+    # never a torn intermediate.
+    full = frozenset({0, 2, 3, 4, 5, 6, 7})
+    degraded = frozenset({2, 3, 4, 5, 6, 7})
+    assert set(seen_tokens) <= {full, degraded}, set(seen_tokens)
+
+
+# ---------------------------------------------------------------------------
+# delta-aware snapshots: publish-time serialization, ETag, 304 (ISSUE 12)
+# ---------------------------------------------------------------------------
+
+def test_publish_unchanged_labels_is_serialization_churn_free():
+    obs_metrics.reset_for_tests()
+    coord = SliceCoordinator(0, ["w0", "w1"], default_port=1, peer_timeout=0.1)
+    coord.publish_local({"a": "b"}, "full")
+    body1, etag1 = coord.snapshot_response()
+    for _ in range(5):
+        coord.publish_local({"a": "b"}, "full")
+    body2, etag2 = coord.snapshot_response()
+    assert (body1, etag1) == (body2, etag2)
+    assert coord.snapshot_payload()["generation"] == 1
+    assert obs_metrics.PEER_SNAPSHOT_SERIALIZATIONS.value() == 1
+    # A distinct publish re-serializes once and moves the ETag.
+    coord.publish_local({"a": "c"}, "full")
+    body3, etag3 = coord.snapshot_response()
+    assert etag3 != etag1 and body3 != body1
+    assert obs_metrics.PEER_SNAPSHOT_SERIALIZATIONS.value() == 2
+    # Same labels, different MODE: a distinct snapshot too (mode tells
+    # peers how stale the set may be).
+    coord.publish_local({"a": "c"}, "degraded")
+    assert obs_metrics.PEER_SNAPSHOT_SERIALIZATIONS.value() == 3
+
+
+def test_snapshot_response_body_parses_and_matches_payload():
+    coord = SliceCoordinator(0, ["w0", "w1"], default_port=1, peer_timeout=0.1)
+    coord.publish_local({"google.com/tpu.count": "4"}, "full")
+    body, etag = coord.snapshot_response()
+    assert etag.startswith('"') and etag.endswith('"')
+    assert parse_snapshot(body) == coord.snapshot_payload()
+
+
+def test_idle_slice_rounds_are_304_and_serialization_free(serving_peer):
+    """Acceptance (ISSUE 12): after the first full-body poll, every
+    later round against an unchanged peer is a 304 header exchange —
+    >= 90% of steady-state polls — with ZERO additional serializations
+    on the serving side."""
+    server, serving, polling = serving_peer
+    polling.poll_once()  # round 1: full body
+    serializations_after_first = (
+        obs_metrics.PEER_SNAPSHOT_SERIALIZATIONS.value()
+    )
+    rounds = 10
+    for _ in range(rounds):
+        # The run loop re-publishes the same labels every cycle; the
+        # serving side must stay byte-stable through it.
+        serving.publish_local(
+            {
+                "google.com/tpu.count": "4",
+                "google.com/tpu.chips.healthy": "4",
+                "google.com/tpu.chips.sick": "0",
+            },
+            "full",
+        )
+        polling.poll_once()
+    assert obs_metrics.PEER_SNAPSHOT_NOT_MODIFIED.value() == rounds
+    assert (
+        obs_metrics.PEER_SNAPSHOT_SERIALIZATIONS.value()
+        == serializations_after_first
+    )
+    # Every steady-state poll reused the persistent connection too.
+    assert obs_metrics.PEER_CONNECTION_REUSES.value() == rounds
+    view = polling.view()
+    assert view.healthy_hosts == 2 and not view.degraded
+
+
+def test_etag_change_serves_full_body_and_updates_aggregate(serving_peer):
+    """Snapshot change -> new ETag -> full body: the poller's aggregate
+    tracks the new content (no stale 304 short-circuit)."""
+    server, serving, polling = serving_peer
+    polling.poll_once()
+    polling.poll_once()  # 304 round
+    assert obs_metrics.PEER_SNAPSHOT_NOT_MODIFIED.value() == 1
+    serving.publish_local(
+        {
+            "google.com/tpu.count": "4",
+            "google.com/tpu.chips.healthy": "3",
+            "google.com/tpu.chips.sick": "1",
+        },
+        "full",
+    )
+    labels = dict(polling.labels())
+    assert labels[SLICE_SICK_CHIPS_LABEL] == "1"
+    # The change round was a full body, not a 304.
+    assert obs_metrics.PEER_SNAPSHOT_NOT_MODIFIED.value() == 1
+    # And the NEXT unchanged round 304s against the NEW ETag.
+    polling.poll_once()
+    assert obs_metrics.PEER_SNAPSHOT_NOT_MODIFIED.value() == 2
+
+
+def test_304_rounds_still_advance_confirmation_bookkeeping(serving_peer):
+    """Unchanged -> 304 -> _poll_succeeded: the 2-consecutive-miss
+    verdict machinery is fed exactly as by a full body — a subsequent
+    real outage still needs (and gets) its 2-miss confirmation."""
+    server, serving, polling = serving_peer
+    polling.poll_once()  # full body: establishes the peer
+    polling.poll_once()  # 304: still a success, streak stays earned
+    state = polling._peer_state[1]
+    assert state.consecutive_failures == 0 and state.ever_reached
+    # The peer goes dark for real. server.close() also severs the
+    # established keep-alive connection (obs/server.py
+    # _TrackingHTTPServer) — a closed server must actually stop
+    # answering the pollers holding persistent connections.
+    server.close()
+    polling.poll_once()
+    assert not polling.view().degraded  # miss 1 of 2: established grace
+    polling.poll_once()
+    assert polling.view().degraded  # miss 2: confirmed
+
+
+def test_closed_server_stops_answering_reused_connections(serving_peer):
+    """The ghost-server regression guard: with persistent peer
+    connections, closing the obs server must sever ESTABLISHED
+    keep-alive connections too — otherwise a retired epoch's handler
+    thread keeps serving its stale snapshot to every poller that
+    already holds a connection (and a 'killed' worker in the hermetic
+    slice harness would never read as dead)."""
+    server, serving, polling = serving_peer
+    polling.poll_once()  # establish the persistent connection
+    assert polling._peer_state[1].conn is not None
+    server.close()
+    for _ in range(CONFIRM_POLLS):
+        polling.poll_once()
+    assert polling.view().degraded, (
+        "the closed server kept answering over the reused connection"
+    )
+
+
+def test_misdirected_peer_etag_is_never_cached():
+    """A peer answering as somebody else (stale DNS) must stay a MISS on
+    every poll: caching the impostor's ETag would let its 304s replay
+    the old valid snapshot past the worker-id check, counting the
+    misdirected peer reachable forever."""
+    obs_metrics.reset_for_tests()
+    impostor = SliceCoordinator(
+        0, ["w0", "w1"], default_port=1, peer_timeout=0.5
+    )
+    impostor.publish_local({"google.com/tpu.count": "4"}, "full")
+    state = IntrospectionState(60.0)
+    server = IntrospectionServer(
+        obs_metrics.REGISTRY,
+        state,
+        addr="127.0.0.1",
+        port=0,
+        peer_snapshot=impostor.snapshot_response,
+    )
+    server.start()
+    polling = SliceCoordinator(
+        0,
+        [f"127.0.0.1:{server.port + 1}", f"127.0.0.1:{server.port}"],
+        default_port=server.port,
+        peer_timeout=0.5,
+    )
+    try:
+        for _ in range(CONFIRM_POLLS):
+            polling.poll_once()
+            polling._peer_state[1].next_attempt = 0.0  # reopen backoff
+        peer_state = polling._peer_state[1]
+        assert peer_state.etag is None, "impostor ETag was cached"
+        assert peer_state.last_snapshot is None
+        assert peer_state.consecutive_failures == CONFIRM_POLLS
+        assert polling.view().degraded
+        exposition = obs_metrics.REGISTRY.render()
+        assert "tfd_peer_snapshot_not_modified_total 0" in exposition
+    finally:
+        polling.close()
+        server.close()
