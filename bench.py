@@ -1188,6 +1188,161 @@ def main() -> int:
         file=sys.stderr,
     )
 
+    # Hierarchical cohort aggregation (ISSUE 13, --cohort-size): a
+    # 256-host slice in 4 cohorts of 64 with ONE DEAD COHORT LEADER
+    # (w64 is a bound-but-never-accepting listener; w65 serves the
+    # re-derived aggregate). The slice leader's round polls its own 63
+    # cohort siblings (live servers) plus each other cohort's leadership
+    # chain — the members behind the cohort leaders are never contacted
+    # at all, which is the scaling claim: the slice-tier plane costs one
+    # poll and ONE PERSISTENT CONNECTION per COHORT, not per host (the
+    # flat plane at 256 hosts would hold 255). Every measured round pays
+    # the dead leader's full timeout (backoff zeroed), so the number is
+    # the steady-state worst case, CI-asserted at ~O(peer-timeout).
+    def _measure_hier_round():
+        from gpu_feature_discovery_tpu.peering.snapshot import (
+            build_cohort_aggregate,
+        )
+
+        total, cohort_size = 256, 64
+        cohorts = total // cohort_size
+        servers, blackholes = [], []
+        leader = None
+        ports = {}
+        names = [f"w{i}" for i in range(total)]
+        member_labels = {
+            "google.com/tpu.count": "4",
+            "google.com/tpu.chips.healthy": "4",
+            "google.com/tpu.chips.sick": "0",
+        }
+
+        def _aggregate(index, dead=()):
+            start = index * cohort_size
+            members = {}
+            for wid in range(start, start + cohort_size):
+                live = wid not in dead
+                members[wid] = {
+                    "reachable": live,
+                    "generation": 1 if live else None,
+                    "sick": 0 if live else None,
+                    "mode": "full" if live else None,
+                }
+            return build_cohort_aggregate(index, members)
+
+        def _serve(peer_id, aggregate=None):
+            serving = SliceCoordinator(
+                peer_id,
+                names,
+                default_port=1,
+                peer_timeout=1.0,
+                cohort_size=cohort_size,
+            )
+            serving.publish_local(member_labels, "full")
+            if aggregate is not None:
+                serving._set_aggregate(aggregate)
+            server = IntrospectionServer(
+                obs_metrics.REGISTRY,
+                IntrospectionState(60.0),
+                addr="127.0.0.1",
+                port=0,
+                peer_snapshot=serving.snapshot_response,
+            )
+            server.start()
+            servers.append(server)
+            ports[peer_id] = server.port
+
+        try:
+            for peer_id in range(1, cohort_size):  # w0's cohort siblings
+                _serve(peer_id)
+            # Cohort 1: its leader w64 is DEAD (backlog listener — the
+            # worst per-peer cost); w65 answers with the re-derived
+            # aggregate counting w64 out.
+            dead_sock = _slice_socket.socket()
+            dead_sock.bind(("127.0.0.1", 0))
+            dead_sock.listen(16)
+            blackholes.append(dead_sock)
+            ports[64] = dead_sock.getsockname()[1]
+            _serve(65, aggregate=_aggregate(1, dead=(64,)))
+            _serve(128, aggregate=_aggregate(2))
+            _serve(192, aggregate=_aggregate(3))
+            hostnames = [
+                f"127.0.0.1:{ports[i]}" if i in ports else "127.0.0.1:1"
+                for i in range(total)
+            ]
+            hostnames[0] = "127.0.0.1:1"  # self: never polled
+            leader = SliceCoordinator(
+                0,
+                hostnames,
+                default_port=1,
+                peer_timeout=slice_scale_peer_timeout_s,
+                cohort_size=cohort_size,
+                # Re-poll the dead chain member every round: measure the
+                # round that PAYS the timeout, not the backoff skip.
+                backoff_factory=lambda: _SliceBackoff(
+                    base=0.0, factor=1.0, cap=0.0, jitter=0.0
+                ),
+            )
+            iters = max(
+                2, int(os.environ.get("TFD_BENCH_SLICE_SCALE_ITERS", "3"))
+            )
+            leader.poll_once()  # warm: confirm w64 dead, find w65
+            rounds_ms = []
+            for _ in range(iters):
+                t0 = time.perf_counter()
+                leader.poll_once()
+                rounds_ms.append((time.perf_counter() - t0) * 1e3)
+            view = leader.view()
+            assert view.role == "leader", view
+            # 255 live of 256 (w64 dead), no cohort degraded: the chain
+            # re-derived w65.
+            assert view.healthy_hosts == total - 1, view
+            assert view.degraded_cohorts == (), view
+            tier2_conns = sum(
+                1
+                for s in leader._tier_state.values()
+                if s.conn is not None
+            )
+            member_conns = sum(
+                1
+                for s in leader._peer_state.values()
+                if s.conn is not None
+            )
+            assert tier2_conns <= cohorts, (
+                f"slice-tier connections {tier2_conns} exceed the "
+                f"cohort count {cohorts}"
+            )
+            return (
+                round(statistics.median(rounds_ms), 3),
+                tier2_conns,
+                member_conns + tier2_conns,
+                cohorts,
+            )
+        finally:
+            if leader is not None:
+                leader.close()
+            for server in servers:
+                server.close()
+            for sock in blackholes:
+                sock.close()
+
+    (
+        slice_aggregation_hier_256_ms,
+        slice_hier_tier2_connections,
+        slice_hier_total_connections,
+        slice_hier_cohorts,
+    ) = _measure_hier_round()
+    print(
+        f"bench: hierarchical slice round (256 hosts, "
+        f"{slice_hier_cohorts} cohorts, 1 dead cohort leader, peer "
+        f"timeout {slice_scale_peer_timeout_s * 1e3:.0f}ms) "
+        f"p50={slice_aggregation_hier_256_ms}ms, slice-tier "
+        f"connections={slice_hier_tier2_connections} "
+        f"(<= cohort count {slice_hier_cohorts}), total "
+        f"connections={slice_hier_total_connections} "
+        f"(flat would hold 255)",
+        file=sys.stderr,
+    )
+
     # Event-driven reconcile latency (ISSUE 9): POST /probe on the obs
     # server -> label file mtime change, with the sleep interval at 60s
     # so only the event path (cmd/events.py PROBE_REQUEST wake) can
@@ -1411,6 +1566,17 @@ def main() -> int:
                 # (2x / 2.5x with scheduling headroom), not N x.
                 "slice_aggregation_16_ms": slice_aggregation_16_ms,
                 "slice_aggregation_64_ms": slice_aggregation_64_ms,
+                # Hierarchical cohort aggregation (ISSUE 13): a 256-host
+                # slice in 4 cohorts with one dead cohort leader — CI
+                # asserts the round is ~O(peer-timeout) AND the
+                # slice-tier persistent-connection count is bounded by
+                # the cohort count, not the host count (total includes
+                # the leader's own 63 intra-cohort connections; flat
+                # would hold 255).
+                "slice_aggregation_hier_256_ms": slice_aggregation_hier_256_ms,
+                "slice_hier_tier2_connections": slice_hier_tier2_connections,
+                "slice_hier_total_connections": slice_hier_total_connections,
+                "slice_hier_cohorts": slice_hier_cohorts,
                 "slice_scale_peer_timeout_ms": round(
                     slice_scale_peer_timeout_s * 1e3, 3
                 ),

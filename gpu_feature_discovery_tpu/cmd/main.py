@@ -258,7 +258,11 @@ def start(argv: Optional[list] = None) -> int:
 
 
 def start_introspection_server(
-    config: Config, quiet: bool = False, peer_snapshot=None, probe_request=None
+    config: Config,
+    quiet: bool = False,
+    peer_snapshot=None,
+    probe_request=None,
+    peer_fault=None,
 ):
     """Bind the obs introspection server for a daemon epoch; returns
     ``(server, state)`` or ``(None, None)``. Oneshot NEVER serves (a
@@ -292,6 +296,7 @@ def start_introspection_server(
             peer_snapshot=peer_snapshot,
             probe_request=probe_request,
             probe_token=tfd.probe_token or "",
+            peer_fault=peer_fault,
         )
     except OSError as e:
         if not quiet:
@@ -591,6 +596,12 @@ def run(
     peer_snapshot = (
         coordinator.snapshot_response if coordinator is not None else None
     )
+    # The two-tier chaos sites' gate (peer.tier-partition /
+    # peer.cohort-leader-dead): consulted by the serving handler per
+    # /peer/snapshot request, enacted there at the wire.
+    peer_fault = (
+        coordinator.serving_fault if coordinator is not None else None
+    )
     # Event-driven reconcile loop (cmd/events.py): --reconcile=event (the
     # supervised-daemon default via auto) blocks on the typed event queue
     # instead of sleeping the interval; interval mode constructs NONE of
@@ -680,7 +691,10 @@ def run(
     # Introspection server (obs/): daemon epochs only, rebound per epoch
     # so a SIGHUP reload picks up new --metrics-* flags.
     obs_server, obs_state = start_introspection_server(
-        config, peer_snapshot=peer_snapshot, probe_request=probe_request
+        config,
+        peer_snapshot=peer_snapshot,
+        probe_request=probe_request,
+        peer_fault=peer_fault,
     )
     # Anti-flap hysteresis (--flap-window > 1): per-epoch, daemon only —
     # oneshot publishes exactly what it measured.
@@ -729,6 +743,7 @@ def run(
                     quiet=True,
                     peer_snapshot=peer_snapshot,
                     probe_request=probe_request,
+                    peer_fault=peer_fault,
                 )
             cycle_mode = "full"
             try:

@@ -30,6 +30,7 @@ from gpu_feature_discovery_tpu.config.spec import (
     parse_bool as _parse_bool,
     parse_config_file,
     parse_fraction as _parse_fraction,
+    parse_cohort_size as _parse_cohort_size,
     parse_nonneg_int as _parse_nonneg_int,
     parse_positive_float as _parse_positive_float,
     parse_positive_int as _parse_positive_int,
@@ -103,6 +104,15 @@ DEFAULT_PEER_TIMEOUT = 2.0
 # behind the round budget. 1 reproduces the sequential round byte for
 # byte (no pool is constructed at all).
 DEFAULT_PEER_FANOUT = 0
+# Two-tier cohort coordination (peering/cohort.py): partition the
+# hostname list into fixed cohorts of this size; each cohort's lowest
+# reachable worker-id aggregates its members' snapshots and the slice
+# leader polls only cohort leaders, so the top-tier fan-out (and the
+# leader's persistent connection count) scales with the COHORT COUNT
+# instead of the host count. "0" (the default) is flat — the
+# single-tier plane, byte-identical to the pre-cohort coordination;
+# "auto" resolves to 64 exactly when the slice is larger than 64 hosts.
+DEFAULT_COHORT_SIZE = "0"
 # Event-driven reconcile loop (cmd/events.py): the staleness bound
 # defaults to the sleep interval (0 = "track --sleep-interval", so the
 # interval flag keeps one meaning in both modes); the debounce window
@@ -540,6 +550,26 @@ FLAG_DEFS: List[FlagDef] = [
         "count are capped at it",
         setter=lambda c, v: setattr(_f(c).tfd, "peer_fanout", v),
         getter=lambda c: _f(c).tfd.peer_fanout,
+    ),
+    FlagDef(
+        name="cohort-size",
+        env_vars=("TFD_COHORT_SIZE",),
+        parse=_parse_cohort_size,
+        default=DEFAULT_COHORT_SIZE,
+        help="with slice coordination on, partition the "
+        "TPU_WORKER_HOSTNAMES list into fixed cohorts of this size for "
+        "two-tier aggregation: within each cohort the lowest reachable "
+        "worker-id aggregates its members' snapshots, and the slice "
+        "leader polls only cohort leaders (falling back to directly "
+        "polling a cohort whose whole leadership chain is dark, marked "
+        "google.com/tpu.slice.cohort.<i>.degraded); '0' (default) is "
+        "the flat single-tier coordination, byte-identical to before; "
+        "'auto' resolves to 64 when the slice exceeds 64 hosts; every "
+        "robustness semantic (2-consecutive-miss confirmation, "
+        "confirmed-dead backoff, rotation fairness, budget cutoff, "
+        "no-election failover) applies at both tiers",
+        setter=lambda c, v: setattr(_f(c).tfd, "cohort_size", v),
+        getter=lambda c: _f(c).tfd.cohort_size,
     ),
     FlagDef(
         name="backends",

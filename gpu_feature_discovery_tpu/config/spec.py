@@ -212,6 +212,12 @@ class TfdFlags:
     # peer polls one round runs at once. 0 = auto (min(8, peers));
     # 1 reproduces the sequential round byte for byte.
     peer_fanout: Optional[int] = None  # 0 = auto
+    # Two-tier cohort coordination (peering/cohort.py): partition the
+    # hostname list into fixed cohorts of this size — each cohort's
+    # lowest reachable id aggregates it, the slice leader polls only
+    # cohort leaders. "0" = flat (single tier, byte-identical to the
+    # pre-cohort plane); "auto" = 64 once the slice outgrows it.
+    cohort_size: Optional[str] = None  # "0" | "auto" | positive int
     # Multi-backend registry (resource/registry.py): comma-separated
     # backend tokens, one per label family ("auto" = the classic
     # TPU-first autodetect, byte-identical to the pre-registry daemon).
@@ -290,6 +296,7 @@ class Config:
                     "sliceCoordination": self.flags.tfd.slice_coordination,
                     "peerTimeout": self.flags.tfd.peer_timeout,
                     "peerFanout": self.flags.tfd.peer_fanout,
+                    "cohortSize": self.flags.tfd.cohort_size,
                     "backends": self.flags.tfd.backends,
                     "reconcile": self.flags.tfd.reconcile,
                     "maxStaleness": self.flags.tfd.max_staleness,
@@ -370,6 +377,25 @@ def parse_positive_float(value: Any) -> float:
     if f <= 0.0:
         raise ConfigError(f"value must be > 0: {value!r}")
     return f
+
+
+def parse_cohort_size(value: Any) -> str:
+    """Strict ``--cohort-size`` grammar: ``auto`` | an integer >= 0
+    (0 = flat single-tier coordination). Returns the canonical string
+    form — resolving ``auto`` needs the slice's host count, which only
+    the peering layer has (peering/cohort.resolve_cohort_size)."""
+    s = str(value).strip().lower()
+    if s == "auto":
+        return "auto"
+    try:
+        n = int(s)
+    except ValueError as e:
+        raise ConfigError(
+            f"invalid cohort-size {value!r} (want 'auto' or an integer >= 0)"
+        ) from e
+    if n < 0:
+        raise ConfigError(f"cohort-size must be >= 0: {value!r}")
+    return str(n)
 
 
 def parse_fraction(value: Any) -> float:
@@ -467,6 +493,8 @@ def parse_config_file(path: str) -> Config:
         config.flags.tfd.peer_timeout = parse_duration(tfd["peerTimeout"])
     if tfd.get("peerFanout") is not None:
         config.flags.tfd.peer_fanout = parse_nonneg_int(tfd["peerFanout"])
+    if tfd.get("cohortSize") is not None:
+        config.flags.tfd.cohort_size = parse_cohort_size(tfd["cohortSize"])
     config.flags.tfd.backends = _opt_str(tfd.get("backends"))
     config.flags.tfd.reconcile = _opt_str(tfd.get("reconcile"))
     if tfd.get("maxStaleness") is not None:

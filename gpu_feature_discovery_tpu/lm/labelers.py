@@ -104,8 +104,13 @@ def multi_backend_label_sources(
     if timestamp is not None:
         ts = timestamp
         sources.append(LabelSource("timestamp", lambda: ts, offload=False))
+    # One concurrent acquisition pass before the per-family source
+    # build: a hung family init overlaps the others instead of
+    # serializing them (BackendSet.acquire_all — the utils/fanout
+    # primitive). Source construction below reads the held managers.
+    backend_set.acquire_all(strict=strict)
     for rt in backend_set.runtimes:
-        manager = rt.acquire(strict=strict)
+        manager = rt.manager
         if rt.family == "tpu":
             if manager is not None:
                 with timed("tpu.init"):

@@ -38,11 +38,26 @@ SLICE_HEALTHY_HOSTS_LABEL = "google.com/tpu.slice.healthy-hosts"
 SLICE_TOTAL_HOSTS_LABEL = "google.com/tpu.slice.total-hosts"
 SLICE_DEGRADED_LABEL = "google.com/tpu.slice.degraded"
 SLICE_SICK_CHIPS_LABEL = "google.com/tpu.slice.sick-chips"
+# Two-tier cohort coordination (--cohort-size > 0): every coordinating
+# daemon publishes its own cohort index; the slice leader additionally
+# publishes the cohort count and one degraded marker per cohort whose
+# leadership chain is dark (served by the direct-poll fallback).
+SLICE_COHORT_LABEL = "google.com/tpu.slice.cohort"
+SLICE_COHORTS_LABEL = "google.com/tpu.slice.cohorts"
+# Dynamic family: google.com/tpu.slice.cohort.<i>.degraded. Every key
+# under this prefix is a coordination label (no node-local label lives
+# under it — the node's own slice facts are slice.chips/hosts/memory/
+# capable/accelerator-type/topology, none of which collide).
+SLICE_COHORT_PREFIX = "google.com/tpu.slice.cohort."
 
 # The whole coordination family, for snapshot stripping: a peer's
 # snapshot must carry its NODE facts, not the slice labels a previous
 # aggregation round derived from other peers — feeding those back in
-# would let one stale aggregate echo around the slice.
+# would let one stale aggregate echo around the slice. NOTE: consumers
+# that filter by line prefix (tests/slice_fixture.non_coord_lines) rely
+# on SLICE_COHORT_LABEL also prefix-matching SLICE_COHORTS_LABEL and the
+# whole SLICE_COHORT_PREFIX family; exact-key consumers must pair this
+# tuple with is_cohort_label().
 SLICE_COORD_LABELS = (
     SLICE_ROLE_LABEL,
     SLICE_LEADER_LABEL,
@@ -51,12 +66,28 @@ SLICE_COORD_LABELS = (
     SLICE_TOTAL_HOSTS_LABEL,
     SLICE_DEGRADED_LABEL,
     SLICE_SICK_CHIPS_LABEL,
+    SLICE_COHORT_LABEL,
+    SLICE_COHORTS_LABEL,
 )
 
 
+def cohort_degraded_label(index: int) -> str:
+    return f"{SLICE_COHORT_PREFIX}{int(index)}.degraded"
+
+
+def is_cohort_label(key: str) -> bool:
+    """True for any member of the dynamic cohort label family (the
+    per-index degraded markers exact-key sets cannot enumerate)."""
+    return key.startswith(SLICE_COHORT_PREFIX)
+
+
 def slice_labels(view) -> Labels:
-    """The label set for one aggregation view (peering SliceView)."""
+    """The label set for one aggregation view (peering SliceView). Flat
+    views (``view.cohorts`` 0 — the default) render exactly the original
+    single-tier family; hierarchical views add the cohort rows and the
+    ``cohort-leader`` role vocabulary."""
     labels = Labels()
+    hierarchical = getattr(view, "cohorts", 0) > 0
     if view.role == "leader":
         labels[SLICE_ROLE_LABEL] = "leader"
         labels[SLICE_LEADER_LABEL] = label_safe_value(view.leader_hostname)
@@ -64,11 +95,25 @@ def slice_labels(view) -> Labels:
         labels[SLICE_TOTAL_HOSTS_LABEL] = str(view.total_hosts)
         labels[SLICE_DEGRADED_LABEL] = "true" if view.degraded else "false"
         labels[SLICE_SICK_CHIPS_LABEL] = str(view.sick_chips)
+        if hierarchical:
+            labels[SLICE_COHORTS_LABEL] = str(view.cohorts)
+            for index in view.degraded_cohorts:
+                # Marked only while degraded (absent otherwise): the
+                # fallback regime is exceptional, and a per-cohort
+                # false row on every healthy slice would be pure churn
+                # surface at thousand-host scale.
+                labels[cohort_degraded_label(index)] = "true"
     else:
-        labels[SLICE_ROLE_LABEL] = "follower"
+        # "cohort-leader" surfaces the middle tier; plain followers keep
+        # the original vocabulary.
+        labels[SLICE_ROLE_LABEL] = (
+            "cohort-leader" if view.role == "cohort-leader" else "follower"
+        )
         labels[SLICE_LEADER_SEEN_LABEL] = (
             "true" if view.leader_seen else "false"
         )
+    if hierarchical:
+        labels[SLICE_COHORT_LABEL] = str(view.cohort)
     return labels
 
 

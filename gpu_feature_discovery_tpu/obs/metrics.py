@@ -283,6 +283,30 @@ SLICE_DEGRADED = REGISTRY.gauge(
     "1 while the aggregated slice view counts fewer reachable hosts than "
     "TPU_WORKER_HOSTNAMES names (the slice.degraded label), else 0.",
 )
+COHORT_LEADERS = REGISTRY.gauge(
+    "tfd_cohort_leaders",
+    "Two-tier coordination (--cohort-size): cohorts this node currently "
+    "sees served by a LIVE leader — on the slice leader, its own cohort "
+    "plus every cohort whose leadership chain answered with an "
+    "aggregate; 1 on a mid-tier cohort leader; leader visibility (0/1) "
+    "on followers. 0 in flat mode.",
+)
+COHORT_DEGRADED = REGISTRY.gauge(
+    "tfd_cohort_degraded",
+    "Cohorts currently marked degraded in this node's view (whole "
+    "leadership chain dark, members served by the slice leader's "
+    "direct-poll fallback — the slice.cohort.<i>.degraded labels). "
+    "0 in flat mode and on every non-slice-leader.",
+)
+COHORT_POLL_ROUNDS = REGISTRY.counter(
+    "tfd_cohort_poll_rounds_total",
+    "Hierarchical poll rounds STARTED by tier: cohort (the intra-cohort "
+    "sibling round every member runs) or slice (the slice leader's "
+    "inter-cohort leadership round). Counted at round start — a round "
+    "abandoned by an epoch teardown still counts. Absent entirely in "
+    "flat mode.",
+    labelnames=("tier",),
+)
 HTTP_ERRORS = REGISTRY.counter(
     "tfd_http_errors_total",
     "Introspection endpoint handlers that raised; the response is a 500 "

@@ -180,6 +180,13 @@ def _endpoint_label(path: str) -> str:
     return path if path in _KNOWN_ENDPOINTS else "other"
 
 
+# The poller's tier marker (peering/coordinator.py POLL_TIER_HEADER —
+# the name is restated here because obs must not import peering): which
+# plane of the two-tier coordination a /peer/snapshot request belongs
+# to. Flat-mode pollers send no header.
+_POLL_TIER_HEADER = "X-TFD-Poll-Tier"
+
+
 def _make_handler(
     registry: Registry,
     state: IntrospectionState,
@@ -187,6 +194,7 @@ def _make_handler(
     peer_snapshot: Optional[Callable[[], "tuple[bytes, str]"]] = None,
     probe_request: Optional[Callable[[], None]] = None,
     probe_token: str = "",
+    peer_fault: Optional[Callable[[str], bool]] = None,
 ):
     class _Handler(BaseHTTPRequestHandler):
         # Content-Length is always sent, so keep-alive is safe.
@@ -327,6 +335,19 @@ def _make_handler(
                 # Stall past the poller's --peer-timeout; the eventual
                 # reply lands on a socket the poller abandoned.
                 time.sleep(PEER_SLOW_DELAY_S)
+            if peer_fault is not None:
+                # The two-tier sites (peer.tier-partition /
+                # peer.cohort-leader-dead) need coordinator-side context
+                # — the request's tier and this daemon's current role —
+                # so their gate lives on the coordinator
+                # (SliceCoordinator.serving_fault); the ENACTMENT (the
+                # dropped connection, the same wire signature a dead
+                # host's RST produces) stays here at the serving
+                # handler.
+                tier = self.headers.get(_POLL_TIER_HEADER, "")
+                if peer_fault(tier):
+                    self.close_connection = True
+                    return True
             return False
 
         def _reply(
@@ -403,6 +424,7 @@ class IntrospectionServer:
         peer_snapshot: Optional[Callable[[], "tuple[bytes, str]"]] = None,
         probe_request: Optional[Callable[[], None]] = None,
         probe_token: str = "",
+        peer_fault: Optional[Callable[[str], bool]] = None,
     ):
         self._httpd = _TrackingHTTPServer(
             (addr, port),
@@ -413,6 +435,7 @@ class IntrospectionServer:
                 peer_snapshot,
                 probe_request=probe_request,
                 probe_token=probe_token,
+                peer_fault=peer_fault,
             ),
         )
         self._httpd.daemon_threads = True

@@ -63,6 +63,26 @@ at all never claims leadership (``all peers down`` is overwhelmingly a
 local partition, not a slice where every other host died): it publishes
 ``slice.role=follower`` + ``slice.leader-seen=false`` so the partition
 is visible on its own node without poisoning the slice aggregate.
+
+Two-tier cohort aggregation (``--cohort-size`` > 0, ISSUE 13): the flat
+plane costs the leader one poll and one persistent connection per HOST;
+at thousands of hosts that table is both the scaling bound and a single
+blast radius. The hostname list partitions into FIXED contiguous
+cohorts (peering/cohort.py — a pure function of the list, so every
+member derives the identical table). Everyone polls its own cohort's
+siblings (the flat machinery, cohort-scoped); the derived cohort leader
+serves its members' verdicts as an aggregate section on its own
+snapshot (same publish-time body/ETag/304 economy) and probes lower
+cohorts' leadership chains to decide whether IT is the slice leader;
+the slice leader polls only each cohort's 3-deep chain. Failover stays
+re-derivation at both tiers, and a cohort whose whole chain is dark is
+marked degraded and served by direct member polls under the round
+budget — partial data beats no data. Leadership-chain links get their
+OWN per-peer states (``_tier_state``): under an inter-tier partition a
+peer can be dark on the leadership plane while answering direct polls,
+and one shared state would oscillate between the verdicts forever.
+``--cohort-size=0`` (the default) constructs none of this and is the
+flat round byte for byte.
 """
 
 from __future__ import annotations
@@ -71,21 +91,29 @@ import http.client
 import logging
 import threading
 import time
-from concurrent.futures import CancelledError, ThreadPoolExecutor
 from dataclasses import dataclass, field
-from typing import Any, Callable, Dict, List, Optional
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from gpu_feature_discovery_tpu.lm.labels import Labels
 from gpu_feature_discovery_tpu.lm.slice_labeler import slice_labels
 from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+from gpu_feature_discovery_tpu.peering.cohort import (
+    chain_ids,
+    cohort_index,
+    cohort_partition,
+    resolve_cohort_size,
+)
 from gpu_feature_discovery_tpu.peering.snapshot import (
     MAX_SNAPSHOT_BYTES,
     PEER_SNAPSHOT_PATH,
     PeerSnapshotError,
+    build_cohort_aggregate,
     build_snapshot,
     parse_snapshot,
     serialize_snapshot,
 )
+from gpu_feature_discovery_tpu.utils.fanout import BoundedPool
 from gpu_feature_discovery_tpu.utils.retry import BackoffPolicy
 
 log = logging.getLogger("tfd.peering")
@@ -115,6 +143,16 @@ _STALE_CONN_ERRORS = (
 # must survive one repetition.
 CONFIRM_POLLS = 2
 
+# Poll-tier names, sent as the X-TFD-Poll-Tier request header in
+# hierarchical mode so the wire itself says which plane a request
+# belongs to (the peer.tier-partition fault site drops exactly the
+# "slice" plane at the serving handler — obs/server.py). Flat-mode polls
+# send NO tier header, keeping the wire byte-identical to PR 12.
+TIER_COHORT = "cohort"    # intra-cohort sibling polls
+TIER_SLICE = "slice"      # slice leader <-> cohort leadership chain
+TIER_DIRECT = "direct"    # degraded-cohort direct-poll fallback
+POLL_TIER_HEADER = "X-TFD-Poll-Tier"
+
 # Backoff schedule for re-polling a CONFIRMED-dead peer: base one cycle
 # of patience, capped well under the default sleep interval so a healed
 # peer is noticed within a few cycles even on a long-interval daemon.
@@ -142,8 +180,29 @@ class PeerEndpoint:
 
 
 def _split_host_port(entry: str, default_port: int) -> "tuple[str, int]":
+    """Split one TPU_WORKER_HOSTNAMES entry into (host, port).
+
+    ``[::1]:9101`` / ``[::1]`` — the bracketed IPv6 forms — yield the
+    unbracketed address; an UNBRACKETED entry with more than one colon
+    (a bare IPv6 address like ``::1`` or ``fe80::2``) is host-only: its
+    trailing ``:1``/``:2`` group is part of the address, not a port
+    (rpartition used to mis-split ``::1`` into host ``::`` port 1).
+    Only a single-colon ``host:port`` with a numeric port carries an
+    explicit port; everything else is a bare host on the default port.
+    """
+    if entry.startswith("["):
+        bracket, sep, rest = entry.partition("]")
+        if sep:
+            host = bracket[1:]
+            if not rest:
+                return host, default_port
+            if rest.startswith(":") and rest[1:].isdigit():
+                return host, int(rest[1:])
+        # Malformed bracket form: treat the raw entry as a bare host
+        # rather than guessing at a split.
+        return entry, default_port
     host, sep, port = entry.rpartition(":")
-    if sep and port.isdigit():
+    if sep and port.isdigit() and ":" not in host:
         return host, int(port)
     return entry, default_port
 
@@ -160,6 +219,13 @@ class _PeerState:
     # unlike the verdict fields above these need no lock.
     conn: Optional[http.client.HTTPConnection] = None
     etag: Optional[str] = None
+    # Whether this state's verdict transitions drive the per-peer
+    # tfd_peer_unreachable gauge. In hierarchical mode one peer can be
+    # tracked on TWO planes at once (its slice-tier leadership link and
+    # the direct/member plane); only the member-plane state owns the
+    # gauge, or a tier-partitioned-but-alive peer would flap the series
+    # between 1 and 0 every round.
+    owns_gauge: bool = True
     backoff: BackoffPolicy = field(
         default_factory=lambda: BackoffPolicy(
             base=PEER_BACKOFF_BASE_S, cap=PEER_BACKOFF_CAP_S
@@ -180,15 +246,33 @@ class _PeerState:
 @dataclass(frozen=True)
 class SliceView:
     """One aggregation round's verdict (lm/slice_labeler.slice_labels
-    renders it)."""
+    renders it). The cohort fields stay at their defaults on a flat
+    (single-tier) coordinator, which keeps the rendered label set
+    byte-identical to the pre-cohort family."""
 
-    role: str                    # "leader" | "follower"
+    role: str                    # "leader" | "cohort-leader" | "follower"
     leader_hostname: str
     leader_seen: bool
     healthy_hosts: int
     total_hosts: int
     degraded: bool
     sick_chips: int
+    cohort: int = 0                       # own cohort index (hier only)
+    cohorts: int = 0                      # cohort count; 0 = flat
+    degraded_cohorts: Tuple[int, ...] = ()  # served by direct-poll fallback
+
+
+@dataclass
+class _CohortView:
+    """The slice leader's view of ONE other cohort, resolved per round
+    from the leadership-chain states (and the direct-poll fallback when
+    the chain is dark)."""
+
+    index: int
+    leader_id: Optional[int]      # live cohort leader found on the chain
+    degraded: bool                # chain dark -> direct-poll fallback
+    healthy: int                  # reachable members (leader included)
+    sick: int                     # summed member sick-chip counts
 
 
 class SliceCoordinator:
@@ -205,6 +289,7 @@ class SliceCoordinator:
         clock: Callable[[], float] = time.monotonic,
         backoff_factory: Optional[Callable[[], BackoffPolicy]] = None,
         fanout: Optional[int] = None,
+        cohort_size: int = 0,
     ):
         if not 0 <= worker_id < len(hostnames):
             raise ValueError(
@@ -223,6 +308,7 @@ class SliceCoordinator:
         )
         self._clock = clock
         self._round_offset = 0
+        self._backoff_factory = backoff_factory
         self._peers: List[PeerEndpoint] = []
         self._peer_state: Dict[int, _PeerState] = {}
         for i, entry in enumerate(hostnames):
@@ -230,27 +316,40 @@ class SliceCoordinator:
                 continue
             host, port = _split_host_port(entry, default_port)
             self._peers.append(PeerEndpoint(i, entry, host, port))
-            state = _PeerState()
-            if backoff_factory is not None:
-                state.backoff = backoff_factory()
-            self._peer_state[i] = state
+            self._peer_state[i] = self._new_state()
+        self._peer_by_id = {p.worker_id: p for p in self._peers}
+        # Two-tier cohort partition (--cohort-size): () = flat, exactly
+        # the single-tier coordination this module always ran. The
+        # partition is a PURE function of (host count, size) — every
+        # member derives the identical table (peering/cohort.py).
+        self.cohort_size = int(cohort_size or 0)
+        self._cohorts = cohort_partition(self.total_hosts, self.cohort_size)
+        self._hier = len(self._cohorts) > 1
+        self._my_cohort = (
+            cohort_index(self.worker_id, self.cohort_size) if self._hier else 0
+        )
+        # Slice-tier leadership-link state (chain polls), separate from
+        # the member-plane _peer_state: under an inter-tier partition a
+        # peer can be dark on the leadership link while answering direct
+        # polls, and one shared state would oscillate between the two
+        # verdicts forever. Lazily populated; gauge ownership stays with
+        # the member plane (_PeerState.owns_gauge).
+        self._tier_state: Dict[int, _PeerState] = {}
+        self._tier_round_offset = 0
         # Bounded poll fan-out: None/0 = auto (min(AUTO_FANOUT_CAP,
         # peers)); an explicit width is capped at the peer count (extra
         # threads could never run) and floored at 1 (the sequential
-        # round, which constructs NO pool at all — pinned).
+        # round, which constructs NO pool at all — pinned). The pool is
+        # the extracted utils/fanout primitive; both tiers of a
+        # hierarchical round share it.
         peers = max(1, len(self._peers))
         self.fanout = (
             min(AUTO_FANOUT_CAP, peers)
             if not fanout
             else max(1, min(int(fanout), peers))
         )
-        self._pool = (
-            ThreadPoolExecutor(
-                max_workers=self.fanout,
-                thread_name_prefix=f"tfd-peer-poll-w{worker_id}",
-            )
-            if self.fanout > 1
-            else None
+        self._fanout = BoundedPool(
+            self.fanout, name=f"tfd-peer-poll-w{worker_id}"
         )
         # Serving-side state (handler threads read, run loop writes).
         self._lock = threading.Lock()
@@ -272,6 +371,40 @@ class SliceCoordinator:
         # round may already be polling on the engine thread — hence
         # stored under the serving lock, not read from _peer_state.
         self._membership: Optional[frozenset] = None
+        # Hierarchical round state, committed under the serving lock at
+        # the end of each _poll_hier round: the derived SliceView, the
+        # cohort aggregate this daemon serves while it leads its cohort
+        # (rides the published snapshot — same body/ETag/304 machinery),
+        # and the current role (the peer.cohort-leader-dead fault gate).
+        self._last_view: Optional[SliceView] = None
+        self._cohort_aggregate: Optional[Dict[str, Any]] = None
+        self._role: str = "follower"
+        # Hermetic-harness fault scoping (tests/slice_fixture.py): the
+        # fault registry is process-global there, so the chaos rows arm
+        # these per-worker flags instead. Production arms the real
+        # TFD_FAULT_SPEC sites; both are enacted at the serving handler
+        # via serving_fault().
+        self.force_tier_partition = False
+        self.force_cohort_leader_dead = False
+
+    def _new_state(self, owns_gauge: bool = True) -> _PeerState:
+        state = _PeerState(owns_gauge=owns_gauge)
+        if self._backoff_factory is not None:
+            state.backoff = self._backoff_factory()
+        return state
+
+    def _tier_state_for(self, worker_id: int) -> _PeerState:
+        state = self._tier_state.get(worker_id)
+        if state is None:
+            state = self._new_state(owns_gauge=False)
+            self._tier_state[worker_id] = state
+        return state
+
+    @property
+    def _pool(self):
+        """The fan-out executor (None when fanout == 1 — the sequential
+        round constructs no pool at all, pinned)."""
+        return self._fanout.pool
 
     # -- serving side (obs server) ----------------------------------------
 
@@ -305,18 +438,74 @@ class SliceCoordinator:
             self._local_labels,
             self._generation,
             self._local_mode,
+            cohort=self._cohort_aggregate,
         )
         self._snapshot_body, self._snapshot_etag = serialize_snapshot(doc)
         obs_metrics.PEER_SNAPSHOT_SERIALIZATIONS.inc()
+
+    def _set_aggregate(self, aggregate: Optional[Dict[str, Any]]) -> None:
+        """Refresh the cohort aggregate this daemon serves (None while
+        it is not a cohort leader). An UNCHANGED aggregate keeps the
+        cached body/ETag frozen — the idle-slice 304 economy holds at
+        the aggregate tier too. The snapshot generation does NOT move:
+        it counts distinct LABEL publishes; aggregate freshness travels
+        by ETag, and bumping the generation here would feed the
+        aggregate's own self-entry back into the body and re-render
+        every round forever."""
+        with self._lock:
+            if aggregate == self._cohort_aggregate:
+                return
+            self._cohort_aggregate = aggregate
+            if self._snapshot_body is not None:
+                self._render_snapshot_locked()
 
     def snapshot_payload(self) -> Dict[str, Any]:
         with self._lock:
             labels = dict(self._local_labels)
             mode = self._local_mode
             generation = self._generation
+            aggregate = self._cohort_aggregate
         return build_snapshot(
-            self.worker_id, self.hostname, labels, generation, mode
+            self.worker_id,
+            self.hostname,
+            labels,
+            generation,
+            mode,
+            cohort=aggregate,
         )
+
+    def serving_fault(self, tier: str) -> bool:
+        """The serving handler's fault gate for the two-tier chaos
+        sites (obs/server.py calls this per /peer/snapshot request,
+        BEFORE answering): True = drop the connection with no response,
+        the same wire signature a dead host's RST produces.
+
+        - ``peer.tier-partition`` severs exactly the slice-tier
+          leadership links (requests whose X-TFD-Poll-Tier header says
+          "slice"), leaving intra-cohort and direct-fallback traffic
+          intact — the inter-tier partition the graceful-degradation
+          path exists for.
+        - ``peer.cohort-leader-dead`` makes this daemon dark at the
+          wire exactly while it IS a cohort leader — the mid-tier death
+          whose failover must re-derive the next chain member.
+
+        The force_* flags are the hermetic harness's per-worker scope
+        (the fault registry is process-global there)."""
+        from gpu_feature_discovery_tpu.utils import faults
+
+        if tier == TIER_SLICE:
+            if self.force_tier_partition:
+                return True
+            if faults.consume("peer.tier-partition"):
+                return True
+        with self._lock:
+            role = self._role
+        if role == "cohort-leader":
+            if self.force_cohort_leader_dead:
+                return True
+            if faults.consume("peer.cohort-leader-dead"):
+                return True
+        return False
 
     def snapshot_response(self) -> "tuple[bytes, str]":
         """The ``GET /peer/snapshot`` serving hook: the cached serialized
@@ -358,27 +547,26 @@ class SliceCoordinator:
         (each just under the per-peer timeout, never confirmed down)
         cannot starve the tail forever — a never-polled peer has no
         failures, counts reachable, and a dead host behind it would stay
-        invisible indefinitely."""
+        invisible indefinitely.
+
+        Hierarchical mode (``cohort_size`` > 0 with more than one
+        cohort) replaces the all-peers round with the two-tier round
+        (``_poll_hier``): an intra-cohort sibling round for everyone,
+        plus — on the derived cohort leader — the slice-tier leadership
+        round. Every semantic above (rotation, budget cutoff, 2-miss
+        confirmation, confirmed-dead backoff, pooled fan-out) applies
+        unchanged at both tiers; flat mode is this method byte for
+        byte."""
+        if self._hier:
+            self._poll_hier()
+            return
         round_started = time.perf_counter()
         offset = self._round_offset % len(self._peers) if self._peers else 0
         self._round_offset += 1
         rotated = self._peers[offset:] + self._peers[:offset]
-        if self._pool is None:
-            for peer in rotated:
-                self._poll_peer(peer, round_started)
-        else:
-            futures = [
-                self._pool.submit(self._poll_peer, peer, round_started)
-                for peer in rotated
-            ]
-            for future in futures:
-                try:
-                    future.result()
-                except CancelledError:
-                    # close() cancelled the still-queued polls of a
-                    # round the epoch teardown abandoned; nothing reads
-                    # this round's verdict.
-                    pass
+        self._fanout.run(
+            [partial(self._poll_peer, peer, round_started) for peer in rotated]
+        )
         token = frozenset(
             p.worker_id
             for p in self._peers
@@ -386,6 +574,412 @@ class SliceCoordinator:
         )
         with self._lock:
             self._membership = token
+
+    # -- the hierarchical (two-tier) round ---------------------------------
+
+    def _poll_hier(self) -> None:
+        """One two-tier round. Tier 1 (everyone): poll own-cohort
+        siblings — the flat round scoped to the cohort. Tier 2 (the
+        derived cohort leader only): probe whether any LOWER cohort has
+        a live leadership-chain member (if so, the slice leader lives
+        there and this node stays a cohort leader); the slice leader —
+        no live lower chain — walks every other cohort's leadership
+        chain for its aggregate, and direct-polls the members of any
+        cohort whose whole chain is dark (graceful degradation: partial
+        data beats no data). Both tiers share the round budget and the
+        fan-out pool."""
+        round_started = time.perf_counter()
+        obs_metrics.COHORT_POLL_ROUNDS.labels(tier=TIER_COHORT).inc()
+        siblings = self._sibling_peers()
+        offset = self._round_offset % len(siblings) if siblings else 0
+        self._round_offset += 1
+        rotated = siblings[offset:] + siblings[:offset]
+        self._fanout.run(
+            [
+                partial(
+                    self._poll_peer,
+                    peer,
+                    round_started,
+                    state=self._peer_state[peer.worker_id],
+                    tier=TIER_COHORT,
+                )
+                for peer in rotated
+            ]
+        )
+        if self._cohort_leader_id() == self.worker_id:
+            lower_live = False
+            for j in range(self._my_cohort):
+                if self._probe_lower_chain(j, round_started):
+                    lower_live = True
+                    break
+            if not lower_live:
+                self._poll_slice_tier(round_started)
+        self._commit_hier_round()
+
+    def _sibling_peers(self) -> List[PeerEndpoint]:
+        return [
+            self._peer_by_id[i]
+            for i in self._cohorts[self._my_cohort]
+            if i != self.worker_id
+        ]
+
+    def _cohort_leader_id(self) -> int:
+        """The derived leader of THIS node's cohort: the lowest
+        not-confirmed-down member id, self included (member-plane
+        states — trust is earned per plane)."""
+        candidates = [self.worker_id] + [
+            p.worker_id
+            for p in self._sibling_peers()
+            if not self._peer_state[p.worker_id].confirmed_down
+        ]
+        return min(candidates)
+
+    def _probe_lower_chain(self, j: int, round_started: float) -> bool:
+        """Slice-leadership derivation: is any leadership-chain member
+        of LOWER cohort ``j`` alive? Walks the chain in id order and
+        stops at the first live one (steady state: one poll). The
+        verdicts ride the slice-tier states, so a single dropped poll of
+        an established lower leader cannot flap this node into claiming
+        slice leadership (the 2-miss confirmation, applied at tier 2)."""
+        for wid in chain_ids(self._cohorts[j]):
+            peer = self._peer_by_id[wid]
+            state = self._tier_state_for(wid)
+            self._poll_peer(peer, round_started, state=state, tier=TIER_SLICE)
+            if not state.confirmed_down:
+                return True
+        return False
+
+    def _poll_slice_tier(self, round_started: float) -> None:
+        """The slice leader's tier-2 round: walk every other cohort's
+        leadership chain (one pooled task per cohort — chains are
+        sequential inside, independent across cohorts), then direct-poll
+        the members of every cohort whose chain came up dark."""
+        obs_metrics.COHORT_POLL_ROUNDS.labels(tier=TIER_SLICE).inc()
+        others = [
+            j for j in range(len(self._cohorts)) if j != self._my_cohort
+        ]
+        if not others:
+            return
+        toff = self._tier_round_offset % len(others)
+        self._tier_round_offset += 1
+        ordered = others[toff:] + others[:toff]
+        self._fanout.run(
+            [partial(self._walk_chain, j, round_started) for j in ordered]
+        )
+        # Graceful degradation: a cohort whose whole chain is dark gets
+        # its members polled DIRECTLY under the same round budget —
+        # member-plane states, so an alive-but-tier-partitioned chain
+        # member is counted by the evidence of its direct answer while
+        # its leadership link stays confirmed down.
+        fallback_peers: List[PeerEndpoint] = []
+        for j in ordered:
+            if self._chain_resolution(j)[0] is None and self._chain_dark(j):
+                fallback_peers.extend(
+                    self._peer_by_id[wid] for wid in self._cohorts[j]
+                )
+        if fallback_peers:
+            self._fanout.run(
+                [
+                    partial(
+                        self._poll_peer,
+                        peer,
+                        round_started,
+                        state=self._peer_state[peer.worker_id],
+                        tier=TIER_DIRECT,
+                    )
+                    for peer in fallback_peers
+                ]
+            )
+
+    def _walk_chain(self, j: int, round_started: float) -> None:
+        """Walk cohort ``j``'s leadership chain looking for its derived
+        leader: poll candidates in id order (each under the tier-2
+        state's own backoff/confirmation) and stop at the first one that
+        is live AND answering with a cohort-``j`` aggregate. A live
+        candidate WITHOUT an aggregate is not the leader (it defers to a
+        lower member this node cannot see) — keep walking."""
+        for wid in chain_ids(self._cohorts[j]):
+            peer = self._peer_by_id[wid]
+            state = self._tier_state_for(wid)
+            self._poll_peer(peer, round_started, state=state, tier=TIER_SLICE)
+            if not state.confirmed_down and (
+                self._aggregate_from(state, j) is not None
+            ):
+                return
+
+    @staticmethod
+    def _aggregate_from(
+        state: _PeerState, j: int
+    ) -> Optional[Dict[str, Any]]:
+        snapshot = state.last_snapshot
+        if snapshot is None:
+            return None
+        aggregate = snapshot.get("cohort")
+        if aggregate is not None and aggregate.get("index") == j:
+            return aggregate
+        return None
+
+    def _chain_resolution(
+        self, j: int
+    ) -> "tuple[Optional[int], Optional[Dict[str, Any]]]":
+        """(leader_id, aggregate) for cohort ``j`` from the current
+        tier-2 states: the lowest live chain member answering with a
+        cohort-``j`` aggregate, or (None, None)."""
+        for wid in chain_ids(self._cohorts[j]):
+            state = self._tier_state.get(wid)
+            if state is None or state.confirmed_down:
+                continue
+            aggregate = self._aggregate_from(state, j)
+            if aggregate is not None:
+                return wid, aggregate
+        return None, None
+
+    def _chain_dark(self, j: int) -> bool:
+        """True when cohort ``j``'s ENTIRE leadership chain is
+        evidence-confirmed unusable: every candidate is either confirmed
+        down or reached-and-aggregateless. A never-polled candidate
+        (budget skip) is NOT dark — degradation is declared on evidence,
+        never on a round that ran out of time."""
+        for wid in chain_ids(self._cohorts[j]):
+            state = self._tier_state.get(wid)
+            if state is None:
+                return False
+            if not state.confirmed_down and not state.ever_reached:
+                return False
+        return True
+
+    def _build_own_aggregate(self) -> Dict[str, Any]:
+        """This cohort leader's aggregate: one entry per cohort member
+        (self included) carrying the member-plane reachability verdict,
+        the member's last seen snapshot generation, its pre-extracted
+        sick-chip count, and its write mode (null when the leader holds
+        no current data — an unreachable member's stale facts must not
+        masquerade as current)."""
+        with self._lock:
+            own_generation = self._generation
+            own_mode = self._local_mode
+        own_sick = _sick_from(self.snapshot_payload())
+        members: Dict[int, Dict[str, Any]] = {}
+        for wid in self._cohorts[self._my_cohort]:
+            if wid == self.worker_id:
+                members[wid] = {
+                    "reachable": True,
+                    "generation": own_generation,
+                    "sick": own_sick,
+                    "mode": own_mode,
+                }
+                continue
+            state = self._peer_state[wid]
+            snapshot = state.last_snapshot
+            live = not state.confirmed_down and snapshot is not None
+            members[wid] = {
+                "reachable": not state.confirmed_down,
+                "generation": snapshot["generation"] if live else None,
+                "sick": _sick_from(snapshot) if live else None,
+                "mode": snapshot.get("mode") if live else None,
+            }
+        return build_cohort_aggregate(self._my_cohort, members)
+
+    def _derive_hier(
+        self,
+    ) -> "tuple[SliceView, Optional[Dict[str, Any]], frozenset]":
+        """Derive this node's (view, served aggregate, membership token)
+        purely from the current poll states — no network. Run after a
+        round's polls (or on a pre-round view() read, where missing
+        states resolve to the humble default: defer leadership, trust
+        nothing unseen)."""
+        members = self._cohorts[self._my_cohort]
+        reachable_sibs = [
+            wid
+            for wid in members
+            if wid != self.worker_id
+            and not self._peer_state[wid].confirmed_down
+        ]
+        cohort_healthy = 1 + len(reachable_sibs)
+        total_cohorts = len(self._cohorts)
+        leader_id = min([self.worker_id] + reachable_sibs)
+        # All-tuple fingerprint: the event loop renders it with
+        # sorted(), so the items must be mutually comparable.
+        token_items: List[Any] = [("sib", wid) for wid in reachable_sibs]
+        if leader_id != self.worker_id:
+            # Plain follower: its leader is its COHORT leader; healthy/
+            # degraded describe the universe this node actually
+            # observes (its cohort).
+            state = self._peer_state[leader_id]
+            view = SliceView(
+                role="follower",
+                leader_hostname=self._peer_by_id[leader_id].hostname,
+                leader_seen=state.ever_reached,
+                healthy_hosts=cohort_healthy,
+                total_hosts=self.total_hosts,
+                degraded=cohort_healthy < len(members),
+                sick_chips=0,
+                cohort=self._my_cohort,
+                cohorts=total_cohorts,
+            )
+            token_items.append(("role", "follower", leader_id))
+            return view, None, frozenset(token_items)
+        # This node leads its cohort. Slice leadership: only when every
+        # LOWER cohort's whole leadership chain is confirmed dark (a
+        # chain member this node never managed to poll defers — trust
+        # is earned by a poll, never presumed, the flat rule at tier 2).
+        lower_live_seen = False
+        is_slice_leader = True
+        for j in range(self._my_cohort):
+            for wid in chain_ids(self._cohorts[j]):
+                state = self._tier_state.get(wid)
+                if state is None or not state.confirmed_down:
+                    is_slice_leader = False
+                    if state is not None and state.ever_reached:
+                        lower_live_seen = True
+            if not is_slice_leader:
+                break
+        if not is_slice_leader:
+            view = SliceView(
+                role="cohort-leader",
+                leader_hostname="",
+                leader_seen=lower_live_seen,
+                healthy_hosts=cohort_healthy,
+                total_hosts=self.total_hosts,
+                degraded=cohort_healthy < len(members),
+                sick_chips=0,
+                cohort=self._my_cohort,
+                cohorts=total_cohorts,
+            )
+            token_items.append(("role", "cohort-leader"))
+            return view, self._build_own_aggregate(), frozenset(token_items)
+        # Slice leader: aggregate every other cohort through its chain
+        # resolution (live leader's aggregate), or the direct-poll
+        # fallback verdicts when the chain is dark, or the optimistic
+        # never-polled default (flat semantics: no failures = reachable).
+        healthy = cohort_healthy
+        sick = _sick_from(self.snapshot_payload())
+        for wid in reachable_sibs:
+            snapshot = self._peer_state[wid].last_snapshot
+            if snapshot is not None:
+                sick += _sick_from(snapshot)
+        degraded_cohorts: List[int] = []
+        for j in range(total_cohorts):
+            if j == self._my_cohort:
+                continue
+            cohort_view = self._resolve_cohort_view(j)
+            healthy += cohort_view.healthy
+            sick += cohort_view.sick
+            if cohort_view.degraded:
+                degraded_cohorts.append(j)
+            token_items.append(
+                (
+                    "cohort",
+                    j,
+                    cohort_view.leader_id,
+                    cohort_view.degraded,
+                    cohort_view.healthy,
+                )
+            )
+        if not reachable_sibs and healthy == 1 and self.total_hosts > 1:
+            # Fully partitioned: every sibling AND every other cohort
+            # confirmed dark. Never claim to lead a slice this node
+            # cannot see (the flat never-lead rule, both tiers) — and
+            # WITHDRAW the served aggregate: under an egress-only
+            # partition (outbound polls dead, inbound serving fine) an
+            # aggregate marking every sibling unreachable would be
+            # found by the slice leader's chain walk and poison the
+            # slice-wide healthy count for a cohort that is actually
+            # fine. With no aggregate served, the chain walks past this
+            # node (reachable-but-aggregateless) and the direct-poll
+            # fallback counts the members by their own answers.
+            view = SliceView(
+                role="follower",
+                leader_hostname="",
+                leader_seen=False,
+                healthy_hosts=1,
+                total_hosts=self.total_hosts,
+                degraded=True,
+                sick_chips=0,
+                cohort=self._my_cohort,
+                cohorts=total_cohorts,
+            )
+            token_items.append(("role", "partitioned"))
+            return view, None, frozenset(token_items)
+        view = SliceView(
+            role="leader",
+            leader_hostname=self.hostname,
+            leader_seen=True,
+            healthy_hosts=healthy,
+            total_hosts=self.total_hosts,
+            degraded=healthy < self.total_hosts,
+            sick_chips=sick,
+            cohort=self._my_cohort,
+            cohorts=total_cohorts,
+            degraded_cohorts=tuple(degraded_cohorts),
+        )
+        token_items.append(("role", "leader"))
+        return view, self._build_own_aggregate(), frozenset(token_items)
+
+    def _resolve_cohort_view(self, j: int) -> _CohortView:
+        leader_id, aggregate = self._chain_resolution(j)
+        member_ids = set(self._cohorts[j])
+        if aggregate is not None:
+            healthy = 0
+            sick = 0
+            for key, entry in aggregate["members"].items():
+                wid = int(key)
+                if wid not in member_ids:
+                    continue  # defensive: ignore out-of-cohort entries
+                if entry.get("reachable"):
+                    healthy += 1
+                    if isinstance(entry.get("sick"), int):
+                        sick += entry["sick"]
+            return _CohortView(j, leader_id, False, healthy, sick)
+        if self._chain_dark(j):
+            # Direct-poll fallback verdicts (member-plane states): the
+            # cohort is DEGRADED — no live aggregation link — but its
+            # members' own answers keep healthy-hosts truthful.
+            healthy = 0
+            sick = 0
+            for wid in self._cohorts[j]:
+                state = self._peer_state[wid]
+                if state.confirmed_down:
+                    continue
+                healthy += 1
+                if state.last_snapshot is not None:
+                    sick += _sick_from(state.last_snapshot)
+            return _CohortView(j, None, True, healthy, sick)
+        # Chain state unknown (never polled / budget-skipped this
+        # round): the flat never-polled semantics — no failures counts
+        # reachable, carries no data, and is NOT degraded (degradation
+        # is declared on evidence).
+        return _CohortView(j, None, False, len(self._cohorts[j]), 0)
+
+    def _commit_hier_round(self) -> None:
+        view, aggregate, token = self._derive_hier()
+        if view.role == "leader":
+            live_leaders = 1 + sum(
+                1
+                for item in token
+                if isinstance(item, tuple)
+                and item[0] == "cohort"
+                and item[2] is not None
+            )
+        elif view.role == "cohort-leader":
+            live_leaders = 1
+        else:
+            live_leaders = 1 if view.leader_seen else 0
+        self._set_aggregate(aggregate)
+        with self._lock:
+            if self._closed:
+                # A commit racing the epoch teardown must not re-latch
+                # anything close() just reset — including the gauges,
+                # which is why they are written UNDER this lock (close()
+                # flips _closed under it before zeroing them, so a
+                # commit either lands wholly before the flip or no-ops).
+                return
+            self._last_view = view
+            self._role = view.role
+            self._membership = token
+            obs_metrics.SLICE_DEGRADED.set(1 if view.degraded else 0)
+            obs_metrics.COHORT_DEGRADED.set(len(view.degraded_cohorts))
+            obs_metrics.COHORT_LEADERS.set(live_leaders)
 
     def membership_token(self) -> Optional[frozenset]:
         """Reachable-peer fingerprint as of the last poll round (None
@@ -395,13 +989,26 @@ class SliceCoordinator:
         with self._lock:
             return self._membership
 
-    def _poll_peer(self, peer: PeerEndpoint, round_started: float) -> None:
+    def _poll_peer(
+        self,
+        peer: PeerEndpoint,
+        round_started: float,
+        state: Optional[_PeerState] = None,
+        tier: Optional[str] = None,
+    ) -> None:
         """One peer's poll, exactly as the sequential round ran it:
         backoff-window check, budget cutoff, fetch, then the verdict
         transition — the last applied under the serving lock, because
         with fanout > 1 several polls finish concurrently and the run
-        loop's ``membership_token`` reads race the round."""
-        state = self._peer_state[peer.worker_id]
+        loop's ``membership_token`` reads race the round.
+
+        ``state`` selects which plane's verdict this poll feeds (the
+        member plane by default; the hierarchical round passes the
+        slice-tier leadership-link states for chain polls). ``tier``
+        rides as the X-TFD-Poll-Tier request header; None (flat mode)
+        sends no header at all — the PR 12 wire, byte for byte."""
+        if state is None:
+            state = self._peer_state[peer.worker_id]
         now = self._clock()
         if state.confirmed_down and now < state.next_attempt:
             return  # backoff window still closed; stays down
@@ -424,7 +1031,7 @@ class SliceCoordinator:
         started = time.perf_counter()
         obs_metrics.PEER_FANOUT_INFLIGHT.inc()
         try:
-            snapshot = self._fetch(peer, timeout)
+            snapshot = self._fetch_tiered(peer, timeout, state, tier)
             if snapshot["worker_id"] != peer.worker_id:
                 # Backstop only: the real HTTP path already rejected a
                 # mismatched worker_id inside _request (it must happen
@@ -450,17 +1057,46 @@ class SliceCoordinator:
                 time.perf_counter() - started
             )
 
+    def _fetch_tiered(
+        self,
+        peer: PeerEndpoint,
+        timeout: float,
+        state: _PeerState,
+        tier: Optional[str],
+    ) -> Dict[str, Any]:
+        """Route one fetch to the right plane's connection/ETag state,
+        honoring a test-injected ``_fetch`` instance override (the
+        hermetic state-machine suites replace ``coord._fetch`` with a
+        ``(peer, timeout)`` hook that neither knows nor needs tiers)."""
+        injected = self.__dict__.get("_fetch")
+        if injected is not None:
+            return injected(peer, timeout)
+        return self._fetch_impl(peer, timeout, state, tier)
+
     def _fetch(self, peer: PeerEndpoint, timeout: float) -> Dict[str, Any]:
-        """One GET /peer/snapshot over the peer's persistent keep-alive
+        """The single-plane fetch entry (flat-mode semantics): kept as
+        the stable seam tests wrap; delegates to the tier-aware
+        implementation with the member-plane state."""
+        return self._fetch_impl(
+            peer, timeout, self._peer_state[peer.worker_id], None
+        )
+
+    def _fetch_impl(
+        self,
+        peer: PeerEndpoint,
+        timeout: float,
+        state: _PeerState,
+        tier: Optional[str],
+    ) -> Dict[str, Any]:
+        """One GET /peer/snapshot over the plane's persistent keep-alive
         connection (opened on demand; any failure tears it down so the
         next poll reconnects). A 304 answer returns the last-parsed
         snapshot unchanged — the caller's success bookkeeping advances
         exactly as on a full body."""
-        state = self._peer_state[peer.worker_id]
         reused = state.conn is not None
         try:
             try:
-                snapshot = self._request(peer, state, timeout)
+                snapshot = self._request(peer, state, timeout, tier)
             except _STALE_CONN_ERRORS:
                 if not reused:
                     raise
@@ -470,7 +1106,7 @@ class SliceCoordinator:
                 # a fresh connection before anything counts as a miss.
                 self._drop_connection(state)
                 reused = False
-                snapshot = self._request(peer, state, timeout)
+                snapshot = self._request(peer, state, timeout, tier)
         except Exception:
             self._drop_connection(state)
             raise
@@ -479,7 +1115,11 @@ class SliceCoordinator:
         return snapshot
 
     def _request(
-        self, peer: PeerEndpoint, state: _PeerState, timeout: float
+        self,
+        peer: PeerEndpoint,
+        state: _PeerState,
+        timeout: float,
+        tier: Optional[str] = None,
     ) -> Dict[str, Any]:
         with self._lock:
             # Checked and created UNDER the lock close() flips _closed
@@ -506,6 +1146,12 @@ class SliceCoordinator:
         headers = {}
         if state.etag is not None and state.last_snapshot is not None:
             headers["If-None-Match"] = state.etag
+        if tier is not None:
+            # The wire says which plane this poll belongs to, so the
+            # serving side can enact tier-scoped faults (and operators
+            # can tcpdump-tell a leadership-chain poll from a fallback
+            # one). Flat mode sends no header at all.
+            headers[POLL_TIER_HEADER] = tier
         conn.request("GET", PEER_SNAPSHOT_PATH, headers=headers)
         resp = conn.getresponse()
         if resp.status == 304:
@@ -563,7 +1209,8 @@ class SliceCoordinator:
         state.next_attempt = 0.0
         state.ever_reached = True
         state.last_snapshot = snapshot
-        obs_metrics.PEER_UNREACHABLE.labels(peer=peer.hostname).set(0)
+        if state.owns_gauge:
+            obs_metrics.PEER_UNREACHABLE.labels(peer=peer.hostname).set(0)
 
     def _poll_failed(
         self, peer: PeerEndpoint, state: _PeerState, error: BaseException
@@ -577,7 +1224,8 @@ class SliceCoordinator:
             return
         state.consecutive_failures += 1
         if state.confirmed_down:
-            obs_metrics.PEER_UNREACHABLE.labels(peer=peer.hostname).set(1)
+            if state.owns_gauge:
+                obs_metrics.PEER_UNREACHABLE.labels(peer=peer.hostname).set(1)
             delay = state.backoff.delay(min(state.backoff_attempt, 63))
             state.backoff_attempt += 1
             state.next_attempt = self._clock() + delay
@@ -605,6 +1253,21 @@ class SliceCoordinator:
     # -- aggregation -------------------------------------------------------
 
     def view(self) -> SliceView:
+        if self._hier:
+            # Hierarchical views (and their gauges) are committed at
+            # round end; a pre-round read derives one from the current
+            # states (no network) with the humble defaults.
+            with self._lock:
+                stored = self._last_view
+            if stored is None:
+                self._commit_hier_round()
+                with self._lock:
+                    stored = self._last_view
+            if stored is None:
+                # Closed before any round committed: a bare derivation
+                # (no gauges, nothing stored) keeps the caller whole.
+                stored = self._derive_hier()[0]
+            return stored
         reachable_peers = [
             p for p in self._peers
             if not self._peer_state[p.worker_id].confirmed_down
@@ -685,12 +1348,22 @@ class SliceCoordinator:
             # gauge write is zeroed below) or sees _closed and no-ops —
             # it can never re-latch a gauge after the reset.
             self._closed = True
-        if self._pool is not None:
-            self._pool.shutdown(wait=False, cancel_futures=True)
+        self._fanout.shutdown(wait=False)
         for peer in self._peers:
             self._drop_connection(self._peer_state[peer.worker_id])
             obs_metrics.PEER_UNREACHABLE.labels(peer=peer.hostname).set(0)
+        # list(): a straggler chain poll of the abandoned round may
+        # still be lazily inserting tier states; the snapshot keeps this
+        # sweep safe, and the straggler's own connection dies with its
+        # socket timeout (its _request sees _closed and refuses to open
+        # a fresh one).
+        for state in list(self._tier_state.values()):
+            # The slice-tier leadership links hold their own persistent
+            # connections (a chain member can be tracked on two planes).
+            self._drop_connection(state)
         obs_metrics.SLICE_DEGRADED.set(0)
+        obs_metrics.COHORT_LEADERS.set(0)
+        obs_metrics.COHORT_DEGRADED.set(0)
 
 
 def _sick_from(snapshot: Dict[str, Any]) -> int:
@@ -765,6 +1438,9 @@ def new_slice_coordinator(config, host_info=None) -> Optional[SliceCoordinator]:
         if tfd.labeler_timeout is not None
         else DEFAULT_LABELER_TIMEOUT
     )
+    effective_cohort_size = resolve_cohort_size(
+        getattr(tfd, "cohort_size", None), len(hostnames)
+    )
     coordinator = SliceCoordinator(
         worker_id=worker_id,
         hostnames=hostnames,
@@ -779,14 +1455,22 @@ def new_slice_coordinator(config, host_info=None) -> Optional[SliceCoordinator]:
         # 0/None = auto (min(AUTO_FANOUT_CAP, peers)); 1 pins the
         # sequential round.
         fanout=tfd.peer_fanout,
+        # 0 = flat (single-tier, byte-identical to PR 12); auto = 64
+        # once the slice outgrows it (peering/cohort.py).
+        cohort_size=effective_cohort_size,
     )
     log.info(
         "slice coordination on: worker %d of %d (%s), peer timeout "
-        "%.3fs, fan-out %d",
+        "%.3fs, fan-out %d, cohorts %s",
         worker_id,
         len(hostnames),
         coordinator.hostname,
         timeout,
         coordinator.fanout,
+        (
+            f"{len(coordinator._cohorts)} x {effective_cohort_size}"
+            if effective_cohort_size
+            else "flat"
+        ),
     )
     return coordinator

@@ -22,6 +22,32 @@ Document shape (schema 1)::
       "chips": {"healthy": 4, "sick": 0}   # values null when unprobed
     }
 
+A COHORT LEADER (two-tier coordination, ``--cohort-size``) additionally
+carries its cohort's aggregate — its own schema-versioned section on the
+same wire surface, riding the same publish-time serialization, ETag and
+304 machinery::
+
+      "cohort": {
+        "schema": 1,               # forward-rejecting, independently of
+                                   # the outer snapshot schema
+        "index": 2,                # which cohort this aggregate covers
+        "members": {               # EVERY cohort member, the leader too
+          "128": {"reachable": true, "generation": 7,
+                  "sick": 0, "mode": "full"},
+          "129": {"reachable": false, "generation": null,
+                  "sick": null, "mode": null}
+        }
+      }
+
+Member verdicts carry the cohort leader's reachability view (the same
+2-consecutive-miss confirmation every tier applies), the member's last
+seen snapshot generation, its pre-extracted sick-chip count, and its
+write mode; ``null`` means the leader holds no current data for that
+member. The section appears exactly while the serving daemon IS a
+cohort leader — followers and flat-mode daemons never carry it, so
+``--cohort-size=0`` documents stay byte-identical to schema 1 as it
+always was.
+
 ``labels`` is the daemon's last WRITTEN label set, marker-stripped
 (status markers describe the serving cycle, not the inventory) and with
 the ``slice.*`` coordination family removed — a snapshot must carry the
@@ -39,6 +65,14 @@ from typing import Any, Dict, Optional
 
 PEER_SCHEMA_VERSION = 1
 PEER_SNAPSHOT_PATH = "/peer/snapshot"
+
+# The embedded cohort-aggregate section's own schema counter: versioned
+# independently of the outer snapshot so the aggregate shape can evolve
+# without invalidating plain member snapshots mid-rollout. A leader
+# answering with an unknown aggregate schema is treated exactly like an
+# unreachable one (forward-rejecting — the slice leader then walks the
+# chain / falls back to direct polls rather than mis-aggregating).
+COHORT_SCHEMA_VERSION = 1
 
 # Snapshot documents are small (a label set is ~1-2 KiB); anything
 # larger is junk or an attack surface, same discipline as the broker's
@@ -67,7 +101,10 @@ def strip_snapshot_labels(labels: Dict[str, str]) -> Dict[str, str]:
     from gpu_feature_discovery_tpu.lm.pjrt_family import (
         FAMILY_DEGRADED_LABELS,
     )
-    from gpu_feature_discovery_tpu.lm.slice_labeler import SLICE_COORD_LABELS
+    from gpu_feature_discovery_tpu.lm.slice_labeler import (
+        SLICE_COORD_LABELS,
+        is_cohort_label,
+    )
     from gpu_feature_discovery_tpu.sandbox.flap import FLAPPING_LABEL
 
     dropped = {
@@ -81,7 +118,13 @@ def strip_snapshot_labels(labels: Dict[str, str]) -> Dict[str, str]:
         *FAMILY_DEGRADED_LABELS.values(),
         *SLICE_COORD_LABELS,
     }
-    return {k: str(v) for k, v in labels.items() if k not in dropped}
+    # is_cohort_label: the per-index slice.cohort.<i>.degraded markers
+    # are a dynamic family no exact-key set can enumerate.
+    return {
+        k: str(v)
+        for k, v in labels.items()
+        if k not in dropped and not is_cohort_label(k)
+    }
 
 
 def _chip_verdict(labels: Dict[str, str]) -> Dict[str, Optional[int]]:
@@ -103,9 +146,10 @@ def build_snapshot(
     labels: Dict[str, str],
     generation: int,
     mode: Optional[str],
+    cohort: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     stripped = strip_snapshot_labels(labels)
-    return {
+    doc = {
         "schema": PEER_SCHEMA_VERSION,
         "worker_id": int(worker_id),
         "hostname": str(hostname),
@@ -113,6 +157,24 @@ def build_snapshot(
         "mode": mode,
         "labels": stripped,
         "chips": _chip_verdict(stripped),
+    }
+    if cohort is not None:
+        # The key is ABSENT (not null) on non-leaders: a flat-mode
+        # document must stay byte-identical to the pre-cohort schema.
+        doc["cohort"] = cohort
+    return doc
+
+
+def build_cohort_aggregate(
+    index: int, members: Dict[int, Dict[str, Any]]
+) -> Dict[str, Any]:
+    """The cohort leader's aggregate section. ``members`` is keyed by
+    int worker id here; JSON object keys are strings, so the wire form
+    stringifies them (parse_snapshot validates they are digit strings)."""
+    return {
+        "schema": COHORT_SCHEMA_VERSION,
+        "index": int(index),
+        "members": {str(wid): dict(entry) for wid, entry in members.items()},
     }
 
 
@@ -169,4 +231,47 @@ def parse_snapshot(body: bytes) -> Dict[str, Any]:
             not isinstance(value, int) or isinstance(value, bool)
         ):
             raise PeerSnapshotError(f"bad chips.{key} {value!r}")
+    if "cohort" in doc:
+        _validate_cohort(doc["cohort"])
     return doc
+
+
+def _validate_cohort(cohort: Any) -> None:
+    """Validate an embedded cohort aggregate — forward-rejecting and
+    field-strict, same discipline as the outer document: one corrupt (or
+    newer-versioned) cohort leader must read as unreachable, never
+    mis-aggregate a thousand-host slice."""
+    if not isinstance(cohort, dict):
+        raise PeerSnapshotError("cohort must be an object")
+    if cohort.get("schema") != COHORT_SCHEMA_VERSION:
+        raise PeerSnapshotError(
+            f"unsupported cohort schema {cohort.get('schema')!r} "
+            f"(want {COHORT_SCHEMA_VERSION})"
+        )
+    index = cohort.get("index")
+    if not isinstance(index, int) or isinstance(index, bool) or index < 0:
+        raise PeerSnapshotError(f"bad cohort.index {index!r}")
+    members = cohort.get("members")
+    if not isinstance(members, dict):
+        raise PeerSnapshotError("cohort.members must be an object")
+    for key, entry in members.items():
+        if not isinstance(key, str) or not key.isdigit():
+            raise PeerSnapshotError(f"bad cohort member id {key!r}")
+        if not isinstance(entry, dict):
+            raise PeerSnapshotError(f"cohort member {key} must be an object")
+        if not isinstance(entry.get("reachable"), bool):
+            raise PeerSnapshotError(
+                f"bad cohort member {key} reachable "
+                f"{entry.get('reachable')!r}"
+            )
+        for field in ("generation", "sick"):
+            value = entry.get(field)
+            if value is not None and (
+                not isinstance(value, int) or isinstance(value, bool)
+            ):
+                raise PeerSnapshotError(
+                    f"bad cohort member {key} {field} {value!r}"
+                )
+        mode = entry.get("mode")
+        if mode is not None and not isinstance(mode, str):
+            raise PeerSnapshotError(f"bad cohort member {key} mode {mode!r}")

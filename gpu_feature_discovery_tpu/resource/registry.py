@@ -492,6 +492,16 @@ class BackendRuntime:
     def down(self) -> bool:
         return self.manager is None and self.failures > 0
 
+    def attempt_due(self) -> bool:
+        """True when the next acquire() would actually try to build (no
+        held manager, backoff window open). acquire_all uses it to skip
+        fan-out machinery for runtimes that would instantly no-op —
+        every steady-state down cycle would otherwise churn a pool for
+        nothing."""
+        return self.manager is None and (
+            not self.failures or self._clock() >= self._next_attempt
+        )
+
     @property
     def exhausted(self) -> bool:
         return self.failures >= self._init_retries
@@ -598,6 +608,11 @@ class BackendSet:
     """The multi-backend cycle's acquisition roster: one BackendRuntime
     per ``--backends`` token, in flag order."""
 
+    # Init fan-out width cap: family counts are small (one per label
+    # family), so the cap only matters if the family set ever grows —
+    # the point is overlap, not width.
+    INIT_FANOUT_CAP = 4
+
     def __init__(self, tokens: List[str], config: Config,
                  clock: Callable[[], float] = time.monotonic):
         self._config = config
@@ -605,6 +620,60 @@ class BackendSet:
 
     def has_family(self, family: str) -> bool:
         return any(rt.family == family for rt in self.runtimes)
+
+    def acquire_all(self, strict: bool = False) -> None:
+        """One acquisition pass over every enabled backend, fanned out
+        on the bounded pool (utils/fanout.BoundedPool — the peer
+        coordinator's extracted primitive): a hung family init (bounded
+        by its own --probe-timeout when sandboxed) overlaps the other
+        families' inits instead of serializing them, so the cycle pays
+        max(init) rather than sum(init). Steady state (every manager
+        held, or at most one pending) skips the pool entirely —
+        ``BackendRuntime.acquire`` is a no-op while a manager is held or
+        a backoff window is closed.
+
+        ``strict`` (oneshot) re-raises the FIRST failure in flag order
+        after the pass, preserving the error-to-exit parity; every
+        family still gets its attempt (the pass is concurrent, so
+        holding earlier attempts back would buy nothing)."""
+        from gpu_feature_discovery_tpu.utils.fanout import BoundedPool, ErrorSink
+
+        # Only runtimes whose attempt is actually DUE ride the pool: a
+        # closed backoff window makes acquire() an instant no-op, and a
+        # steady-state down family must not cost a pool construct/join
+        # every cycle. strict (oneshot) bypasses windows, like acquire().
+        pending = [
+            rt
+            for rt in self.runtimes
+            if rt.manager is None and (strict or rt.attempt_due())
+        ]
+        if not pending:
+            return
+        errors = ErrorSink()
+
+        def acquire_task(rt: BackendRuntime):
+            def run() -> None:
+                try:
+                    rt.acquire(strict=strict)
+                except Exception as e:  # noqa: BLE001 - strict mode only
+                    errors.put(rt.token, e)
+
+            return run
+
+        if len(pending) == 1:
+            acquire_task(pending[0])()
+        else:
+            pool = BoundedPool(
+                min(len(pending), self.INIT_FANOUT_CAP),
+                name="tfd-backend-init",
+            )
+            try:
+                pool.run([acquire_task(rt) for rt in pending])
+            finally:
+                pool.shutdown(wait=True)
+        for rt in self.runtimes:
+            if rt.token in errors.errors:
+                raise errors.errors[rt.token]
 
     def check_escalation(self) -> None:
         """InitRetriesExhausted only when EVERY enabled backend is down

@@ -1,0 +1,110 @@
+"""Bounded fan-out pool: the PR 12 concurrency primitive, extracted.
+
+``peering/coordinator.py`` established the shape: a round of independent
+blocking tasks dispatches onto a bounded thread pool and the round
+blocks until every dispatched task finishes, so N slow tasks cost
+~N/width instead of N; width 1 constructs NO pool at all and runs the
+tasks inline in order — the sequential round, byte for byte. The
+budget-as-dispatch-cutoff discipline rides on top: a task checks its
+round budget as its FIRST act (when the pool actually starts it), so a
+spent budget skips exactly the tasks that had not started yet — the
+budget check lives in the task body because only the task knows what a
+"skip" means for its own state (the peer poller counts a metric and
+leaves reachability untouched; a backend init just stays unacquired).
+
+Consumers: the peer coordinator's poll rounds (both tiers of the cohort
+hierarchy) and the multi-backend registry's per-family init
+(resource/registry.BackendSet.acquire_all — a hung family init bounded
+by its own probe timeout now overlaps the other families' inits instead
+of serializing them).
+"""
+
+from __future__ import annotations
+
+import threading
+from concurrent.futures import CancelledError, ThreadPoolExecutor
+from typing import Callable, List, Optional
+
+
+class BoundedPool:
+    """A reusable bounded fan-out pool.
+
+    ``width <= 1`` keeps ``pool`` as None and ``run`` executes the tasks
+    inline in list order — callers pin "no pool exists at all" against
+    that attribute (the sequential-round contract). Tasks must contain
+    their own failures; an exception escaping a task propagates out of
+    ``run`` exactly as it would from the inline loop. ``CancelledError``
+    from a ``shutdown(cancel_futures=True)`` racing an in-flight ``run``
+    is swallowed: nothing reads an abandoned round's results.
+    """
+
+    def __init__(self, width: int, name: str = "tfd-fanout"):
+        self.width = max(1, int(width))
+        self.pool: Optional[ThreadPoolExecutor] = (
+            ThreadPoolExecutor(
+                max_workers=self.width, thread_name_prefix=name
+            )
+            if self.width > 1
+            else None
+        )
+
+    def run(self, tasks: List[Callable[[], None]]) -> None:
+        """Dispatch every task and block until all of them finished (or
+        the pool was shut down under the round)."""
+        if self.pool is None:
+            for task in tasks:
+                task()
+            return
+        futures = [self.pool.submit(task) for task in tasks]
+        for future in futures:
+            try:
+                future.result()
+            except CancelledError:
+                # shutdown(cancel_futures=True) cancelled still-queued
+                # tasks of a round the owner abandoned; nothing reads
+                # this round's results.
+                pass
+
+    def shutdown(self, wait: bool = False) -> None:
+        if self.pool is not None:
+            self.pool.shutdown(wait=wait, cancel_futures=True)
+
+
+class Budget:
+    """One round's wall-clock budget, shared by every task of the round.
+
+    ``remaining()`` is what a task consults as its first act; ``spent``
+    (with the caller's grace margin) is the dispatch cutoff. None = an
+    unbounded round (remaining() is None, never spent)."""
+
+    def __init__(
+        self,
+        budget_s: Optional[float],
+        clock: Callable[[], float],
+    ):
+        self._budget = float(budget_s) if budget_s is not None else None
+        self._clock = clock
+        self._started = clock()
+
+    def remaining(self) -> Optional[float]:
+        if self._budget is None:
+            return None
+        return self._budget - (self._clock() - self._started)
+
+    def spent(self, grace: float = 0.0) -> bool:
+        remaining = self.remaining()
+        return remaining is not None and remaining <= grace
+
+
+# A tiny shared-state helper for fan-out consumers that collect results
+# from pool threads: plain dict writes are GIL-atomic, but gathering
+# (key -> error) pairs with a lock keeps the intent explicit and safe if
+# values ever grow compound updates.
+class ErrorSink:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.errors: dict = {}
+
+    def put(self, key, error: BaseException) -> None:
+        with self._lock:
+            self.errors[key] = error

@@ -29,7 +29,15 @@ publishes fresh slice labels within 2 poll intervals;
 ``slice:slow-peer-storm`` stalls half of a 6-worker slice's serving
 surfaces and asserts the leader's fan-out round stays bounded by ~1x the
 per-peer timeout with no peer skipped for budget and slice labels
-unmoved (run_slow_peer_storm).
+unmoved (run_slow_peer_storm); ``slice:cohort-leader-death`` kills a
+cohort leader of a two-tier 6-worker slice (--cohort-size=2) and
+asserts leadership RE-DERIVES to the next chain member with truthful
+healthy-hosts and zero failed cycles (run_cohort_leader_death);
+``slice:tier-partition`` severs an 8-worker slice's cohort-1 leadership
+chain at the wire (the peer.tier-partition behavior enacted in the
+serving handler, per-worker scoped) and asserts only that cohort
+degrades while the direct-poll fallback keeps healthy-hosts at the full
+slice, recovering when the partition heals (run_tier_partition).
 
 ``reconcile:broker-death`` is likewise not a fault spec: it SIGKILLs the
 long-lived broker worker of an EVENT-mode daemon whose sleep interval is
@@ -84,6 +92,10 @@ def run_slice_chaos(scenario, workdir, timeout_s=None):
 
     if scenario == "slow-peer-storm":
         return run_slow_peer_storm(workdir, timeout_s=timeout_s)
+    if scenario == "cohort-leader-death":
+        return run_cohort_leader_death(workdir, timeout_s=timeout_s)
+    if scenario == "tier-partition":
+        return run_tier_partition(workdir, timeout_s=timeout_s)
     victims = {"peer-unreachable": 3, "leader-failover": 0}
     if scenario not in victims:
         raise ValueError(f"unknown slice chaos scenario {scenario!r}")
@@ -257,6 +269,164 @@ def run_slow_peer_storm(workdir, timeout_s=None):
         "converged_s": round(elapsed, 3),
         "worst_round_s": round(max(durations[rounds_at_converge:]), 3),
         "labels": len(final[0]),
+    }
+
+
+def run_cohort_leader_death(workdir, timeout_s=None):
+    """slice:cohort-leader-death (ISSUE 13): a 6-worker two-tier slice
+    (--cohort-size=2 -> cohorts {0,1} {2,3} {4,5}) with cohort 1's
+    leader (w2) killed mid-run. The contract:
+
+      1. the cohort leadership RE-DERIVES — w3 flips to
+         slice.role=cohort-leader with no election protocol;
+      2. slice.healthy-hosts stays TRUTHFUL (6 -> 5, exactly the dead
+         host) and the cohort is NOT left degraded — the re-derived
+         leader's aggregate serves it;
+      3. zero failed cycles across every surviving daemon (a mid-tier
+         death is a peer event, never a cycle fault);
+      4. every survivor's node-local labels never move."""
+    from slice_fixture import SliceHarness, non_coord_lines
+
+    from gpu_feature_discovery_tpu.lm.slice_labeler import (
+        SLICE_DEGRADED_LABEL,
+        SLICE_HEALTHY_HOSTS_LABEL,
+        SLICE_ROLE_LABEL,
+        cohort_degraded_label,
+    )
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+    budget = timeout_s or 60.0
+    started = time.monotonic()
+    harness = SliceHarness(
+        workdir, workers=6, sleep_interval="0.05s", cohort_size=2
+    ).start()
+    try:
+        harness.wait_for(
+            lambda s: (
+                s[0].get(SLICE_ROLE_LABEL) == "leader"
+                and s[0].get(SLICE_HEALTHY_HOSTS_LABEL) == "6"
+                and s[2].get(SLICE_ROLE_LABEL) == "cohort-leader"
+                and s[4].get(SLICE_ROLE_LABEL) == "cohort-leader"
+            ),
+            timeout=budget,
+            what="healthy 6-worker two-tier slice",
+        )
+        survivors = [w for w in harness.workers if w.worker_id != 2]
+        before = {
+            w.worker_id: non_coord_lines(w.raw_output()) for w in survivors
+        }
+        harness.stop_worker(2)
+        converged = harness.wait_for(
+            lambda s: (
+                s[3].get(SLICE_ROLE_LABEL) == "cohort-leader"
+                and s[0].get(SLICE_HEALTHY_HOSTS_LABEL) == "5"
+                and s[0].get(SLICE_DEGRADED_LABEL) == "true"
+                and cohort_degraded_label(1) not in s[0]
+            ),
+            timeout=budget,
+            what="cohort leadership re-derivation after killing w2",
+        )
+        for worker in survivors:
+            assert non_coord_lines(worker.raw_output()) == before[
+                worker.worker_id
+            ], (
+                f"worker {worker.worker_id}'s node-local labels moved "
+                f"when the cohort leader died"
+            )
+        failed = obs_metrics.CYCLES_TOTAL.value(outcome="failed")
+        assert failed == 0, (
+            f"a cohort-leader death cost {failed} failed cycle(s)"
+        )
+    finally:
+        harness.stop()
+    elapsed = time.monotonic() - started
+    return {
+        "spec": "slice:cohort-leader-death",
+        "converged_s": round(elapsed, 3),
+        "labels": len(converged[0]),
+    }
+
+
+def run_tier_partition(workdir, timeout_s=None):
+    """slice:tier-partition (ISSUE 13): an 8-worker two-tier slice
+    (--cohort-size=4 -> cohorts {0..3} {4..7}) whose cohort-1 leadership
+    chain (w4, w5, w6) drops slice-tier polls AT THE WIRE (the
+    peer.tier-partition behavior enacted in the serving handler, scoped
+    per worker because the fault registry is process-global in the
+    hermetic harness) while answering every other plane. The contract:
+
+      1. ONLY the affected cohort degrades: the slice leader marks
+         slice.cohort.1.degraded=true and nothing else;
+      2. the direct-poll fallback keeps every member's verdict flowing —
+         slice.healthy-hosts stays 8 and slice.degraded stays false
+         (partial data beats no data, and everyone IS alive);
+      3. healing the partition clears the degraded marker;
+      4. node-local labels never move, zero failed cycles."""
+    from slice_fixture import SliceHarness, non_coord_lines
+
+    from gpu_feature_discovery_tpu.lm.slice_labeler import (
+        SLICE_DEGRADED_LABEL,
+        SLICE_HEALTHY_HOSTS_LABEL,
+        SLICE_ROLE_LABEL,
+        cohort_degraded_label,
+    )
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+    budget = timeout_s or 60.0
+    started = time.monotonic()
+    harness = SliceHarness(
+        workdir,
+        workers=8,
+        sleep_interval="0.05s",
+        cohort_size=4,
+        tier_partitioned_workers=(4, 5, 6),
+    ).start()
+    try:
+        degraded = harness.wait_for(
+            lambda s: (
+                s[0].get(SLICE_ROLE_LABEL) == "leader"
+                and s[0].get(cohort_degraded_label(1)) == "true"
+                and s[0].get(SLICE_HEALTHY_HOSTS_LABEL) == "8"
+                and s[0].get(SLICE_DEGRADED_LABEL) == "false"
+            ),
+            timeout=budget,
+            what="cohort 1 degraded with truthful healthy-hosts",
+        )
+        assert cohort_degraded_label(0) not in degraded[0], (
+            "the partition leaked into an unaffected cohort"
+        )
+        before = {
+            w.worker_id: non_coord_lines(w.raw_output())
+            for w in harness.workers
+        }
+        # Heal the partition: the leadership chain answers slice-tier
+        # polls again and the degraded marker must clear.
+        for wid in (4, 5, 6):
+            harness.workers[wid].coordinator.force_tier_partition = False
+        healed = harness.wait_for(
+            lambda s: (
+                cohort_degraded_label(1) not in s[0]
+                and s[0].get(SLICE_HEALTHY_HOSTS_LABEL) == "8"
+                and s[0].get(SLICE_DEGRADED_LABEL) == "false"
+            ),
+            timeout=budget,
+            what="degraded marker clearing after the partition heals",
+        )
+        for worker in harness.workers:
+            assert non_coord_lines(worker.raw_output()) == before[
+                worker.worker_id
+            ], f"worker {worker.worker_id}'s node-local labels moved"
+        failed = obs_metrics.CYCLES_TOTAL.value(outcome="failed")
+        assert failed == 0, (
+            f"the tier partition cost {failed} failed cycle(s)"
+        )
+    finally:
+        harness.stop()
+    elapsed = time.monotonic() - started
+    return {
+        "spec": "slice:tier-partition",
+        "converged_s": round(elapsed, 3),
+        "labels": len(healed[0]),
     }
 
 

@@ -160,6 +160,8 @@ class SliceHarness:
         round_budget=None,
         slow_workers=(),
         slow_delay_s=0.0,
+        cohort_size=0,
+        tier_partitioned_workers=(),
     ):
         """``slow_workers``/``slow_delay_s`` arm the peer.slow behavior
         on SPECIFIC workers' serving surfaces (the chaos slow-peer-storm
@@ -170,7 +172,17 @@ class SliceHarness:
         never "on half of the slice". ``round_budget`` bounds each
         coordinator's poll round (None = unbounded, the pre-existing
         harness behavior); ``peer_fanout`` is --peer-fanout (None =
-        auto)."""
+        auto).
+
+        ``cohort_size`` > 0 runs the two-tier cohort plane
+        (--cohort-size); ``tier_partitioned_workers`` arms the
+        peer.tier-partition behavior on SPECIFIC workers' serving
+        surfaces (their handler drops slice-tier leadership polls at
+        the wire while intra-cohort and direct-fallback traffic keeps
+        answering) — per-worker scope for the same process-global
+        fault-registry reason as ``slow_workers``; flip
+        ``workers[i].coordinator.force_tier_partition`` to heal it
+        mid-scenario."""
         import os
 
         from gpu_feature_discovery_tpu.config import new_config
@@ -219,6 +231,7 @@ class SliceHarness:
                     "probe-broker": "off",
                     "slice-coordination": coordination,
                     "peer-timeout": peer_timeout,
+                    "cohort-size": str(cohort_size),
                 },
                 environ={},
             )
@@ -231,11 +244,14 @@ class SliceHarness:
                     peer_timeout=float(peer_timeout.rstrip("s")),
                     round_budget=round_budget,
                     fanout=peer_fanout,
+                    cohort_size=cohort_size,
                 )
                 if i in slow_workers and slow_delay_s > 0:
                     coordinator.snapshot_response = _slowed(
                         coordinator.snapshot_response, slow_delay_s
                     )
+                if i in tier_partitioned_workers:
+                    coordinator.force_tier_partition = True
             env = dict(base_env)
             env["TPU_WORKER_ID"] = str(i)
             interconnect = InterconnectLabeler(

@@ -56,6 +56,19 @@ CHAOS_SPECS = [
     # round must stay bounded by ~1x --peer-timeout, no peer may be
     # skipped for budget, and slice labels must not move.
     "slice:slow-peer-storm",
+    # Two-tier cohort aggregation (ISSUE 13, --cohort-size): killing a
+    # cohort leader must RE-DERIVE the next chain member (w3 flips to
+    # slice.role=cohort-leader) with truthful healthy-hosts, no lingering
+    # cohort degraded marker, zero failed cycles, and node-local labels
+    # untouched.
+    "slice:cohort-leader-death",
+    # An inter-tier partition (the peer.tier-partition behavior enacted
+    # in the serving handler: slice-tier leadership polls dropped at the
+    # wire while every other plane answers) must degrade ONLY the
+    # affected cohort while the direct-poll fallback keeps healthy-hosts
+    # truthful at the full slice — and healing the partition clears the
+    # marker.
+    "slice:tier-partition",
     # Multi-backend registry (resource/registry.py, --backends): an
     # injected pjrt_init failure on ONE backend family must degrade only
     # that family's labels (its <family>.tfd.degraded marker) while the
@@ -99,6 +112,13 @@ CHAOS_EXPECTATIONS = {
     # 6 concurrent daemon loops, each round stalled 0.4s by the slow
     # half of the slice: startup + >= 4 storm rounds needs room.
     "slice:slow-peer-storm": {"timeout_s": 60.0},
+    # 6 / 8 concurrent two-tier daemon loops running TWO full
+    # convergence waits each (healthy baseline, then failover/heal):
+    # converged_s covers startup + both waits, so the budget is wider
+    # than the single-wait slice rows' (the chip rows' 90s rationale —
+    # observed >60s total once under full CI-driver load).
+    "slice:cohort-leader-death": {"timeout_s": 90.0},
+    "slice:tier-partition": {"timeout_s": 90.0},
     # The multi-backend row: the REAL cpu backend (jax cpu platform)
     # plus a mock gpu family; first cpu acquisition may pay the jax
     # import, hence the larger budget.

@@ -214,3 +214,30 @@ def test_configuration_doc_covers_every_backend_token():
         assert registry.provider_for(f"{match}:v4-8") is not None or (
             registry.provider_for(f"{match}:2") is not None
         ), f"doc names backend prefix {match!r} the registry rejects"
+
+
+def test_cohort_metric_families_are_registered_and_documented():
+    """ISSUE 13 drift guard, both directions and explicit: the two-tier
+    coordination families must exist in the live registry AND carry a
+    docs/observability.md row (the generic registry<->doc sweep in
+    test_obs.py covers them too, but a rename slipping through both
+    sides of that sweep would pass it — this pins the exact names the
+    runbook tells operators to alert on)."""
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+    doc = read("observability.md")
+    registered = set(obs_metrics.REGISTRY.families())
+    for name in (
+        "tfd_cohort_leaders",
+        "tfd_cohort_degraded",
+        "tfd_cohort_poll_rounds_total",
+    ):
+        assert name in registered, f"{name} missing from the registry"
+        assert f"`{name}`" in doc, (
+            f"{name} undocumented in docs/observability.md"
+        )
+    # The runbook's label vocabulary exists too.
+    ops = read("operations.md")
+    assert "Two-tier coordination" in ops
+    for label_bit in ("slice.cohort.<i>.degraded", "cohort-leader"):
+        assert label_bit in ops

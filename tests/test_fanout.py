@@ -1,0 +1,286 @@
+"""utils/fanout.BoundedPool — the PR 12 concurrency primitive,
+extracted (ISSUE 13 satellite): pool semantics, inline width-1 path,
+budget helper, and the registry's concurrent per-family init riding it
+(a hung family init must overlap, not serialize, the others)."""
+
+import threading
+import time
+
+import pytest
+
+from gpu_feature_discovery_tpu.utils.fanout import BoundedPool, Budget, ErrorSink
+
+
+def test_width_one_runs_inline_in_order_with_no_pool():
+    pool = BoundedPool(1)
+    assert pool.pool is None
+    order = []
+    pool.run([lambda i=i: order.append(i) for i in range(5)])
+    assert order == [0, 1, 2, 3, 4]
+    # Thread identity: inline means THIS thread, no handoff at all.
+    ran_on = []
+    pool.run([lambda: ran_on.append(threading.current_thread())])
+    assert ran_on == [threading.current_thread()]
+    pool.shutdown()
+
+
+def test_bounded_width_overlaps_but_never_exceeds_the_cap():
+    pool = BoundedPool(3, name="t-fanout")
+    in_flight = []
+    peak = []
+    lock = threading.Lock()
+
+    def task():
+        with lock:
+            in_flight.append(1)
+            peak.append(len(in_flight))
+        time.sleep(0.05)
+        with lock:
+            in_flight.pop()
+
+    started = time.perf_counter()
+    pool.run([task] * 9)
+    elapsed = time.perf_counter() - started
+    pool.shutdown()
+    assert max(peak) <= 3
+    # 9 x 0.05s at width 3 = ~3 waves, far under the 0.45s serial cost.
+    assert elapsed < 0.4, elapsed
+
+
+def test_run_blocks_until_every_task_finished():
+    pool = BoundedPool(4)
+    done = []
+
+    def task(i):
+        time.sleep(0.01 * (4 - i % 4))
+        done.append(i)
+
+    pool.run([lambda i=i: task(i) for i in range(8)])
+    assert sorted(done) == list(range(8))
+    pool.shutdown()
+
+
+def test_task_exception_propagates_like_the_inline_loop():
+    pool = BoundedPool(2)
+    with pytest.raises(RuntimeError, match="boom"):
+        pool.run([lambda: (_ for _ in ()).throw(RuntimeError("boom"))])
+    pool.shutdown()
+    inline = BoundedPool(1)
+    with pytest.raises(RuntimeError, match="boom"):
+        inline.run([lambda: (_ for _ in ()).throw(RuntimeError("boom"))])
+
+
+def test_budget_remaining_and_spent():
+    clock = [100.0]
+    budget = Budget(2.0, clock=lambda: clock[0])
+    assert budget.remaining() == pytest.approx(2.0)
+    assert not budget.spent(grace=0.05)
+    clock[0] += 1.9
+    assert budget.remaining() == pytest.approx(0.1)
+    assert not budget.spent(grace=0.05)
+    clock[0] += 0.2
+    assert budget.spent()
+    unbounded = Budget(None, clock=lambda: clock[0])
+    assert unbounded.remaining() is None
+    assert not unbounded.spent(grace=1e9)
+
+
+def test_error_sink_collects_across_threads():
+    sink = ErrorSink()
+    threads = [
+        threading.Thread(target=sink.put, args=(i, ValueError(str(i))))
+        for i in range(8)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    assert set(sink.errors) == set(range(8))
+
+
+# ---------------------------------------------------------------------------
+# registry rider (the satellite's point): per-family init overlaps
+# ---------------------------------------------------------------------------
+
+def _two_slow_backend_set(delay_s):
+    """A BackendSet over two throwaway providers whose builds each
+    sleep ``delay_s`` — registered under test-only tokens and removed
+    by the caller."""
+    from gpu_feature_discovery_tpu.config import new_config
+    from gpu_feature_discovery_tpu.resource import registry
+    from gpu_feature_discovery_tpu.resource.pjrt_backend import (
+        StaticPjrtManager,
+    )
+
+    def slow_gpu(config, token):
+        time.sleep(delay_s)
+        return StaticPjrtManager.mock_gpu(1)
+
+    def slow_cpu(config, token):
+        time.sleep(delay_s)
+        return StaticPjrtManager.mock_cpu(1)
+
+    registry.register(
+        registry.BackendProvider("slow-test-gpu", registry.FAMILY_GPU, slow_gpu)
+    )
+    registry.register(
+        registry.BackendProvider("slow-test-cpu", registry.FAMILY_CPU, slow_cpu)
+    )
+    config = new_config(
+        cli_values={"probe-isolation": "none"}, environ={}
+    )
+    return registry.BackendSet(["slow-test-gpu", "slow-test-cpu"], config)
+
+
+def _drop_test_providers():
+    from gpu_feature_discovery_tpu.resource import registry
+
+    registry._PROVIDERS.pop("slow-test-gpu", None)
+    registry._PROVIDERS.pop("slow-test-cpu", None)
+
+
+def test_acquire_all_overlaps_slow_family_inits():
+    """The satellite's contract: two families whose inits each cost
+    ~0.3s acquire in ~max, not ~sum — a hung family init (bounded by
+    its own probe timeout when sandboxed) no longer serializes the
+    others."""
+    delay = 0.3
+    bs = _two_slow_backend_set(delay)
+    try:
+        started = time.perf_counter()
+        bs.acquire_all()
+        elapsed = time.perf_counter() - started
+        assert all(rt.manager is not None for rt in bs.runtimes)
+        # Sequential would be >= 0.6s; concurrent ~0.3s. 0.5 splits the
+        # shapes with loaded-host headroom.
+        assert elapsed < 2 * delay - 0.1, (
+            f"acquisitions serialized: {elapsed:.3f}s"
+        )
+        # Steady state: everything held, second pass is a no-op.
+        started = time.perf_counter()
+        bs.acquire_all()
+        assert time.perf_counter() - started < 0.05
+    finally:
+        bs.release_all()
+        _drop_test_providers()
+
+
+def test_acquire_all_strict_raises_first_failure_in_flag_order():
+    """Oneshot parity: every family still gets its (concurrent)
+    attempt, and the FIRST failure in --backends order is what
+    propagates."""
+    from gpu_feature_discovery_tpu.config import new_config
+    from gpu_feature_discovery_tpu.resource import registry
+    from gpu_feature_discovery_tpu.resource.pjrt_backend import (
+        StaticPjrtManager,
+    )
+
+    def broken_gpu(config, token):
+        raise RuntimeError("gpu init exploded")
+
+    def broken_cpu(config, token):
+        raise RuntimeError("cpu init exploded")
+
+    registry.register(
+        registry.BackendProvider(
+            "slow-test-gpu", registry.FAMILY_GPU, broken_gpu
+        )
+    )
+    registry.register(
+        registry.BackendProvider(
+            "slow-test-cpu", registry.FAMILY_CPU, broken_cpu
+        )
+    )
+    config = new_config(cli_values={"probe-isolation": "none"}, environ={})
+    bs = registry.BackendSet(["slow-test-gpu", "slow-test-cpu"], config)
+    try:
+        with pytest.raises(RuntimeError, match="gpu init exploded"):
+            bs.acquire_all(strict=True)
+    finally:
+        bs.release_all()
+        _drop_test_providers()
+
+
+def test_acquire_all_nonstrict_contains_failures_per_family():
+    from gpu_feature_discovery_tpu.config import new_config
+    from gpu_feature_discovery_tpu.resource import registry
+    from gpu_feature_discovery_tpu.resource.pjrt_backend import (
+        StaticPjrtManager,
+    )
+
+    def broken_gpu(config, token):
+        raise RuntimeError("gpu init exploded")
+
+    def ok_cpu(config, token):
+        return StaticPjrtManager.mock_cpu(1)
+
+    registry.register(
+        registry.BackendProvider(
+            "slow-test-gpu", registry.FAMILY_GPU, broken_gpu
+        )
+    )
+    registry.register(
+        registry.BackendProvider("slow-test-cpu", registry.FAMILY_CPU, ok_cpu)
+    )
+    config = new_config(cli_values={"probe-isolation": "none"}, environ={})
+    bs = registry.BackendSet(["slow-test-gpu", "slow-test-cpu"], config)
+    try:
+        bs.acquire_all()  # contained: no raise
+        gpu_rt = next(rt for rt in bs.runtimes if rt.family == "gpu")
+        cpu_rt = next(rt for rt in bs.runtimes if rt.family == "cpu")
+        assert gpu_rt.manager is None and gpu_rt.down
+        assert cpu_rt.manager is not None
+    finally:
+        bs.release_all()
+        _drop_test_providers()
+
+
+def test_acquire_all_skips_pool_while_backoff_windows_are_closed():
+    """Review fix: a steady-state down family (manager None, backoff
+    window closed) must not cost a pool construct/teardown every cycle
+    — acquire_all's pending filter only admits runtimes whose attempt
+    is actually due."""
+    from gpu_feature_discovery_tpu.config import new_config
+    from gpu_feature_discovery_tpu.resource import registry
+
+    def broken(config, token):
+        raise RuntimeError("down")
+
+    registry.register(
+        registry.BackendProvider("slow-test-gpu", registry.FAMILY_GPU, broken)
+    )
+    registry.register(
+        registry.BackendProvider("slow-test-cpu", registry.FAMILY_CPU, broken)
+    )
+    config = new_config(cli_values={"probe-isolation": "none"}, environ={})
+    clock = [0.0]
+    bs = registry.BackendSet(
+        ["slow-test-gpu", "slow-test-cpu"], config, clock=lambda: clock[0]
+    )
+    try:
+        bs.acquire_all()  # both fail; windows now closed
+        assert all(rt.down for rt in bs.runtimes)
+        assert not any(rt.attempt_due() for rt in bs.runtimes)
+        import gpu_feature_discovery_tpu.utils.fanout as fanout_mod
+
+        constructed = []
+        original = fanout_mod.BoundedPool.__init__
+
+        def counting_init(self, *args, **kwargs):
+            constructed.append(1)
+            return original(self, *args, **kwargs)
+
+        fanout_mod.BoundedPool.__init__ = counting_init
+        try:
+            for _ in range(5):
+                bs.acquire_all()  # windows closed: no pool, no attempts
+        finally:
+            fanout_mod.BoundedPool.__init__ = original
+        assert not constructed, "pool churned on closed backoff windows"
+        assert all(rt.failures == 1 for rt in bs.runtimes)
+        clock[0] += 1000.0  # windows open: attempts (and the pool) resume
+        bs.acquire_all()
+        assert all(rt.failures == 2 for rt in bs.runtimes)
+    finally:
+        bs.release_all()
+        _drop_test_providers()

@@ -50,6 +50,7 @@ FILE_KEYS = {
     "slice-coordination": ("tfd", "sliceCoordination"),
     "peer-timeout": ("tfd", "peerTimeout"),
     "peer-fanout": ("tfd", "peerFanout"),
+    "cohort-size": ("tfd", "cohortSize"),
     "backends": ("tfd", "backends"),
     "reconcile": ("tfd", "reconcile"),
     "max-staleness": ("tfd", "maxStaleness"),
@@ -77,6 +78,7 @@ VALUE_PAIRS = {
     "slice-coordination": ("on", "off"),
     "peer-timeout": ("1s", "3s"),
     "peer-fanout": ("2", "4"),
+    "cohort-size": ("16", "auto"),
     # Registry tokens (resource/registry.py): values must parse, so the
     # generic "/value-a" str fallback does not apply.
     "backends": ("tpu,cpu", "cpu"),

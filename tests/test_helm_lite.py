@@ -185,6 +185,7 @@ def test_slice_env_defaults_render():
     assert env["TFD_SLICE_COORDINATION"] == "auto"
     assert env["TFD_PEER_TIMEOUT"] == "2s"
     assert env["TFD_PEER_FANOUT"] == "0"
+    assert env["TFD_COHORT_SIZE"] == "0"
 
 
 def test_reconcile_env_defaults_render_and_token_is_gated():

@@ -1109,3 +1109,719 @@ def test_misdirected_peer_etag_is_never_cached():
     finally:
         polling.close()
         server.close()
+
+
+# ---------------------------------------------------------------------------
+# host:port parsing (ISSUE 13 satellite): IPv6 forms
+# ---------------------------------------------------------------------------
+
+def test_split_host_port_forms():
+    """Bracketed IPv6 splits; an UNBRACKETED colon-bearing entry is
+    host-only (``::1`` must never parse as host ``::`` port 1)."""
+    from gpu_feature_discovery_tpu.peering.coordinator import _split_host_port
+
+    assert _split_host_port("[::1]:9101", 7007) == ("::1", 9101)
+    assert _split_host_port("[::1]", 7007) == ("::1", 7007)
+    assert _split_host_port("[fe80::2%eth0]:80", 7007) == ("fe80::2%eth0", 80)
+    assert _split_host_port("::1", 7007) == ("::1", 7007)
+    assert _split_host_port("fe80::2", 7007) == ("fe80::2", 7007)
+    assert _split_host_port("2001:db8::1:9101", 7007) == (
+        "2001:db8::1:9101",
+        7007,
+    )  # ambiguous unbracketed IPv6: host-only, never a guessed split
+    assert _split_host_port("w0:9101", 7007) == ("w0", 9101)
+    assert _split_host_port("w0", 7007) == ("w0", 7007)
+    assert _split_host_port("w0:abc", 7007) == ("w0:abc", 7007)
+    assert _split_host_port("[broken:9101", 7007) == ("[broken:9101", 7007)
+
+
+def test_ipv6_hostname_entries_build_host_only_peers():
+    coord = SliceCoordinator(
+        0,
+        ["[::1]:9001", "::1", "[2001:db8::5]"],
+        default_port=7007,
+        peer_timeout=0.1,
+    )
+    by_id = {p.worker_id: p for p in coord._peers}
+    assert by_id[1].host == "::1" and by_id[1].port == 7007
+    assert by_id[2].host == "2001:db8::5" and by_id[2].port == 7007
+    assert coord.hostname == "::1"
+
+
+# ---------------------------------------------------------------------------
+# cohort partition math (ISSUE 13): pure-function determinism
+# ---------------------------------------------------------------------------
+
+def _hostnames_256():
+    """256 mixed-form entries (ports, bare hosts, bracketed IPv6)."""
+    out = []
+    for i in range(256):
+        if i % 7 == 0:
+            out.append(f"[2001:db8::{i:x}]:9101")
+        elif i % 3 == 0:
+            out.append(f"10.0.{i // 256}.{i % 256}:91{i % 90 + 10}")
+        else:
+            out.append(f"w{i}")
+    return out
+
+
+def test_cohort_partition_pure_function_shapes():
+    from gpu_feature_discovery_tpu.peering.cohort import (
+        cohort_partition,
+        resolve_cohort_size,
+    )
+
+    assert cohort_partition(256, 64) == tuple(
+        tuple(range(s, s + 64)) for s in range(0, 256, 64)
+    )
+    ragged = cohort_partition(250, 64)
+    assert [len(c) for c in ragged] == [64, 64, 64, 58]
+    assert cohort_partition(8, 0) == ()
+    assert cohort_partition(8, 8) == ()  # one cohort IS flat
+    assert cohort_partition(8, 16) == ()
+    # auto: flat until the slice outgrows 64 hosts
+    assert resolve_cohort_size("auto", 64) == 0
+    assert resolve_cohort_size("auto", 65) == 64
+    assert resolve_cohort_size("0", 4096) == 0
+    assert resolve_cohort_size("16", 8) == 0  # >= host count -> flat
+    assert resolve_cohort_size("16", 100) == 16
+    assert resolve_cohort_size(None, 100) == 0
+
+
+def test_cohort_assignment_identical_from_every_worker_256_hosts():
+    """Property (satellite): every host derives the IDENTICAL cohort
+    partition from the hostname list alone — independent of its own
+    worker id and of reachability (no polls ever run here)."""
+    hostnames = _hostnames_256()
+    tables = {}
+    for worker_id in (0, 1, 63, 64, 127, 128, 200, 255):
+        coord = SliceCoordinator(
+            worker_id,
+            hostnames,
+            default_port=9101,
+            peer_timeout=0.1,
+            cohort_size=64,
+        )
+        tables[worker_id] = coord._cohorts
+        assert coord._my_cohort == worker_id // 64
+        coord.close()
+    reference = tables[0]
+    assert all(t == reference for t in tables.values())
+    # The partition covers every worker exactly once.
+    flat = [wid for cohort in reference for wid in cohort]
+    assert flat == list(range(256))
+
+
+# ---------------------------------------------------------------------------
+# two-tier coordination (ISSUE 13 tentpole): state machine
+# ---------------------------------------------------------------------------
+
+def _hier_coordinator(
+    worker_id, n, size, clock=None, responses=None, round_budget=None,
+    fanout=1,
+):
+    """A hierarchical _coordinator twin: same injected-fetch harness,
+    cohort_size=size."""
+    coord = SliceCoordinator(
+        worker_id,
+        [f"w{i}" for i in range(n)],
+        default_port=1,
+        peer_timeout=0.1,
+        round_budget=round_budget,
+        clock=clock or _Clock(),
+        backoff_factory=lambda: BackoffPolicy(
+            base=5.0, factor=1.0, cap=5.0, jitter=0.0
+        ),
+        fanout=fanout,
+        cohort_size=size,
+    )
+    responses = responses if responses is not None else {}
+
+    def fetch(peer, timeout):
+        result = responses.get(peer.worker_id, ConnectionRefusedError("down"))
+        if isinstance(result, BaseException):
+            raise result
+        if callable(result):
+            return result(timeout)
+        return result
+
+    coord._fetch = fetch
+    return coord, responses
+
+
+def _leader_doc(worker_id, index, reachable, sick=None, absent=()):
+    """A cohort leader's snapshot: plain doc + aggregate for ``index``
+    claiming ``reachable`` member ids live (``absent`` ids dark)."""
+    from gpu_feature_discovery_tpu.peering.snapshot import (
+        build_cohort_aggregate,
+    )
+
+    sick = sick or {}
+    members = {}
+    for wid in list(reachable) + list(absent):
+        live = wid in reachable
+        members[wid] = {
+            "reachable": live,
+            "generation": 1 if live else None,
+            "sick": (sick.get(wid, 0)) if live else None,
+            "mode": "full" if live else None,
+        }
+    doc = build_snapshot(
+        worker_id,
+        f"w{worker_id}",
+        {"google.com/tpu.count": "4"},
+        1,
+        "full",
+        cohort=build_cohort_aggregate(index, members),
+    )
+    return doc
+
+
+def test_cohort_size_zero_is_flat_and_byte_identical():
+    """Acceptance: --cohort-size=0 IS the flat plane — no tiers, no
+    aggregate key on the wire, identical label output and identical
+    serialized snapshot bytes to a coordinator built without the
+    parameter at all."""
+    import io
+
+    responses = {i: _peer_doc(i, sick=i % 2) for i in (1, 2, 3)}
+    outputs, bodies = {}, {}
+    for tag, kwargs in (("default", {}), ("explicit-zero", {"cohort_size": 0})):
+        coord = SliceCoordinator(
+            0,
+            [f"w{i}" for i in range(4)],
+            default_port=1,
+            peer_timeout=0.1,
+            fanout=1,
+            **kwargs,
+        )
+
+        def fetch(peer, timeout, responses=responses):
+            return responses[peer.worker_id]
+
+        coord._fetch = fetch
+        assert coord._hier is False
+        coord.publish_local({"google.com/tpu.chips.sick": "1"}, "full")
+        buf = io.StringIO()
+        coord.labels().write_to(buf)
+        outputs[tag] = buf.getvalue()
+        bodies[tag] = coord.snapshot_response()
+        assert "cohort" not in coord.snapshot_payload()
+        coord.close()
+    assert outputs["default"] == outputs["explicit-zero"]
+    assert bodies["default"] == bodies["explicit-zero"]
+
+
+def test_hier_all_reachable_slice_leader_aggregates_cohort_leaders():
+    """9 workers in 3 cohorts: w0 leads; its round polls its own 2
+    siblings plus the other cohorts' leaders (w3, w6) and sums health
+    and sick chips from their aggregates."""
+    obs_metrics.reset_for_tests()
+    from gpu_feature_discovery_tpu.lm.slice_labeler import (
+        SLICE_COHORT_LABEL,
+        SLICE_COHORTS_LABEL,
+    )
+
+    responses = {
+        1: _peer_doc(1),
+        2: _peer_doc(2, sick=1),
+        3: _leader_doc(3, 1, reachable=(3, 4, 5), sick={4: 2}),
+        6: _leader_doc(6, 2, reachable=(6, 7, 8)),
+    }
+    coord, _ = _hier_coordinator(0, 9, 3, responses=responses)
+    coord.publish_local(
+        {"google.com/tpu.chips.healthy": "3", "google.com/tpu.chips.sick": "1"},
+        "full",
+    )
+    labels = dict(coord.labels())
+    assert labels[SLICE_ROLE_LABEL] == "leader"
+    assert labels[SLICE_HEALTHY_HOSTS_LABEL] == "9"
+    assert labels[SLICE_TOTAL_HOSTS_LABEL] == "9"
+    assert labels[SLICE_DEGRADED_LABEL] == "false"
+    # own 1 + w2's 1 + cohort1 aggregate's 2 (member 4)
+    assert labels[SLICE_SICK_CHIPS_LABEL] == "4"
+    assert labels[SLICE_COHORT_LABEL] == "0"
+    assert labels[SLICE_COHORTS_LABEL] == "3"
+    assert not any(".degraded" in k for k in labels if "cohort" in k)
+    exposition = obs_metrics.REGISTRY.render()
+    assert 'tfd_cohort_poll_rounds_total{tier="cohort"} 1' in exposition
+    assert 'tfd_cohort_poll_rounds_total{tier="slice"} 1' in exposition
+    assert "tfd_cohort_leaders 3" in exposition
+    assert "tfd_cohort_degraded 0" in exposition
+    coord.close()
+
+
+def test_hier_dead_cohort_leader_fails_over_to_next_chain_member():
+    """No-election failover at the middle tier: w3 dies, the slice
+    leader's chain walk confirms it (2-miss at tier 2 once established)
+    and finds w4 answering with the re-derived aggregate; healthy-hosts
+    stays truthful (drops exactly the dead host) and the cohort is NOT
+    degraded — it has a live leader."""
+    responses = {
+        1: _peer_doc(1),
+        2: _peer_doc(2),
+        3: _leader_doc(3, 1, reachable=(3, 4, 5)),
+        6: _leader_doc(6, 2, reachable=(6, 7, 8)),
+    }
+    coord, responses = _hier_coordinator(0, 9, 3, responses=responses)
+    assert dict(coord.labels())[SLICE_HEALTHY_HOSTS_LABEL] == "9"
+    # w3 dies; w4 takes over its cohort and aggregates it.
+    del responses[3]
+    responses[4] = _leader_doc(4, 1, reachable=(4, 5), absent=(3,))
+    labels = {}
+    for _ in range(CONFIRM_POLLS):
+        labels = dict(coord.labels())
+    assert labels[SLICE_ROLE_LABEL] == "leader"
+    assert labels[SLICE_HEALTHY_HOSTS_LABEL] == "8"
+    assert labels[SLICE_DEGRADED_LABEL] == "true"
+    from gpu_feature_discovery_tpu.lm.slice_labeler import (
+        cohort_degraded_label,
+    )
+
+    assert cohort_degraded_label(1) not in labels
+    coord.close()
+
+
+def test_hier_dark_chain_degrades_cohort_and_direct_polls_members():
+    """Graceful degradation: cohort 1's whole leadership chain is dark
+    -> slice.cohort.1.degraded=true and the members are direct-polled
+    under the round budget (here all dead too -> healthy drops by the
+    cohort)."""
+    obs_metrics.reset_for_tests()
+    from gpu_feature_discovery_tpu.lm.slice_labeler import (
+        cohort_degraded_label,
+    )
+
+    responses = {
+        1: _peer_doc(1),
+        2: _peer_doc(2),
+        6: _leader_doc(6, 2, reachable=(6, 7, 8)),
+    }
+    coord, _ = _hier_coordinator(0, 9, 3, responses=responses)
+    labels = dict(coord.labels())
+    assert labels[SLICE_HEALTHY_HOSTS_LABEL] == "6"
+    assert labels[SLICE_DEGRADED_LABEL] == "true"
+    assert labels[cohort_degraded_label(1)] == "true"
+    assert cohort_degraded_label(2) not in labels
+    exposition = obs_metrics.REGISTRY.render()
+    assert "tfd_cohort_degraded 1" in exposition
+    coord.close()
+
+
+def test_hier_tier_partition_keeps_healthy_hosts_truthful():
+    """The inter-tier partition: cohort 1's chain members answer DIRECT
+    polls but not slice-tier leadership polls (two verdict planes, one
+    peer). The cohort goes degraded — no aggregation link — while the
+    direct-poll fallback keeps every member's verdict flowing:
+    healthy-hosts stays at the full slice."""
+    from gpu_feature_discovery_tpu.lm.slice_labeler import (
+        cohort_degraded_label,
+    )
+
+    plain = {wid: _peer_doc(wid) for wid in (1, 2, 3, 4, 5)}
+
+    responses = {
+        1: _peer_doc(1),
+        2: _peer_doc(2),
+        6: _leader_doc(6, 2, reachable=(6, 7, 8)),
+    }
+    coord, _ = _hier_coordinator(0, 9, 3, responses=responses)
+
+    # Tier-aware injected fetch: the _fetch hook cannot see tiers, so
+    # inject one level lower — _fetch_impl is bypassed entirely and
+    # _fetch_tiered is replaced.
+    def tiered_fetch(peer, timeout, state, tier):
+        from gpu_feature_discovery_tpu.peering.coordinator import TIER_SLICE
+
+        if peer.worker_id in (3, 4, 5) and tier == TIER_SLICE:
+            raise ConnectionResetError("tier partitioned")
+        if peer.worker_id in plain and peer.worker_id in (3, 4, 5):
+            return plain[peer.worker_id]
+        result = responses.get(
+            peer.worker_id, ConnectionRefusedError("down")
+        )
+        if isinstance(result, BaseException):
+            raise result
+        return result
+
+    del coord.__dict__["_fetch"]
+    coord._fetch_tiered = tiered_fetch
+    labels = dict(coord.labels())
+    assert labels[cohort_degraded_label(1)] == "true"
+    assert labels[SLICE_HEALTHY_HOSTS_LABEL] == "9"
+    assert labels[SLICE_DEGRADED_LABEL] == "false"
+    coord.close()
+
+
+def test_hier_cohort_leader_role_and_aggregate_serving():
+    """w3's own view with w0 alive: it leads cohort 1 (role
+    cohort-leader, leader-seen via the live lower chain) and serves its
+    cohort aggregate on the snapshot surface — valid schema, correct
+    index, every member accounted."""
+    responses = {0: _peer_doc(0), 4: _peer_doc(4, sick=1), 5: _peer_doc(5)}
+    coord, _ = _hier_coordinator(3, 9, 3, responses=responses)
+    coord.publish_local({"google.com/tpu.chips.sick": "0"}, "full")
+    labels = dict(coord.labels())
+    assert labels[SLICE_ROLE_LABEL] == "cohort-leader"
+    assert labels[SLICE_LEADER_SEEN_LABEL] == "true"
+    payload = coord.snapshot_payload()
+    parsed = parse_snapshot(
+        json.dumps(payload).encode()
+    )  # the aggregate survives the forward-rejecting parse
+    cohort = parsed["cohort"]
+    assert cohort["index"] == 1
+    assert set(cohort["members"]) == {"3", "4", "5"}
+    assert cohort["members"]["4"]["sick"] == 1
+    assert all(m["reachable"] for m in cohort["members"].values())
+    body, etag = coord.snapshot_response()
+    assert parse_snapshot(body)["cohort"]["index"] == 1
+    coord.close()
+
+
+def test_hier_slice_leadership_fails_over_across_cohorts():
+    """Cohort 0 entirely dark: w3 (cohort 1's leader) re-derives slice
+    leadership — no election — and the dead cohort is degraded with its
+    members counted out."""
+    from gpu_feature_discovery_tpu.lm.slice_labeler import (
+        cohort_degraded_label,
+    )
+
+    responses = {
+        4: _peer_doc(4),
+        5: _peer_doc(5),
+        6: _leader_doc(6, 2, reachable=(6, 7, 8)),
+    }
+    coord, _ = _hier_coordinator(3, 9, 3, responses=responses)
+    labels = {}
+    for _ in range(CONFIRM_POLLS):
+        labels = dict(coord.labels())
+    assert labels[SLICE_ROLE_LABEL] == "leader"
+    assert labels[SLICE_LEADER_LABEL] == "w3"
+    assert labels[SLICE_HEALTHY_HOSTS_LABEL] == "6"
+    assert labels[cohort_degraded_label(0)] == "true"
+    coord.close()
+
+
+def test_hier_fully_partitioned_node_never_claims_leadership():
+    """Both tiers dark from w0's seat: the flat never-lead rule holds —
+    follower + leader-seen=false, the partition visible on itself."""
+    coord, _ = _hier_coordinator(0, 9, 3)
+    labels = {}
+    for _ in range(CONFIRM_POLLS):
+        labels = dict(coord.labels())
+    assert labels[SLICE_ROLE_LABEL] == "follower"
+    assert labels[SLICE_LEADER_SEEN_LABEL] == "false"
+    view = coord.view()
+    assert view.healthy_hosts == 1 and view.degraded
+    coord.close()
+
+
+def test_hier_aggregate_change_moves_etag_not_generation():
+    """The aggregate rides the published snapshot: a changed aggregate
+    re-renders the body and moves the strong ETag (pollers see fresh
+    data), but the generation counter — distinct LABEL publishes — does
+    not move, and an UNCHANGED aggregate re-set keeps the bytes frozen
+    (the idle-slice 304 economy holds at the aggregate tier)."""
+    obs_metrics.reset_for_tests()
+    coord = SliceCoordinator(
+        3,
+        [f"w{i}" for i in range(9)],
+        default_port=1,
+        peer_timeout=0.1,
+        fanout=1,
+        cohort_size=3,
+    )
+    coord.publish_local({"a": "b"}, "full")
+    body1, etag1 = coord.snapshot_response()
+    serializations = obs_metrics.PEER_SNAPSHOT_SERIALIZATIONS.value()
+    aggregate = coord._build_own_aggregate()
+    coord._set_aggregate(aggregate)
+    body2, etag2 = coord.snapshot_response()
+    assert etag2 != etag1 and body2 != body1
+    assert coord.snapshot_payload()["generation"] == 1
+    assert obs_metrics.PEER_SNAPSHOT_SERIALIZATIONS.value() == serializations + 1
+    coord._set_aggregate(dict(aggregate))  # equal value: churn-free
+    body3, etag3 = coord.snapshot_response()
+    assert (body3, etag3) == (body2, etag2)
+    assert obs_metrics.PEER_SNAPSHOT_SERIALIZATIONS.value() == serializations + 1
+    coord.close()
+
+
+# ---------------------------------------------------------------------------
+# aggregate wire schema: forward-rejecting validation
+# ---------------------------------------------------------------------------
+
+def _cohort_doc_body(cohort):
+    doc = build_snapshot(3, "w3", {}, 1, "full")
+    doc["cohort"] = cohort
+    return json.dumps(doc).encode()
+
+
+@pytest.mark.parametrize(
+    "cohort, why",
+    [
+        ([], "not an object"),
+        ({"schema": 2, "index": 0, "members": {}}, "future cohort schema"),
+        ({"index": 0, "members": {}}, "missing cohort schema"),
+        ({"schema": 1, "index": "x", "members": {}}, "bad index"),
+        ({"schema": 1, "index": -1, "members": {}}, "negative index"),
+        ({"schema": 1, "index": 0, "members": []}, "members not a map"),
+        (
+            {"schema": 1, "index": 0, "members": {"w3": {"reachable": True}}},
+            "non-digit member id",
+        ),
+        (
+            {"schema": 1, "index": 0, "members": {"3": {"reachable": "yes"}}},
+            "non-bool reachable",
+        ),
+        (
+            {
+                "schema": 1,
+                "index": 0,
+                "members": {"3": {"reachable": True, "sick": "1"}},
+            },
+            "non-int sick",
+        ),
+        (
+            {
+                "schema": 1,
+                "index": 0,
+                "members": {"3": {"reachable": True, "mode": 4}},
+            },
+            "non-str mode",
+        ),
+    ],
+)
+def test_parse_snapshot_rejects_bad_cohort_sections(cohort, why):
+    with pytest.raises(PeerSnapshotError):
+        parse_snapshot(_cohort_doc_body(cohort))
+
+
+def test_parse_snapshot_accepts_valid_cohort_section():
+    from gpu_feature_discovery_tpu.peering.snapshot import (
+        build_cohort_aggregate,
+    )
+
+    aggregate = build_cohort_aggregate(
+        1,
+        {
+            3: {"reachable": True, "generation": 4, "sick": 0, "mode": "full"},
+            4: {"reachable": False, "generation": None, "sick": None,
+                "mode": None},
+        },
+    )
+    parsed = parse_snapshot(_cohort_doc_body(aggregate))
+    assert parsed["cohort"]["members"]["4"]["reachable"] is False
+
+
+def test_unknown_cohort_schema_counts_as_a_miss():
+    """A mid-rollout cohort leader speaking a NEWER aggregate schema is
+    treated exactly like an unreachable one — forward rejection at the
+    poll, never mis-aggregation."""
+    bad = _leader_doc(3, 1, reachable=(3, 4, 5))
+    bad["cohort"]["schema"] = 99
+    responses = {
+        1: _peer_doc(1),
+        2: _peer_doc(2),
+        3: (lambda timeout: parse_snapshot(json.dumps(bad).encode())),
+        6: _leader_doc(6, 2, reachable=(6, 7, 8)),
+    }
+    coord, _ = _hier_coordinator(0, 9, 3, responses=responses)
+    coord.poll_once()
+    state = coord._tier_state[3]
+    assert state.consecutive_failures >= 1
+    coord.close()
+
+
+# ---------------------------------------------------------------------------
+# two-tier fault sites: enacted at the serving handler, at the wire
+# ---------------------------------------------------------------------------
+
+def _hier_serving_pair(tmp_role=None, serving_kwargs=None):
+    """A hierarchical serving coordinator behind a REAL obs server
+    (peer_fault wired), plus a flat polling coordinator aimed at it."""
+    obs_metrics.reset_for_tests()
+    serving = SliceCoordinator(
+        3,
+        [f"w{i}" for i in range(9)],
+        default_port=1,
+        peer_timeout=0.5,
+        cohort_size=3,
+        **(serving_kwargs or {}),
+    )
+    serving.publish_local({"google.com/tpu.count": "4"}, "full")
+    if tmp_role is not None:
+        with serving._lock:
+            serving._role = tmp_role
+    state = IntrospectionState(60.0)
+    server = IntrospectionServer(
+        obs_metrics.REGISTRY,
+        state,
+        addr="127.0.0.1",
+        port=0,
+        peer_snapshot=serving.snapshot_response,
+        peer_fault=serving.serving_fault,
+    )
+    server.start()
+    hostnames = ["127.0.0.1:1", "127.0.0.1:2", "127.0.0.1:3",
+                 f"127.0.0.1:{server.port}"]
+    polling = SliceCoordinator(
+        0, hostnames, default_port=server.port, peer_timeout=0.5
+    )
+    return server, serving, polling
+
+
+def test_tier_partition_fault_drops_only_slice_tier_requests():
+    """peer.tier-partition enacted at the wire: the serving handler
+    drops requests whose X-TFD-Poll-Tier header says 'slice' and keeps
+    answering every other plane — exactly the partition the
+    graceful-degradation fallback exists for."""
+    import time as _time
+
+    from gpu_feature_discovery_tpu.peering.coordinator import (
+        TIER_COHORT,
+        TIER_DIRECT,
+        TIER_SLICE,
+    )
+
+    server, serving, polling = _hier_serving_pair()
+    serving.force_tier_partition = True
+    try:
+        peer = polling._peer_by_id[3]
+        state = polling._peer_state[3]
+        tier_state = polling._tier_state_for(3)
+        started = _time.perf_counter()
+        polling._poll_peer(peer, started, state=tier_state, tier=TIER_SLICE)
+        assert tier_state.consecutive_failures == 1
+        polling._poll_peer(peer, started, state=state, tier=TIER_DIRECT)
+        assert state.consecutive_failures == 0
+        assert state.last_snapshot is not None
+        polling._poll_peer(peer, started, state=state, tier=TIER_COHORT)
+        assert state.consecutive_failures == 0
+        # Partition heals: the slice tier answers again.
+        serving.force_tier_partition = False
+        tier_state.next_attempt = 0.0
+        polling._poll_peer(peer, started, state=tier_state, tier=TIER_SLICE)
+        assert tier_state.consecutive_failures == 0
+    finally:
+        polling.close()
+        serving.close()
+        server.close()
+        faults.reset()
+
+
+def test_cohort_leader_dead_fault_gates_on_role():
+    """peer.cohort-leader-dead drops requests exactly while the serving
+    daemon IS a cohort leader; a follower's shots stay armed (the gate
+    precedes the consume — the budget is not burned on the wrong
+    role)."""
+    import time as _time
+
+    server, serving, polling = _hier_serving_pair(tmp_role="follower")
+    try:
+        # The first dropped poll costs TWO shots: the established poller
+        # holds a reused keep-alive connection, and a drop there is
+        # retried once on a fresh connection before counting a miss —
+        # the same shot accounting peer.unreachable documents.
+        faults.load_fault_spec("peer.cohort-leader-dead:fail:3")
+        peer = polling._peer_by_id[3]
+        state = polling._peer_state[3]
+        started = _time.perf_counter()
+        polling._poll_peer(peer, started, state=state)
+        assert state.consecutive_failures == 0  # follower: answers
+        with serving._lock:
+            serving._role = "cohort-leader"
+        polling._poll_peer(peer, started, state=state)
+        assert state.consecutive_failures == 1  # leader: dark at the wire
+        polling._poll_peer(peer, started, state=state)
+        assert state.consecutive_failures == 2
+        polling._peer_state[3].next_attempt = 0.0
+        polling._poll_peer(peer, started, state=state)  # budget drained
+        assert state.consecutive_failures == 0
+    finally:
+        polling.close()
+        serving.close()
+        server.close()
+        faults.reset()
+
+
+def test_partitioned_cohort_leader_withdraws_its_aggregate():
+    """Review fix (ISSUE 13): a fully-partitioned node must WITHDRAW
+    the aggregate it serves, not publish one marking every sibling
+    unreachable — under an egress-only partition (outbound dead,
+    inbound serving fine) the slice leader's chain walk would find that
+    aggregate and count a healthy cohort as 1 host."""
+    coord, responses = _hier_coordinator(3, 9, 3)
+    coord.publish_local({"google.com/tpu.count": "4"}, "full")
+    for _ in range(CONFIRM_POLLS):
+        coord.poll_once()
+    assert coord.view().role == "follower"  # never-lead while partitioned
+    assert "cohort" not in coord.snapshot_payload()
+    body, _ = coord.snapshot_response()
+    assert "cohort" not in parse_snapshot(body)
+    # Partition heals: the node re-derives cohort leadership and the
+    # aggregate comes back.
+    responses.update(
+        {0: _peer_doc(0), 4: _peer_doc(4), 5: _peer_doc(5)}
+    )
+    for state in coord._peer_state.values():
+        state.next_attempt = 0.0
+    for state in coord._tier_state.values():
+        state.next_attempt = 0.0
+    coord.poll_once()
+    assert coord.view().role == "cohort-leader"
+    assert coord.snapshot_payload()["cohort"]["index"] == 1
+    coord.close()
+
+
+def test_aggregateless_chain_degrades_to_truthful_direct_polls():
+    """The egress-partitioned-leader scenario end to end from the slice
+    leader's seat: every cohort-1 chain member answers plain snapshots
+    (reachable, but nobody serves an aggregate — their derived leader
+    is egress-partitioned and withdrew its own). The chain is
+    leadership-dark -> the cohort degrades and the direct-poll fallback
+    counts the members by their own answers: healthy-hosts stays
+    truthful at the full slice."""
+    from gpu_feature_discovery_tpu.lm.slice_labeler import (
+        cohort_degraded_label,
+    )
+
+    responses = {
+        1: _peer_doc(1),
+        2: _peer_doc(2),
+        3: _peer_doc(3),  # reachable, NO aggregate
+        4: _peer_doc(4),
+        5: _peer_doc(5),
+        6: _leader_doc(6, 2, reachable=(6, 7, 8)),
+    }
+    coord, _ = _hier_coordinator(0, 9, 3, responses=responses)
+    labels = dict(coord.labels())
+    assert labels[cohort_degraded_label(1)] == "true"
+    assert labels[SLICE_HEALTHY_HOSTS_LABEL] == "9"
+    assert labels[SLICE_DEGRADED_LABEL] == "false"
+    coord.close()
+
+
+def test_close_racing_commit_never_relatches_cohort_gauges():
+    """Review fix: the commit writes its gauges UNDER the serving lock
+    where it checks _closed, so a round committed after close() cannot
+    re-latch tfd_cohort_* / tfd_slice_degraded past close()'s reset."""
+    obs_metrics.reset_for_tests()
+    responses = {
+        1: _peer_doc(1),
+        2: _peer_doc(2),
+        6: _leader_doc(6, 2, reachable=(6, 7, 8)),
+    }
+    coord, _ = _hier_coordinator(0, 9, 3, responses=responses)
+    coord.poll_once()  # cohort 1 dark -> degraded gauge latches 1
+    assert "tfd_cohort_degraded 1" in obs_metrics.REGISTRY.render()
+    coord.close()
+    exposition = obs_metrics.REGISTRY.render()
+    assert "tfd_cohort_degraded 0" in exposition
+    assert "tfd_cohort_leaders 0" in exposition
+    # A straggler commit landing after close() must no-op entirely.
+    coord._commit_hier_round()
+    exposition = obs_metrics.REGISTRY.render()
+    assert "tfd_cohort_degraded 0" in exposition
+    assert "tfd_cohort_leaders 0" in exposition
+    assert coord.membership_token() is None or True  # view state frozen
