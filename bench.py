@@ -1343,6 +1343,101 @@ def main() -> int:
         file=sys.stderr,
     )
 
+    # Fleet aggregation (ISSUE 14, fleet/): one collector scrape round
+    # over N live slice-leader endpoints, measured IDLE — the leaders'
+    # snapshots never change between rounds, so after the warm round
+    # every poll should be a 304 header exchange over a reused
+    # keep-alive connection (no body, no JSON parse on either end). CI
+    # asserts the round's p50 and that >= 90% of the measured polls were
+    # 304s — the steady-state economy the collector inherits from the
+    # peer tier.
+    from gpu_feature_discovery_tpu.fleet import FleetCollector, SliceTarget
+
+    fleet_targets_n = 8
+    fleet_servers = []
+    fleet_serving = []
+    fleet_collector = None
+    try:
+        fleet_target_list = []
+        for i in range(fleet_targets_n):
+            serving = SliceCoordinator(
+                0, [f"s{i}w0:1", f"s{i}w1:1"], default_port=1,
+                peer_timeout=1.0,
+            )
+            serving.publish_local(
+                {
+                    "google.com/tpu.count": "4",
+                    "google.com/tpu.chips.healthy": "4",
+                    "google.com/tpu.chips.sick": "0",
+                    "google.com/tpu.slice.role": "leader",
+                    "google.com/tpu.slice.leader": f"s{i}w0",
+                    "google.com/tpu.slice.healthy-hosts": "2",
+                    "google.com/tpu.slice.total-hosts": "2",
+                    "google.com/tpu.slice.degraded": "false",
+                    "google.com/tpu.slice.sick-chips": "0",
+                },
+                "full",
+            )
+            server = IntrospectionServer(
+                obs_metrics.REGISTRY,
+                IntrospectionState(60.0),
+                addr="127.0.0.1",
+                port=0,
+                peer_snapshot=serving.snapshot_response,
+            )
+            server.start()
+            fleet_serving.append(serving)
+            fleet_servers.append(server)
+            fleet_target_list.append(
+                SliceTarget(
+                    name=f"slice-{i}", hosts=(f"127.0.0.1:{server.port}",)
+                )
+            )
+        fleet_collector = FleetCollector(fleet_target_list, peer_timeout=1.0)
+        fleet_collector.poll_round()  # warm: full bodies + connections
+        fleet_iters = max(
+            3, int(os.environ.get("TFD_BENCH_FLEET_ITERS", "5"))
+        )
+        not_modified_before = obs_metrics.FLEET_SNAPSHOT_NOT_MODIFIED.value()
+        polls_before = sum(
+            obs_metrics.FLEET_POLLS.value(outcome=o)
+            for o in ("ok", "error", "skipped")
+        )
+        fleet_rounds_ms = []
+        for _ in range(fleet_iters):
+            t0 = time.perf_counter()
+            fleet_collector.poll_round()
+            fleet_rounds_ms.append((time.perf_counter() - t0) * 1e3)
+        fleet_304 = (
+            obs_metrics.FLEET_SNAPSHOT_NOT_MODIFIED.value()
+            - not_modified_before
+        )
+        fleet_polls = (
+            sum(
+                obs_metrics.FLEET_POLLS.value(outcome=o)
+                for o in ("ok", "error", "skipped")
+            )
+            - polls_before
+        )
+        fleet_scrape_round_ms = round(statistics.median(fleet_rounds_ms), 3)
+        fleet_not_modified_ratio = round(
+            fleet_304 / fleet_polls if fleet_polls else 0.0, 3
+        )
+    finally:
+        if fleet_collector is not None:
+            fleet_collector.close()
+        for server in fleet_servers:
+            server.close()
+        for serving in fleet_serving:
+            serving.close()
+    print(
+        f"bench: fleet scrape round over {fleet_targets_n} idle slices "
+        f"p50={fleet_scrape_round_ms}ms, 304 ratio "
+        f"{fleet_not_modified_ratio} ({int(fleet_304)}/{int(fleet_polls)} "
+        f"polls — header exchanges only)",
+        file=sys.stderr,
+    )
+
     # Event-driven reconcile latency (ISSUE 9): POST /probe on the obs
     # server -> label file mtime change, with the sleep interval at 60s
     # so only the event path (cmd/events.py PROBE_REQUEST wake) can
@@ -1580,6 +1675,15 @@ def main() -> int:
                 "slice_scale_peer_timeout_ms": round(
                     slice_scale_peer_timeout_s * 1e3, 3
                 ),
+                # Fleet aggregation acceptance (ISSUE 14): one collector
+                # scrape round over 8 idle slice leaders — after the
+                # warm round every poll is a 304 header exchange on a
+                # reused keep-alive connection, so CI asserts the 304
+                # ratio >= 0.9 and the round far under the per-target
+                # timeout it would cost against dark slices.
+                "fleet_scrape_round_ms": fleet_scrape_round_ms,
+                "fleet_not_modified_ratio": fleet_not_modified_ratio,
+                "fleet_targets": fleet_targets_n,
                 "sleep_interval_ms": round(DEFAULT_SLEEP_INTERVAL * 1e3, 3),
                 # Event-driven reconcile acceptance (ISSUE 9): POST
                 # /probe -> label file mtime change against a 60s sleep

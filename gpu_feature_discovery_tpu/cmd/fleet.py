@@ -1,0 +1,317 @@
+"""The ``fleet-collector`` mode: ``python -m gpu_feature_discovery_tpu
+fleet-collector --targets-file fleet.yaml``.
+
+A long-running out-of-cluster service (one small Deployment, not a
+DaemonSet) built entirely from the repo's existing primitives: the
+collector (fleet/collector.py) scrapes every configured slice's
+leadership chain per round; the obs server (obs/server.py) serves the
+aggregated inventory at ``GET /fleet/snapshot`` next to ``/metrics``,
+``/healthz``, ``/readyz`` on its own server instance; the targets file
+is mtime-watch reloaded through cmd/events.ConfigFileWatcher (edit the
+file, the epoch rebuilds — no restart, exactly like the daemon's config
+watcher); SIGHUP forces the same reload, SIGTERM/SIGINT exit cleanly.
+
+Flags resolve CLI > env > default (the collector has no config file —
+the targets file IS its config; FLEET_FLAG_DEFS is the one table docs
+and the parser both read, same anti-drift shape as config/flags.py).
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import time
+from dataclasses import dataclass
+from typing import Any, Callable, List, Optional, Sequence
+
+from gpu_feature_discovery_tpu.config.flags import (
+    DEFAULT_METRICS_ADDR,
+    DEFAULT_PEER_FANOUT,
+    DEFAULT_PEER_TIMEOUT,
+    parse_duration,
+)
+from gpu_feature_discovery_tpu.config.spec import (
+    ConfigError,
+    parse_nonneg_int,
+)
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+from gpu_feature_discovery_tpu.utils import logging as tfd_logging
+
+log = logging.getLogger("tfd.fleet")
+
+# The collector's own metrics port: next to the daemon's 9101 so one
+# scrape config covers both, distinct so a collector colocated with a
+# daemon (dev, tests) needs no flag.
+DEFAULT_FLEET_METRICS_PORT = 9102
+# How often the collector runs a scrape round. 10s keeps a fleet pane
+# near-live while an idle fleet's round is N 304 header exchanges — the
+# cost is connection keep-alive, not bodies.
+DEFAULT_SCRAPE_INTERVAL = 10.0
+# Round budget as a fraction of the interval: a round must never bleed
+# into the next (the engine's 0.8 * labeler-timeout rationale).
+ROUND_BUDGET_FRACTION = 0.8
+
+
+@dataclass(frozen=True)
+class FleetFlag:
+    """One collector flag: the FLAG_DEFS shape minus the Config setter
+    (the collector resolves straight to a values dict). docs drift
+    guards (tests/test_docs.py) read this table."""
+
+    name: str
+    env_vars: Sequence[str]
+    parse: Callable[[Any], Any]
+    default: Any
+    help: str
+
+
+FLEET_FLAG_DEFS: List[FleetFlag] = [
+    FleetFlag(
+        name="targets-file",
+        env_vars=("TFD_FLEET_TARGETS",),
+        parse=str,
+        default="",
+        help="path to the fleet targets file (slice name -> host list, "
+        "fleet/targets.py grammar); REQUIRED — the collector has "
+        "nothing to scrape without it; mtime-watched, so an edit "
+        "reloads the fleet without a restart",
+    ),
+    FleetFlag(
+        name="scrape-interval",
+        env_vars=("TFD_FLEET_SCRAPE_INTERVAL",),
+        parse=parse_duration,
+        default=DEFAULT_SCRAPE_INTERVAL,
+        help="time between fleet scrape rounds (Go duration, e.g. 10s); "
+        "an idle fleet's round is ~N 304 header exchanges, so short "
+        "intervals are cheap",
+    ),
+    FleetFlag(
+        name="metrics-addr",
+        env_vars=("TFD_METRICS_ADDR",),
+        parse=str,
+        default=DEFAULT_METRICS_ADDR,
+        help="bind address for the collector's HTTP server "
+        "(/fleet/snapshot, /metrics, /healthz, /readyz)",
+    ),
+    FleetFlag(
+        name="metrics-port",
+        env_vars=("TFD_METRICS_PORT",),
+        parse=parse_nonneg_int,
+        default=DEFAULT_FLEET_METRICS_PORT,
+        help="port for the collector's HTTP server; 0 binds an "
+        "ephemeral port (the collector always serves — the inventory "
+        "IS the product)",
+    ),
+    FleetFlag(
+        name="peer-timeout",
+        env_vars=("TFD_PEER_TIMEOUT",),
+        parse=parse_duration,
+        default=DEFAULT_PEER_TIMEOUT,
+        help="per-target connect/read budget for one /peer/snapshot "
+        "poll (2 consecutive misses confirm a chain member "
+        "unreachable, exactly like the slice tier)",
+    ),
+    FleetFlag(
+        name="peer-fanout",
+        env_vars=("TFD_PEER_FANOUT",),
+        parse=parse_nonneg_int,
+        default=DEFAULT_PEER_FANOUT,
+        help="how many slices one scrape round polls concurrently; "
+        "0 (default) is auto — min(8, slices); 1 is sequential",
+    ),
+    FleetFlag(
+        name="peer-token",
+        env_vars=("TFD_PEER_TOKEN",),
+        parse=str,
+        default="",
+        help="shared secret sent on every /peer/snapshot poll (the "
+        "slices' daemons require it once their --peer-token is set) "
+        "and required on the collector's own /fleet/snapshot; empty "
+        "sends nothing and serves the inventory openly",
+    ),
+    FleetFlag(
+        name="state-dir",
+        env_vars=("TFD_STATE_DIR",),
+        parse=str,
+        default="",
+        help="directory where the last-good fleet inventory is "
+        "persisted atomically; a collector restart serves it "
+        "immediately with per-slice restored markers until each "
+        "slice's first live poll (empty = disabled)",
+    ),
+]
+
+
+def build_arg_parser() -> argparse.ArgumentParser:
+    parser = argparse.ArgumentParser(
+        prog="tpu-feature-discovery fleet-collector",
+        description="aggregate many slices' /peer/snapshot into one "
+        "authenticated fleet inventory",
+    )
+    for fd in FLEET_FLAG_DEFS:
+        parser.add_argument(
+            f"--{fd.name}", dest=fd.name, default=None, help=fd.help
+        )
+    parser.add_argument(
+        "--debug", action="store_true", help="enable debug logging"
+    )
+    return parser
+
+
+def resolve_flags(ns: dict, environ: Optional[dict] = None) -> dict:
+    """CLI > env > default for the collector's flag table."""
+    environ = environ if environ is not None else dict(os.environ)
+    values = {}
+    for fd in FLEET_FLAG_DEFS:
+        raw = ns.get(fd.name)
+        if raw is None:
+            raw = next(
+                (
+                    environ[e]
+                    for e in fd.env_vars
+                    if environ.get(e) not in (None, "")
+                ),
+                None,
+            )
+        values[fd.name] = fd.parse(raw) if raw is not None else fd.default
+    return values
+
+
+def run_epoch(values: dict, targets, sigs) -> str:
+    """One collector epoch: build the collector + server + targets
+    watcher, scrape until a decision. Returns "restart" (SIGHUP or a
+    changed targets file — the caller re-reads and rebuilds),
+    "shutdown" (clean signal exit), or "error" (the server could not
+    bind — serving the inventory IS the product, so the caller must
+    exit nonzero, not report a clean completion)."""
+    from gpu_feature_discovery_tpu.cmd import events as reconcile_events
+    from gpu_feature_discovery_tpu.cmd.main import _check_signal
+    from gpu_feature_discovery_tpu.fleet.collector import FleetCollector
+    from gpu_feature_discovery_tpu.obs.server import (
+        IntrospectionServer,
+        IntrospectionState,
+    )
+
+    interval = values["scrape-interval"]
+    collector = FleetCollector(
+        targets,
+        peer_timeout=values["peer-timeout"],
+        fanout=values["peer-fanout"] or None,
+        round_budget=ROUND_BUDGET_FRACTION * interval,
+        peer_token=values["peer-token"],
+        state_dir=values["state-dir"],
+    )
+    state = IntrospectionState(interval)
+    server = None
+    try:
+        server = IntrospectionServer(
+            obs_metrics.REGISTRY,
+            state,
+            addr=values["metrics-addr"],
+            port=values["metrics-port"],
+            # The collector has no per-source provenance to leak; its
+            # /debug/labels serves the per-slice summary below.
+            debug_endpoints=True,
+            fleet_snapshot=collector.inventory_response,
+            peer_token=values["peer-token"],
+        )
+    except OSError as e:
+        log.error(
+            "cannot bind collector server on %s:%s: %s",
+            values["metrics-addr"],
+            values["metrics-port"],
+            e,
+        )
+        collector.close()
+        return "error"
+    server.start()
+    log.info(
+        "fleet collector serving on %s:%d (%d slices, scrape interval "
+        "%.1fs)",
+        values["metrics-addr"],
+        server.port,
+        len(targets),
+        interval,
+    )
+    events = reconcile_events.EventQueue()
+    watcher = reconcile_events.ConfigFileWatcher(
+        values["targets-file"], events
+    ).start()
+    if collector.restored_slices:
+        state.labels_written(
+            _summary(collector), mode="restored"
+        )
+    try:
+        while True:
+            collector.poll_round()
+            state.cycle_completed()
+            state.labels_written(_summary(collector), mode="full")
+            deadline = time.monotonic() + interval
+            while True:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                # Two producers, one wait: the OS signal queue decides
+                # immediately; the targets watcher's CONFIG_CHANGED is
+                # a restart. Bounded sub-waits keep reload latency
+                # under ~0.2s on top of the watcher's own poll.
+                decision = _check_signal(
+                    sigs, timeout=min(0.2, remaining)
+                )
+                if decision is not None:
+                    return decision
+                event = events.get_nowait()
+                if event is not None and (
+                    event.reason == reconcile_events.REASON_CONFIG_CHANGED
+                ):
+                    log.info("targets file changed; reloading fleet")
+                    return "restart"
+    finally:
+        watcher.stop()
+        server.close()
+        collector.close()
+
+
+def _summary(collector) -> dict:
+    """The /debug/labels view of the inventory: one row per slice."""
+    doc = collector.inventory_payload()
+    out = {}
+    for name, entry in doc["slices"].items():
+        healthy = entry.get("healthy_hosts")
+        total = entry.get("total_hosts")
+        status = "stale" if entry.get("stale") else (
+            "restored" if entry.get("restored") else "live"
+        )
+        out[name] = f"{status}:{healthy}/{total}"
+    return out
+
+
+def main(argv: Optional[list] = None) -> int:
+    parser = build_arg_parser()
+    ns = vars(parser.parse_args(argv))
+    tfd_logging.setup(debug=ns.pop("debug", False))
+    from gpu_feature_discovery_tpu.cmd.main import new_os_watcher
+    from gpu_feature_discovery_tpu.fleet.targets import parse_targets_file
+
+    sigs = new_os_watcher()
+    while True:
+        try:
+            values = resolve_flags(ns)
+            if not values["targets-file"]:
+                log.error(
+                    "no targets file: pass --targets-file or set "
+                    "TFD_FLEET_TARGETS"
+                )
+                return 1
+            targets = parse_targets_file(values["targets-file"])
+        except ConfigError as e:
+            log.error("unable to load fleet targets: %s", e)
+            return 1
+        if not targets:
+            log.warning("targets file names no slices; serving an empty "
+                        "inventory until it does")
+        decision = run_epoch(values, targets, sigs)
+        if decision == "restart":
+            continue
+        return 0 if decision == "shutdown" else 1

@@ -297,6 +297,9 @@ def start_introspection_server(
             probe_request=probe_request,
             probe_token=tfd.probe_token or "",
             peer_fault=peer_fault,
+            # --peer-token: when set, /peer/snapshot requires the shared
+            # secret (the coordinator's own poller sends it too).
+            peer_token=tfd.peer_token or "",
         )
     except OSError as e:
         if not quiet:
@@ -1083,6 +1086,15 @@ def run(
 
 
 def main() -> None:
+    # Subcommand dispatch lives HERE — the one entry both the installed
+    # console script (pyproject [project.scripts]) and `python -m`
+    # (__main__.py) funnel through — so `tpu-feature-discovery
+    # fleet-collector ...` works exactly as the collector's own usage
+    # string advertises.
+    if len(sys.argv) > 1 and sys.argv[1] == "fleet-collector":
+        from gpu_feature_discovery_tpu.cmd.fleet import main as fleet_main
+
+        sys.exit(fleet_main(sys.argv[2:]))
     sys.exit(start())
 
 

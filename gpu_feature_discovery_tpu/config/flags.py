@@ -651,6 +651,22 @@ FLAG_DEFS: List[FlagDef] = [
         getter=lambda c: _f(c).tfd.probe_token,
     ),
     FlagDef(
+        name="peer-token",
+        env_vars=("TFD_PEER_TOKEN",),
+        parse=str,
+        default="",
+        help="shared secret authenticating GET /peer/snapshot on the "
+        "introspection server (X-TFD-Probe-Token header or "
+        "Authorization: Bearer): when set, the slice leader's poll "
+        "round and the fleet collector send it and unauthenticated "
+        "requests are rejected (missing header 403, wrong token 401), "
+        "so the peer surface can be exposed beyond the node network; "
+        "empty (default) keeps the endpoint open — byte-identical "
+        "back-compat",
+        setter=lambda c, v: setattr(_f(c).tfd, "peer_token", v),
+        getter=lambda c: _f(c).tfd.peer_token,
+    ),
+    FlagDef(
         name="state-dir",
         env_vars=("TFD_STATE_DIR",),
         parse=str,

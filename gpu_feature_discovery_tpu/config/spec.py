@@ -231,6 +231,12 @@ class TfdFlags:
     reconcile_debounce: Optional[float] = None  # seconds
     max_probe_rate: Optional[float] = None  # event-driven cycles per second
     probe_token: Optional[str] = None  # "" = POST /probe disabled
+    # Peer-surface auth (obs/server.py + peering/coordinator.py +
+    # fleet/collector.py): shared secret required on GET /peer/snapshot
+    # when set, sent by the slice leader's poller and the fleet
+    # collector. "" (the default) keeps the surface open on the node
+    # network — byte-identical back-compat.
+    peer_token: Optional[str] = None  # "" = /peer/snapshot open
 
 
 @dataclass
@@ -310,6 +316,14 @@ class Config:
                         "<redacted>"
                         if self.flags.tfd.probe_token
                         else self.flags.tfd.probe_token
+                    ),
+                    # Same redaction contract as probeToken: the
+                    # /peer/snapshot shared secret must never reach the
+                    # startup dump either.
+                    "peerToken": (
+                        "<redacted>"
+                        if self.flags.tfd.peer_token
+                        else self.flags.tfd.peer_token
                     ),
                 },
             },
@@ -508,6 +522,7 @@ def parse_config_file(path: str) -> Config:
             tfd["maxProbeRate"]
         )
     config.flags.tfd.probe_token = _opt_str(tfd.get("probeToken"))
+    config.flags.tfd.peer_token = _opt_str(tfd.get("peerToken"))
 
     config.resources = raw.get("resources", {}) or {}
     config.sharing = Sharing.from_dict(raw.get("sharing", {}) or {})

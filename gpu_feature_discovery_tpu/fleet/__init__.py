@@ -1,0 +1,50 @@
+"""Fleet aggregation service (ISSUE 14): an out-of-cluster collector
+over the slice coordination plane's ``/peer/snapshot`` surface.
+
+The reference GFD stops at the node boundary; the peering layer (PRs
+7/12/13) stops at the slice. This package is the next consumer tier up:
+a long-running collector (``python -m gpu_feature_discovery_tpu
+fleet-collector``, cmd/fleet.py) scrapes the slice LEADERS' stable,
+versioned, ETag-cached ``/peer/snapshot`` endpoints across many slices
+— walking each slice's 3-deep leadership chain exactly like the cohort
+tier — and serves the aggregated fleet inventory as schema-versioned
+JSON at ``GET /fleet/snapshot`` with the same publish-time
+serialization / strong-ETag / 304 machinery the peer surface uses, so
+one operator pane answers "which slices are schedulable right now".
+
+- ``targets.py`` — the static targets file (slice name -> host list),
+  mtime-watch reloaded through cmd/events.ConfigFileWatcher.
+- ``inventory.py`` — the ``/fleet/snapshot`` wire schema + the
+  ``--state-dir`` persistence so a collector restart serves
+  ``restored`` data immediately.
+- ``collector.py`` — the poller: bounded concurrent rounds
+  (utils/fanout), persistent keep-alive connections with
+  If-None-Match/304 polling per target, 2-consecutive-miss confirmation
+  with confirmed-dead backoff, leader-chain failover per slice.
+"""
+
+from gpu_feature_discovery_tpu.fleet.collector import FleetCollector
+from gpu_feature_discovery_tpu.fleet.inventory import (
+    FLEET_SCHEMA_VERSION,
+    FLEET_SNAPSHOT_PATH,
+    InventoryStore,
+    build_inventory,
+    parse_inventory,
+    serialize_inventory,
+)
+from gpu_feature_discovery_tpu.fleet.targets import (
+    SliceTarget,
+    parse_targets_file,
+)
+
+__all__ = [
+    "FLEET_SCHEMA_VERSION",
+    "FLEET_SNAPSHOT_PATH",
+    "FleetCollector",
+    "InventoryStore",
+    "SliceTarget",
+    "build_inventory",
+    "parse_inventory",
+    "parse_targets_file",
+    "serialize_inventory",
+]

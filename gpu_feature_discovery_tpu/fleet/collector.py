@@ -1,0 +1,523 @@
+"""The fleet collector: scrape many slices' leaders, serve one inventory.
+
+One collector per targets epoch (cmd/fleet.py rebuilds it on a targets
+reload). Two faces, the coordinator's exact split:
+
+- **Serving** (obs server handler threads): ``inventory_response`` hands
+  the ``GET /fleet/snapshot`` handler the inventory body serialized once
+  per DISTINCT inventory with a strong ETag — an idle fleet's dashboard
+  polls are 304 header exchanges.
+- **Polling** (the run loop): ``poll_round`` walks every configured
+  slice's leadership chain concurrently on a bounded fan-out pool
+  (utils/fanout.BoundedPool, ``--peer-fanout`` semantics) under a round
+  budget, with every robustness primitive the peer tier established:
+
+  - one persistent keep-alive connection per (slice, chain host), with
+    the single stale-connection retry so reuse never mints a miss;
+  - ``If-None-Match`` per host — an idle slice costs a 304 header
+    exchange, no body, no parse (≥90% of a steady-state round);
+  - 2-consecutive-miss unreachability confirmation per host (earned
+    trust: a host this collector has never reached counts down on its
+    first miss) and confirmed-dead backoff, so a dark slice stops
+    costing a full timeout every round;
+  - leader-chain failover: the chain is walked in worker-id order and
+    the round stops at the first member answering WITH a slice-aggregate
+    section (the derived leader); a live member without one — a
+    partitioned would-be leader — is kept as reachability evidence and
+    the walk continues, exactly like the cohort tier's chain probe.
+
+A slice whose ENTIRE chain is evidence-confirmed dark flips its entry to
+degraded-stale: ``reachable=false, stale=true`` with the last-known data
+and its ``last_seen_unix`` preserved — a dark slice keeps its last
+verdict visible with an honest age instead of vanishing from the pane.
+
+With ``--peer-token`` set the collector sends the shared secret on every
+poll (peering/coordinator.PEER_TOKEN_HEADER — the serving daemons
+require it once configured), and its own ``/fleet/snapshot`` is gated by
+the same token (obs/server.py).
+"""
+
+from __future__ import annotations
+
+import http.client
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any, Callable, Dict, List, Optional
+
+from gpu_feature_discovery_tpu.fleet.inventory import (
+    InventoryStore,
+    build_inventory,
+    serialize_inventory,
+)
+from gpu_feature_discovery_tpu.fleet.targets import SliceTarget
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+# The collector deliberately shares the peer tier's wire vocabulary —
+# the stale-connection set, the host[:port] splitter (one IPv6 policy),
+# the confirmation/backoff constants, the auth header — so the two
+# pollers cannot drift apart on semantics. The FETCH/REACHABILITY shape
+# here intentionally parallels peering/coordinator._poll_peer/_request
+# (the canonical statement of those semantics lives there); a behavioral
+# fix on one side should be mirrored — the coordinator's version carries
+# extra concerns (tier planes, gauge ownership, injected-_fetch seams)
+# that keep a full extraction from paying for itself yet.
+from gpu_feature_discovery_tpu.peering.coordinator import (
+    AUTO_FANOUT_CAP,
+    CONFIRM_POLLS,
+    PEER_BACKOFF_BASE_S,
+    PEER_BACKOFF_CAP_S,
+    PEER_TOKEN_HEADER,
+    STALE_CONN_ERRORS,
+    split_host_port,
+)
+from gpu_feature_discovery_tpu.peering.snapshot import (
+    MAX_SNAPSHOT_BYTES,
+    PEER_SNAPSHOT_PATH,
+    PeerSnapshotError,
+    parse_snapshot,
+)
+from gpu_feature_discovery_tpu.utils.fanout import BoundedPool, Budget
+from gpu_feature_discovery_tpu.utils.retry import BackoffPolicy
+
+log = logging.getLogger("tfd.fleet")
+
+# The dispatch-cutoff grace the peer poller uses: a poll with less than
+# this much budget left is skipped, not started.
+_BUDGET_GRACE_S = 0.05
+
+# Freshness granularity of the published ``last_seen_unix``: quantized so
+# an IDLE fleet's successive rounds produce byte-identical inventory
+# bodies (an exact per-round stamp would re-render the body, bump the
+# generation, and hand every /fleet/snapshot consumer a fresh ETag each
+# round for nothing). The quantum must sit WELL ABOVE the scrape
+# interval or the stamp crosses a boundary most rounds and the idle-
+# fleet 304 economy (and the churn-free state save) never materializes:
+# at the default 10s interval, 5 minutes means ~1 re-render per 30
+# rounds. Dark-slice detection does not ride on this resolution — the
+# ``stale`` flag flips within the confirmation window and the stamp
+# FREEZES at the last success; the age only needs to answer "minutes or
+# days", which 5-minute granularity does.
+LAST_SEEN_QUANTUM_S = 300
+
+
+@dataclass
+class _HostState:
+    """One (slice, chain host)'s reachability + connection state — the
+    peer tier's _PeerState shape, collector-side. Touched only by the
+    single round task a slice gets per round (rounds never overlap a
+    slice with itself), so no lock."""
+
+    host: str
+    port: int
+    consecutive_failures: int = 0
+    ever_reached: bool = False
+    last_snapshot: Optional[Dict[str, Any]] = None
+    next_attempt: float = 0.0
+    backoff_attempt: int = 0
+    conn: Optional[http.client.HTTPConnection] = None
+    etag: Optional[str] = None
+    backoff: BackoffPolicy = field(
+        default_factory=lambda: BackoffPolicy(
+            base=PEER_BACKOFF_BASE_S, cap=PEER_BACKOFF_CAP_S
+        )
+    )
+
+    @property
+    def confirmed_down(self) -> bool:
+        # Earned trust (peering/coordinator._PeerState.confirmed_down):
+        # the 2-poll grace is for ESTABLISHED conversations only.
+        if not self.ever_reached:
+            return self.consecutive_failures >= 1
+        return self.consecutive_failures >= CONFIRM_POLLS
+
+
+@dataclass
+class _SliceState:
+    """One configured slice: its chain hosts' states and the current
+    inventory entry."""
+
+    target: SliceTarget
+    hosts: List[_HostState]
+    entry: Dict[str, Any]
+    restored: bool = False
+
+
+def _blank_entry() -> Dict[str, Any]:
+    return {
+        "reachable": False,
+        "stale": False,
+        "leader": None,
+        "last_seen_unix": None,
+        "healthy_hosts": None,
+        "total_hosts": None,
+        "degraded": None,
+        "sick_chips": None,
+        "mode": None,
+        "generation": None,
+        "restored": False,
+    }
+
+
+class FleetCollector:
+    """See module docstring."""
+
+    def __init__(
+        self,
+        targets: List[SliceTarget],
+        default_port: int = 9101,
+        peer_timeout: float = 2.0,
+        fanout: Optional[int] = None,
+        round_budget: Optional[float] = None,
+        peer_token: str = "",
+        state_dir: str = "",
+        clock: Callable[[], float] = time.monotonic,
+        wall_clock: Callable[[], float] = time.time,
+        backoff_factory: Optional[Callable[[], BackoffPolicy]] = None,
+    ):
+        self.peer_timeout = float(peer_timeout)
+        self.round_budget = (
+            float(round_budget) if round_budget is not None else None
+        )
+        self.peer_token = peer_token or ""
+        self._clock = clock
+        self._wall_clock = wall_clock
+        self._round_offset = 0
+        self._slices: Dict[str, _SliceState] = {}
+        for target in targets:
+            hosts = []
+            for entry in target.chain:
+                host, port = split_host_port(entry, default_port)
+                state = _HostState(host=host, port=port)
+                if backoff_factory is not None:
+                    state.backoff = backoff_factory()
+                hosts.append(state)
+            self._slices[target.name] = _SliceState(
+                target=target, hosts=hosts, entry=_blank_entry()
+            )
+        n = max(1, len(self._slices))
+        self.fanout = (
+            min(AUTO_FANOUT_CAP, n)
+            if not fanout
+            else max(1, min(int(fanout), n))
+        )
+        self._fanout = BoundedPool(self.fanout, name="tfd-fleet-scrape")
+        # Serving-side state (the coordinator's publish/serve split).
+        self._lock = threading.Lock()
+        self._generation = 0
+        self._published: Optional[Dict[str, Dict[str, Any]]] = None
+        self._body: Optional[bytes] = None
+        self._etag: Optional[str] = None
+        self._closed = False
+        # --state-dir: restore last-good entries for slices still in the
+        # targets (a dropped slice's state must not resurrect) and serve
+        # them marked restored until each slice's first live poll.
+        self._store = InventoryStore(state_dir) if state_dir else None
+        self.restored_slices = 0
+        if self._store is not None:
+            persisted = self._store.load()
+            if persisted:
+                for name, entry in persisted.items():
+                    state = self._slices.get(name)
+                    if state is None:
+                        continue
+                    restored = dict(_blank_entry())
+                    restored.update(
+                        {k: entry.get(k) for k in restored if k in entry}
+                    )
+                    restored["restored"] = True
+                    state.entry = restored
+                    state.restored = True
+                    self.restored_slices += 1
+                if self.restored_slices:
+                    log.info(
+                        "serving %d restored slice entries until their "
+                        "first live poll",
+                        self.restored_slices,
+                    )
+        obs_metrics.FLEET_SLICES.set(len(self._slices))
+        self._commit()
+
+    # -- serving side ------------------------------------------------------
+
+    def inventory_response(self) -> "tuple[bytes, str]":
+        """The GET /fleet/snapshot serving hook: cached body + strong
+        ETag, rendered at commit time (never per request)."""
+        with self._lock:
+            return self._body, self._etag
+
+    def inventory_payload(self) -> Dict[str, Any]:
+        with self._lock:
+            return build_inventory(
+                {n: dict(s.entry) for n, s in self._slices.items()},
+                self._generation,
+                any(s.restored for s in self._slices.values()),
+            )
+
+    def _commit(self) -> None:
+        """Publish the current entries: render body/ETag only on a
+        DISTINCT inventory (the 304 economy), refresh the gauges, and
+        persist churn-free."""
+        entries = {n: dict(s.entry) for n, s in self._slices.items()}
+        stale = sum(1 for e in entries.values() if e.get("stale"))
+        restored = any(s.restored for s in self._slices.values())
+        with self._lock:
+            if self._closed:
+                return
+            if self._body is None or entries != self._published:
+                if self._published is not None:
+                    self._generation += 1
+                self._published = entries
+                self._body, self._etag = serialize_inventory(
+                    build_inventory(entries, self._generation, restored)
+                )
+            obs_metrics.FLEET_SLICES_STALE.set(stale)
+            obs_metrics.FLEET_RESTORED.set(1 if restored else 0)
+        if self._store is not None:
+            self._store.save(entries)
+
+    # -- polling side ------------------------------------------------------
+
+    def poll_round(self) -> None:
+        """One scrape round: every slice's chain walk dispatched onto
+        the bounded pool in rotated order (budget skips land on whoever
+        rotation puts last — the peer tier's fairness rule), then one
+        commit."""
+        obs_metrics.FLEET_SCRAPE_ROUNDS.inc()
+        started = time.perf_counter()
+        budget = Budget(self.round_budget, time.perf_counter)
+        names = list(self._slices)
+        offset = self._round_offset % len(names) if names else 0
+        self._round_offset += 1
+        rotated = names[offset:] + names[:offset]
+        self._fanout.run(
+            [
+                partial(self._poll_slice, self._slices[name], budget)
+                for name in rotated
+            ]
+        )
+        self._commit()
+        obs_metrics.FLEET_SCRAPE_DURATION.observe(
+            time.perf_counter() - started
+        )
+
+    def _poll_slice(self, state: _SliceState, budget: Budget) -> None:
+        """Walk one slice's leadership chain. Stops at the first member
+        answering with a slice section (the leader); keeps walking past
+        live-but-sectionless members; a member inside its confirmed-dead
+        backoff window is passed over without a poll."""
+        best_live: Optional[_HostState] = None
+        now = self._clock()
+        for hstate in state.hosts:
+            if hstate.confirmed_down and now < hstate.next_attempt:
+                continue  # backoff window closed; try the next link
+            if budget.spent(_BUDGET_GRACE_S):
+                obs_metrics.FLEET_POLLS.labels(outcome="skipped").inc()
+                log.warning(
+                    "fleet round budget spent; skipping slice %s this "
+                    "round",
+                    state.target.name,
+                )
+                break
+            timeout = self.peer_timeout
+            remaining = budget.remaining()
+            if remaining is not None:
+                timeout = min(timeout, remaining)
+            try:
+                snapshot = self._fetch(hstate, timeout)
+            except Exception as e:  # noqa: BLE001 - any failure = one miss
+                obs_metrics.FLEET_POLLS.labels(outcome="error").inc()
+                self._host_failed(state, hstate, e)
+                continue
+            obs_metrics.FLEET_POLLS.labels(outcome="ok").inc()
+            self._host_succeeded(hstate, snapshot)
+            if snapshot.get("slice") is not None:
+                self._refresh_entry(state, hstate, snapshot)
+                return
+            # Live but aggregateless (a partitioned would-be leader, or
+            # a follower): reachability evidence, keep walking for the
+            # member that actually carries the verdict.
+            if best_live is None:
+                best_live = hstate
+        if best_live is not None:
+            self._refresh_entry(state, best_live, best_live.last_snapshot)
+            return
+        self._mark_unreached(state)
+
+    def _refresh_entry(
+        self,
+        state: _SliceState,
+        hstate: _HostState,
+        snapshot: Dict[str, Any],
+    ) -> None:
+        section = snapshot.get("slice")
+        if section is None:
+            # A live-but-sectionless chain member (the leader missed ONE
+            # poll and a follower answered): reachability evidence only.
+            # The VERDICT fields keep their last-known values — a single
+            # transient leader miss must not null data that even a fully
+            # dark slice keeps (the degraded-stale rule); a slice that
+            # never had a verdict stays at the blank entry's nulls.
+            section = {
+                k: state.entry.get(k)
+                for k in (
+                    "healthy_hosts", "total_hosts", "degraded", "sick_chips"
+                )
+            }
+        state.entry = {
+            "reachable": True,
+            "stale": False,
+            "leader": snapshot.get("hostname"),
+            "last_seen_unix": (
+                int(self._wall_clock())
+                // LAST_SEEN_QUANTUM_S
+                * LAST_SEEN_QUANTUM_S
+            ),
+            "healthy_hosts": section.get("healthy_hosts"),
+            "total_hosts": section.get("total_hosts"),
+            "degraded": section.get("degraded"),
+            "sick_chips": section.get("sick_chips"),
+            "mode": snapshot.get("mode"),
+            "generation": snapshot.get("generation"),
+            "restored": False,
+        }
+        state.restored = False
+
+    def _mark_unreached(self, state: _SliceState) -> None:
+        """No chain member answered this round. Degraded-stale is
+        declared on EVIDENCE — every chain member confirmed down — never
+        on a round that merely ran out of budget or sat out backoff
+        windows."""
+        if not all(h.confirmed_down for h in state.hosts):
+            return
+        if state.entry.get("stale"):
+            return
+        entry = dict(state.entry)
+        entry["reachable"] = False
+        entry["stale"] = True
+        state.entry = entry
+
+    def _host_succeeded(
+        self, hstate: _HostState, snapshot: Dict[str, Any]
+    ) -> None:
+        if hstate.confirmed_down:
+            log.info("fleet target %s reachable again", hstate.host)
+        hstate.consecutive_failures = 0
+        hstate.backoff_attempt = 0
+        hstate.next_attempt = 0.0
+        hstate.ever_reached = True
+        hstate.last_snapshot = snapshot
+
+    def _host_failed(
+        self, state: _SliceState, hstate: _HostState, error: BaseException
+    ) -> None:
+        hstate.consecutive_failures += 1
+        if hstate.confirmed_down:
+            delay = hstate.backoff.delay(min(hstate.backoff_attempt, 63))
+            hstate.backoff_attempt += 1
+            hstate.next_attempt = self._clock() + delay
+            if hstate.consecutive_failures == CONFIRM_POLLS:
+                log.warning(
+                    "slice %s chain member %s confirmed unreachable "
+                    "after %d consecutive failed polls (%s); re-polling "
+                    "under backoff",
+                    state.target.name,
+                    hstate.host,
+                    hstate.consecutive_failures,
+                    error,
+                )
+        else:
+            log.info(
+                "poll of slice %s chain member %s failed (%d/%d before "
+                "confirmation): %s",
+                state.target.name,
+                hstate.host,
+                hstate.consecutive_failures,
+                CONFIRM_POLLS,
+                error,
+            )
+
+    # -- the HTTP fetch (the peer tier's persistent-connection shape) ------
+
+    def _fetch(
+        self, hstate: _HostState, timeout: float
+    ) -> Dict[str, Any]:
+        reused = hstate.conn is not None
+        try:
+            try:
+                return self._request(hstate, timeout)
+            except STALE_CONN_ERRORS:
+                if not reused:
+                    raise
+                # Server closed the idle keep-alive connection between
+                # rounds: connection lifecycle, not slice health — one
+                # retry on a fresh connection before anything counts as
+                # a miss (the peer poller's exact rule).
+                self._drop_connection(hstate)
+                return self._request(hstate, timeout)
+        except Exception:
+            self._drop_connection(hstate)
+            raise
+
+    def _request(
+        self, hstate: _HostState, timeout: float
+    ) -> Dict[str, Any]:
+        with self._lock:
+            # Same closed-gate discipline as the peer poller's _request:
+            # a straggler round racing close() must not reopen a dropped
+            # connection (the constructor does no IO under the lock).
+            if self._closed:
+                raise PeerSnapshotError("collector closed")
+            conn = hstate.conn
+            if conn is None:
+                conn = http.client.HTTPConnection(
+                    hstate.host, hstate.port, timeout=timeout
+                )
+                hstate.conn = conn
+        conn.timeout = timeout
+        if conn.sock is not None:
+            conn.sock.settimeout(timeout)
+        headers = {}
+        if self.peer_token:
+            headers[PEER_TOKEN_HEADER] = self.peer_token
+        if hstate.etag is not None and hstate.last_snapshot is not None:
+            headers["If-None-Match"] = hstate.etag
+        conn.request("GET", PEER_SNAPSHOT_PATH, headers=headers)
+        resp = conn.getresponse()
+        if resp.status == 304:
+            resp.read()
+            obs_metrics.FLEET_SNAPSHOT_NOT_MODIFIED.inc()
+            if hstate.last_snapshot is None:
+                raise PeerSnapshotError("304 with no cached snapshot")
+            return hstate.last_snapshot
+        if resp.status != 200:
+            raise PeerSnapshotError(f"HTTP {resp.status}")
+        body = resp.read(MAX_SNAPSHOT_BYTES + 1)
+        snapshot = parse_snapshot(body)
+        etag = resp.getheader("ETag")
+        hstate.etag = etag if etag else None
+        return snapshot
+
+    @staticmethod
+    def _drop_connection(hstate: _HostState) -> None:
+        conn, hstate.conn = hstate.conn, None
+        if conn is not None:
+            try:
+                conn.close()
+            except OSError:
+                pass
+
+    def close(self) -> None:
+        """Epoch end: retire the pool and every persistent connection,
+        zero this collector's gauges (a targets reload rebuilds the
+        collector — a dropped slice must not stay latched stale)."""
+        with self._lock:
+            self._closed = True
+        self._fanout.shutdown(wait=False)
+        for state in self._slices.values():
+            for hstate in state.hosts:
+                self._drop_connection(hstate)
+        obs_metrics.FLEET_SLICES.set(0)
+        obs_metrics.FLEET_SLICES_STALE.set(0)
+        obs_metrics.FLEET_RESTORED.set(0)

@@ -1,0 +1,204 @@
+"""The fleet inventory wire schema (``GET /fleet/snapshot``) and its
+``--state-dir`` persistence.
+
+Document shape (schema 1)::
+
+    {
+      "schema": 1,               # THIS document's schema
+      "peer_schema": 1,          # the /peer/snapshot schema the
+                                 # collector speaks — the ONE shared
+                                 # constant (peering/snapshot.py
+                                 # PEER_SCHEMA_VERSION); a slice
+                                 # answering with any other version
+                                 # reads as unreachable, never
+                                 # mis-aggregated
+      "generation": 7,           # distinct-inventory counter (an
+                                 # unchanged round keeps body/ETag/
+                                 # generation frozen — the idle fleet's
+                                 # scrape is a header exchange)
+      "restored": false,         # any entry still served from the
+                                 # persisted last-good inventory
+      "slices": {
+        "slice-a": {
+          "reachable": true,     # some leadership-chain member answers
+          "stale": false,        # whole chain confirmed dark -> the
+                                 # entry is last-known data (every
+                                 # field below null = the collector has
+                                 # NEVER reached this slice since it
+                                 # started: a typo'd or decommissioned
+                                 # target, not one that went dark)
+          "leader": "w0",        # the answering chain member's hostname
+          "last_seen_unix": 1722800000,   # wall clock of the last
+                                 # successful poll, quantized (collector
+                                 # LAST_SEEN_QUANTUM_S) so idle rounds
+                                 # keep the body byte-identical;
+                                 # consumers compute age = now - this;
+                                 # null = never reached
+          "healthy_hosts": 4,    # the leader's published slice verdict
+          "total_hosts": 4,      # (null while the answering member
+          "degraded": false,     # serves no slice section — e.g. a
+          "sick_chips": 0,       # partitioned would-be leader)
+          "mode": "full",        # the leader's write mode
+          "generation": 12,      # the leader's snapshot generation
+          "restored": false      # entry restored from --state-dir,
+                                 # cleared by the slice's first live poll
+        }
+      }
+    }
+
+Serialization is the peer layer's exact body format + strong-ETag pair
+(peering/snapshot.serialize_snapshot), rendered once per DISTINCT
+inventory; ``/fleet/snapshot`` answers a matching ``If-None-Match`` with
+``304`` (obs/server.py shares the handler with ``/peer/snapshot``).
+
+Persistence (``InventoryStore``) follows sandbox/state.LabelStateStore:
+versioned JSON through the fsync-before-rename writer, all failures
+contained, corrupt/mismatched documents load as "no state" — a collector
+restart then serves the last-good inventory immediately with
+``restored`` entries until each slice's first live poll replaces it.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import time
+from typing import Any, Dict, Optional
+
+from gpu_feature_discovery_tpu.lm.labels import _write_file_atomically
+from gpu_feature_discovery_tpu.peering.snapshot import (
+    PEER_SCHEMA_VERSION,
+    serialize_snapshot,
+)
+
+log = logging.getLogger("tfd.fleet")
+
+FLEET_SCHEMA_VERSION = 1
+FLEET_SNAPSHOT_PATH = "/fleet/snapshot"
+
+STATE_VERSION = 1
+INVENTORY_FILENAME = "fleet-inventory.json"
+INVENTORY_MODE = 0o644
+
+
+def build_inventory(
+    slices: Dict[str, Dict[str, Any]],
+    generation: int,
+    restored: bool,
+) -> Dict[str, Any]:
+    return {
+        "schema": FLEET_SCHEMA_VERSION,
+        # The one shared constant: the collector parses peer snapshots
+        # through peering/snapshot.parse_snapshot, which rejects any
+        # other version — this field states on the wire which version
+        # that is (tests/test_fleet.py pins the bidirectional guard).
+        "peer_schema": PEER_SCHEMA_VERSION,
+        "generation": int(generation),
+        "restored": bool(restored),
+        "slices": {name: dict(entry) for name, entry in slices.items()},
+    }
+
+
+def serialize_inventory(doc: Dict[str, Any]) -> "tuple[bytes, str]":
+    """Wire body + strong ETag — the peer snapshot's exact economy,
+    reused: one serialization per distinct inventory, 304s for everyone
+    polling an idle fleet."""
+    return serialize_snapshot(doc)
+
+
+def parse_inventory(body: bytes) -> Dict[str, Any]:
+    """Validate one /fleet/snapshot body (dashboard clients, tests).
+    ValueError on anything a consumer cannot trust."""
+    doc = json.loads(body.decode("utf-8"))
+    if not isinstance(doc, dict):
+        raise ValueError("inventory must be an object")
+    if doc.get("schema") != FLEET_SCHEMA_VERSION:
+        raise ValueError(
+            f"unsupported fleet schema {doc.get('schema')!r} "
+            f"(want {FLEET_SCHEMA_VERSION})"
+        )
+    if not isinstance(doc.get("slices"), dict):
+        raise ValueError("inventory slices must be an object")
+    return doc
+
+
+class InventoryStore:
+    """Load/save the last-good fleet inventory under ``--state-dir``.
+    Contained failures, churn-free saves — the LabelStateStore contract
+    (sandbox/state.py), applied to the collector."""
+
+    def __init__(self, state_dir: str):
+        self._dir = state_dir
+        self._path = os.path.join(state_dir, INVENTORY_FILENAME)
+        self._save_warned = False
+        self._last_saved: Optional[Dict[str, Any]] = None
+
+    @property
+    def path(self) -> str:
+        return self._path
+
+    def load(self) -> Optional[Dict[str, Dict[str, Any]]]:
+        """The persisted per-slice entries, or None (absent, unreadable,
+        corrupt, wrong version)."""
+        try:
+            with open(self._path) as f:
+                doc = json.load(f)
+        except FileNotFoundError:
+            return None
+        except (OSError, ValueError) as e:
+            log.warning(
+                "ignoring unreadable fleet state file %s: %s", self._path, e
+            )
+            return None
+        if not isinstance(doc, dict) or doc.get("version") != STATE_VERSION:
+            log.warning(
+                "ignoring fleet state file %s: unsupported version %r",
+                self._path,
+                doc.get("version") if isinstance(doc, dict) else None,
+            )
+            return None
+        slices = doc.get("slices")
+        if not isinstance(slices, dict) or not all(
+            isinstance(k, str) and isinstance(v, dict)
+            for k, v in slices.items()
+        ):
+            log.warning(
+                "ignoring fleet state file %s: slices are not a "
+                "str->object map",
+                self._path,
+            )
+            return None
+        return {name: dict(entry) for name, entry in slices.items()}
+
+    def save(self, slices: Dict[str, Dict[str, Any]]) -> bool:
+        """Persist the per-slice entries atomically; False (after one
+        warning) on failure. Churn-free: an unchanged inventory is not
+        re-fsynced every round."""
+        snapshot = {name: dict(entry) for name, entry in slices.items()}
+        if self._last_saved is not None and snapshot == self._last_saved:
+            return True
+        doc = {
+            "version": STATE_VERSION,
+            "saved_unix": int(time.time()),
+            "slices": snapshot,
+        }
+        try:
+            os.makedirs(self._dir, exist_ok=True)
+            _write_file_atomically(
+                self._path,
+                json.dumps(doc, sort_keys=True).encode(),
+                INVENTORY_MODE,
+            )
+            self._last_saved = snapshot
+            return True
+        except OSError as e:
+            if not self._save_warned:
+                self._save_warned = True
+                log.warning(
+                    "cannot persist fleet inventory to %s: %s "
+                    "(restarts will start cold)",
+                    self._path,
+                    e,
+                )
+            return False

@@ -307,6 +307,62 @@ COHORT_POLL_ROUNDS = REGISTRY.counter(
     "flat mode.",
     labelnames=("tier",),
 )
+# -- fleet aggregation service (fleet/, the fleet-collector mode) -----------
+
+FLEET_SLICES = REGISTRY.gauge(
+    "tfd_fleet_slices",
+    "Slices the fleet collector is configured to scrape (the targets "
+    "file's slice count; re-read on a targets reload).",
+)
+FLEET_SLICES_STALE = REGISTRY.gauge(
+    "tfd_fleet_slices_stale",
+    "Slices whose ENTIRE leadership chain is confirmed dark in the "
+    "collector's current inventory: entries served degraded-stale with "
+    "their last-known data and a staleness age, or all-null for a "
+    "target never reached since the collector started (a typo'd or "
+    "decommissioned slice — null last_seen_unix tells the two apart). "
+    "0 on a healthy fleet.",
+)
+FLEET_POLLS = REGISTRY.counter(
+    "tfd_fleet_polls_total",
+    "Collector /peer/snapshot polls by outcome: ok (valid snapshot or "
+    "304), error (timeout, HTTP failure, junk body, schema mismatch), "
+    "or skipped (the round budget ran out before this target).",
+    labelnames=("outcome",),
+)
+FLEET_SNAPSHOT_NOT_MODIFIED = REGISTRY.counter(
+    "tfd_fleet_snapshot_not_modified_total",
+    "Collector polls answered 304 Not Modified by the slice leader (the "
+    "collector's If-None-Match matched): a header exchange, no body, no "
+    "parse. On an idle fleet this should dominate "
+    "tfd_fleet_polls_total{outcome=\"ok\"}.",
+)
+FLEET_INVENTORY_NOT_MODIFIED = REGISTRY.counter(
+    "tfd_fleet_inventory_not_modified_total",
+    "Inbound /fleet/snapshot requests THIS collector answered 304 Not "
+    "Modified (the consumer's If-None-Match matched the cached inventory "
+    "ETag) — the serving-side twin of the collector's own outbound "
+    "tfd_fleet_snapshot_not_modified_total; the peer-surface counter "
+    "(tfd_peer_snapshot_not_modified_total) never moves on a collector.",
+)
+FLEET_SCRAPE_ROUNDS = REGISTRY.counter(
+    "tfd_fleet_scrape_rounds_total",
+    "Fleet scrape rounds STARTED (one bounded concurrent pass over every "
+    "configured slice's leadership chain).",
+)
+FLEET_SCRAPE_DURATION = REGISTRY.histogram(
+    "tfd_fleet_scrape_round_duration_seconds",
+    "Wall time of each fleet scrape round, whatever its outcomes (a "
+    "round against dark slices contributes its timeouts).",
+)
+FLEET_RESTORED = REGISTRY.gauge(
+    "tfd_fleet_restored",
+    "1 while the served fleet inventory still contains entries restored "
+    "from --state-dir (a collector restart serves last-good data "
+    "immediately; each entry clears on its slice's first live poll), "
+    "else 0.",
+)
+
 HTTP_ERRORS = REGISTRY.counter(
     "tfd_http_errors_total",
     "Introspection endpoint handlers that raised; the response is a 500 "

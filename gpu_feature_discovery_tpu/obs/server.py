@@ -29,7 +29,17 @@ Endpoint semantics:
   poll round is header exchanges only. Served only while slice
   coordination built a coordinator (gated independently of
   ``--debug-endpoints`` — peers depend on it for correctness); 404
-  otherwise.
+  otherwise. With ``--peer-token`` set, requires the shared secret
+  (``X-TFD-Probe-Token`` or ``Authorization: Bearer``, the same
+  ``hmac.compare_digest`` path as ``POST /probe``): missing header 403,
+  wrong token 401 — so the surface can leave the node network without
+  serving inventory to anyone who can reach the port. Unset keeps it
+  open, byte-identical to before.
+- ``/fleet/snapshot`` — the fleet collector's aggregated inventory
+  (fleet/inventory.py), served only by the ``fleet-collector`` mode
+  (cmd/fleet.py) with the same publish-time body/strong-ETag/304
+  machinery and the same ``--peer-token`` gate as ``/peer/snapshot``;
+  404 on ordinary daemons.
 - ``POST /probe`` — on-demand reconcile wake (``--reconcile=event``,
   cmd/events.py): authenticated by the ``--probe-token`` shared secret
   (``X-TFD-Probe-Token`` header or ``Authorization: Bearer``), answers
@@ -37,6 +47,11 @@ Endpoint semantics:
   rate-guards like any other wake. 404 without an event loop, 403
   without a configured token (never unauthenticated — the server is
   node-network exposed), 401 on a mismatch.
+
+``HEAD`` is answered for every GET endpoint with the same status and
+headers (Content-Length states the GET body's size) and no body — load
+balancers in front of an off-node collector probe with HEAD, which used
+to fall through to the 404 path.
 
 An exception inside any endpoint handler answers 500 with the error
 class name (and counts in ``tfd_http_errors_total{endpoint}``) instead
@@ -164,6 +179,7 @@ _KNOWN_ENDPOINTS = (
     "/readyz",
     "/debug/labels",
     "/peer/snapshot",
+    "/fleet/snapshot",
     "/probe",
 )
 
@@ -195,6 +211,8 @@ def _make_handler(
     probe_request: Optional[Callable[[], None]] = None,
     probe_token: str = "",
     peer_fault: Optional[Callable[[str], bool]] = None,
+    peer_token: str = "",
+    fleet_snapshot: Optional[Callable[[], "tuple[bytes, str]"]] = None,
 ):
     class _Handler(BaseHTTPRequestHandler):
         # Content-Length is always sent, so keep-alive is safe.
@@ -219,6 +237,13 @@ def _make_handler(
                     # The connection itself is gone (client hung up
                     # mid-reply); nothing left to answer on.
                     self.close_connection = True
+
+        def do_HEAD(self):  # noqa: N802 - BaseHTTPRequestHandler API
+            # Same dispatch as GET; _reply suppresses the body for HEAD
+            # (Content-Length still states the GET body's size, per
+            # RFC 9110). Load balancers probing /healthz//readyz with
+            # HEAD used to fall through to the 404 path.
+            self.do_GET()
 
         def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
             path = urlsplit(self.path).path
@@ -251,12 +276,8 @@ def _make_handler(
                     403, b"probe endpoint disabled: --probe-token not set\n"
                 )
                 return
-            provided = self.headers.get("X-TFD-Probe-Token", "")
-            auth = self.headers.get("Authorization", "")
-            if not provided and auth.startswith("Bearer "):
-                provided = auth[len("Bearer "):]
             if not hmac.compare_digest(
-                provided.encode(), probe_token.encode()
+                self._provided_token().encode(), probe_token.encode()
             ):
                 self._reply(401, b"unauthorized\n")
                 return
@@ -279,6 +300,54 @@ def _make_handler(
             if length:
                 self.rfile.read(length)
 
+        def _provided_token(self) -> str:
+            """The shared-secret transport both authenticated surfaces
+            (POST /probe, the tokened snapshot endpoints) read:
+            X-TFD-Probe-Token, or an Authorization: Bearer fallback."""
+            provided = self.headers.get("X-TFD-Probe-Token", "")
+            auth = self.headers.get("Authorization", "")
+            if not provided and auth.startswith("Bearer "):
+                provided = auth[len("Bearer "):]
+            return provided
+
+        def _peer_auth_ok(self) -> bool:
+            """--peer-token gate for the snapshot surfaces. True = let
+            the request through (including the unset-token back-compat
+            path); False = a 403/401 was already sent. Missing header is
+            403 (the caller does not know auth is required — name the
+            contract), a wrong token is 401 (same vocabulary as
+            POST /probe's mismatch)."""
+            if not peer_token:
+                # No token configured: the surface stays open on the
+                # node network, byte-identical to the pre-auth wire.
+                return True
+            provided = self._provided_token()
+            if not provided:
+                self._reply(
+                    403, b"peer token required: set --peer-token\n"
+                )
+                return False
+            if not hmac.compare_digest(
+                provided.encode(), peer_token.encode()
+            ):
+                self._reply(401, b"unauthorized\n")
+                return False
+            return True
+
+        def _reply_snapshot(
+            self, body: bytes, etag: "Optional[str]", counter
+        ):
+            """Publish-time-cached body + strong ETag, 304 on a matching
+            If-None-Match — the delta-polling economy both snapshot
+            surfaces share. ``counter`` is the surface's OWN 304 series:
+            a collector's inbound /fleet/snapshot 304s must not inflate
+            the peer-surface counter it never serves."""
+            if etag and self.headers.get("If-None-Match") == etag:
+                counter.inc()
+                self._reply(304, b"", "application/json", etag=etag)
+            else:
+                self._reply(200, body, "application/json", etag=etag)
+
         def _dispatch(self, path: str):
             if path == "/metrics":
                 self._reply(200, registry.render().encode(), CONTENT_TYPE)
@@ -299,17 +368,27 @@ def _make_handler(
                 # correctness, debug introspection is an operator
                 # convenience — an operator turning one off must not
                 # silently partition the slice.
+                if not self._peer_auth_ok():
+                    return
                 if self._peer_fault():
                     return
                 # The hook (SliceCoordinator.snapshot_response) returns
                 # the body serialized at PUBLISH time plus its strong
                 # ETag — this handler never serializes anything.
-                body, etag = peer_snapshot()
-                if etag and self.headers.get("If-None-Match") == etag:
-                    metrics.PEER_SNAPSHOT_NOT_MODIFIED.inc()
-                    self._reply(304, b"", "application/json", etag=etag)
-                else:
-                    self._reply(200, body, "application/json", etag=etag)
+                self._reply_snapshot(
+                    *peer_snapshot(),
+                    counter=metrics.PEER_SNAPSHOT_NOT_MODIFIED,
+                )
+            elif path == "/fleet/snapshot" and fleet_snapshot is not None:
+                # The collector's aggregated inventory, same token gate
+                # and publish-time-cache economy as the peer surface it
+                # is built over.
+                if not self._peer_auth_ok():
+                    return
+                self._reply_snapshot(
+                    *fleet_snapshot(),
+                    counter=metrics.FLEET_INVENTORY_NOT_MODIFIED,
+                )
             else:
                 self._reply(404, b"not found\n")
 
@@ -363,7 +442,11 @@ def _make_handler(
             if etag:
                 self.send_header("ETag", etag)
             self.end_headers()
-            self.wfile.write(body)
+            if self.command != "HEAD":
+                # HEAD gets status + headers only; Content-Length above
+                # deliberately states the GET body's size (RFC 9110) so
+                # a prober can still see what a GET would cost.
+                self.wfile.write(body)
 
         def log_message(self, format, *args):  # noqa: A002 - stdlib name
             log.debug("introspection: %s", format % args)
@@ -425,6 +508,8 @@ class IntrospectionServer:
         probe_request: Optional[Callable[[], None]] = None,
         probe_token: str = "",
         peer_fault: Optional[Callable[[str], bool]] = None,
+        peer_token: str = "",
+        fleet_snapshot: Optional[Callable[[], "tuple[bytes, str]"]] = None,
     ):
         self._httpd = _TrackingHTTPServer(
             (addr, port),
@@ -436,6 +521,8 @@ class IntrospectionServer:
                 probe_request=probe_request,
                 probe_token=probe_token,
                 peer_fault=peer_fault,
+                peer_token=peer_token,
+                fleet_snapshot=fleet_snapshot,
             ),
         )
         self._httpd.daemon_threads = True

@@ -109,6 +109,7 @@ from gpu_feature_discovery_tpu.peering.snapshot import (
     PEER_SNAPSHOT_PATH,
     PeerSnapshotError,
     build_cohort_aggregate,
+    build_slice_section,
     build_snapshot,
     parse_snapshot,
     serialize_snapshot,
@@ -130,12 +131,16 @@ AUTO_FANOUT_CAP = 8
 # when the server closed it between rounds (peer restart, idle reap):
 # retried once on a fresh connection before anything counts as a miss —
 # reuse must never mint failures a fresh-connection poll would not see.
-_STALE_CONN_ERRORS = (
+# Public as STALE_CONN_ERRORS: the fleet collector's fetch applies the
+# same retry-once rule (fleet/collector.py) and must track additions to
+# this set, never hold a stale copy.
+STALE_CONN_ERRORS = (
     http.client.RemoteDisconnected,
     http.client.CannotSendRequest,
     ConnectionResetError,
     BrokenPipeError,
 )
+_STALE_CONN_ERRORS = STALE_CONN_ERRORS
 
 # Consecutive failed polls before a peer counts as unreachable — the
 # same 2-consecutive confirmation the straggler detector uses
@@ -152,6 +157,13 @@ TIER_COHORT = "cohort"    # intra-cohort sibling polls
 TIER_SLICE = "slice"      # slice leader <-> cohort leadership chain
 TIER_DIRECT = "direct"    # degraded-cohort direct-poll fallback
 POLL_TIER_HEADER = "X-TFD-Poll-Tier"
+
+# The /peer/snapshot auth header (--peer-token): deliberately the SAME
+# header POST /probe authenticates with (obs/server.py) — one shared-
+# secret transport for the whole introspection surface, verified through
+# the same hmac.compare_digest path. Sent by this poller and by the
+# fleet collector (fleet/collector.py) whenever a token is configured.
+PEER_TOKEN_HEADER = "X-TFD-Probe-Token"
 
 # Backoff schedule for re-polling a CONFIRMED-dead peer: base one cycle
 # of patience, capped well under the default sleep interval so a healed
@@ -205,6 +217,12 @@ def _split_host_port(entry: str, default_port: int) -> "tuple[str, int]":
     if sep and port.isdigit() and ":" not in host:
         return host, int(port)
     return entry, default_port
+
+
+# Public alias: the fleet collector's targets share the exact
+# host[:port] entry grammar (fleet/collector.py) — one splitter, one
+# IPv6 policy.
+split_host_port = _split_host_port
 
 
 @dataclass
@@ -290,6 +308,7 @@ class SliceCoordinator:
         backoff_factory: Optional[Callable[[], BackoffPolicy]] = None,
         fanout: Optional[int] = None,
         cohort_size: int = 0,
+        peer_token: str = "",
     ):
         if not 0 <= worker_id < len(hostnames):
             raise ValueError(
@@ -307,6 +326,10 @@ class SliceCoordinator:
             float(round_budget) if round_budget is not None else None
         )
         self._clock = clock
+        # Sent on every poll when configured (--peer-token); the serving
+        # side requires it the same way, so a tokened slice keeps
+        # coordinating while anonymous off-node scrapes are rejected.
+        self.peer_token = peer_token or ""
         self._round_offset = 0
         self._backoff_factory = backoff_factory
         self._peers: List[PeerEndpoint] = []
@@ -361,6 +384,10 @@ class SliceCoordinator:
         # publish or snapshot_response call of the epoch.
         self._snapshot_body: Optional[bytes] = None
         self._snapshot_etag: Optional[str] = None
+        # The slice-aggregate wire section (snapshot.build_slice_section)
+        # extracted from the last published PRE-strip label set; None on
+        # followers, so their documents stay byte-identical.
+        self._slice_section: Optional[Dict[str, Any]] = None
         # Flipped by close(): an in-flight round abandoned by an epoch
         # teardown (engine.close does not wait for stragglers) must not
         # reopen connections the teardown just dropped.
@@ -429,6 +456,11 @@ class SliceCoordinator:
             self._generation += 1
             self._local_labels = dict(labels)
             self._local_mode = mode
+            # The slice-aggregate section mirrors what these labels
+            # already published (slice.role=leader only): extracted from
+            # the PRE-strip set, because strip_snapshot_labels removes
+            # the slice family from the snapshot's label map.
+            self._slice_section = build_slice_section(labels)
             self._render_snapshot_locked()
 
     def _render_snapshot_locked(self) -> None:
@@ -439,6 +471,7 @@ class SliceCoordinator:
             self._generation,
             self._local_mode,
             cohort=self._cohort_aggregate,
+            slice_section=self._slice_section,
         )
         self._snapshot_body, self._snapshot_etag = serialize_snapshot(doc)
         obs_metrics.PEER_SNAPSHOT_SERIALIZATIONS.inc()
@@ -465,6 +498,7 @@ class SliceCoordinator:
             mode = self._local_mode
             generation = self._generation
             aggregate = self._cohort_aggregate
+            slice_section = self._slice_section
         return build_snapshot(
             self.worker_id,
             self.hostname,
@@ -472,6 +506,7 @@ class SliceCoordinator:
             generation,
             mode,
             cohort=aggregate,
+            slice_section=slice_section,
         )
 
     def serving_fault(self, tier: str) -> bool:
@@ -1144,6 +1179,8 @@ class SliceCoordinator:
         if conn.sock is not None:
             conn.sock.settimeout(timeout)
         headers = {}
+        if self.peer_token:
+            headers[PEER_TOKEN_HEADER] = self.peer_token
         if state.etag is not None and state.last_snapshot is not None:
             headers["If-None-Match"] = state.etag
         if tier is not None:
@@ -1458,6 +1495,9 @@ def new_slice_coordinator(config, host_info=None) -> Optional[SliceCoordinator]:
         # 0 = flat (single-tier, byte-identical to PR 12); auto = 64
         # once the slice outgrows it (peering/cohort.py).
         cohort_size=effective_cohort_size,
+        # --peer-token: the serving side requires it (obs/server.py), so
+        # this poller must send it or the slice partitions itself.
+        peer_token=tfd.peer_token or "",
     )
     log.info(
         "slice coordination on: worker %d of %d (%s), peer timeout "

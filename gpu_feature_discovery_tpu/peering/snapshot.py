@@ -74,6 +74,16 @@ PEER_SNAPSHOT_PATH = "/peer/snapshot"
 # chain / falls back to direct polls rather than mis-aggregating).
 COHORT_SCHEMA_VERSION = 1
 
+# The embedded slice-aggregate section (the SLICE LEADER's published
+# google.com/tpu.slice.* verdict, mirrored onto the wire for the fleet
+# collector): present exactly while the serving daemon's own written
+# labels say slice.role=leader — the labels themselves stay stripped
+# (module docstring), but an out-of-cluster consumer has no other way to
+# read the slice-wide healthy-hosts/degraded/sick verdict than the
+# leader's snapshot. Versioned independently, forward-rejecting, exactly
+# like the cohort section.
+SLICE_SECTION_SCHEMA_VERSION = 1
+
 # Snapshot documents are small (a label set is ~1-2 KiB); anything
 # larger is junk or an attack surface, same discipline as the broker's
 # MAX_FRAME_BYTES oversize rejection.
@@ -147,6 +157,7 @@ def build_snapshot(
     generation: int,
     mode: Optional[str],
     cohort: Optional[Dict[str, Any]] = None,
+    slice_section: Optional[Dict[str, Any]] = None,
 ) -> Dict[str, Any]:
     stripped = strip_snapshot_labels(labels)
     doc = {
@@ -162,7 +173,47 @@ def build_snapshot(
         # The key is ABSENT (not null) on non-leaders: a flat-mode
         # document must stay byte-identical to the pre-cohort schema.
         doc["cohort"] = cohort
+    if slice_section is not None:
+        # Same absence discipline: only the slice leader's document
+        # carries it, so follower/off documents stay byte-identical.
+        doc["slice"] = slice_section
     return doc
+
+
+def build_slice_section(labels: Dict[str, str]) -> Optional[Dict[str, Any]]:
+    """The slice-aggregate section mirrored from one WRITTEN label set
+    (before stripping): present exactly when these labels carry
+    ``slice.role=leader`` — the section restates what the leader already
+    published on its node, never a separate derivation that could
+    disagree with it. None on followers, partitioned nodes, and
+    coordination-off daemons."""
+    from gpu_feature_discovery_tpu.lm.slice_labeler import (
+        SLICE_DEGRADED_LABEL,
+        SLICE_HEALTHY_HOSTS_LABEL,
+        SLICE_LEADER_LABEL,
+        SLICE_ROLE_LABEL,
+        SLICE_SICK_CHIPS_LABEL,
+        SLICE_TOTAL_HOSTS_LABEL,
+    )
+
+    if labels.get(SLICE_ROLE_LABEL) != "leader":
+        return None
+
+    def _int(key: str) -> Optional[int]:
+        raw = labels.get(key)
+        try:
+            return int(raw) if raw is not None else None
+        except (TypeError, ValueError):
+            return None
+
+    return {
+        "schema": SLICE_SECTION_SCHEMA_VERSION,
+        "leader": str(labels.get(SLICE_LEADER_LABEL, "")),
+        "healthy_hosts": _int(SLICE_HEALTHY_HOSTS_LABEL),
+        "total_hosts": _int(SLICE_TOTAL_HOSTS_LABEL),
+        "degraded": labels.get(SLICE_DEGRADED_LABEL) == "true",
+        "sick_chips": _int(SLICE_SICK_CHIPS_LABEL),
+    }
 
 
 def build_cohort_aggregate(
@@ -233,7 +284,38 @@ def parse_snapshot(body: bytes) -> Dict[str, Any]:
             raise PeerSnapshotError(f"bad chips.{key} {value!r}")
     if "cohort" in doc:
         _validate_cohort(doc["cohort"])
+    if "slice" in doc:
+        _validate_slice_section(doc["slice"])
     return doc
+
+
+def _validate_slice_section(section: Any) -> None:
+    """Validate an embedded slice-aggregate section — the same
+    forward-rejecting discipline as the cohort section: a leader
+    answering with an unknown (newer) section schema reads as
+    unreachable rather than letting the fleet collector mis-read a
+    shape it does not understand."""
+    if not isinstance(section, dict):
+        raise PeerSnapshotError("slice section must be an object")
+    if section.get("schema") != SLICE_SECTION_SCHEMA_VERSION:
+        raise PeerSnapshotError(
+            f"unsupported slice section schema {section.get('schema')!r} "
+            f"(want {SLICE_SECTION_SCHEMA_VERSION})"
+        )
+    if not isinstance(section.get("leader"), str):
+        raise PeerSnapshotError(
+            f"bad slice.leader {section.get('leader')!r}"
+        )
+    if not isinstance(section.get("degraded"), bool):
+        raise PeerSnapshotError(
+            f"bad slice.degraded {section.get('degraded')!r}"
+        )
+    for field in ("healthy_hosts", "total_hosts", "sick_chips"):
+        value = section.get(field)
+        if value is not None and (
+            not isinstance(value, int) or isinstance(value, bool)
+        ):
+            raise PeerSnapshotError(f"bad slice.{field} {value!r}")
 
 
 def _validate_cohort(cohort: Any) -> None:

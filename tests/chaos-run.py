@@ -39,6 +39,13 @@ serving handler, per-worker scoped) and asserts only that cohort
 degrades while the direct-poll fallback keeps healthy-hosts at the full
 slice, recovering when the partition heals (run_tier_partition).
 
+``fleet:slice-dark`` (ISSUE 14) runs a fleet COLLECTOR over three
+hermetic 2-worker slices and kills one slice's entire leadership chain
+for real: its inventory entry must flip to degraded-stale (keeping the
+last-known verdict + staleness stamp) within the 2-miss confirmation
+window while the other slices' entries stay untouched and keep polling
+ok (run_fleet_chaos).
+
 ``reconcile:broker-death`` is likewise not a fault spec: it SIGKILLs the
 long-lived broker worker of an EVENT-mode daemon whose sleep interval is
 pinned at 60s — only the WORKER_DIED wake (cmd/events.py) can explain a
@@ -430,6 +437,130 @@ def run_tier_partition(workdir, timeout_s=None):
     }
 
 
+def run_fleet_chaos(scenario, workdir, timeout_s=None):
+    """fleet:slice-dark (ISSUE 14): a fleet collector over THREE
+    hermetic 2-worker slice fixtures (real supervised daemons, real
+    HTTP). One slice's ENTIRE leadership chain is killed for real (both
+    its daemons' clean shutdown path — their obs servers close, so the
+    collector sees the connection refusals a dead host produces). The
+    contract:
+
+      1. within the 2-consecutive-miss confirmation window, the dark
+         slice's inventory entry flips to degraded-stale (reachable
+         false, stale true) while KEEPING its last-known verdict and a
+         staleness stamp — a dark slice ages on the pane, it never
+         vanishes;
+      2. the other slices' entries are untouched (same leader, same
+         verdict, still live) and their polls keep succeeding;
+      3. the collector itself never errors — tfd_fleet_slices_stale
+         reads exactly 1."""
+    from slice_fixture import SliceHarness
+
+    from gpu_feature_discovery_tpu.fleet import FleetCollector, SliceTarget
+    from gpu_feature_discovery_tpu.lm.slice_labeler import (
+        SLICE_HEALTHY_HOSTS_LABEL,
+        SLICE_ROLE_LABEL,
+    )
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+    if scenario != "slice-dark":
+        raise ValueError(f"unknown fleet chaos scenario {scenario!r}")
+    budget = timeout_s or 60.0
+    started = time.monotonic()
+    harnesses = []
+    collector = None
+    try:
+        for i in range(3):
+            slice_dir = os.path.join(workdir, f"slice-{i}")
+            os.makedirs(slice_dir, exist_ok=True)
+            harnesses.append(
+                SliceHarness(
+                    slice_dir,
+                    workers=2,
+                    sleep_interval="0.05s",
+                    peer_timeout="0.5s",
+                )
+            )
+        for harness in harnesses:
+            harness.start()
+        for i, harness in enumerate(harnesses):
+            harness.wait_for(
+                lambda s: (
+                    s[0].get(SLICE_ROLE_LABEL) == "leader"
+                    and s[0].get(SLICE_HEALTHY_HOSTS_LABEL) == "2"
+                ),
+                timeout=budget,
+                what=f"healthy slice {i}",
+            )
+        targets = [
+            SliceTarget(
+                name=f"slice-{i}",
+                hosts=tuple(
+                    f"127.0.0.1:{w.port}" for w in harness.workers
+                ),
+            )
+            for i, harness in enumerate(harnesses)
+        ]
+        collector = FleetCollector(targets, peer_timeout=0.5)
+        deadline = time.monotonic() + budget
+
+        def entries():
+            return collector.inventory_payload()["slices"]
+
+        while time.monotonic() < deadline:
+            collector.poll_round()
+            if all(
+                e.get("healthy_hosts") == 2 and not e.get("stale")
+                for e in entries().values()
+            ):
+                break
+            time.sleep(0.02)
+        healthy = entries()
+        assert all(
+            e["healthy_hosts"] == 2 and e["reachable"] for e in healthy.values()
+        ), f"collector never saw 3 healthy slices: {healthy}"
+        before = {
+            name: dict(entry)
+            for name, entry in healthy.items()
+            if name != "slice-1"
+        }
+        # The whole leadership chain of slice-1 goes dark: both daemons.
+        harnesses[1].stop()
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            collector.poll_round()
+            if entries()["slice-1"].get("stale"):
+                break
+            time.sleep(0.02)
+        final = entries()
+        dark = final["slice-1"]
+        assert dark["stale"] is True and dark["reachable"] is False, final
+        assert dark["healthy_hosts"] == 2, (
+            f"degraded-stale must keep the last-known verdict: {dark}"
+        )
+        assert dark["last_seen_unix"] is not None, dark
+        for name, entry in before.items():
+            now_entry = final[name]
+            assert now_entry["stale"] is False, final
+            assert now_entry["reachable"] is True, final
+            assert now_entry["healthy_hosts"] == 2, final
+            assert now_entry["leader"] == entry["leader"], final
+        assert obs_metrics.FLEET_SLICES_STALE.value() == 1, (
+            obs_metrics.FLEET_SLICES_STALE.value()
+        )
+    finally:
+        if collector is not None:
+            collector.close()
+        for harness in harnesses:
+            harness.stop()
+    elapsed = time.monotonic() - started
+    return {
+        "spec": f"fleet:{scenario}",
+        "converged_s": round(elapsed, 3),
+        "labels": len(final["slice-1"]),
+    }
+
+
 def run_reconcile_chaos(scenario, workdir, timeout_s=None):
     """reconcile:broker-death (module docstring): kill the broker worker
     under a 60s sleep interval; the event path must recover within 2x
@@ -620,6 +751,13 @@ def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
         # worker; the contract is wake-driven recovery, not fault-spec
         # convergence.
         return run_reconcile_chaos(
+            spec.partition(":")[2], workdir, timeout_s=timeout_s
+        )
+    if spec.startswith("fleet:"):
+        # Fleet-collector chaos (ISSUE 14): a collector over several
+        # hermetic slice fixtures with one slice's whole leadership
+        # chain killed for real.
+        return run_fleet_chaos(
             spec.partition(":")[2], workdir, timeout_s=timeout_s
         )
     chip_faults = any(

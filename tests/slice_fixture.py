@@ -162,6 +162,7 @@ class SliceHarness:
         slow_delay_s=0.0,
         cohort_size=0,
         tier_partitioned_workers=(),
+        peer_token="",
     ):
         """``slow_workers``/``slow_delay_s`` arm the peer.slow behavior
         on SPECIFIC workers' serving surfaces (the chaos slow-peer-storm
@@ -182,7 +183,13 @@ class SliceHarness:
         answering) — per-worker scope for the same process-global
         fault-registry reason as ``slow_workers``; flip
         ``workers[i].coordinator.force_tier_partition`` to heal it
-        mid-scenario."""
+        mid-scenario.
+
+        ``peer_token`` arms the /peer/snapshot shared-secret gate
+        (--peer-token) on every worker's serving side AND its
+        coordinator's poller — the tokened-slice acceptance
+        (tests/test_fleet.py) pins that coordination keeps working
+        while anonymous scrapes are rejected."""
         import os
 
         from gpu_feature_discovery_tpu.config import new_config
@@ -232,6 +239,7 @@ class SliceHarness:
                     "slice-coordination": coordination,
                     "peer-timeout": peer_timeout,
                     "cohort-size": str(cohort_size),
+                    "peer-token": peer_token,
                 },
                 environ={},
             )
@@ -245,6 +253,7 @@ class SliceHarness:
                     round_budget=round_budget,
                     fanout=peer_fanout,
                     cohort_size=cohort_size,
+                    peer_token=peer_token,
                 )
                 if i in slow_workers and slow_delay_s > 0:
                     coordinator.snapshot_response = _slowed(

@@ -75,6 +75,13 @@ CHAOS_SPECS = [
     # OTHER enabled family keeps publishing fresh in every observation,
     # then converge with both families full and clean.
     "pjrt_init.cpu:fail:2",
+    # Fleet aggregation service (ISSUE 14, fleet/): a collector over 3
+    # hermetic 2-worker slice fixtures with ONE slice's entire
+    # leadership chain killed for real — its inventory entry must flip
+    # to degraded-stale (keeping the last-known verdict + staleness
+    # stamp) within the confirmation window while the other slices'
+    # entries stay untouched and keep polling ok.
+    "fleet:slice-dark",
     # Event-driven reconcile loop (cmd/events.py, --reconcile): SIGKILL
     # the long-lived broker worker of an event-mode daemon whose sleep
     # interval is pinned at 60s — only the WORKER_DIED wake can explain
@@ -129,6 +136,11 @@ CHAOS_EXPECTATIONS = {
         "expect_absent": ["node.features/cpu.tfd.degraded"],
         "timeout_s": 60.0,
     },
+    # 6 concurrent daemon loops across 3 slices plus the collector's
+    # own rounds, with TWO full convergence waits (healthy fleet, then
+    # dark-slice confirmation) — the cohort rows' two-wait budget
+    # rationale.
+    "fleet:slice-dark": {"timeout_s": 90.0},
     # Startup (first full cycle + broker spawn) can be slow on a loaded
     # host; the kill-to-recovery bound itself is 2x probe-timeout and
     # asserted INSIDE the driver, not via this budget.

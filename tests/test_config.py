@@ -207,3 +207,17 @@ def test_config_to_dict_redacts_probe_token():
     assert (
         new_config(environ={}).to_dict()["flags"]["tfd"]["probeToken"] == ""
     )
+
+
+def test_config_to_dict_redacts_peer_token():
+    """The /peer/snapshot shared secret (--peer-token, ISSUE 14) gets
+    the exact probeToken redaction contract above — the startup dump
+    must show whether a token exists, never its value."""
+    cfg = new_config(environ={"TFD_PEER_TOKEN": "p33r-secret"})
+    dumped = json.dumps(cfg.to_dict())
+    assert "p33r-secret" not in dumped
+    assert cfg.to_dict()["flags"]["tfd"]["peerToken"] == "<redacted>"
+    assert cfg.flags.tfd.peer_token == "p33r-secret"
+    assert (
+        new_config(environ={}).to_dict()["flags"]["tfd"]["peerToken"] == ""
+    )

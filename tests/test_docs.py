@@ -51,12 +51,30 @@ def test_configuration_doc_covers_every_flag():
 def test_configuration_doc_names_no_phantom_flags():
     """Every `--flag` the doc mentions must exist (catches docs outliving
     a removed/renamed flag)."""
+    from gpu_feature_discovery_tpu.cmd.fleet import FLEET_FLAG_DEFS
+
     doc = read("configuration.md")
     known = {fd.name for fd in FLAG_DEFS} | {
+        fd.name for fd in FLEET_FLAG_DEFS
+    } | {
         "config-file", "version", "output", "mig-strategy"
     }  # --mig-strategy appears only as the reference analog; -o is an alias
     for m in re.finditer(r"`--([a-z][a-z0-9-]*)`", doc):
         assert m.group(1) in known, f"doc names unknown flag --{m.group(1)}"
+
+
+def test_configuration_doc_covers_every_fleet_flag():
+    """The fleet-collector mode's flag table (cmd/fleet.FLEET_FLAG_DEFS)
+    gets the same doc coverage contract as the daemon table."""
+    from gpu_feature_discovery_tpu.cmd.fleet import FLEET_FLAG_DEFS
+
+    doc = read("configuration.md")
+    for fd in FLEET_FLAG_DEFS:
+        assert f"`--{fd.name}`" in doc, (
+            f"fleet flag --{fd.name} undocumented"
+        )
+        for env in fd.env_vars:
+            assert f"`{env}`" in doc, f"env alias {env} undocumented"
 
 
 def test_configuration_doc_config_file_keys_parse(tmp_path):
@@ -241,3 +259,42 @@ def test_cohort_metric_families_are_registered_and_documented():
     assert "Two-tier coordination" in ops
     for label_bit in ("slice.cohort.<i>.degraded", "cohort-leader"):
         assert label_bit in ops
+
+
+def test_fleet_metric_families_are_registered_and_documented():
+    """ISSUE 14 drift guard, both directions and explicit (the cohort
+    guard's anti-vacuity contract): the fleet collector families must
+    exist in the live registry with the right kind AND carry a typed
+    docs/observability.md table row, and the runbook the flags point at
+    must exist."""
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+    expected = {
+        "tfd_fleet_slices": "gauge",
+        "tfd_fleet_slices_stale": "gauge",
+        "tfd_fleet_polls_total": "counter",
+        "tfd_fleet_snapshot_not_modified_total": "counter",
+        "tfd_fleet_inventory_not_modified_total": "counter",
+        "tfd_fleet_scrape_rounds_total": "counter",
+        "tfd_fleet_scrape_round_duration_seconds": "histogram",
+        "tfd_fleet_restored": "gauge",
+    }
+    families = obs_metrics.REGISTRY.families()
+    doc = read("observability.md")
+    for name, kind in expected.items():
+        assert name in families, f"fleet metric {name} missing"
+        assert families[name].kind == kind, name
+        row = next(
+            (
+                line
+                for line in doc.splitlines()
+                if line.startswith(f"| `{name}`")
+            ),
+            "",
+        )
+        assert kind in row, f"{name}: no doc table row stating {kind!r}"
+    assert families["tfd_fleet_polls_total"].labelnames == ("outcome",)
+    ops = read("operations.md")
+    assert "Running the fleet collector" in ops
+    for bit in ("/fleet/snapshot", "--peer-token", "targets"):
+        assert bit in ops, f"fleet runbook missing {bit!r}"

@@ -57,6 +57,7 @@ FILE_KEYS = {
     "reconcile-debounce": ("tfd", "reconcileDebounce"),
     "max-probe-rate": ("tfd", "maxProbeRate"),
     "probe-token": ("tfd", "probeToken"),
+    "peer-token": ("tfd", "peerToken"),
 }
 
 # Two distinct valid raw values per flag (a wins the dominance checks).

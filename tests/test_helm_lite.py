@@ -491,3 +491,116 @@ def test_duplicate_else_fails_loudly(tmp_path):
             "{{ if .Values.a }}A{{ else }}B{{ else if .Values.a }}C{{ end }}\n",
             values="a: 1\n",
         )
+
+
+def test_peer_token_env_is_gated_and_secret_wins():
+    """slice.peerToken follows the probeToken contract: absent by
+    default, inline renders a literal env, and the Secret form wins so
+    the token never lands in the rendered pod spec."""
+    names = [
+        e["name"] for e in _tfd_daemonset(render_chart(CHART))["env"]
+    ]
+    assert "TFD_PEER_TOKEN" not in names
+    env = {
+        e["name"]: e
+        for e in _tfd_daemonset(
+            render_chart(
+                CHART, values_overrides={"slice.peerToken": "inline-tok"}
+            )
+        )["env"]
+    }
+    assert env["TFD_PEER_TOKEN"]["value"] == "inline-tok"
+    env = {
+        e["name"]: e
+        for e in _tfd_daemonset(
+            render_chart(
+                CHART,
+                values_overrides={
+                    "slice.peerToken": "inline-tok",
+                    "slice.peerTokenSecret.name": "peer-secret",
+                },
+            )
+        )["env"]
+    }
+    assert env["TFD_PEER_TOKEN"]["valueFrom"]["secretKeyRef"] == {
+        "name": "peer-secret",
+        "key": "token",
+    }
+
+
+def test_fleet_collector_renders_behind_gate():
+    """fleetCollector.enabled=false (default) renders nothing; enabled
+    renders the Deployment + Service + targets ConfigMap with the
+    collector's env surface and a parseable targets document."""
+    import yaml
+
+    assert not [
+        d
+        for d in render_chart(CHART)
+        if "fleet" in (d.get("metadata", {}).get("name") or "")
+    ]
+    docs = render_chart(
+        CHART,
+        values_overrides={
+            "fleetCollector.enabled": True,
+            "fleetCollector.targets": [
+                {"name": "slice-a", "hosts": ["h0:9101", "h1:9101"]}
+            ],
+            "fleetCollector.peerTokenSecret.name": "fleet-secret",
+        },
+    )
+    fleet = [
+        d
+        for d in docs
+        if "fleet" in (d.get("metadata", {}).get("name") or "")
+    ]
+    assert {d["kind"] for d in fleet} == {
+        "ConfigMap", "Deployment", "Service"
+    }
+    dep = next(d for d in fleet if d["kind"] == "Deployment")
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    assert container["command"][-1] == "fleet-collector"
+    env = {e["name"]: e for e in container["env"]}
+    assert env["TFD_FLEET_TARGETS"]["value"].endswith("targets.yaml")
+    assert env["TFD_METRICS_PORT"]["value"] == "9102"
+    assert env["TFD_PEER_TOKEN"]["valueFrom"]["secretKeyRef"]["name"] == (
+        "fleet-secret"
+    )
+    cm = next(d for d in fleet if d["kind"] == "ConfigMap")
+    parsed = yaml.safe_load(cm["data"]["targets.yaml"])
+    assert parsed == {
+        "version": "v1",
+        "slices": [{"name": "slice-a", "hosts": ["h0:9101", "h1:9101"]}],
+    }
+    # The Service fronts the collector pods on the fleet port.
+    svc = next(d for d in fleet if d["kind"] == "Service")
+    assert svc["spec"]["ports"][0]["port"] == 9102
+    assert (
+        svc["spec"]["selector"]["app.kubernetes.io/component"]
+        == "fleet-collector"
+    )
+    # State volume: emptyDir by default (container-restart durable
+    # only), a PVC when stateClaim names one (rollout-durable restore).
+    vols = {v["name"]: v for v in dep["spec"]["template"]["spec"]["volumes"]}
+    assert vols["fleet-state"] == {"name": "fleet-state", "emptyDir": {}}
+    docs_pvc = render_chart(
+        CHART,
+        values_overrides={
+            "fleetCollector.enabled": True,
+            "fleetCollector.stateClaim": "fleet-pvc",
+        },
+    )
+    dep_pvc = next(
+        d
+        for d in docs_pvc
+        if d.get("kind") == "Deployment"
+        and "fleet" in d["metadata"]["name"]
+    )
+    vols_pvc = {
+        v["name"]: v
+        for v in dep_pvc["spec"]["template"]["spec"]["volumes"]
+    }
+    assert vols_pvc["fleet-state"] == {
+        "name": "fleet-state",
+        "persistentVolumeClaim": {"claimName": "fleet-pvc"},
+    }

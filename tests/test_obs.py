@@ -904,3 +904,75 @@ def test_post_probe_handler_exception_answers_500_and_counts():
         assert obs_metrics.HTTP_ERRORS.value(endpoint="/probe") == 1
     finally:
         server.close()
+
+
+# ---------------------------------------------------------------------------
+# HEAD support (ISSUE 14 satellite): load balancers in front of an
+# off-node collector probe with HEAD — it must answer like GET, bodiless
+# ---------------------------------------------------------------------------
+
+def test_head_answers_every_probe_endpoint():
+    state = IntrospectionState(60.0)
+    server = IntrospectionServer(
+        obs_metrics.REGISTRY, state, addr="127.0.0.1", port=0
+    )
+    server.start()
+    base = f"http://127.0.0.1:{server.port}"
+    try:
+        for path, want in (
+            ("/metrics", 200),
+            ("/healthz", 200),
+            ("/readyz", 503),  # nothing written this epoch yet
+        ):
+            req = urllib.request.Request(base + path, method="HEAD")
+            try:
+                with urllib.request.urlopen(req, timeout=5) as resp:
+                    code, body, headers = (
+                        resp.status, resp.read(), resp.headers
+                    )
+            except urllib.error.HTTPError as e:
+                code, body, headers = e.code, e.read(), e.headers
+            assert code == want, path
+            assert body == b"", f"HEAD {path} must carry no body"
+            # Content-Length states what the GET body would cost.
+            assert int(headers["Content-Length"]) > 0, path
+        state.labels_written({"a": "b"})
+        req = urllib.request.Request(base + "/readyz", method="HEAD")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 200
+            assert resp.read() == b""
+        # And a GET after the HEADs still carries its body (the
+        # suppression is per-request, never latched on the handler).
+        code, body, _ = _get(base + "/healthz")
+        assert code == 200 and body
+    finally:
+        server.close()
+
+
+def test_debug_labels_never_carries_the_tokens():
+    """The /debug/labels provenance dump must not leak the shared
+    secrets the server was configured with (same redaction contract as
+    Config.to_dict's startup dump — pinned in test_config.py)."""
+    state = IntrospectionState(60.0)
+    state.labels_written(
+        {"google.com/tpu.count": "4"},
+        {"device": {"status": "fresh", "duration_ms": 1.0}},
+    )
+    server = IntrospectionServer(
+        obs_metrics.REGISTRY,
+        state,
+        addr="127.0.0.1",
+        port=0,
+        probe_token="probe-s3cret",
+        peer_token="peer-s3cret",
+    )
+    server.start()
+    try:
+        code, body, _ = _get(
+            f"http://127.0.0.1:{server.port}/debug/labels"
+        )
+        assert code == 200
+        assert "probe-s3cret" not in body
+        assert "peer-s3cret" not in body
+    finally:
+        server.close()
