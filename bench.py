@@ -1438,6 +1438,124 @@ def main() -> int:
         file=sys.stderr,
     )
 
+    # Collector federation (ISSUE 15, --upstream-mode=collectors): one
+    # ROOT scrape round over an idle REGION collector. The region's
+    # inventory is frozen between rounds, so after the warm round every
+    # root poll should be a single 304 header exchange per region — the
+    # same economy, one tier up (an idle root round is ~1 304/region).
+    # CI asserts the round p50 and the >= 90% 304 ratio.
+    fed_region = None
+    fed_region_server = None
+    fed_root = None
+    fed_servers = []
+    fed_serving = []
+    try:
+        fed_target_list = []
+        for i in range(4):
+            serving = SliceCoordinator(
+                0, [f"f{i}w0:1", f"f{i}w1:1"], default_port=1,
+                peer_timeout=1.0,
+            )
+            serving.publish_local(
+                {
+                    "google.com/tpu.count": "4",
+                    "google.com/tpu.chips.healthy": "4",
+                    "google.com/tpu.chips.sick": "0",
+                    "google.com/tpu.slice.role": "leader",
+                    "google.com/tpu.slice.leader": f"f{i}w0",
+                    "google.com/tpu.slice.healthy-hosts": "2",
+                    "google.com/tpu.slice.total-hosts": "2",
+                    "google.com/tpu.slice.degraded": "false",
+                    "google.com/tpu.slice.sick-chips": "0",
+                },
+                "full",
+            )
+            server = IntrospectionServer(
+                obs_metrics.REGISTRY,
+                IntrospectionState(60.0),
+                addr="127.0.0.1",
+                port=0,
+                peer_snapshot=serving.snapshot_response,
+            )
+            server.start()
+            fed_serving.append(serving)
+            fed_servers.append(server)
+            fed_target_list.append(
+                SliceTarget(
+                    name=f"fed-slice-{i}",
+                    hosts=(f"127.0.0.1:{server.port}",),
+                )
+            )
+        fed_region = FleetCollector(fed_target_list, peer_timeout=1.0)
+        fed_region.poll_round()  # the region's pane goes live once
+        fed_region_server = IntrospectionServer(
+            obs_metrics.REGISTRY,
+            IntrospectionState(60.0),
+            addr="127.0.0.1",
+            port=0,
+            fleet_snapshot=fed_region.inventory_response,
+        )
+        fed_region_server.start()
+        fed_root = FleetCollector(
+            [
+                SliceTarget(
+                    name="region-0",
+                    hosts=(f"127.0.0.1:{fed_region_server.port}",),
+                )
+            ],
+            peer_timeout=1.0,
+            upstream_mode="collectors",
+        )
+        fed_root.poll_round()  # warm: full body + connection
+        fed_iters = max(
+            3, int(os.environ.get("TFD_BENCH_FLEET_ITERS", "5"))
+        )
+        fed_304_before = obs_metrics.FLEET_SNAPSHOT_NOT_MODIFIED.value()
+        fed_polls_before = sum(
+            obs_metrics.FLEET_POLLS.value(outcome=o)
+            for o in ("ok", "error", "skipped")
+        )
+        fed_rounds_ms = []
+        for _ in range(fed_iters):
+            t0 = time.perf_counter()
+            fed_root.poll_round()
+            fed_rounds_ms.append((time.perf_counter() - t0) * 1e3)
+        fed_304 = (
+            obs_metrics.FLEET_SNAPSHOT_NOT_MODIFIED.value()
+            - fed_304_before
+        )
+        fed_polls = (
+            sum(
+                obs_metrics.FLEET_POLLS.value(outcome=o)
+                for o in ("ok", "error", "skipped")
+            )
+            - fed_polls_before
+        )
+        fleet_federation_round_ms = round(
+            statistics.median(fed_rounds_ms), 3
+        )
+        fleet_federation_not_modified_ratio = round(
+            fed_304 / fed_polls if fed_polls else 0.0, 3
+        )
+    finally:
+        if fed_root is not None:
+            fed_root.close()
+        if fed_region_server is not None:
+            fed_region_server.close()
+        if fed_region is not None:
+            fed_region.close()
+        for server in fed_servers:
+            server.close()
+        for serving in fed_serving:
+            serving.close()
+    print(
+        f"bench: federated root round over 1 idle region (4 slices) "
+        f"p50={fleet_federation_round_ms}ms, 304 ratio "
+        f"{fleet_federation_not_modified_ratio} ({int(fed_304)}/"
+        f"{int(fed_polls)} polls — one header exchange per region)",
+        file=sys.stderr,
+    )
+
     # Event-driven reconcile latency (ISSUE 9): POST /probe on the obs
     # server -> label file mtime change, with the sleep interval at 60s
     # so only the event path (cmd/events.py PROBE_REQUEST wake) can
@@ -1684,6 +1802,10 @@ def main() -> int:
                 "fleet_scrape_round_ms": fleet_scrape_round_ms,
                 "fleet_not_modified_ratio": fleet_not_modified_ratio,
                 "fleet_targets": fleet_targets_n,
+                "fleet_federation_round_ms": fleet_federation_round_ms,
+                "fleet_federation_not_modified_ratio": (
+                    fleet_federation_not_modified_ratio
+                ),
                 "sleep_interval_ms": round(DEFAULT_SLEEP_INTERVAL * 1e3, 3),
                 # Event-driven reconcile acceptance (ISSUE 9): POST
                 # /probe -> label file mtime change against a 60s sleep

@@ -4,12 +4,23 @@ fleet-collector --targets-file fleet.yaml``.
 A long-running out-of-cluster service (one small Deployment, not a
 DaemonSet) built entirely from the repo's existing primitives: the
 collector (fleet/collector.py) scrapes every configured slice's
-leadership chain per round; the obs server (obs/server.py) serves the
+leadership chain per round — or, under ``--upstream-mode=collectors``,
+every configured REGION's collector chain over ``/fleet/snapshot`` (the
+federation root tier); the obs server (obs/server.py) serves the
 aggregated inventory at ``GET /fleet/snapshot`` next to ``/metrics``,
 ``/healthz``, ``/readyz`` on its own server instance; the targets file
-is mtime-watch reloaded through cmd/events.ConfigFileWatcher (edit the
-file, the epoch rebuilds — no restart, exactly like the daemon's config
-watcher); SIGHUP forces the same reload, SIGTERM/SIGINT exit cleanly.
+is stat-triple watched (mtime/size/inode — cmd/events.ConfigFileWatcher,
+so a same-second rewrite by a config-management tool still reloads; edit
+the file, the epoch rebuilds — no restart, exactly like the daemon's
+config watcher); SIGHUP forces the same reload, SIGTERM/SIGINT exit
+cleanly. ``/readyz`` answers 503 until the first scrape round completes
+(or the --state-dir restore served last-good data), so a fresh replica
+behind the HA Service never serves an empty inventory as ready.
+
+With ``--ha-peers``/``--ha-self`` set, an HaMonitor (fleet/ha.py) rides
+the scrape cadence: role re-derived every round against the shared
+ordered list (no election), the standby mirroring the active's
+``/fleet/snapshot`` and publishing the role/divergence gauges.
 
 Flags resolve CLI > env > default (the collector has no config file —
 the targets file IS its config; FLEET_FLAG_DEFS is the one table docs
@@ -27,13 +38,17 @@ from typing import Any, Callable, List, Optional, Sequence
 
 from gpu_feature_discovery_tpu.config.flags import (
     DEFAULT_METRICS_ADDR,
+    DEFAULT_METRICS_PORT,
     DEFAULT_PEER_FANOUT,
     DEFAULT_PEER_TIMEOUT,
     parse_duration,
 )
 from gpu_feature_discovery_tpu.config.spec import (
+    UPSTREAM_COLLECTORS,
+    UPSTREAM_SLICES,
     ConfigError,
     parse_nonneg_int,
+    parse_upstream_mode,
 )
 from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
 from gpu_feature_discovery_tpu.utils import logging as tfd_logging
@@ -72,10 +87,12 @@ FLEET_FLAG_DEFS: List[FleetFlag] = [
         env_vars=("TFD_FLEET_TARGETS",),
         parse=str,
         default="",
-        help="path to the fleet targets file (slice name -> host list, "
-        "fleet/targets.py grammar); REQUIRED — the collector has "
-        "nothing to scrape without it; mtime-watched, so an edit "
-        "reloads the fleet without a restart",
+        help="path to the fleet targets file (target name -> host list, "
+        "fleet/targets.py grammar; slices, or regions under "
+        "--upstream-mode=collectors); REQUIRED — the collector has "
+        "nothing to scrape without it; stat-triple watched "
+        "(mtime/size/inode), so any rewrite — even within the same "
+        "second — reloads the fleet without a restart",
     ),
     FleetFlag(
         name="scrape-interval",
@@ -138,7 +155,47 @@ FLEET_FLAG_DEFS: List[FleetFlag] = [
         help="directory where the last-good fleet inventory is "
         "persisted atomically; a collector restart serves it "
         "immediately with per-slice restored markers until each "
-        "slice's first live poll (empty = disabled)",
+        "slice's first live poll — a restarted ROOT restores per-"
+        "region entries until each region's first live scrape. An HA "
+        "pair may share one directory: saves are atomic renames, so "
+        "the file is last-writer-wins, never torn (empty = disabled)",
+    ),
+    FleetFlag(
+        name="upstream-mode",
+        env_vars=("TFD_FLEET_UPSTREAM_MODE",),
+        parse=parse_upstream_mode,
+        default=UPSTREAM_SLICES,
+        help="what the targets file's entries are: slices (default — "
+        "each entry is one slice's worker list, scraped over "
+        "/peer/snapshot) or collectors (each entry is a REGION whose "
+        "hosts are that region's fleet collectors, scraped over "
+        "/fleet/snapshot and merged under region/<name>/<slice> keys — "
+        "the federation tier; the merged body is itself schema-"
+        "versioned and ETag-cached, so a root is a valid upstream for "
+        "a higher root)",
+    ),
+    FleetFlag(
+        name="ha-peers",
+        env_vars=("TFD_FLEET_HA_PEERS",),
+        parse=str,
+        default="",
+        help="ordered comma-separated host[:port] list of EVERY "
+        "collector in this HA group, identical on every replica; the "
+        "first reachable entry derives itself the active — no "
+        "election, re-derived every round (the slice tier's lowest-"
+        "reachable-id rule). A standby mirrors the active's "
+        "/fleet/snapshot (If-None-Match — an agreeing pair exchanges "
+        "304s) and publishes the tfd_fleet_ha_role/divergence gauges; "
+        "every replica scrapes and serves regardless of role. Empty "
+        "disables HA",
+    ),
+    FleetFlag(
+        name="ha-self",
+        env_vars=("TFD_FLEET_HA_SELF",),
+        parse=str,
+        default="",
+        help="this replica's own entry in --ha-peers, verbatim; "
+        "required exactly when --ha-peers is set",
     ),
 ]
 
@@ -188,20 +245,46 @@ def run_epoch(values: dict, targets, sigs) -> str:
     from gpu_feature_discovery_tpu.cmd import events as reconcile_events
     from gpu_feature_discovery_tpu.cmd.main import _check_signal
     from gpu_feature_discovery_tpu.fleet.collector import FleetCollector
+    from gpu_feature_discovery_tpu.fleet.ha import HaMonitor, parse_ha_peers
     from gpu_feature_discovery_tpu.obs.server import (
         IntrospectionServer,
         IntrospectionState,
     )
 
     interval = values["scrape-interval"]
+    upstream_mode = values["upstream-mode"]
     collector = FleetCollector(
         targets,
+        # Bare target hosts default to the tier they name: slice daemons
+        # serve on the daemon metrics port, region collectors on the
+        # collector port.
+        default_port=(
+            DEFAULT_FLEET_METRICS_PORT
+            if upstream_mode == UPSTREAM_COLLECTORS
+            else DEFAULT_METRICS_PORT
+        ),
         peer_timeout=values["peer-timeout"],
         fanout=values["peer-fanout"] or None,
         round_budget=ROUND_BUDGET_FRACTION * interval,
         peer_token=values["peer-token"],
         state_dir=values["state-dir"],
+        upstream_mode=upstream_mode,
     )
+    ha = None
+    if values["ha-peers"]:
+        ha = HaMonitor(
+            parse_ha_peers(values["ha-peers"]),
+            values["ha-self"],
+            # Bare --ha-peers entries default to THIS collector's own
+            # serving port: the peers are replicas of the same
+            # deployment, so they serve where we serve (an ephemeral
+            # port-0 bind falls back to the collector default).
+            default_port=(
+                values["metrics-port"] or DEFAULT_FLEET_METRICS_PORT
+            ),
+            peer_timeout=values["peer-timeout"],
+            peer_token=values["peer-token"],
+        )
     state = IntrospectionState(interval)
     server = None
     try:
@@ -223,6 +306,8 @@ def run_epoch(values: dict, targets, sigs) -> str:
             values["metrics-port"],
             e,
         )
+        if ha is not None:
+            ha.close()
         collector.close()
         return "error"
     server.start()
@@ -245,7 +330,16 @@ def run_epoch(values: dict, targets, sigs) -> str:
     try:
         while True:
             collector.poll_round()
+            if ha is not None:
+                # Role + standby mirror ride the scrape cadence: the
+                # mirror poll doubles as the active's liveness probe.
+                ha.observe_round(
+                    collector.inventory_payload()["slices"]
+                )
             state.cycle_completed()
+            # /readyz stays 503 until here on a cold start (no state
+            # restore): a fresh replica behind the HA Service must never
+            # serve an empty inventory as ready.
             state.labels_written(_summary(collector), mode="full")
             deadline = time.monotonic() + interval
             while True:
@@ -270,6 +364,8 @@ def run_epoch(values: dict, targets, sigs) -> str:
     finally:
         watcher.stop()
         server.close()
+        if ha is not None:
+            ha.close()
         collector.close()
 
 
@@ -304,9 +400,28 @@ def main(argv: Optional[list] = None) -> int:
                     "TFD_FLEET_TARGETS"
                 )
                 return 1
+            if bool(values["ha-peers"]) != bool(values["ha-self"]):
+                raise ConfigError(
+                    "--ha-peers and --ha-self must be set together "
+                    "(the ordered group AND this replica's entry in it)"
+                )
+            if values["ha-peers"]:
+                # Fail a bad pairing at startup, not mid-epoch: the
+                # monitor re-runs the same validation when built.
+                from gpu_feature_discovery_tpu.fleet.ha import (
+                    parse_ha_peers,
+                )
+
+                if values["ha-self"] not in parse_ha_peers(
+                    values["ha-peers"]
+                ):
+                    raise ConfigError(
+                        f"--ha-self {values['ha-self']!r} is not an "
+                        "entry of --ha-peers"
+                    )
             targets = parse_targets_file(values["targets-file"])
         except ConfigError as e:
-            log.error("unable to load fleet targets: %s", e)
+            log.error("unable to load fleet collector config: %s", e)
             return 1
         if not targets:
             log.warning("targets file names no slices; serving an empty "

@@ -89,6 +89,18 @@ SLICE_COORDINATION_MODES = (
     SLICE_COORDINATION_AUTO,
 )
 
+# Fleet collector upstream modes (fleet/, cmd/fleet.py --upstream-mode):
+# `slices` scrapes each targets-file entry as a slice's worker list over
+# /peer/snapshot (the PR 14 collector, the default); `collectors` treats
+# each entry as a REGION whose hosts are that region's fleet collectors,
+# scraped over /fleet/snapshot and merged under region/<name>/<slice>
+# keys — the federation tier. The merged body is itself schema-versioned
+# and ETag-cached, so a root collector is a valid upstream for a higher
+# root.
+UPSTREAM_SLICES = "slices"
+UPSTREAM_COLLECTORS = "collectors"
+UPSTREAM_MODES = (UPSTREAM_SLICES, UPSTREAM_COLLECTORS)
+
 
 @dataclass
 class ReplicatedResource:
@@ -410,6 +422,19 @@ def parse_cohort_size(value: Any) -> str:
     if n < 0:
         raise ConfigError(f"cohort-size must be >= 0: {value!r}")
     return str(n)
+
+
+def parse_upstream_mode(value: Any) -> str:
+    """Strict ``--upstream-mode`` grammar: ``slices`` | ``collectors``.
+    A typo must fail the collector's startup loudly — scraping the wrong
+    surface would silently serve an empty or mis-shaped pane."""
+    s = str(value).strip().lower()
+    if s not in UPSTREAM_MODES:
+        raise ConfigError(
+            f"invalid upstream-mode {value!r} "
+            f"(want one of {', '.join(UPSTREAM_MODES)})"
+        )
+    return s
 
 
 def parse_fraction(value: Any) -> float:

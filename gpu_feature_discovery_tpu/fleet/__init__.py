@@ -12,18 +12,33 @@ JSON at ``GET /fleet/snapshot`` with the same publish-time
 serialization / strong-ETag / 304 machinery the peer surface uses, so
 one operator pane answers "which slices are schedulable right now".
 
-- ``targets.py`` — the static targets file (slice name -> host list),
-  mtime-watch reloaded through cmd/events.ConfigFileWatcher.
+Because ``/fleet/snapshot`` carries the same schema-versioned,
+ETag-cached discipline as the surface it aggregates, the tier RECURSES:
+``--upstream-mode=collectors`` points the same collector at region
+collectors instead of slice leaders (``collector.py`` federation — the
+ROOT tier, entries merged under ``region/<name>/<slice>`` keys, a dark
+region served degraded-stale), and ``ha.py`` pairs collectors behind one
+Service with role-by-re-derivation and a standby mirror — no election
+protocol at any tier of this system.
+
+- ``targets.py`` — the static targets file (target name -> host list;
+  slices, or regions at the root tier), stat-triple watch reloaded
+  through cmd/events.ConfigFileWatcher.
 - ``inventory.py`` — the ``/fleet/snapshot`` wire schema + the
   ``--state-dir`` persistence so a collector restart serves
-  ``restored`` data immediately.
+  ``restored`` data immediately (per-region at the root tier).
 - ``collector.py`` — the poller: bounded concurrent rounds
   (utils/fanout), persistent keep-alive connections with
   If-None-Match/304 polling per target, 2-consecutive-miss confirmation
-  with confirmed-dead backoff, leader-chain failover per slice.
+  with confirmed-dead backoff, leader-chain failover per slice (chain
+  failover per region at the root tier).
+- ``ha.py`` — the no-election HA monitor: role derived from the shared
+  ordered --ha-peers list, standby mirror of the active's
+  /fleet/snapshot, split-pane divergence gauge.
 """
 
 from gpu_feature_discovery_tpu.fleet.collector import FleetCollector
+from gpu_feature_discovery_tpu.fleet.ha import HaMonitor, parse_ha_peers
 from gpu_feature_discovery_tpu.fleet.inventory import (
     FLEET_SCHEMA_VERSION,
     FLEET_SNAPSHOT_PATH,
@@ -41,9 +56,11 @@ __all__ = [
     "FLEET_SCHEMA_VERSION",
     "FLEET_SNAPSHOT_PATH",
     "FleetCollector",
+    "HaMonitor",
     "InventoryStore",
     "SliceTarget",
     "build_inventory",
+    "parse_ha_peers",
     "parse_inventory",
     "parse_targets_file",
     "serialize_inventory",

@@ -1,4 +1,5 @@
-"""The fleet collector: scrape many slices' leaders, serve one inventory.
+"""The fleet collector: scrape many slices' leaders — or, one tier up,
+many REGION collectors — and serve one inventory.
 
 One collector per targets epoch (cmd/fleet.py rebuilds it on a targets
 reload). Two faces, the coordinator's exact split:
@@ -31,6 +32,23 @@ degraded-stale: ``reachable=false, stale=true`` with the last-known data
 and its ``last_seen_unix`` preserved — a dark slice keeps its last
 verdict visible with an honest age instead of vanishing from the pane.
 
+**Federation** (``--upstream-mode=collectors``, the ROOT tier): the same
+collector, pointed one tier up. Each targets-file entry names a REGION
+and its hosts are that region's collectors (an HA pair is a natural
+chain); the poll walks the chain over ``GET /fleet/snapshot`` instead of
+``/peer/snapshot`` — same persistent keep-alive + If-None-Match (an idle
+root round is ~1 304/region), same 2-miss confirmation + confirmed-dead
+backoff, same bounded fan-out under the round budget, same
+``--peer-token`` on the wire — and MERGES each region's per-slice
+entries VERBATIM under ``region/<name>/<slice>`` keys (plus a ``region``
+attribution field; the federation identity property). A region whose
+whole chain is confirmed dark is marked degraded in the ``regions`` meta
+map and every one of its merged slice entries is served degraded-stale
+with ``last_seen_unix`` preserved — a dark region ages on the pane
+exactly like a dark slice, it never vanishes. The merged body is the
+same schema-versioned, ETag-cached document, so a root is itself a valid
+upstream for a higher root.
+
 With ``--peer-token`` set the collector sends the shared secret on every
 poll (peering/coordinator.PEER_TOKEN_HEADER — the serving daemons
 require it once configured), and its own ``/fleet/snapshot`` is gated by
@@ -47,9 +65,16 @@ from dataclasses import dataclass, field
 from functools import partial
 from typing import Any, Callable, Dict, List, Optional
 
+from gpu_feature_discovery_tpu.config.spec import (
+    UPSTREAM_COLLECTORS,
+    UPSTREAM_SLICES,
+)
 from gpu_feature_discovery_tpu.fleet.inventory import (
+    FLEET_SNAPSHOT_PATH,
+    MAX_INVENTORY_BYTES,
     InventoryStore,
     build_inventory,
+    parse_inventory,
     serialize_inventory,
 )
 from gpu_feature_discovery_tpu.fleet.targets import SliceTarget
@@ -104,10 +129,12 @@ LAST_SEEN_QUANTUM_S = 300
 
 @dataclass
 class _HostState:
-    """One (slice, chain host)'s reachability + connection state — the
+    """One (target, chain host)'s reachability + connection state — the
     peer tier's _PeerState shape, collector-side. Touched only by the
-    single round task a slice gets per round (rounds never overlap a
-    slice with itself), so no lock."""
+    single round task a target gets per round (rounds never overlap a
+    target with itself), so no lock. The HA mirror (fleet/ha.py) reuses
+    this shape for its senior-replica states — one reachability
+    vocabulary across every fleet-tier poller."""
 
     host: str
     port: int
@@ -134,13 +161,17 @@ class _HostState:
 
 
 @dataclass
-class _SliceState:
-    """One configured slice: its chain hosts' states and the current
-    inventory entry."""
+class _TargetState:
+    """One configured target: its chain hosts' states and the current
+    inventory data. In slices mode ``entry`` IS the slice's inventory
+    entry; in collectors mode ``entry`` is the region's meta entry (the
+    ``regions`` map) and ``slices`` holds the merged
+    ``region/<name>/<slice>`` entries."""
 
     target: SliceTarget
     hosts: List[_HostState]
     entry: Dict[str, Any]
+    slices: Dict[str, Dict[str, Any]] = field(default_factory=dict)
     restored: bool = False
 
 
@@ -160,6 +191,101 @@ def _blank_entry() -> Dict[str, Any]:
     }
 
 
+def _blank_region_meta() -> Dict[str, Any]:
+    """A region's meta entry before its collector chain is ever reached
+    — all-null is the same 'never existed vs went dark' discriminator
+    the slice entries carry."""
+    return {
+        "reachable": False,
+        "stale": False,
+        "collector": None,
+        "last_seen_unix": None,
+        "generation": None,
+        "restored": False,
+    }
+
+
+# -- the shared HTTP fetch (the peer tier's persistent-connection shape) ---
+#
+# Both fleet-tier pollers — the collector's chain walk and the HA
+# standby's active mirror (fleet/ha.py) — ride these two functions, so
+# the keep-alive / If-None-Match / stale-retry semantics cannot drift
+# between them. The caller's ``request`` closure owns connection
+# creation (so each poller keeps its own closed-gate discipline).
+
+
+def drop_connection(hstate: _HostState) -> None:
+    conn, hstate.conn = hstate.conn, None
+    if conn is not None:
+        try:
+            conn.close()
+        except OSError:
+            pass
+
+
+def fetch_with_stale_retry(
+    hstate: _HostState, request: Callable[[], Dict[str, Any]]
+) -> Dict[str, Any]:
+    """Run one gated request with the single stale-connection retry: a
+    server closing the idle keep-alive connection between rounds is
+    connection lifecycle, not target health — one retry on a fresh
+    connection before anything counts as a miss (the peer poller's exact
+    rule). Any other failure drops the connection and propagates."""
+    reused = hstate.conn is not None
+    try:
+        try:
+            return request()
+        except STALE_CONN_ERRORS:
+            if not reused:
+                raise
+            drop_connection(hstate)
+            return request()
+    except Exception:
+        drop_connection(hstate)
+        raise
+
+
+def request_snapshot(
+    hstate: _HostState,
+    timeout: float,
+    path: str,
+    parse: Callable[[bytes], Dict[str, Any]],
+    max_bytes: int,
+    token: str = "",
+    not_modified_counter: Any = None,
+) -> Dict[str, Any]:
+    """The wire half of one poll: GET ``path`` on ``hstate``'s existing
+    connection with If-None-Match (a 304 answers from the cached
+    snapshot), the peer token when configured, and a bounded body read
+    through ``parse``. The caller created ``hstate.conn`` under its own
+    closed-gate before calling."""
+    conn = hstate.conn
+    conn.timeout = timeout
+    if conn.sock is not None:
+        conn.sock.settimeout(timeout)
+    headers = {}
+    if token:
+        headers[PEER_TOKEN_HEADER] = token
+    if hstate.etag is not None and hstate.last_snapshot is not None:
+        headers["If-None-Match"] = hstate.etag
+    conn.request("GET", path, headers=headers)
+    resp = conn.getresponse()
+    if resp.status == 304:
+        resp.read()
+        if not_modified_counter is not None:
+            not_modified_counter.inc()
+        if hstate.last_snapshot is None:
+            raise PeerSnapshotError("304 with no cached snapshot")
+        return hstate.last_snapshot
+    if resp.status != 200:
+        raise PeerSnapshotError(f"HTTP {resp.status}")
+    body = resp.read(max_bytes + 1)
+    snapshot = parse(body)
+    etag = resp.getheader("ETag")
+    hstate.etag = etag if etag else None
+    return snapshot
+
+
 class FleetCollector:
     """See module docstring."""
 
@@ -172,10 +298,26 @@ class FleetCollector:
         round_budget: Optional[float] = None,
         peer_token: str = "",
         state_dir: str = "",
+        upstream_mode: str = UPSTREAM_SLICES,
         clock: Callable[[], float] = time.monotonic,
         wall_clock: Callable[[], float] = time.time,
         backoff_factory: Optional[Callable[[], BackoffPolicy]] = None,
     ):
+        if upstream_mode not in (UPSTREAM_SLICES, UPSTREAM_COLLECTORS):
+            raise ValueError(f"unknown upstream mode {upstream_mode!r}")
+        self.upstream_mode = upstream_mode
+        self._federated = upstream_mode == UPSTREAM_COLLECTORS
+        # What one poll fetches and how it parses: slice leaders'
+        # /peer/snapshot, or region collectors' /fleet/snapshot (the
+        # same document this collector serves — federation nests).
+        if self._federated:
+            self._poll_path = FLEET_SNAPSHOT_PATH
+            self._parse = parse_inventory
+            self._max_body = MAX_INVENTORY_BYTES
+        else:
+            self._poll_path = PEER_SNAPSHOT_PATH
+            self._parse = parse_snapshot
+            self._max_body = MAX_SNAPSHOT_BYTES
         self.peer_timeout = float(peer_timeout)
         self.round_budget = (
             float(round_budget) if round_budget is not None else None
@@ -184,7 +326,7 @@ class FleetCollector:
         self._clock = clock
         self._wall_clock = wall_clock
         self._round_offset = 0
-        self._slices: Dict[str, _SliceState] = {}
+        self._slices: Dict[str, _TargetState] = {}
         for target in targets:
             hosts = []
             for entry in target.chain:
@@ -193,8 +335,14 @@ class FleetCollector:
                 if backoff_factory is not None:
                     state.backoff = backoff_factory()
                 hosts.append(state)
-            self._slices[target.name] = _SliceState(
-                target=target, hosts=hosts, entry=_blank_entry()
+            self._slices[target.name] = _TargetState(
+                target=target,
+                hosts=hosts,
+                entry=(
+                    _blank_region_meta()
+                    if self._federated
+                    else _blank_entry()
+                ),
             )
         n = max(1, len(self._slices))
         self.fanout = (
@@ -216,8 +364,47 @@ class FleetCollector:
         self._store = InventoryStore(state_dir) if state_dir else None
         self.restored_slices = 0
         if self._store is not None:
-            persisted = self._store.load()
-            if persisted:
+            persisted, persisted_regions = self._store.load_doc()
+            if persisted and self._federated:
+                # Restore-at-root: persisted region/<name>/<slice> keys
+                # group back under their configured region; each region
+                # serves restored-marked entries until ITS first live
+                # scrape, mirroring the slice-entry restore one tier
+                # down. A region dropped from the targets must not
+                # resurrect.
+                for name, state in self._slices.items():
+                    prefix = f"region/{name}/"
+                    mine = {
+                        k: entry
+                        for k, entry in persisted.items()
+                        if k.startswith(prefix)
+                    }
+                    if not mine:
+                        continue
+                    for key, entry in mine.items():
+                        restored_entry = dict(entry)
+                        restored_entry["restored"] = True
+                        state.slices[key] = restored_entry
+                    meta = _blank_region_meta()
+                    stored_meta = (persisted_regions or {}).get(name) or {}
+                    meta.update(
+                        {
+                            k: stored_meta.get(k)
+                            for k in meta
+                            if k in stored_meta
+                        }
+                    )
+                    meta["restored"] = True
+                    state.entry = meta
+                    state.restored = True
+                    self.restored_slices += 1
+                if self.restored_slices:
+                    log.info(
+                        "serving %d restored region inventories until "
+                        "each region's first live scrape",
+                        self.restored_slices,
+                    )
+            elif persisted:
                 for name, entry in persisted.items():
                     state = self._slices.get(name)
                     if state is None:
@@ -236,7 +423,9 @@ class FleetCollector:
                         "first live poll",
                         self.restored_slices,
                     )
-        obs_metrics.FLEET_SLICES.set(len(self._slices))
+        obs_metrics.FLEET_REGIONS.set(
+            len(self._slices) if self._federated else 0
+        )
         self._commit()
 
     # -- serving side ------------------------------------------------------
@@ -247,35 +436,63 @@ class FleetCollector:
         with self._lock:
             return self._body, self._etag
 
+    def _current_entries(
+        self,
+    ) -> "tuple[Dict[str, Dict[str, Any]], Optional[Dict[str, Dict[str, Any]]]]":
+        """The (slices, regions) pair the inventory publishes: per-slice
+        entries either directly (slices mode) or merged across regions
+        (collectors mode, where the per-target meta becomes the regions
+        map)."""
+        if self._federated:
+            entries: Dict[str, Dict[str, Any]] = {}
+            for state in self._slices.values():
+                entries.update(
+                    {k: dict(v) for k, v in state.slices.items()}
+                )
+            regions = {n: dict(s.entry) for n, s in self._slices.items()}
+            return entries, regions
+        return {n: dict(s.entry) for n, s in self._slices.items()}, None
+
     def inventory_payload(self) -> Dict[str, Any]:
         with self._lock:
+            entries, regions = self._current_entries()
             return build_inventory(
-                {n: dict(s.entry) for n, s in self._slices.items()},
+                entries,
                 self._generation,
                 any(s.restored for s in self._slices.values()),
+                regions=regions,
             )
 
     def _commit(self) -> None:
         """Publish the current entries: render body/ETag only on a
         DISTINCT inventory (the 304 economy), refresh the gauges, and
         persist churn-free."""
-        entries = {n: dict(s.entry) for n, s in self._slices.items()}
+        entries, regions = self._current_entries()
         stale = sum(1 for e in entries.values() if e.get("stale"))
+        regions_stale = (
+            sum(1 for m in regions.values() if m.get("stale"))
+            if regions is not None
+            else 0
+        )
         restored = any(s.restored for s in self._slices.values())
         with self._lock:
             if self._closed:
                 return
-            if self._body is None or entries != self._published:
+            if self._body is None or (entries, regions) != self._published:
                 if self._published is not None:
                     self._generation += 1
-                self._published = entries
+                self._published = (entries, regions)
                 self._body, self._etag = serialize_inventory(
-                    build_inventory(entries, self._generation, restored)
+                    build_inventory(
+                        entries, self._generation, restored, regions=regions
+                    )
                 )
+            obs_metrics.FLEET_SLICES.set(len(entries))
             obs_metrics.FLEET_SLICES_STALE.set(stale)
+            obs_metrics.FLEET_REGIONS_STALE.set(regions_stale)
             obs_metrics.FLEET_RESTORED.set(1 if restored else 0)
         if self._store is not None:
-            self._store.save(entries)
+            self._store.save(entries, regions)
 
     # -- polling side ------------------------------------------------------
 
@@ -293,7 +510,7 @@ class FleetCollector:
         rotated = names[offset:] + names[:offset]
         self._fanout.run(
             [
-                partial(self._poll_slice, self._slices[name], budget)
+                partial(self._poll_target, self._slices[name], budget)
                 for name in rotated
             ]
         )
@@ -302,11 +519,14 @@ class FleetCollector:
             time.perf_counter() - started
         )
 
-    def _poll_slice(self, state: _SliceState, budget: Budget) -> None:
-        """Walk one slice's leadership chain. Stops at the first member
-        answering with a slice section (the leader); keeps walking past
-        live-but-sectionless members; a member inside its confirmed-dead
-        backoff window is passed over without a poll."""
+    def _poll_target(self, state: _TargetState, budget: Budget) -> None:
+        """Walk one target's chain. In slices mode the walk stops at the
+        first member answering with a slice section (the leader), keeps
+        walking past live-but-sectionless members; in collectors mode
+        ANY member serving a valid inventory is authoritative (a region
+        collector either has the region's pane or errors — there is no
+        sectionless middle). A member inside its confirmed-dead backoff
+        window is passed over without a poll."""
         best_live: Optional[_HostState] = None
         now = self._clock()
         for hstate in state.hosts:
@@ -315,7 +535,7 @@ class FleetCollector:
             if budget.spent(_BUDGET_GRACE_S):
                 obs_metrics.FLEET_POLLS.labels(outcome="skipped").inc()
                 log.warning(
-                    "fleet round budget spent; skipping slice %s this "
+                    "fleet round budget spent; skipping target %s this "
                     "round",
                     state.target.name,
                 )
@@ -332,6 +552,9 @@ class FleetCollector:
                 continue
             obs_metrics.FLEET_POLLS.labels(outcome="ok").inc()
             self._host_succeeded(hstate, snapshot)
+            if self._federated:
+                self._refresh_region(state, hstate, snapshot)
+                return
             if snapshot.get("slice") is not None:
                 self._refresh_entry(state, hstate, snapshot)
                 return
@@ -343,11 +566,74 @@ class FleetCollector:
         if best_live is not None:
             self._refresh_entry(state, best_live, best_live.last_snapshot)
             return
-        self._mark_unreached(state)
+        if self._federated:
+            self._mark_region_unreached(state)
+        else:
+            self._mark_unreached(state)
+
+    def _now_quantized(self) -> int:
+        return (
+            int(self._wall_clock())
+            // LAST_SEEN_QUANTUM_S
+            * LAST_SEEN_QUANTUM_S
+        )
+
+    def _refresh_region(
+        self,
+        state: _TargetState,
+        hstate: _HostState,
+        doc: Dict[str, Any],
+    ) -> None:
+        """One region's live scrape: merge its per-slice entries
+        VERBATIM under region/<name>/<slice> keys (only the ``region``
+        attribution field is added — the federation identity property),
+        refresh the region meta, clear the restore regime."""
+        merged: Dict[str, Dict[str, Any]] = {}
+        for sname, sentry in doc.get("slices", {}).items():
+            entry = dict(sentry)
+            prior = entry.get("region")
+            # Nested federation composes the attribution path: a root's
+            # entries arrive already region-stamped by the tier below.
+            entry["region"] = (
+                state.target.name
+                if not prior
+                else f"{state.target.name}/{prior}"
+            )
+            merged[f"region/{state.target.name}/{sname}"] = entry
+        state.slices = merged
+        state.entry = {
+            "reachable": True,
+            "stale": False,
+            "collector": hstate.host,
+            "last_seen_unix": self._now_quantized(),
+            "generation": doc.get("generation"),
+            "restored": False,
+        }
+        state.restored = False
+
+    def _mark_region_unreached(self, state: _TargetState) -> None:
+        """No collector in the region's chain answered this round. Same
+        evidence rule as a dark slice: every chain member confirmed down
+        — never a budget skip or a sat-out backoff window. The region's
+        merged slice entries flip degraded-stale with their data (and
+        ``last_seen_unix``) preserved: partial data beats no data, one
+        tier up."""
+        if not all(h.confirmed_down for h in state.hosts):
+            return
+        if state.entry.get("stale"):
+            return
+        meta = dict(state.entry)
+        meta["reachable"] = False
+        meta["stale"] = True
+        state.entry = meta
+        state.slices = {
+            key: {**entry, "stale": True}
+            for key, entry in state.slices.items()
+        }
 
     def _refresh_entry(
         self,
-        state: _SliceState,
+        state: _TargetState,
         hstate: _HostState,
         snapshot: Dict[str, Any],
     ) -> None:
@@ -369,11 +655,7 @@ class FleetCollector:
             "reachable": True,
             "stale": False,
             "leader": snapshot.get("hostname"),
-            "last_seen_unix": (
-                int(self._wall_clock())
-                // LAST_SEEN_QUANTUM_S
-                * LAST_SEEN_QUANTUM_S
-            ),
+            "last_seen_unix": self._now_quantized(),
             "healthy_hosts": section.get("healthy_hosts"),
             "total_hosts": section.get("total_hosts"),
             "degraded": section.get("degraded"),
@@ -384,7 +666,7 @@ class FleetCollector:
         }
         state.restored = False
 
-    def _mark_unreached(self, state: _SliceState) -> None:
+    def _mark_unreached(self, state: _TargetState) -> None:
         """No chain member answered this round. Degraded-stale is
         declared on EVIDENCE — every chain member confirmed down — never
         on a round that merely ran out of budget or sat out backoff
@@ -410,7 +692,7 @@ class FleetCollector:
         hstate.last_snapshot = snapshot
 
     def _host_failed(
-        self, state: _SliceState, hstate: _HostState, error: BaseException
+        self, state: _TargetState, hstate: _HostState, error: BaseException
     ) -> None:
         hstate.consecutive_failures += 1
         if hstate.confirmed_down:
@@ -419,7 +701,7 @@ class FleetCollector:
             hstate.next_attempt = self._clock() + delay
             if hstate.consecutive_failures == CONFIRM_POLLS:
                 log.warning(
-                    "slice %s chain member %s confirmed unreachable "
+                    "target %s chain member %s confirmed unreachable "
                     "after %d consecutive failed polls (%s); re-polling "
                     "under backoff",
                     state.target.name,
@@ -429,7 +711,7 @@ class FleetCollector:
                 )
         else:
             log.info(
-                "poll of slice %s chain member %s failed (%d/%d before "
+                "poll of target %s chain member %s failed (%d/%d before "
                 "confirmation): %s",
                 state.target.name,
                 hstate.host,
@@ -443,22 +725,9 @@ class FleetCollector:
     def _fetch(
         self, hstate: _HostState, timeout: float
     ) -> Dict[str, Any]:
-        reused = hstate.conn is not None
-        try:
-            try:
-                return self._request(hstate, timeout)
-            except STALE_CONN_ERRORS:
-                if not reused:
-                    raise
-                # Server closed the idle keep-alive connection between
-                # rounds: connection lifecycle, not slice health — one
-                # retry on a fresh connection before anything counts as
-                # a miss (the peer poller's exact rule).
-                self._drop_connection(hstate)
-                return self._request(hstate, timeout)
-        except Exception:
-            self._drop_connection(hstate)
-            raise
+        return fetch_with_stale_retry(
+            hstate, partial(self._request, hstate, timeout)
+        )
 
     def _request(
         self, hstate: _HostState, timeout: float
@@ -469,44 +738,19 @@ class FleetCollector:
             # connection (the constructor does no IO under the lock).
             if self._closed:
                 raise PeerSnapshotError("collector closed")
-            conn = hstate.conn
-            if conn is None:
-                conn = http.client.HTTPConnection(
+            if hstate.conn is None:
+                hstate.conn = http.client.HTTPConnection(
                     hstate.host, hstate.port, timeout=timeout
                 )
-                hstate.conn = conn
-        conn.timeout = timeout
-        if conn.sock is not None:
-            conn.sock.settimeout(timeout)
-        headers = {}
-        if self.peer_token:
-            headers[PEER_TOKEN_HEADER] = self.peer_token
-        if hstate.etag is not None and hstate.last_snapshot is not None:
-            headers["If-None-Match"] = hstate.etag
-        conn.request("GET", PEER_SNAPSHOT_PATH, headers=headers)
-        resp = conn.getresponse()
-        if resp.status == 304:
-            resp.read()
-            obs_metrics.FLEET_SNAPSHOT_NOT_MODIFIED.inc()
-            if hstate.last_snapshot is None:
-                raise PeerSnapshotError("304 with no cached snapshot")
-            return hstate.last_snapshot
-        if resp.status != 200:
-            raise PeerSnapshotError(f"HTTP {resp.status}")
-        body = resp.read(MAX_SNAPSHOT_BYTES + 1)
-        snapshot = parse_snapshot(body)
-        etag = resp.getheader("ETag")
-        hstate.etag = etag if etag else None
-        return snapshot
-
-    @staticmethod
-    def _drop_connection(hstate: _HostState) -> None:
-        conn, hstate.conn = hstate.conn, None
-        if conn is not None:
-            try:
-                conn.close()
-            except OSError:
-                pass
+        return request_snapshot(
+            hstate,
+            timeout,
+            self._poll_path,
+            self._parse,
+            self._max_body,
+            token=self.peer_token,
+            not_modified_counter=obs_metrics.FLEET_SNAPSHOT_NOT_MODIFIED,
+        )
 
     def close(self) -> None:
         """Epoch end: retire the pool and every persistent connection,
@@ -517,7 +761,9 @@ class FleetCollector:
         self._fanout.shutdown(wait=False)
         for state in self._slices.values():
             for hstate in state.hosts:
-                self._drop_connection(hstate)
+                drop_connection(hstate)
         obs_metrics.FLEET_SLICES.set(0)
         obs_metrics.FLEET_SLICES_STALE.set(0)
+        obs_metrics.FLEET_REGIONS.set(0)
+        obs_metrics.FLEET_REGIONS_STALE.set(0)
         obs_metrics.FLEET_RESTORED.set(0)

@@ -46,6 +46,37 @@ Document shape (schema 1)::
       }
     }
 
+Under ``--upstream-mode=collectors`` (the federation tier, collector.py)
+the document additionally carries::
+
+      "upstream": "collectors",  # this inventory is a MERGE of region
+                                 # collectors' /fleet/snapshot bodies
+      "regions": {               # one meta entry per upstream region
+        "us-east": {
+          "reachable": true,     # some collector in the region's chain
+                                 # answers
+          "stale": false,        # whole chain confirmed dark -> every
+                                 # merged slice entry below is served
+                                 # degraded-stale (last-known data,
+                                 # last_seen_unix preserved)
+          "collector": "c0",     # the answering collector host
+          "last_seen_unix": 1722800000,  # quantized, same economy
+          "generation": 9,       # the region inventory's generation
+          "restored": false      # region entries restored from
+                                 # --state-dir, cleared by the region's
+                                 # first live scrape
+        }
+      }
+
+and its ``slices`` keys are ``region/<name>/<slice>`` with a ``region``
+attribution field added to each merged entry — otherwise the entries are
+VERBATIM what the region collector served (the federation identity
+property tests/test_fleet.py pins). Both keys are ABSENT in slices mode,
+so a PR 14 collector's wire stays byte-identical. Because the merged
+body is the same schema-versioned, ETag-cached document, a root
+collector is itself a valid upstream for a higher root (federation
+nests; the region prefix composes).
+
 Serialization is the peer layer's exact body format + strong-ETag pair
 (peering/snapshot.serialize_snapshot), rendered once per DISTINCT
 inventory; ``/fleet/snapshot`` answers a matching ``If-None-Match`` with
@@ -77,6 +108,12 @@ log = logging.getLogger("tfd.fleet")
 FLEET_SCHEMA_VERSION = 1
 FLEET_SNAPSHOT_PATH = "/fleet/snapshot"
 
+# A merged regional inventory is many slices wide — the peer snapshot's
+# 256 KiB cap (one node's labels) is the wrong budget for it. ~4 MiB
+# covers tens of thousands of slice entries while still bounding what a
+# root collector will buffer from one upstream.
+MAX_INVENTORY_BYTES = 4 * 1024 * 1024
+
 STATE_VERSION = 1
 INVENTORY_FILENAME = "fleet-inventory.json"
 INVENTORY_MODE = 0o644
@@ -86,8 +123,9 @@ def build_inventory(
     slices: Dict[str, Dict[str, Any]],
     generation: int,
     restored: bool,
+    regions: Optional[Dict[str, Dict[str, Any]]] = None,
 ) -> Dict[str, Any]:
-    return {
+    doc = {
         "schema": FLEET_SCHEMA_VERSION,
         # The one shared constant: the collector parses peer snapshots
         # through peering/snapshot.parse_snapshot, which rejects any
@@ -98,6 +136,15 @@ def build_inventory(
         "restored": bool(restored),
         "slices": {name: dict(entry) for name, entry in slices.items()},
     }
+    if regions is not None:
+        # The federation tier only: a slices-mode collector's document
+        # must stay byte-identical to the PR 14 wire, so these keys are
+        # ABSENT there, never null.
+        doc["upstream"] = "collectors"
+        doc["regions"] = {
+            name: dict(entry) for name, entry in regions.items()
+        }
+    return doc
 
 
 def serialize_inventory(doc: Dict[str, Any]) -> "tuple[bytes, str]":
@@ -108,8 +155,15 @@ def serialize_inventory(doc: Dict[str, Any]) -> "tuple[bytes, str]":
 
 
 def parse_inventory(body: bytes) -> Dict[str, Any]:
-    """Validate one /fleet/snapshot body (dashboard clients, tests).
-    ValueError on anything a consumer cannot trust."""
+    """Validate one /fleet/snapshot body (the root collector's read
+    surface, the HA mirror, dashboard clients, tests). ValueError on
+    anything a consumer cannot trust — forward-rejecting on schema, the
+    peering parser's exact discipline."""
+    if len(body) > MAX_INVENTORY_BYTES:
+        raise ValueError(
+            f"inventory body {len(body)} bytes exceeds "
+            f"{MAX_INVENTORY_BYTES}"
+        )
     doc = json.loads(body.decode("utf-8"))
     if not isinstance(doc, dict):
         raise ValueError("inventory must be an object")
@@ -118,8 +172,20 @@ def parse_inventory(body: bytes) -> Dict[str, Any]:
             f"unsupported fleet schema {doc.get('schema')!r} "
             f"(want {FLEET_SCHEMA_VERSION})"
         )
-    if not isinstance(doc.get("slices"), dict):
-        raise ValueError("inventory slices must be an object")
+    if not isinstance(doc.get("slices"), dict) or not all(
+        isinstance(k, str) and isinstance(v, dict)
+        for k, v in doc["slices"].items()
+    ):
+        raise ValueError("inventory slices must be a str->object map")
+    regions = doc.get("regions")
+    if regions is not None and (
+        not isinstance(regions, dict)
+        or not all(
+            isinstance(k, str) and isinstance(v, dict)
+            for k, v in regions.items()
+        )
+    ):
+        raise ValueError("inventory regions must be a str->object map")
     return doc
 
 
@@ -141,23 +207,32 @@ class InventoryStore:
     def load(self) -> Optional[Dict[str, Dict[str, Any]]]:
         """The persisted per-slice entries, or None (absent, unreadable,
         corrupt, wrong version)."""
+        slices, _ = self.load_doc()
+        return slices
+
+    def load_doc(
+        self,
+    ) -> "tuple[Optional[Dict[str, Dict[str, Any]]], Optional[Dict[str, Dict[str, Any]]]]":
+        """The persisted ``(slices, regions)`` pair. ``slices`` is None
+        on any unusable file; ``regions`` is None when the state was
+        written by a slices-mode collector (no regions key)."""
         try:
             with open(self._path) as f:
                 doc = json.load(f)
         except FileNotFoundError:
-            return None
+            return None, None
         except (OSError, ValueError) as e:
             log.warning(
                 "ignoring unreadable fleet state file %s: %s", self._path, e
             )
-            return None
+            return None, None
         if not isinstance(doc, dict) or doc.get("version") != STATE_VERSION:
             log.warning(
                 "ignoring fleet state file %s: unsupported version %r",
                 self._path,
                 doc.get("version") if isinstance(doc, dict) else None,
             )
-            return None
+            return None, None
         slices = doc.get("slices")
         if not isinstance(slices, dict) or not all(
             isinstance(k, str) and isinstance(v, dict)
@@ -168,21 +243,45 @@ class InventoryStore:
                 "str->object map",
                 self._path,
             )
-            return None
-        return {name: dict(entry) for name, entry in slices.items()}
+            return None, None
+        regions = doc.get("regions")
+        if not isinstance(regions, dict) or not all(
+            isinstance(k, str) and isinstance(v, dict)
+            for k, v in regions.items()
+        ):
+            # Absent (slices-mode state) or malformed: the per-slice
+            # entries still restore; only the region meta starts blank.
+            regions = None
+        else:
+            regions = {name: dict(entry) for name, entry in regions.items()}
+        return {name: dict(entry) for name, entry in slices.items()}, regions
 
-    def save(self, slices: Dict[str, Dict[str, Any]]) -> bool:
-        """Persist the per-slice entries atomically; False (after one
-        warning) on failure. Churn-free: an unchanged inventory is not
-        re-fsynced every round."""
+    def save(
+        self,
+        slices: Dict[str, Dict[str, Any]],
+        regions: Optional[Dict[str, Dict[str, Any]]] = None,
+    ) -> bool:
+        """Persist the per-slice entries (and, at the federation tier,
+        the per-region meta) atomically; False (after one warning) on
+        failure. Churn-free: an unchanged inventory is not re-fsynced
+        every round. Two HA replicas sharing one --state-dir both call
+        this — the atomic rename makes it last-writer-wins, never a torn
+        file."""
         snapshot = {name: dict(entry) for name, entry in slices.items()}
-        if self._last_saved is not None and snapshot == self._last_saved:
+        region_snapshot = (
+            {name: dict(entry) for name, entry in regions.items()}
+            if regions is not None
+            else None
+        )
+        if self._last_saved == (snapshot, region_snapshot):
             return True
         doc = {
             "version": STATE_VERSION,
             "saved_unix": int(time.time()),
             "slices": snapshot,
         }
+        if region_snapshot is not None:
+            doc["regions"] = region_snapshot
         try:
             os.makedirs(self._dir, exist_ok=True)
             _write_file_atomically(
@@ -190,7 +289,7 @@ class InventoryStore:
                 json.dumps(doc, sort_keys=True).encode(),
                 INVENTORY_MODE,
             )
-            self._last_saved = snapshot
+            self._last_saved = (snapshot, region_snapshot)
             return True
         except OSError as e:
             if not self._save_warned:

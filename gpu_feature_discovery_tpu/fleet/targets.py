@@ -1,11 +1,14 @@
-"""The fleet collector's targets file: which slices to scrape.
+"""The fleet collector's targets file: which slices — or, at the
+federation root tier, which regions' collectors — to scrape.
 
 A static, versioned YAML/JSON document — deliberately the same
 parse-or-ConfigError discipline as the daemon config file
 (config/spec.parse_config_file): a typo must fail the load loudly, never
-silently shrink the fleet the collector watches. The file is mtime-watch
-reloaded (cmd/fleet.py reuses cmd/events.ConfigFileWatcher), so adding a
-slice is an edit, not a restart.
+silently shrink the fleet the collector watches. The file is stat-triple
+watch reloaded (cmd/fleet.py reuses cmd/events.ConfigFileWatcher, which
+fingerprints mtime_ns + size + inode — a rewrite landing within the same
+second, exactly what config-management tools produce, still fires the
+reload), so adding a slice is an edit, not a restart.
 
 Document shape::
 
@@ -23,6 +26,17 @@ derived leader is the lowest reachable worker-id, so the chain walk
 finds it exactly like the cohort tier's chain probe does. Entries may
 carry an explicit ``:port``; bare hosts default to ``default_port``
 (the collector's ``--peer-timeout`` sibling flag surface, cmd/fleet.py).
+
+Under ``--upstream-mode=collectors`` the grammar is UNCHANGED but the
+vocabulary shifts one tier up: each entry names a REGION and its
+``hosts`` are that region's fleet collectors in failover order (an HA
+pair is a natural 2-deep chain) — the root walks them exactly like a
+leadership chain, over ``/fleet/snapshot``::
+
+    version: v1
+    slices:
+      - name: us-east
+        hosts: ["collector-a.us-east:9102", "collector-b.us-east:9102"]
 """
 
 from __future__ import annotations

@@ -311,8 +311,10 @@ COHORT_POLL_ROUNDS = REGISTRY.counter(
 
 FLEET_SLICES = REGISTRY.gauge(
     "tfd_fleet_slices",
-    "Slices the fleet collector is configured to scrape (the targets "
-    "file's slice count; re-read on a targets reload).",
+    "Slices in the served fleet inventory: the targets file's slice "
+    "count in slices mode (re-read on a targets reload), or the merged "
+    "region/<name>/<slice> entry count under --upstream-mode=collectors "
+    "(the federation tier's pane width).",
 )
 FLEET_SLICES_STALE = REGISTRY.gauge(
     "tfd_fleet_slices_stale",
@@ -325,17 +327,19 @@ FLEET_SLICES_STALE = REGISTRY.gauge(
 )
 FLEET_POLLS = REGISTRY.counter(
     "tfd_fleet_polls_total",
-    "Collector /peer/snapshot polls by outcome: ok (valid snapshot or "
-    "304), error (timeout, HTTP failure, junk body, schema mismatch), "
-    "or skipped (the round budget ran out before this target).",
+    "Collector upstream polls (/peer/snapshot in slices mode, "
+    "/fleet/snapshot under --upstream-mode=collectors) by outcome: ok "
+    "(valid snapshot or 304), error (timeout, HTTP failure, junk body, "
+    "schema mismatch), or skipped (the round budget ran out before "
+    "this target).",
     labelnames=("outcome",),
 )
 FLEET_SNAPSHOT_NOT_MODIFIED = REGISTRY.counter(
     "tfd_fleet_snapshot_not_modified_total",
-    "Collector polls answered 304 Not Modified by the slice leader (the "
-    "collector's If-None-Match matched): a header exchange, no body, no "
-    "parse. On an idle fleet this should dominate "
-    "tfd_fleet_polls_total{outcome=\"ok\"}.",
+    "Collector polls answered 304 Not Modified by the upstream (slice "
+    "leader or region collector — the collector's If-None-Match "
+    "matched): a header exchange, no body, no parse. On an idle fleet "
+    "this should dominate tfd_fleet_polls_total{outcome=\"ok\"}.",
 )
 FLEET_INVENTORY_NOT_MODIFIED = REGISTRY.counter(
     "tfd_fleet_inventory_not_modified_total",
@@ -359,8 +363,39 @@ FLEET_RESTORED = REGISTRY.gauge(
     "tfd_fleet_restored",
     "1 while the served fleet inventory still contains entries restored "
     "from --state-dir (a collector restart serves last-good data "
-    "immediately; each entry clears on its slice's first live poll), "
-    "else 0.",
+    "immediately; each entry clears on its slice's first live poll — at "
+    "the federation tier, on its region's first live scrape), else 0.",
+)
+FLEET_REGIONS = REGISTRY.gauge(
+    "tfd_fleet_regions",
+    "Upstream REGION collectors this collector is configured to scrape "
+    "(--upstream-mode=collectors, the federation tier; the targets "
+    "file's entry count there). 0 on a slices-mode collector.",
+)
+FLEET_REGIONS_STALE = REGISTRY.gauge(
+    "tfd_fleet_regions_stale",
+    "Regions whose ENTIRE collector chain is confirmed dark in the root "
+    "collector's current inventory: the region is marked degraded in "
+    "the regions meta map and every one of its merged slice entries is "
+    "served degraded-stale with last_seen_unix preserved. 0 on a "
+    "healthy federation (or in slices mode).",
+)
+FLEET_HA_ROLE = REGISTRY.gauge(
+    "tfd_fleet_ha_role",
+    "1 while this collector derives itself the ACTIVE of its --ha-peers "
+    "group (the first reachable entry of the shared ordered list — "
+    "re-derived every round, no election protocol), 0 while standby. "
+    "Meaningful only with --ha-peers set; both replicas scrape and "
+    "serve regardless of role.",
+)
+FLEET_HA_DIVERGENCE = REGISTRY.gauge(
+    "tfd_fleet_ha_divergence",
+    "Inventory entries differing between this STANDBY's own pane and "
+    "the active's mirrored /fleet/snapshot (volatile fields excluded: "
+    "the quantized freshness stamp and restore markers). 0 on the "
+    "active and on an agreeing pair; a persistently nonzero value is a "
+    "SPLIT PANE — the two collectors see different fleets and an "
+    "operator must diagnose before trusting either.",
 )
 
 HTTP_ERRORS = REGISTRY.counter(

@@ -39,7 +39,11 @@ Endpoint semantics:
   (fleet/inventory.py), served only by the ``fleet-collector`` mode
   (cmd/fleet.py) with the same publish-time body/strong-ETag/304
   machinery and the same ``--peer-token`` gate as ``/peer/snapshot``;
-  404 on ordinary daemons.
+  404 on ordinary daemons. Because the document keeps the same
+  schema-versioned, ETag-cached discipline, it is ALSO a valid upstream:
+  a federation root (``--upstream-mode=collectors``) and an HA standby's
+  mirror both poll this endpoint with If-None-Match, so an idle
+  federated hop is a 304 header exchange too.
 - ``POST /probe`` — on-demand reconcile wake (``--reconcile=event``,
   cmd/events.py): authenticated by the ``--probe-token`` shared secret
   (``X-TFD-Probe-Token`` header or ``Authorization: Bearer``), answers

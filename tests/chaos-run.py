@@ -46,6 +46,18 @@ last-known verdict + staleness stamp) within the 2-miss confirmation
 window while the other slices' entries stay untouched and keep polling
 ok (run_fleet_chaos).
 
+``fleet:region-dark`` (ISSUE 15) runs a ROOT collector
+(--upstream-mode=collectors) over two region collectors and kills one
+region's collector at the wire: the root must serve that region's
+merged slices degraded-stale (verdicts + last_seen_unix preserved,
+regions meta degraded) while the healthy region's entries stay
+byte-identical (run_fleet_region_dark). ``fleet:collector-failover``
+SIGKILLs the ACTIVE of an HA pair — a real fleet-collector subprocess —
+and asserts the in-process standby serves a complete, non-restored
+inventory within one scrape period with zero entries lost, then
+re-derives itself active within the 2-miss window, no election
+(run_fleet_collector_failover).
+
 ``reconcile:broker-death`` is likewise not a fault spec: it SIGKILLs the
 long-lived broker worker of an EVENT-mode daemon whose sleep interval is
 pinned at 60s — only the WORKER_DIED wake (cmd/events.py) can explain a
@@ -463,6 +475,10 @@ def run_fleet_chaos(scenario, workdir, timeout_s=None):
     )
     from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
 
+    if scenario == "region-dark":
+        return run_fleet_region_dark(workdir, timeout_s=timeout_s)
+    if scenario == "collector-failover":
+        return run_fleet_collector_failover(workdir, timeout_s=timeout_s)
     if scenario != "slice-dark":
         raise ValueError(f"unknown fleet chaos scenario {scenario!r}")
     budget = timeout_s or 60.0
@@ -558,6 +574,395 @@ def run_fleet_chaos(scenario, workdir, timeout_s=None):
         "spec": f"fleet:{scenario}",
         "converged_s": round(elapsed, 3),
         "labels": len(final["slice-1"]),
+    }
+
+
+def _fake_slice_leaders(n, prefix):
+    """n in-process slice leaders (SliceCoordinator publishing a healthy
+    2-host verdict + an obs server each) and the SliceTargets naming
+    them — the bench's lightweight fleet fixture, reused so the
+    federation rows can afford two regions without 8 daemon loops."""
+    from gpu_feature_discovery_tpu.fleet import SliceTarget
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+    from gpu_feature_discovery_tpu.obs.server import (
+        IntrospectionServer,
+        IntrospectionState,
+    )
+    from gpu_feature_discovery_tpu.peering import SliceCoordinator
+
+    coords, servers, targets = [], [], []
+    for i in range(n):
+        coord = SliceCoordinator(
+            0, ["h0:1", "h1:1"], default_port=1, peer_timeout=0.5
+        )
+        coord.publish_local(
+            {
+                "google.com/tpu.count": "4",
+                "google.com/tpu.chips.healthy": "4",
+                "google.com/tpu.chips.sick": "0",
+                "google.com/tpu.slice.role": "leader",
+                "google.com/tpu.slice.leader": f"{prefix}{i}w0",
+                "google.com/tpu.slice.healthy-hosts": "2",
+                "google.com/tpu.slice.total-hosts": "2",
+                "google.com/tpu.slice.degraded": "false",
+                "google.com/tpu.slice.sick-chips": "0",
+            },
+            "full",
+        )
+        server = IntrospectionServer(
+            obs_metrics.REGISTRY,
+            IntrospectionState(60.0),
+            addr="127.0.0.1",
+            port=0,
+            peer_snapshot=coord.snapshot_response,
+        )
+        server.start()
+        coords.append(coord)
+        servers.append(server)
+        targets.append(
+            SliceTarget(
+                name=f"{prefix}{i}", hosts=(f"127.0.0.1:{server.port}",)
+            )
+        )
+    return coords, servers, targets
+
+
+def run_fleet_region_dark(workdir, timeout_s=None):
+    """fleet:region-dark (ISSUE 15): a ROOT collector
+    (--upstream-mode=collectors) over TWO region collectors, each
+    scraping its own pair of slice leaders, with region 1's collector
+    killed at the wire. The contract:
+
+      1. within the 2-miss confirmation window the root marks region 1
+         degraded (regions meta: reachable false, stale true) and serves
+         ITS slices degraded-stale — verdicts and last_seen_unix
+         preserved (partial data beats no data, one tier up);
+      2. region 0's merged entries stay byte-identical and keep
+         refreshing (the healthy region is untouched);
+      3. tfd_fleet_regions_stale reads exactly 1 and the root never
+         errors."""
+    from gpu_feature_discovery_tpu.fleet import FleetCollector, SliceTarget
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+    from gpu_feature_discovery_tpu.obs.server import (
+        IntrospectionServer,
+        IntrospectionState,
+    )
+
+    budget = timeout_s or 60.0
+    started = time.monotonic()
+    coords, servers = [], []
+    regions, region_servers = [], []
+    root = None
+    try:
+        # The injected wall clock is pinned so the quantized freshness
+        # stamps cannot straddle a LAST_SEEN_QUANTUM boundary mid-run —
+        # the byte-identity assertion below is about the DARK region's
+        # treatment, not about real-clock quantum crossings.
+        frozen_wall = 1_700_000_000.0
+        region_targets = []
+        for r in range(2):
+            c, s, t = _fake_slice_leaders(2, prefix=f"r{r}s")
+            coords += c
+            servers += s
+            region = FleetCollector(
+                t, peer_timeout=0.5, wall_clock=lambda: frozen_wall
+            )
+            region_server = IntrospectionServer(
+                obs_metrics.REGISTRY,
+                IntrospectionState(60.0),
+                addr="127.0.0.1",
+                port=0,
+                fleet_snapshot=region.inventory_response,
+            )
+            region_server.start()
+            regions.append(region)
+            region_servers.append(region_server)
+            region_targets.append(
+                SliceTarget(
+                    name=f"region-{r}",
+                    hosts=(f"127.0.0.1:{region_server.port}",),
+                )
+            )
+        root = FleetCollector(
+            region_targets,
+            peer_timeout=0.5,
+            upstream_mode="collectors",
+            wall_clock=lambda: frozen_wall,
+        )
+
+        def pane():
+            return root.inventory_payload()
+
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            for region in regions:
+                region.poll_round()
+            root.poll_round()
+            doc = pane()
+            if len(doc["slices"]) == 4 and all(
+                e.get("healthy_hosts") == 2 and not e.get("stale")
+                for e in doc["slices"].values()
+            ):
+                break
+            time.sleep(0.02)
+        healthy = pane()
+        assert len(healthy["slices"]) == 4 and all(
+            e["healthy_hosts"] == 2 for e in healthy["slices"].values()
+        ), f"root never saw 2 healthy regions: {healthy}"
+        before = {k: dict(v) for k, v in healthy["slices"].items()}
+        # Region 1's collector dies at the wire (server + collector).
+        region_servers[1].close()
+        regions[1].close()
+        dark_keys = [k for k in before if k.startswith("region/region-1/")]
+        live_keys = [k for k in before if k.startswith("region/region-0/")]
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            regions[0].poll_round()
+            root.poll_round()
+            doc = pane()
+            if all(doc["slices"][k].get("stale") for k in dark_keys):
+                break
+            time.sleep(0.02)
+        final = pane()
+        for key in dark_keys:
+            dark = final["slices"][key]
+            assert dark["stale"] is True, final
+            assert dark["healthy_hosts"] == 2, (
+                f"degraded-stale must keep the last-known verdict: {dark}"
+            )
+            assert dark["last_seen_unix"] == before[key]["last_seen_unix"], (
+                f"the staleness stamp must freeze, not vanish: {dark}"
+            )
+        meta = final["regions"]["region-1"]
+        assert meta["reachable"] is False and meta["stale"] is True, final
+        for key in live_keys:
+            assert final["slices"][key] == before[key], (
+                f"the healthy region's entries moved: {final['slices'][key]}"
+            )
+        assert final["regions"]["region-0"]["stale"] is False, final
+        assert obs_metrics.FLEET_REGIONS_STALE.value() == 1, (
+            obs_metrics.FLEET_REGIONS_STALE.value()
+        )
+    finally:
+        if root is not None:
+            root.close()
+        for region_server in region_servers:
+            region_server.close()
+        for region in regions:
+            region.close()
+        for server in servers:
+            server.close()
+        for coord in coords:
+            coord.close()
+    elapsed = time.monotonic() - started
+    return {
+        "spec": "fleet:region-dark",
+        "converged_s": round(elapsed, 3),
+        "labels": len(final["slices"]),
+    }
+
+
+def run_fleet_collector_failover(workdir, timeout_s=None):
+    """fleet:collector-failover (ISSUE 15): an HA pair over one fleet —
+    the ACTIVE is a REAL fleet-collector subprocess (SIGKILLed mid-run),
+    the standby runs in-process so its pane and role are assertable. The
+    contract:
+
+      1. while the active serves, the standby derives role=standby and
+         its mirror agrees (divergence 0, 304 header exchanges);
+      2. after SIGKILL, the standby's /fleet/snapshot (over real HTTP)
+         answers a COMPLETE, non-restored inventory within one scrape
+         period — zero slice entries lost or reset, because the standby
+         was scraping independently the whole time;
+      3. within the 2-miss confirmation window the standby re-derives
+         itself active (tfd_fleet_ha_role flips to 1) with no election
+         round, no handoff, no state exchange."""
+    import signal as _signal
+    import subprocess
+    import urllib.request
+
+    import yaml as _yaml
+    from slice_fixture import free_port
+
+    from gpu_feature_discovery_tpu.fleet import FleetCollector, HaMonitor
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+    from gpu_feature_discovery_tpu.obs.server import (
+        IntrospectionServer,
+        IntrospectionState,
+    )
+
+    budget = timeout_s or 60.0
+    scrape_period_s = 0.5
+    started = time.monotonic()
+    coords, servers = [], []
+    standby = None
+    standby_server = None
+    ha = None
+    active = None
+    try:
+        coords, servers, targets = _fake_slice_leaders(3, prefix="s")
+        targets_path = os.path.join(workdir, "targets.yaml")
+        with open(targets_path, "w") as f:
+            _yaml.safe_dump(
+                {
+                    "version": "v1",
+                    "slices": [
+                        {"name": t.name, "hosts": list(t.hosts)}
+                        for t in targets
+                    ],
+                },
+                f,
+            )
+        active_port = free_port()
+        standby_port = free_port()
+        active_addr = f"127.0.0.1:{active_port}"
+        standby_addr = f"127.0.0.1:{standby_port}"
+        ha_peers = f"{active_addr},{standby_addr}"
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+        active = subprocess.Popen(
+            [
+                sys.executable, "-m", "gpu_feature_discovery_tpu",
+                "fleet-collector",
+                "--targets-file", targets_path,
+                "--metrics-addr", "127.0.0.1",
+                "--metrics-port", str(active_port),
+                "--scrape-interval", f"{scrape_period_s}s",
+                "--peer-timeout", "0.5s",
+                "--ha-peers", ha_peers,
+                "--ha-self", active_addr,
+            ],
+            env=env,
+            stdout=subprocess.DEVNULL,
+            stderr=subprocess.DEVNULL,
+        )
+
+        def http_json(url):
+            with urllib.request.urlopen(url, timeout=2) as resp:
+                return resp.status, resp.read()
+
+        # The active is up once its first scrape round flips /readyz.
+        deadline = time.monotonic() + budget
+        ready = False
+        while time.monotonic() < deadline:
+            try:
+                status, _ = http_json(
+                    f"http://127.0.0.1:{active_port}/readyz"
+                )
+                if status == 200:
+                    ready = True
+                    break
+            except OSError:
+                pass
+            time.sleep(0.05)
+        assert ready, "active collector subprocess never became ready"
+
+        standby = FleetCollector(list(targets), peer_timeout=0.5)
+        standby_server = IntrospectionServer(
+            obs_metrics.REGISTRY,
+            IntrospectionState(60.0),
+            addr="127.0.0.1",
+            port=standby_port,
+            fleet_snapshot=standby.inventory_response,
+        )
+        standby_server.start()
+        ha = HaMonitor(
+            [active_addr, standby_addr], standby_addr, peer_timeout=0.5
+        )
+
+        def standby_round():
+            standby.poll_round()
+            return ha.observe_round(
+                standby.inventory_payload()["slices"]
+            )
+
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            role = standby_round()
+            doc = standby.inventory_payload()
+            if (
+                role == "standby"
+                and ha.divergence == 0
+                and len(doc["slices"]) == 3
+                and all(
+                    e.get("healthy_hosts") == 2
+                    for e in doc["slices"].values()
+                )
+            ):
+                break
+            time.sleep(0.05)
+        assert ha.role == "standby", (
+            f"junior replica must derive standby while the active "
+            f"serves: {ha.role}"
+        )
+        assert ha.divergence == 0, (
+            f"the pair must agree before the kill: {ha.divergence}"
+        )
+        mirror_304s = ha.mirror_not_modified.value
+        standby_round()
+        assert ha.mirror_not_modified.value > mirror_304s, (
+            "an agreeing idle pair must exchange 304s on the mirror"
+        )
+        before = {
+            k: dict(v)
+            for k, v in standby.inventory_payload()["slices"].items()
+        }
+        # SIGKILL the active — no shutdown path runs at all.
+        os.kill(active.pid, _signal.SIGKILL)
+        active.wait(timeout=10)
+        killed = time.monotonic()
+        # Within ONE scrape period the standby's served snapshot is a
+        # complete, non-restored inventory: nothing was lost, because
+        # nothing was handed off.
+        time.sleep(scrape_period_s / 2)
+        status, body = http_json(
+            f"http://127.0.0.1:{standby_port}/fleet/snapshot"
+        )
+        assert status == 200
+        import json as _json
+
+        served = _json.loads(body)
+        assert set(served["slices"]) == set(before), (
+            f"entries lost across the failover: {sorted(served['slices'])}"
+        )
+        assert served["restored"] is False, served
+        for name, entry in served["slices"].items():
+            assert entry["healthy_hosts"] == 2, (name, entry)
+            assert entry["restored"] is False, (name, entry)
+            assert entry["stale"] is False, (name, entry)
+        serving_s = time.monotonic() - killed
+        assert serving_s < scrape_period_s + 0.5, serving_s
+        # And the role re-derives within the 2-miss window.
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            if standby_round() == "active":
+                break
+            time.sleep(0.05)
+        assert ha.role == "active", "standby never re-derived active"
+        assert obs_metrics.FLEET_HA_ROLE.value() == 1
+        failover_s = time.monotonic() - killed
+    finally:
+        if active is not None and active.poll() is None:
+            active.kill()
+            active.wait(timeout=10)
+        if ha is not None:
+            ha.close()
+        if standby_server is not None:
+            standby_server.close()
+        if standby is not None:
+            standby.close()
+        for server in servers:
+            server.close()
+        for coord in coords:
+            coord.close()
+    elapsed = time.monotonic() - started
+    return {
+        "spec": "fleet:collector-failover",
+        "converged_s": round(elapsed, 3),
+        "serving_after_kill_s": round(serving_s, 3),
+        "failover_s": round(failover_s, 3),
+        "labels": len(before),
     }
 
 

@@ -323,6 +323,13 @@ class _Evaluator:
             "typeIs": _type_is,
             "eq": lambda a, b: a == b,
             "ne": lambda a, b: a != b,
+            "gt": lambda a, b: a > b,
+            "ge": lambda a, b: a >= b,
+            "lt": lambda a, b: a < b,
+            # sprig int: charts need it before gt/lt because helm's
+            # value pipeline decodes every YAML number as float64 and
+            # text/template refuses float-vs-int comparisons.
+            "int": lambda v: int(float(v)) if v is not None else 0,
             "len": lambda v: len(v) if v is not None else 0,
             "not": lambda v: not _truthy(v),
             "and": lambda *a: next((x for x in a if not _truthy(x)), a[-1]),

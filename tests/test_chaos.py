@@ -82,6 +82,19 @@ CHAOS_SPECS = [
     # stamp) within the confirmation window while the other slices'
     # entries stay untouched and keep polling ok.
     "fleet:slice-dark",
+    # Collector federation + HA (ISSUE 15, fleet/). region-dark: a ROOT
+    # collector (--upstream-mode=collectors) over two region collectors
+    # with one region's collector killed at the wire — only that
+    # region's merged slice entries flip degraded-stale (verdicts +
+    # last_seen_unix preserved, regions meta marked degraded) while the
+    # healthy region's entries stay byte-identical. collector-failover:
+    # SIGKILL the ACTIVE of an HA pair (a real fleet-collector
+    # subprocess) — the in-process standby must serve a complete,
+    # non-restored inventory within one scrape period with zero entries
+    # lost, and re-derive itself active within the 2-miss window (no
+    # election).
+    "fleet:region-dark",
+    "fleet:collector-failover",
     # Event-driven reconcile loop (cmd/events.py, --reconcile): SIGKILL
     # the long-lived broker worker of an event-mode daemon whose sleep
     # interval is pinned at 60s — only the WORKER_DIED wake can explain
@@ -141,6 +154,14 @@ CHAOS_EXPECTATIONS = {
     # dark-slice confirmation) — the cohort rows' two-wait budget
     # rationale.
     "fleet:slice-dark": {"timeout_s": 90.0},
+    # Two region collectors + a root over lightweight in-process slice
+    # leaders: cheap fixtures, but TWO convergence waits (healthy
+    # federation, then dark-region confirmation).
+    "fleet:region-dark": {"timeout_s": 60.0},
+    # The active is a REAL subprocess: interpreter startup + its first
+    # scrape round precede the kill; the post-kill bounds themselves
+    # are asserted inside the driver.
+    "fleet:collector-failover": {"timeout_s": 90.0},
     # Startup (first full cycle + broker spawn) can be slow on a loaded
     # host; the kill-to-recovery bound itself is 2x probe-timeout and
     # asserted INSIDE the driver, not via this budget.

@@ -278,6 +278,12 @@ def test_fleet_metric_families_are_registered_and_documented():
         "tfd_fleet_scrape_rounds_total": "counter",
         "tfd_fleet_scrape_round_duration_seconds": "histogram",
         "tfd_fleet_restored": "gauge",
+        # Federation + HA (ISSUE 15): the tier/HA families must exist
+        # and carry typed rows too.
+        "tfd_fleet_regions": "gauge",
+        "tfd_fleet_regions_stale": "gauge",
+        "tfd_fleet_ha_role": "gauge",
+        "tfd_fleet_ha_divergence": "gauge",
     }
     families = obs_metrics.REGISTRY.families()
     doc = read("observability.md")
@@ -298,3 +304,16 @@ def test_fleet_metric_families_are_registered_and_documented():
     assert "Running the fleet collector" in ops
     for bit in ("/fleet/snapshot", "--peer-token", "targets"):
         assert bit in ops, f"fleet runbook missing {bit!r}"
+    # The federation runbook (ISSUE 15): topology + the three diagnosis
+    # signatures + the two-hop token rollout must all be written down.
+    assert "Federating the fleet plane" in ops
+    for bit in (
+        "--upstream-mode",
+        "--ha-peers",
+        "region/<name>/<slice>",
+        "DARK REGION",
+        "DEAD ROOT",
+        "SPLIT HA PANE",
+        "Token rollout across two hops",
+    ):
+        assert bit in ops, f"federation runbook missing {bit!r}"

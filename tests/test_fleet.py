@@ -690,6 +690,630 @@ def test_last_seen_quantum_dwarfs_the_default_interval():
 
 
 # ---------------------------------------------------------------------------
+# federation (--upstream-mode=collectors): root over region collectors
+# ---------------------------------------------------------------------------
+
+def _serve_slices(n, prefix="s"):
+    """n fake slice leaders (SliceCoordinator + server each) and the
+    SliceTarget list pointing at them."""
+    from gpu_feature_discovery_tpu.fleet import SliceTarget
+
+    coords, servers, targets = [], [], []
+    for i in range(n):
+        coord = SliceCoordinator(
+            0, ["h0:1", "h1:1"], default_port=1, peer_timeout=0.5
+        )
+        coord.publish_local(LEADER_LABELS, "full")
+        server = IntrospectionServer(
+            obs_metrics.REGISTRY,
+            IntrospectionState(60.0),
+            addr="127.0.0.1",
+            port=0,
+            peer_snapshot=coord.snapshot_response,
+        )
+        server.start()
+        coords.append(coord)
+        servers.append(server)
+        targets.append(
+            SliceTarget(
+                name=f"{prefix}{i}", hosts=(f"127.0.0.1:{server.port}",)
+            )
+        )
+    return coords, servers, targets
+
+
+def _serve_region(targets, **kwargs):
+    """A region collector over ``targets`` plus the server exposing its
+    /fleet/snapshot (what a root scrapes)."""
+    region = FleetCollector(targets, peer_timeout=0.5, **kwargs)
+    server = IntrospectionServer(
+        obs_metrics.REGISTRY,
+        IntrospectionState(60.0),
+        addr="127.0.0.1",
+        port=0,
+        fleet_snapshot=region.inventory_response,
+    )
+    server.start()
+    return region, server
+
+
+def _root_over(region_servers, names=None, **kwargs):
+    from gpu_feature_discovery_tpu.fleet import SliceTarget
+
+    targets = [
+        SliceTarget(
+            name=(names[i] if names else f"region-{i}"),
+            hosts=(f"127.0.0.1:{server.port}",),
+        )
+        for i, server in enumerate(region_servers)
+    ]
+    return FleetCollector(
+        targets, peer_timeout=0.5, upstream_mode="collectors", **kwargs
+    )
+
+
+def test_federation_identity_root_equals_direct_collector():
+    """The federation identity property: a root over ONE region
+    collector serves entry-for-entry the same slice inventory as
+    scraping the slices directly — modulo the region/<name>/ key prefix
+    and the added ``region`` attribution field, NOTHING else moves."""
+    coords, servers, targets = _serve_slices(3)
+    region, region_server = _serve_region(targets)
+    root = None
+    try:
+        region.poll_round()
+        direct = region.inventory_payload()
+        root = _root_over([region_server], names=["r0"])
+        root.poll_round()
+        merged = root.inventory_payload()
+        assert merged["upstream"] == "collectors"
+        assert set(merged["slices"]) == {
+            f"region/r0/{name}" for name in direct["slices"]
+        }
+        for name, entry in direct["slices"].items():
+            root_entry = dict(merged["slices"][f"region/r0/{name}"])
+            assert root_entry.pop("region") == "r0"
+            assert root_entry == entry, (name, root_entry, entry)
+        # The region meta rides next to the merged entries.
+        meta = merged["regions"]["r0"]
+        assert meta["reachable"] is True and meta["stale"] is False
+        assert meta["generation"] == direct["generation"]
+        # And the slices-mode document stays byte-free of the new keys
+        # (the PR 14 wire unchanged).
+        assert "upstream" not in direct and "regions" not in direct
+    finally:
+        if root is not None:
+            root.close()
+        region_server.close()
+        region.close()
+        for s in servers:
+            s.close()
+        for c in coords:
+            c.close()
+
+
+def test_federated_idle_round_is_304_header_exchange():
+    """An idle root round is ~1 304 per region: the If-None-Match
+    economy holds across the /fleet/snapshot hop too, and the root's
+    own body/ETag stay frozen (federation nests)."""
+    coords, servers, targets = _serve_slices(2)
+    region, region_server = _serve_region(targets)
+    root = None
+    try:
+        region.poll_round()
+        root = _root_over([region_server])
+        root.poll_round()
+        body1, etag1 = root.inventory_response()
+        before = obs_metrics.FLEET_SNAPSHOT_NOT_MODIFIED.value()
+        root.poll_round()
+        assert (
+            obs_metrics.FLEET_SNAPSHOT_NOT_MODIFIED.value() == before + 1
+        )
+        assert root.inventory_response() == (body1, etag1)
+        parse_inventory(body1)  # the merged body is a valid upstream
+    finally:
+        if root is not None:
+            root.close()
+        region_server.close()
+        region.close()
+        for s in servers:
+            s.close()
+        for c in coords:
+            c.close()
+
+
+def test_dark_region_served_degraded_stale_with_age_preserved():
+    """A region whose whole collector chain is confirmed dark keeps its
+    merged slice entries on the root pane — flipped stale with their
+    verdicts and last_seen_unix preserved — while a healthy region's
+    entries stay byte-identical."""
+    coords, servers, targets = _serve_slices(2)
+    # Frozen wall clock: the byte-identity assertion below must not
+    # flake on a real-clock LAST_SEEN_QUANTUM boundary crossing.
+    frozen = {"wall_clock": lambda: 1_700_000_000.0}
+    region_a, server_a = _serve_region(targets[:1], **frozen)
+    region_b, server_b = _serve_region(targets[1:], **frozen)
+    root = None
+    try:
+        region_a.poll_round()
+        region_b.poll_round()
+        root = _root_over(
+            [server_a, server_b], names=["ra", "rb"], **frozen
+        )
+        root.poll_round()
+        before = root.inventory_payload()
+        assert before["slices"]["region/ra/s0"]["stale"] is False
+        healthy_before = dict(before["slices"]["region/rb/s1"])
+        # Region A's only collector dies at the wire.
+        server_a.close()
+        region_a.close()
+        for _ in range(3):  # 2-miss confirmation + one commit
+            root.poll_round()
+        doc = root.inventory_payload()
+        meta = doc["regions"]["ra"]
+        assert meta["reachable"] is False and meta["stale"] is True
+        assert meta["last_seen_unix"] is not None
+        dark = doc["slices"]["region/ra/s0"]
+        assert dark["stale"] is True
+        assert dark["healthy_hosts"] == 2
+        assert (
+            dark["last_seen_unix"]
+            == before["slices"]["region/ra/s0"]["last_seen_unix"]
+        )
+        assert doc["slices"]["region/rb/s1"] == healthy_before
+        assert doc["regions"]["rb"]["stale"] is False
+        assert obs_metrics.FLEET_REGIONS_STALE.value() == 1
+    finally:
+        if root is not None:
+            root.close()
+        server_b.close()
+        region_b.close()
+        for s in servers:
+            s.close()
+        for c in coords:
+            c.close()
+
+
+def test_root_restores_region_entries_until_first_live_scrape(tmp_path):
+    """Restore-at-root: a restarted root serves each region's persisted
+    entries marked restored, clearing them on that region's first live
+    scrape — the slice-entry restore, one tier up."""
+    state_dir = os.path.join(str(tmp_path), "root-state")
+    coords, servers, targets = _serve_slices(2)
+    region, region_server = _serve_region(targets)
+    try:
+        region.poll_round()
+        first = _root_over([region_server], names=["r0"])
+        # _root_over has no state_dir parameter; rebuild with one.
+        first.close()
+        from gpu_feature_discovery_tpu.fleet import SliceTarget
+
+        root_targets = [
+            SliceTarget(
+                name="r0", hosts=(f"127.0.0.1:{region_server.port}",)
+            )
+        ]
+        first = FleetCollector(
+            root_targets,
+            peer_timeout=0.5,
+            upstream_mode="collectors",
+            state_dir=state_dir,
+        )
+        first.poll_round()
+        live = first.inventory_payload()
+        first.close()
+        second = FleetCollector(
+            root_targets,
+            peer_timeout=0.5,
+            upstream_mode="collectors",
+            state_dir=state_dir,
+        )
+        try:
+            doc = second.inventory_payload()
+            assert doc["restored"] is True
+            assert doc["regions"]["r0"]["restored"] is True
+            for name, entry in live["slices"].items():
+                assert doc["slices"][name]["restored"] is True
+                assert (
+                    doc["slices"][name]["healthy_hosts"]
+                    == entry["healthy_hosts"]
+                )
+            assert obs_metrics.FLEET_RESTORED.value() == 1
+            second.poll_round()
+            doc = second.inventory_payload()
+            assert doc["restored"] is False
+            assert doc["regions"]["r0"]["restored"] is False
+            assert all(
+                not e["restored"] for e in doc["slices"].values()
+            )
+            assert obs_metrics.FLEET_RESTORED.value() == 0
+        finally:
+            second.close()
+    finally:
+        region_server.close()
+        region.close()
+        for s in servers:
+            s.close()
+        for c in coords:
+            c.close()
+
+
+def test_root_restore_skips_regions_gone_from_targets(tmp_path):
+    """A region dropped from the targets file must not resurrect from
+    --state-dir (the slices-mode rule, one tier up)."""
+    from gpu_feature_discovery_tpu.fleet import SliceTarget
+
+    state_dir = os.path.join(str(tmp_path), "state")
+    store = InventoryStore(state_dir)
+    store.save(
+        {
+            "region/gone/s0": {"reachable": True},
+            "region/kept/s0": {"reachable": True},
+        },
+        regions={
+            "gone": {"reachable": True},
+            "kept": {"reachable": True},
+        },
+    )
+    root = FleetCollector(
+        [SliceTarget(name="kept", hosts=("127.0.0.1:1",))],
+        peer_timeout=0.1,
+        upstream_mode="collectors",
+        state_dir=state_dir,
+    )
+    try:
+        doc = root.inventory_payload()
+        assert "region/gone/s0" not in doc["slices"]
+        assert doc["slices"]["region/kept/s0"]["restored"] is True
+        assert doc["regions"]["kept"]["restored"] is True
+    finally:
+        root.close()
+
+
+# ---------------------------------------------------------------------------
+# HA: role by re-derivation, standby mirror, divergence
+# ---------------------------------------------------------------------------
+
+def test_ha_parse_peers_grammar():
+    from gpu_feature_discovery_tpu.fleet import parse_ha_peers
+
+    assert parse_ha_peers("a:1, b:2,,c") == ["a:1", "b:2", "c"]
+    with pytest.raises(ConfigError):
+        parse_ha_peers("a:1,a:1")
+
+
+def test_ha_bare_peer_entries_take_the_callers_default_port():
+    """run_epoch passes the collector's own serving port as the HA
+    default (replicas of one deployment serve where we serve): a bare
+    --ha-peers entry must mirror THAT port, never a hardcoded one — a
+    wrong default here polls a dead port, confirms the healthy active
+    dead, and hands BOTH replicas role=active."""
+    from gpu_feature_discovery_tpu.fleet import HaMonitor
+
+    ha = HaMonitor(
+        ["senior-host", "self-host"], "self-host", default_port=9200
+    )
+    try:
+        (_, senior), = ha._seniors
+        assert (senior.host, senior.port) == ("senior-host", 9200)
+    finally:
+        ha.close()
+
+
+def test_ha_monitor_rejects_self_not_in_peers():
+    from gpu_feature_discovery_tpu.fleet import HaMonitor
+
+    with pytest.raises(ConfigError):
+        HaMonitor(["a:1", "b:2"], "c:3")
+
+
+def test_ha_first_peer_is_active_without_polling_anyone():
+    """The first entry of the ordered list never polls: everything
+    senior to it is the empty set, so it derives active immediately —
+    and an active's divergence is 0 by definition."""
+    from gpu_feature_discovery_tpu.fleet import HaMonitor
+
+    ha = HaMonitor(["me:1", "other:2"], "me:1", peer_timeout=0.2)
+    try:
+        assert ha.role == "active"
+        assert ha.observe_round({"s0": {"reachable": True}}) == "active"
+        assert obs_metrics.FLEET_HA_ROLE.value() == 1
+        assert ha.divergence == 0
+    finally:
+        ha.close()
+
+
+def test_ha_standby_mirrors_active_with_304s_and_fails_over():
+    """The full HA contract at unit level: the junior replica derives
+    standby while the senior serves, the mirror collapses to 304 header
+    exchanges once the panes agree (divergence 0), ONE missed mirror
+    poll keeps the role (the 2-miss rule), and a confirmed-dead senior
+    re-derives the standby active with its own pane intact."""
+    from gpu_feature_discovery_tpu.fleet import HaMonitor
+
+    coords, servers, targets = _serve_slices(2)
+    active = FleetCollector(targets, peer_timeout=0.5)
+    active_server = IntrospectionServer(
+        obs_metrics.REGISTRY,
+        IntrospectionState(60.0),
+        addr="127.0.0.1",
+        port=0,
+        fleet_snapshot=active.inventory_response,
+    )
+    active_server.start()
+    standby = FleetCollector(targets, peer_timeout=0.5)
+    ha = HaMonitor(
+        [f"127.0.0.1:{active_server.port}", "standby:9102"],
+        "standby:9102",
+        peer_timeout=0.5,
+    )
+    try:
+        active.poll_round()
+        standby.poll_round()
+        own = standby.inventory_payload()["slices"]
+        assert ha.observe_round(own) == "standby"
+        assert obs_metrics.FLEET_HA_ROLE.value() == 0
+        # Both scraped the same fleet: the pair agrees.
+        assert ha.divergence == 0
+        assert obs_metrics.FLEET_HA_DIVERGENCE.value() == 0
+        # An idle agreeing pair exchanges 304s on the mirror — and the
+        # mirror's 304s never touch the scrape-economy counter.
+        scrape_304s = obs_metrics.FLEET_SNAPSHOT_NOT_MODIFIED.value()
+        before = ha.mirror_not_modified.value
+        assert ha.observe_round(own) == "standby"
+        assert ha.mirror_not_modified.value == before + 1
+        assert (
+            obs_metrics.FLEET_SNAPSHOT_NOT_MODIFIED.value() == scrape_304s
+        )
+        # Active dies at the wire: miss 1 keeps the role...
+        active_server.close()
+        active.close()
+        assert ha.observe_round(own) == "standby"
+        # ...miss 2 confirms, and the standby re-derives active.
+        assert ha.observe_round(own) == "active"
+        assert obs_metrics.FLEET_HA_ROLE.value() == 1
+        # The survivor's own pane was live the whole time: complete and
+        # non-restored, zero entries lost.
+        doc = standby.inventory_payload()
+        assert set(doc["slices"]) == {t.name for t in targets}
+        assert doc["restored"] is False
+        assert all(
+            e["healthy_hosts"] == 2 and not e["restored"]
+            for e in doc["slices"].values()
+        )
+    finally:
+        ha.close()
+        standby.close()
+        for s in servers:
+            s.close()
+        for c in coords:
+            c.close()
+
+
+def test_ha_divergence_counts_split_pane_entries():
+    from gpu_feature_discovery_tpu.fleet.ha import entries_divergence
+
+    a = {
+        "s0": {"reachable": True, "stale": False, "last_seen_unix": 100},
+        "s1": {"reachable": True, "stale": False, "last_seen_unix": 100},
+    }
+    # The quantized stamp and restore markers are volatile, never a
+    # split pane.
+    b = {
+        "s0": {"reachable": True, "stale": False, "last_seen_unix": 400},
+        "s1": {"reachable": True, "stale": False, "restored": True},
+    }
+    assert entries_divergence(a, b) == 0
+    b["s1"]["stale"] = True          # a real disagreement
+    b["s2"] = {"reachable": True}    # an entry only one pane has
+    assert entries_divergence(a, b) == 2
+
+
+def test_fleet_main_rejects_half_configured_ha(tmp_path):
+    from gpu_feature_discovery_tpu.cmd import fleet as cmd_fleet
+
+    targets_path = write_targets(
+        tmp_path, [{"name": "s0", "hosts": ["127.0.0.1:1"]}]
+    )
+    assert cmd_fleet.main(
+        ["--targets-file", targets_path, "--ha-peers", "a:1,b:2"]
+    ) == 1
+    assert cmd_fleet.main(
+        [
+            "--targets-file", targets_path,
+            "--ha-peers", "a:1,b:2",
+            "--ha-self", "c:3",
+        ]
+    ) == 1
+
+
+def test_upstream_mode_flag_grammar():
+    from gpu_feature_discovery_tpu.config.spec import parse_upstream_mode
+
+    assert parse_upstream_mode(" Collectors ") == "collectors"
+    assert parse_upstream_mode("slices") == "slices"
+    with pytest.raises(ConfigError):
+        parse_upstream_mode("regions")
+    with pytest.raises(ValueError):
+        FleetCollector([], upstream_mode="bogus")
+
+
+# ---------------------------------------------------------------------------
+# readiness + targets watcher hardening (satellites)
+# ---------------------------------------------------------------------------
+
+def test_collector_readyz_503_until_first_round_then_200(tmp_path):
+    """A fresh replica behind the HA Service must never serve an empty
+    inventory as ready: /readyz answers 503 until the first scrape
+    round completes, then 200."""
+    import queue
+    import threading
+
+    from slice_fixture import free_port
+
+    from gpu_feature_discovery_tpu.cmd.fleet import (
+        resolve_flags,
+        run_epoch,
+    )
+
+    import socket
+
+    # A target that accepts but never answers keeps the first round
+    # busy for a full --peer-timeout — long enough to observe the
+    # not-ready state.
+    blackhole = socket.socket()
+    blackhole.bind(("127.0.0.1", 0))
+    blackhole.listen(5)
+    port = free_port()
+    targets_path = write_targets(
+        tmp_path,
+        [
+            {
+                "name": "s0",
+                "hosts": [f"127.0.0.1:{blackhole.getsockname()[1]}"],
+            }
+        ],
+    )
+    values = resolve_flags(
+        {"targets-file": targets_path, "scrape-interval": "30s",
+         "metrics-addr": "127.0.0.1", "metrics-port": str(port),
+         "peer-timeout": "2s"},
+        environ={},
+    )
+    targets = parse_targets_file(targets_path)
+    sigs = queue.Queue()
+    t = threading.Thread(
+        target=run_epoch, args=(values, targets, sigs), daemon=True
+    )
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{port}/readyz"
+        deadline = time.monotonic() + 10
+        status = None
+        while time.monotonic() < deadline:
+            try:
+                status, _ = http_get(url)
+                break
+            except OSError:
+                time.sleep(0.02)
+        assert status == 503, "a pre-first-round replica must not be ready"
+        # /fleet/snapshot still answers (the endpoint exists), but the
+        # Service won't route here until readiness flips.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and status != 200:
+            status, _ = http_get(url)
+            time.sleep(0.05)
+        assert status == 200, "first completed round must flip readiness"
+    finally:
+        import signal as _signal
+
+        sigs.put(_signal.SIGTERM)
+        t.join(timeout=10)
+        blackhole.close()
+
+
+def test_collector_readyz_200_immediately_on_state_restore(tmp_path):
+    """The restore regime is ready: a replica serving last-good
+    restored data answers 200 before its first live round."""
+    import queue
+    import threading
+
+    from slice_fixture import free_port
+
+    from gpu_feature_discovery_tpu.cmd.fleet import (
+        resolve_flags,
+        run_epoch,
+    )
+
+    import socket
+
+    state_dir = os.path.join(str(tmp_path), "state")
+    store = InventoryStore(state_dir)
+    store.save({"s0": {"reachable": True, "healthy_hosts": 2}})
+    # Same never-answering target as above: the first live round is
+    # still in flight when readiness is probed, so only the restore can
+    # explain a 200.
+    blackhole = socket.socket()
+    blackhole.bind(("127.0.0.1", 0))
+    blackhole.listen(5)
+    port = free_port()
+    targets_path = write_targets(
+        tmp_path,
+        [
+            {
+                "name": "s0",
+                "hosts": [f"127.0.0.1:{blackhole.getsockname()[1]}"],
+            }
+        ],
+    )
+    values = resolve_flags(
+        {"targets-file": targets_path, "scrape-interval": "30s",
+         "metrics-addr": "127.0.0.1", "metrics-port": str(port),
+         "peer-timeout": "2s", "state-dir": state_dir},
+        environ={},
+    )
+    targets = parse_targets_file(targets_path)
+    sigs = queue.Queue()
+    t = threading.Thread(
+        target=run_epoch, args=(values, targets, sigs), daemon=True
+    )
+    t.start()
+    try:
+        url = f"http://127.0.0.1:{port}/readyz"
+        deadline = time.monotonic() + 10
+        status = None
+        while time.monotonic() < deadline:
+            try:
+                status, _ = http_get(url)
+                break
+            except OSError:
+                time.sleep(0.02)
+        assert status == 200, "restored last-good data is ready data"
+    finally:
+        import signal as _signal
+
+        sigs.put(_signal.SIGTERM)
+        t.join(timeout=10)
+        blackhole.close()
+
+
+def test_targets_watcher_fires_on_same_mtime_rewrite(tmp_path):
+    """The stat-triple contract: a targets rewrite whose mtime is
+    UNCHANGED (a same-second atomic replace — exactly what
+    config-management tools produce) still fires the reload, because
+    the watcher fingerprints (mtime_ns, size, inode), not mtime alone."""
+    from gpu_feature_discovery_tpu.cmd.events import (
+        ConfigFileWatcher,
+        EventQueue,
+    )
+
+    path = write_targets(
+        tmp_path, [{"name": "s0", "hosts": ["h0:9101"]}]
+    )
+    st = os.stat(path)
+    events = EventQueue()
+    watcher = ConfigFileWatcher(path, events, poll_s=0.02).start()
+    try:
+        # Same byte length, same forced mtime, NEW inode: only the
+        # inode distinguishes the rewrite.
+        replacement = os.path.join(str(tmp_path), "targets-new.yaml")
+        with open(path, "rb") as f:
+            content = f.read()
+        with open(replacement, "wb") as f:
+            f.write(content)
+        os.utime(replacement, ns=(st.st_atime_ns, st.st_mtime_ns))
+        os.replace(replacement, path)
+        os.utime(path, ns=(st.st_atime_ns, st.st_mtime_ns))
+        after = os.stat(path)
+        assert after.st_mtime_ns == st.st_mtime_ns
+        assert after.st_size == st.st_size
+        event = events.get(timeout=5)
+        assert event is not None and event.reason == "config_changed"
+    finally:
+        watcher.stop()
+
+
+# ---------------------------------------------------------------------------
 # ACCEPTANCE: a live collector over 3 real slice fixtures
 # ---------------------------------------------------------------------------
 

@@ -604,3 +604,137 @@ def test_fleet_collector_renders_behind_gate():
         "name": "fleet-state",
         "persistentVolumeClaim": {"claimName": "fleet-pvc"},
     }
+
+
+def test_fleet_replicas_one_render_byte_identical_to_pr14_golden():
+    """The HA/federation knobs must cost NOTHING at their defaults: the
+    replicas=1, ha-off, root-off render is byte-identical to the
+    pre-federation chart's output (captured in
+    tests/data/fleet_render_pr14_golden.yaml before the template grew
+    the new knobs)."""
+    import yaml
+
+    docs = render_chart(
+        CHART,
+        values_overrides={
+            "fleetCollector.enabled": True,
+            "fleetCollector.targets": [
+                {
+                    "name": "slice-a",
+                    "hosts": ["10.0.0.1:9101", "10.0.0.2:9101"],
+                }
+            ],
+        },
+    )
+    fleet = [
+        d
+        for d in docs
+        if "fleet" in (d.get("metadata", {}).get("name") or "")
+    ]
+    rendered = yaml.safe_dump_all(
+        sorted(fleet, key=lambda d: d["kind"]), sort_keys=True
+    )
+    golden_path = os.path.join(
+        HERE, "data", "fleet_render_pr14_golden.yaml"
+    )
+    with open(golden_path) as f:
+        assert rendered == f.read(), (
+            "replicas=1 fleet render drifted from the PR 14 golden — "
+            "the HA/federation knobs must be invisible at defaults"
+        )
+
+
+def test_fleet_replicas_two_renders_pod_anti_affinity_and_ha_env():
+    """replicas > 1 spreads the HA pair across nodes (required
+    podAntiAffinity on the collector component) and the optional
+    ha.peers/ha.self values land verbatim as the HA env pair."""
+    docs = render_chart(
+        CHART,
+        values_overrides={
+            "fleetCollector.enabled": True,
+            "fleetCollector.replicas": 2,
+            "fleetCollector.ha.peers": "fleet-a:9102,fleet-b:9102",
+            "fleetCollector.ha.self": "fleet-a:9102",
+        },
+    )
+    dep = next(
+        d
+        for d in docs
+        if d.get("kind") == "Deployment"
+        and d["metadata"]["name"].endswith("fleet-collector")
+    )
+    assert dep["spec"]["replicas"] == 2
+    rule = dep["spec"]["template"]["spec"]["affinity"]["podAntiAffinity"][
+        "requiredDuringSchedulingIgnoredDuringExecution"
+    ][0]
+    assert rule["topologyKey"] == "kubernetes.io/hostname"
+    assert (
+        rule["labelSelector"]["matchLabels"][
+            "app.kubernetes.io/component"
+        ]
+        == "fleet-collector"
+    )
+    env = {
+        e["name"]: e.get("value")
+        for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["TFD_FLEET_HA_PEERS"] == "fleet-a:9102,fleet-b:9102"
+    assert env["TFD_FLEET_HA_SELF"] == "fleet-a:9102"
+
+
+def test_fleet_root_renders_the_federation_tier():
+    """root.enabled renders the second deployment one tier up:
+    upstream-mode=collectors env, its own targets ConfigMap (regions),
+    its own Service/port, and the ONE peer token riding both hops."""
+    import yaml
+
+    # Gated off by default even with the region collector on.
+    docs_off = render_chart(
+        CHART, values_overrides={"fleetCollector.enabled": True}
+    )
+    assert not [
+        d
+        for d in docs_off
+        if "fleet-root" in (d.get("metadata", {}).get("name") or "")
+    ]
+    docs = render_chart(
+        CHART,
+        values_overrides={
+            # Independent gate: a root-only cluster (its regions live
+            # elsewhere) is a valid deployment.
+            "fleetCollector.root.enabled": True,
+            "fleetCollector.root.targets": [
+                {
+                    "name": "us-east",
+                    "hosts": ["fleet-a:9102", "fleet-b:9102"],
+                }
+            ],
+            "fleetCollector.peerTokenSecret.name": "fleet-secret",
+        },
+    )
+    root = [
+        d
+        for d in docs
+        if "fleet-root" in (d.get("metadata", {}).get("name") or "")
+    ]
+    assert {d["kind"] for d in root} == {
+        "ConfigMap", "Deployment", "Service"
+    }
+    dep = next(d for d in root if d["kind"] == "Deployment")
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    assert container["command"][-1] == "fleet-collector"
+    env = {e["name"]: e for e in container["env"]}
+    assert env["TFD_FLEET_UPSTREAM_MODE"]["value"] == "collectors"
+    assert env["TFD_METRICS_PORT"]["value"] == "9103"
+    assert env["TFD_PEER_TOKEN"]["valueFrom"]["secretKeyRef"]["name"] == (
+        "fleet-secret"
+    )
+    cm = next(d for d in root if d["kind"] == "ConfigMap")
+    parsed = yaml.safe_load(cm["data"]["targets.yaml"])
+    assert parsed["slices"][0]["name"] == "us-east"
+    svc = next(d for d in root if d["kind"] == "Service")
+    assert svc["spec"]["ports"][0]["port"] == 9103
+    assert (
+        svc["spec"]["selector"]["app.kubernetes.io/component"]
+        == "fleet-root"
+    )
