@@ -1556,6 +1556,84 @@ def main() -> int:
         file=sys.stderr,
     )
 
+    # Fleet-scale delta sync (ISSUE 16): a federated root + region tier
+    # over TFD_BENCH_FLEET_SCALE_SLICES mock slice leaders (default
+    # 1,000; 10,000 is the opt-in slow tier — tests/fleet_scale.py
+    # explains why that is cheap on one core) with 1% churn per round.
+    # CI asserts the root<-region hop moves <= 5% of the full-body
+    # mirroring cost per churn round (fleet_delta_bytes_ratio), the
+    # bottom-up fleet round stays bounded (fleet_scale_root_round_ms),
+    # and the process's resident set stays bounded
+    # (fleet_scale_rss_mb).
+    import random as _scale_random
+
+    sys.path.insert(
+        0,
+        os.path.join(os.path.dirname(os.path.abspath(__file__)), "tests"),
+    )
+    from fleet_scale import FleetTiers, MockFleet
+
+    scale_slices = max(
+        100, int(os.environ.get("TFD_BENCH_FLEET_SCALE_SLICES", "1000"))
+    )
+    scale_mock = MockFleet(scale_slices, keepalive=scale_slices <= 2000)
+    scale_tiers = None
+    try:
+        scale_tiers = FleetTiers(
+            scale_mock,
+            n_regions=max(2, min(16, scale_slices // 250)),
+            wall_clock=lambda: 1_700_000_000.0,
+        )
+        scale_tiers.round()  # warm: full bodies + connections
+        scale_rng = _scale_random.Random(16)
+        scale_rounds_ms = []
+        scale_ratios = []
+        for _ in range(5):
+            scale_mock.churn(0.01, rng=scale_rng)
+            hop_before = sum(
+                obs_metrics.FLEET_POLL_BODY_BYTES.value(kind=k)
+                for k in ("delta", "full")
+            )
+            t0 = time.perf_counter()
+            scale_tiers.round()
+            scale_rounds_ms.append((time.perf_counter() - t0) * 1e3)
+            hop_bytes = (
+                sum(
+                    obs_metrics.FLEET_POLL_BODY_BYTES.value(kind=k)
+                    for k in ("delta", "full")
+                )
+                - hop_before
+            )
+            # What full-body mirroring of every region would have cost
+            # THIS round (any resync full body honestly inflates the
+            # numerator).
+            full_cost = sum(
+                len(r.inventory_response()[0]) for r in scale_tiers.regions
+            )
+            scale_ratios.append(hop_bytes / full_cost)
+        fleet_scale_root_round_ms = round(
+            statistics.median(scale_rounds_ms), 3
+        )
+        fleet_delta_bytes_ratio = round(max(scale_ratios), 4)
+        with open("/proc/self/status") as f:
+            rss_kb = next(
+                int(line.split()[1])
+                for line in f
+                if line.startswith("VmRSS:")
+            )
+        fleet_scale_rss_mb = round(rss_kb / 1024.0, 1)
+    finally:
+        if scale_tiers is not None:
+            scale_tiers.close()
+        scale_mock.close()
+    print(
+        f"bench: fleet-scale round over {scale_slices} mock slices "
+        f"(1% churn) p50={fleet_scale_root_round_ms}ms, delta/full "
+        f"bytes ratio {fleet_delta_bytes_ratio} on the root hop, "
+        f"rss {fleet_scale_rss_mb}MB",
+        file=sys.stderr,
+    )
+
     # Event-driven reconcile latency (ISSUE 9): POST /probe on the obs
     # server -> label file mtime change, with the sleep interval at 60s
     # so only the event path (cmd/events.py PROBE_REQUEST wake) can
@@ -1806,6 +1884,15 @@ def main() -> int:
                 "fleet_federation_not_modified_ratio": (
                     fleet_federation_not_modified_ratio
                 ),
+                # Generation-delta sync at scale (ISSUE 16): the
+                # root<-region hop over a churning 1,000-slice mock
+                # fleet — CI asserts the delta wire moves <= 5% of the
+                # full-body cost per 1%-churn round, the bottom-up
+                # round stays bounded, and resident memory stays flat.
+                "fleet_scale_slices": scale_slices,
+                "fleet_scale_root_round_ms": fleet_scale_root_round_ms,
+                "fleet_delta_bytes_ratio": fleet_delta_bytes_ratio,
+                "fleet_scale_rss_mb": fleet_scale_rss_mb,
                 "sleep_interval_ms": round(DEFAULT_SLEEP_INTERVAL * 1e3, 3),
                 # Event-driven reconcile acceptance (ISSUE 9): POST
                 # /probe -> label file mtime change against a 60s sleep

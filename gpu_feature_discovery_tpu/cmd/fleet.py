@@ -44,9 +44,11 @@ from gpu_feature_discovery_tpu.config.flags import (
     parse_duration,
 )
 from gpu_feature_discovery_tpu.config.spec import (
+    DEFAULT_FLEET_DELTA_WINDOW,
     UPSTREAM_COLLECTORS,
     UPSTREAM_SLICES,
     ConfigError,
+    parse_delta_window,
     parse_nonneg_int,
     parse_upstream_mode,
 )
@@ -175,6 +177,19 @@ FLEET_FLAG_DEFS: List[FleetFlag] = [
         "a higher root)",
     ),
     FleetFlag(
+        name="delta-window",
+        env_vars=("TFD_FLEET_DELTA_WINDOW",),
+        parse=parse_delta_window,
+        default=DEFAULT_FLEET_DELTA_WINDOW,
+        help="how many publish generations of ETag lineage the "
+        "collector retains for /fleet/snapshot?since=<generation> "
+        "delta serving; a client whose generation fell out of the "
+        "window (or whose ETag lineage does not match) gets the full "
+        "body — a forced resync, never a wrong delta. 0 disables "
+        "delta serving entirely (every ?since answers with the full "
+        "body)",
+    ),
+    FleetFlag(
         name="ha-peers",
         env_vars=("TFD_FLEET_HA_PEERS",),
         parse=str,
@@ -269,6 +284,7 @@ def run_epoch(values: dict, targets, sigs) -> str:
         peer_token=values["peer-token"],
         state_dir=values["state-dir"],
         upstream_mode=upstream_mode,
+        delta_window=values["delta-window"],
     )
     ha = None
     if values["ha-peers"]:
@@ -297,6 +313,7 @@ def run_epoch(values: dict, targets, sigs) -> str:
             # /debug/labels serves the per-slice summary below.
             debug_endpoints=True,
             fleet_snapshot=collector.inventory_response,
+            fleet_delta=collector.delta_response,
             peer_token=values["peer-token"],
         )
     except OSError as e:
@@ -329,12 +346,15 @@ def run_epoch(values: dict, targets, sigs) -> str:
         )
     try:
         while True:
-            collector.poll_round()
+            changed = collector.poll_round()
             if ha is not None:
                 # Role + standby mirror ride the scrape cadence: the
                 # mirror poll doubles as the active's liveness probe.
+                # The round's changed keys let the divergence gauge
+                # update O(changed) instead of re-walking the pane.
                 ha.observe_round(
-                    collector.inventory_payload()["slices"]
+                    collector.inventory_payload()["slices"],
+                    own_changed=changed,
                 )
             state.cycle_completed()
             # /readyz stays 503 until here on a cold start (no state

@@ -424,6 +424,23 @@ def parse_cohort_size(value: Any) -> str:
     return str(n)
 
 
+# How many generations back a /fleet/snapshot?since= delta can reach by
+# default: the collector keeps one full-body ETag per generation in its
+# lineage history (a few hundred bytes each), so 1024 generations bound
+# the history to ~100 KiB while covering hours of steady churn at the
+# default scrape interval.
+DEFAULT_FLEET_DELTA_WINDOW = 1024
+
+
+def parse_delta_window(value: Any) -> int:
+    """Strict ``--delta-window`` grammar: an integer >= 0 — how many
+    generations of ETag lineage the collector keeps for answering
+    ``?since=`` delta requests. 0 disables delta serving entirely (every
+    ``?since`` answers the full body — the pre-delta wire), which is a
+    meaningful rollback lever, not an error."""
+    return parse_nonneg_int(value)
+
+
 def parse_upstream_mode(value: Any) -> str:
     """Strict ``--upstream-mode`` grammar: ``slices`` | ``collectors``.
     A typo must fail the collector's startup loudly — scraping the wrong

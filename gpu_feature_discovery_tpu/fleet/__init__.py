@@ -24,9 +24,12 @@ protocol at any tier of this system.
 - ``targets.py`` — the static targets file (target name -> host list;
   slices, or regions at the root tier), stat-triple watch reloaded
   through cmd/events.ConfigFileWatcher.
-- ``inventory.py`` — the ``/fleet/snapshot`` wire schema + the
-  ``--state-dir`` persistence so a collector restart serves
-  ``restored`` data immediately (per-region at the root tier).
+- ``inventory.py`` — the ``/fleet/snapshot`` wire schema (full body
+  AND the ``?since=<generation>`` delta document, with DeltaMirror as
+  the client-side reconstruction), plus the ``--state-dir``
+  persistence so a collector restart serves ``restored`` data
+  immediately (per-region at the root tier) and resumes its delta
+  lineage instead of forcing every client through a full resync.
 - ``collector.py`` — the poller: bounded concurrent rounds
   (utils/fanout), persistent keep-alive connections with
   If-None-Match/304 polling per target, 2-consecutive-miss confirmation
@@ -42,9 +45,13 @@ from gpu_feature_discovery_tpu.fleet.ha import HaMonitor, parse_ha_peers
 from gpu_feature_discovery_tpu.fleet.inventory import (
     FLEET_SCHEMA_VERSION,
     FLEET_SNAPSHOT_PATH,
+    DeltaMirror,
+    DeltaSyncError,
     InventoryStore,
+    build_delta,
     build_inventory,
     parse_inventory,
+    parse_inventory_or_delta,
     serialize_inventory,
 )
 from gpu_feature_discovery_tpu.fleet.targets import (
@@ -55,13 +62,17 @@ from gpu_feature_discovery_tpu.fleet.targets import (
 __all__ = [
     "FLEET_SCHEMA_VERSION",
     "FLEET_SNAPSHOT_PATH",
+    "DeltaMirror",
+    "DeltaSyncError",
     "FleetCollector",
     "HaMonitor",
     "InventoryStore",
     "SliceTarget",
+    "build_delta",
     "build_inventory",
     "parse_ha_peers",
     "parse_inventory",
+    "parse_inventory_or_delta",
     "parse_targets_file",
     "serialize_inventory",
 ]

@@ -7,7 +7,13 @@ reload). Two faces, the coordinator's exact split:
 - **Serving** (obs server handler threads): ``inventory_response`` hands
   the ``GET /fleet/snapshot`` handler the inventory body serialized once
   per DISTINCT inventory with a strong ETag — an idle fleet's dashboard
-  polls are 304 header exchanges.
+  polls are 304 header exchanges. ``delta_response`` is the same hook's
+  ``?since=<generation>`` face (fleet/inventory.py module docstring):
+  per-key generation stamps taken at the commit seam let a changed
+  round answer O(changed) entries plus tombstones instead of the
+  O(fleet) body, with an ETag-lineage check guaranteeing a delta is
+  only ever served to a client that verifiably holds the exact body it
+  diffs against — everyone else gets the full-body resync fallback.
 - **Polling** (the run loop): ``poll_round`` walks every configured
   slice's leadership chain concurrently on a bounded fan-out pool
   (utils/fanout.BoundedPool, ``--peer-fanout`` semantics) under a round
@@ -66,15 +72,18 @@ from functools import partial
 from typing import Any, Callable, Dict, List, Optional
 
 from gpu_feature_discovery_tpu.config.spec import (
+    DEFAULT_FLEET_DELTA_WINDOW,
     UPSTREAM_COLLECTORS,
     UPSTREAM_SLICES,
 )
 from gpu_feature_discovery_tpu.fleet.inventory import (
     FLEET_SNAPSHOT_PATH,
     MAX_INVENTORY_BYTES,
+    DeltaMirror,
     InventoryStore,
+    build_delta,
     build_inventory,
-    parse_inventory,
+    parse_inventory_or_delta,
     serialize_inventory,
 )
 from gpu_feature_discovery_tpu.fleet.targets import SliceTarget
@@ -100,6 +109,7 @@ from gpu_feature_discovery_tpu.peering.coordinator import (
 from gpu_feature_discovery_tpu.peering.snapshot import (
     MAX_SNAPSHOT_BYTES,
     PEER_SNAPSHOT_PATH,
+    OversizeBodyError,
     PeerSnapshotError,
     parse_snapshot,
 )
@@ -145,6 +155,15 @@ class _HostState:
     backoff_attempt: int = 0
     conn: Optional[http.client.HTTPConnection] = None
     etag: Optional[str] = None
+    # Warn-once latch for a host answering 200 with no ETag header (a
+    # stripping proxy): the 304 economy is silently gone for it, which
+    # must be visible without flooding the log every round.
+    etag_warned: bool = False
+    # The delta-sync reconstruction for this host's /fleet/snapshot
+    # (created by request_snapshot on the first delta-aware poll; always
+    # None for /peer/snapshot hosts — peer documents are per-node and
+    # tiny, there is nothing to delta).
+    mirror: Optional[DeltaMirror] = None
     backoff: BackoffPolicy = field(
         default_factory=lambda: BackoffPolicy(
             base=PEER_BACKOFF_BASE_S, cap=PEER_BACKOFF_CAP_S
@@ -253,12 +272,24 @@ def request_snapshot(
     max_bytes: int,
     token: str = "",
     not_modified_counter: Any = None,
+    delta: bool = False,
 ) -> Dict[str, Any]:
     """The wire half of one poll: GET ``path`` on ``hstate``'s existing
     connection with If-None-Match (a 304 answers from the cached
     snapshot), the peer token when configured, and a bounded body read
     through ``parse``. The caller created ``hstate.conn`` under its own
-    closed-gate before calling."""
+    closed-gate before calling.
+
+    With ``delta=True`` (the /fleet/snapshot consumers) the poll rides
+    the generation-delta protocol: once the host's DeltaMirror holds a
+    base document, ``?since=<generation>`` is appended and the returned
+    body — full or delta — is applied through the mirror, so the caller
+    always receives the FULL reconstructed inventory (``last_snapshot``
+    keeps the full-document shape a 304 answers from). Any unsound
+    delta drops the mirror and raises — one counted miss, and the next
+    poll resyncs with a full body. A delta-unaware server ignores the
+    query string and answers full bodies: mixed-version fleets degrade
+    to today's wire, never break."""
     conn = hstate.conn
     conn.timeout = timeout
     if conn.sock is not None:
@@ -268,7 +299,16 @@ def request_snapshot(
         headers[PEER_TOKEN_HEADER] = token
     if hstate.etag is not None and hstate.last_snapshot is not None:
         headers["If-None-Match"] = hstate.etag
-    conn.request("GET", path, headers=headers)
+    request_path = path
+    if delta:
+        if hstate.mirror is None:
+            hstate.mirror = DeltaMirror()
+        if (
+            hstate.mirror.generation is not None
+            and "If-None-Match" in headers
+        ):
+            request_path = f"{path}?since={hstate.mirror.generation}"
+    conn.request("GET", request_path, headers=headers)
     resp = conn.getresponse()
     if resp.status == 304:
         resp.read()
@@ -276,13 +316,46 @@ def request_snapshot(
             not_modified_counter.inc()
         if hstate.last_snapshot is None:
             raise PeerSnapshotError("304 with no cached snapshot")
+        if delta and hstate.mirror is not None:
+            hstate.mirror.note_unchanged()
         return hstate.last_snapshot
     if resp.status != 200:
         raise PeerSnapshotError(f"HTTP {resp.status}")
     body = resp.read(max_bytes + 1)
+    if len(body) > max_bytes:
+        # The sentinel byte arrived: the document is over the tier's
+        # cap. Name it instead of letting parse choke on truncated
+        # bytes — the poll outcome distinguishes "too big" from "junk".
+        raise OversizeBodyError(f"body exceeds {max_bytes} bytes")
     snapshot = parse(body)
     etag = resp.getheader("ETag")
+    if not etag:
+        obs_metrics.FLEET_ETAG_MISSING.inc()
+        if not hstate.etag_warned:
+            hstate.etag_warned = True
+            log.warning(
+                "%s:%d answered 200 with no ETag header (a stripping "
+                "proxy?): every poll of this host now refetches the "
+                "full body instead of exchanging 304 headers",
+                hstate.host,
+                hstate.port,
+            )
     hstate.etag = etag if etag else None
+    if delta:
+        kind = "delta" if snapshot.get("delta") else "full"
+        obs_metrics.FLEET_DELTA_POLLS.labels(kind=kind).inc()
+        obs_metrics.FLEET_POLL_BODY_BYTES.labels(kind=kind).inc(len(body))
+        try:
+            snapshot = hstate.mirror.apply(snapshot, etag)
+        except ValueError as e:
+            # Unsound delta (out of order, unverifiable, or the
+            # reconstruction missed the served ETag): drop the mirror
+            # AND the etag so the next poll fetches the full body.
+            hstate.mirror = DeltaMirror()
+            hstate.etag = None
+            raise PeerSnapshotError(f"delta apply failed: {e}") from e
+    else:
+        obs_metrics.FLEET_POLL_BODY_BYTES.labels(kind="full").inc(len(body))
     return snapshot
 
 
@@ -299,6 +372,7 @@ class FleetCollector:
         peer_token: str = "",
         state_dir: str = "",
         upstream_mode: str = UPSTREAM_SLICES,
+        delta_window: int = DEFAULT_FLEET_DELTA_WINDOW,
         clock: Callable[[], float] = time.monotonic,
         wall_clock: Callable[[], float] = time.time,
         backoff_factory: Optional[Callable[[], BackoffPolicy]] = None,
@@ -312,7 +386,7 @@ class FleetCollector:
         # same document this collector serves — federation nests).
         if self._federated:
             self._poll_path = FLEET_SNAPSHOT_PATH
-            self._parse = parse_inventory
+            self._parse = parse_inventory_or_delta
             self._max_body = MAX_INVENTORY_BYTES
         else:
             self._poll_path = PEER_SNAPSHOT_PATH
@@ -354,17 +428,49 @@ class FleetCollector:
         # Serving-side state (the coordinator's publish/serve split).
         self._lock = threading.Lock()
         self._generation = 0
-        self._published: Optional[Dict[str, Dict[str, Any]]] = None
+        self._published: Optional["tuple"] = None
         self._body: Optional[bytes] = None
         self._etag: Optional[str] = None
+        self._restored = False
         self._closed = False
+        # Delta-sync bookkeeping (all under _lock with the serving
+        # state). Per-key generation stamps and tombstones are INTERNAL
+        # — the full wire body stays byte-identical to the pre-delta
+        # contract; only the ?since= path reads them. The ETag history
+        # is the lineage check: a delta for since=S is served only to a
+        # client whose If-None-Match names the exact full body this
+        # collector published at generation S.
+        self.delta_window = max(0, int(delta_window))
+        self._entry_gens: Dict[str, int] = {}
+        self._region_gens: Dict[str, int] = {}
+        self._tombstones: Dict[str, int] = {}
+        self._region_tombstones: Dict[str, int] = {}
+        self._etag_history: Dict[int, str] = {}
+        self._delta_cache: Dict[int, bytes] = {}
         # --state-dir: restore last-good entries for slices still in the
         # targets (a dropped slice's state must not resurrect) and serve
         # them marked restored until each slice's first live poll.
         self._store = InventoryStore(state_dir) if state_dir else None
         self.restored_slices = 0
         if self._store is not None:
-            persisted, persisted_regions = self._store.load_doc()
+            state = self._store.load_state()
+            persisted, persisted_regions = state["slices"], state["regions"]
+            if state["generation"] is not None:
+                # The persisted generation high-water mark: the counter
+                # NEVER moves backward across restarts, so a client's
+                # ?since ahead of us is always a lost-state artifact
+                # (answered with a full resync), never a wrapped
+                # counter. Seeding _published with the persisted
+                # entries makes the first _commit a normal diff against
+                # the pre-restart pane: the restored-flag flips and any
+                # dropped keys stamp/tombstone at generation + 1
+                # through the one change-tracking path.
+                self._generation = state["generation"]
+                self._etag_history = dict(state["etag_history"])
+                self._tombstones = dict(state["tombstones"])
+                self._region_tombstones = dict(state["region_tombstones"])
+                if persisted is not None:
+                    self._published = (persisted, persisted_regions)
             if persisted and self._federated:
                 # Restore-at-root: persisted region/<name>/<slice> keys
                 # group back under their configured region; each region
@@ -436,6 +542,86 @@ class FleetCollector:
         with self._lock:
             return self._body, self._etag
 
+    def delta_response(
+        self, since: Optional[int], if_none_match: Optional[str]
+    ) -> "tuple[bytes, str]":
+        """The GET /fleet/snapshot?since=<generation> serving hook: an
+        O(changed) delta when the client's claimed generation is inside
+        the lineage window AND its If-None-Match names the exact body
+        this collector published at that generation; the FULL body
+        otherwise (the resync fallback — a client ahead of us after a
+        lost-state restart, behind the window, or off our lineage must
+        never be fed an un-appliable diff). Every response carries the
+        CURRENT full body's strong ETag — it names the state reached,
+        so an in-sync client still 304s and the idle economy holds."""
+        with self._lock:
+            full = (self._body, self._etag)
+            if since is None or self.delta_window <= 0:
+                return full
+            if since == self._generation:
+                # In sync: a matching If-None-Match becomes a 304 in
+                # the handler (the 304-equivalent of an empty delta); a
+                # mismatched one means the client's state is NOT what
+                # it claims — full resync.
+                if if_none_match != self._etag:
+                    obs_metrics.FLEET_DELTA_SERVED.labels(
+                        outcome="resync"
+                    ).inc()
+                return full
+            lineage = self._etag_history.get(since)
+            if (
+                since > self._generation
+                or lineage is None
+                or if_none_match != lineage
+            ):
+                obs_metrics.FLEET_DELTA_SERVED.labels(outcome="resync").inc()
+                return full
+            body = self._delta_cache.get(since)
+            if body is None:
+                entries, regions = self._published
+                changed = {
+                    key: entry
+                    for key, entry in entries.items()
+                    if self._entry_gens.get(key, self._generation) > since
+                }
+                tombstones = [
+                    key
+                    for key, gen in self._tombstones.items()
+                    if gen > since
+                ]
+                regions_changed = regions_tombstones = None
+                if regions is not None:
+                    regions_changed = {
+                        key: meta
+                        for key, meta in regions.items()
+                        if self._region_gens.get(key, self._generation)
+                        > since
+                    }
+                    regions_tombstones = [
+                        key
+                        for key, gen in self._region_tombstones.items()
+                        if gen > since
+                    ]
+                body, _ = serialize_inventory(
+                    build_delta(
+                        since,
+                        self._generation,
+                        self._restored,
+                        changed,
+                        tombstones,
+                        regions_changed=regions_changed,
+                        regions_tombstones=regions_tombstones,
+                    )
+                )
+                if len(self._delta_cache) >= 32:
+                    # Clients cluster on the current generation minus
+                    # one; a handful of stragglers is normal, an
+                    # unbounded spread is not worth caching.
+                    self._delta_cache.clear()
+                self._delta_cache[since] = body
+            obs_metrics.FLEET_DELTA_SERVED.labels(outcome="delta").inc()
+            return body, self._etag
+
     def _current_entries(
         self,
     ) -> "tuple[Dict[str, Dict[str, Any]], Optional[Dict[str, Dict[str, Any]]]]":
@@ -463,10 +649,13 @@ class FleetCollector:
                 regions=regions,
             )
 
-    def _commit(self) -> None:
+    def _commit(self) -> "set":
         """Publish the current entries: render body/ETag only on a
-        DISTINCT inventory (the 304 economy), refresh the gauges, and
-        persist churn-free."""
+        DISTINCT inventory (the 304 economy), stamp per-key generations
+        and tombstones for the delta protocol, refresh the gauges, and
+        persist churn-free. Returns the set of slice keys whose entries
+        changed (including dropped keys) — the O(changed) currency the
+        HA divergence check rides."""
         entries, regions = self._current_entries()
         stale = sum(1 for e in entries.values() if e.get("stale"))
         regions_stale = (
@@ -475,32 +664,85 @@ class FleetCollector:
             else 0
         )
         restored = any(s.restored for s in self._slices.values())
+        changed_keys: "set" = set()
         with self._lock:
             if self._closed:
-                return
+                return changed_keys
             if self._body is None or (entries, regions) != self._published:
+                prev_entries, prev_regions = (
+                    self._published
+                    if self._published is not None
+                    else ({}, None)
+                )
                 if self._published is not None:
                     self._generation += 1
+                gen = self._generation
+                # One pass computes the changed set AND stamps it: the
+                # publish decision, the delta protocol's per-key
+                # generations, and the HA consumer's changed-key report
+                # must never disagree about what moved.
+                for key, entry in entries.items():
+                    if prev_entries.get(key) != entry:
+                        self._entry_gens[key] = gen
+                        changed_keys.add(key)
+                    self._tombstones.pop(key, None)
+                for key in prev_entries:
+                    if key not in entries:
+                        self._entry_gens.pop(key, None)
+                        self._tombstones[key] = gen
+                        changed_keys.add(key)
+                prev_region_map = prev_regions or {}
+                for key, meta in (regions or {}).items():
+                    if prev_region_map.get(key) != meta:
+                        self._region_gens[key] = gen
+                    self._region_tombstones.pop(key, None)
+                for key in prev_region_map:
+                    if key not in (regions or {}):
+                        self._region_gens.pop(key, None)
+                        self._region_tombstones[key] = gen
                 self._published = (entries, regions)
+                self._restored = restored
                 self._body, self._etag = serialize_inventory(
                     build_inventory(
                         entries, self._generation, restored, regions=regions
                     )
                 )
+                self._etag_history[gen] = self._etag
+                self._delta_cache.clear()
+                while len(self._etag_history) > max(1, self.delta_window):
+                    del self._etag_history[min(self._etag_history)]
+                # Tombstones older than the servable window are dead
+                # weight: any client that far behind full-resyncs
+                # anyway (its lineage is gone), so the set stays
+                # bounded by the window, not by keys-ever-seen.
+                floor = min(self._etag_history)
+                for stones in (self._tombstones, self._region_tombstones):
+                    for key in [k for k, g in stones.items() if g <= floor]:
+                        del stones[key]
             obs_metrics.FLEET_SLICES.set(len(entries))
             obs_metrics.FLEET_SLICES_STALE.set(stale)
             obs_metrics.FLEET_REGIONS_STALE.set(regions_stale)
             obs_metrics.FLEET_RESTORED.set(1 if restored else 0)
         if self._store is not None:
-            self._store.save(entries, regions)
+            self._store.save(
+                entries,
+                regions,
+                generation=self._generation,
+                etag_history=self._etag_history,
+                tombstones=self._tombstones,
+                region_tombstones=self._region_tombstones,
+            )
+        return changed_keys
 
     # -- polling side ------------------------------------------------------
 
-    def poll_round(self) -> None:
+    def poll_round(self) -> "set":
         """One scrape round: every slice's chain walk dispatched onto
         the bounded pool in rotated order (budget skips land on whoever
         rotation puts last — the peer tier's fairness rule), then one
-        commit."""
+        commit. Returns the commit's changed slice keys so the caller's
+        per-round consumers (the HA divergence check) can stay
+        O(changed) instead of re-walking the fleet."""
         obs_metrics.FLEET_SCRAPE_ROUNDS.inc()
         started = time.perf_counter()
         budget = Budget(self.round_budget, time.perf_counter)
@@ -514,10 +756,11 @@ class FleetCollector:
                 for name in rotated
             ]
         )
-        self._commit()
+        changed = self._commit()
         obs_metrics.FLEET_SCRAPE_DURATION.observe(
             time.perf_counter() - started
         )
+        return changed
 
     def _poll_target(self, state: _TargetState, budget: Budget) -> None:
         """Walk one target's chain. In slices mode the walk stops at the
@@ -546,6 +789,14 @@ class FleetCollector:
                 timeout = min(timeout, remaining)
             try:
                 snapshot = self._fetch(hstate, timeout)
+            except OversizeBodyError as e:
+                # Still one miss, but its own outcome: a body over the
+                # tier's cap is a named anomaly (junk upstream, or an
+                # inventory that outgrew MAX_INVENTORY_BYTES), not
+                # generic wire noise.
+                obs_metrics.FLEET_POLLS.labels(outcome="oversize").inc()
+                self._host_failed(state, hstate, e)
+                continue
             except Exception as e:  # noqa: BLE001 - any failure = one miss
                 obs_metrics.FLEET_POLLS.labels(outcome="error").inc()
                 self._host_failed(state, hstate, e)
@@ -750,6 +1001,11 @@ class FleetCollector:
             self._max_body,
             token=self.peer_token,
             not_modified_counter=obs_metrics.FLEET_SNAPSHOT_NOT_MODIFIED,
+            # The federation hop rides the delta protocol: a region's
+            # inventory is O(slices) wide, but what moves per round is
+            # O(changed). Peer snapshots are per-node and tiny — no
+            # delta below the fleet tier.
+            delta=self._federated,
         )
 
     def close(self) -> None:

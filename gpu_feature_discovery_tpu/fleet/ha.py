@@ -17,7 +17,10 @@ tier of this system derives leadership — no election protocol:
 - A STANDBY additionally mirrors the active's ``/fleet/snapshot`` once
   per round over a persistent keep-alive connection with
   ``If-None-Match`` — an agreeing pair exchanges 304 header exchanges,
-  nothing more — and publishes ``tfd_fleet_ha_divergence``: how many
+  nothing more, and a changed round moves only the changed entries
+  (``?since=<generation>`` delta, fleet/inventory.DeltaMirror, with
+  full-body resync as the fallback) — and publishes
+  ``tfd_fleet_ha_divergence``: how many
   inventory entries differ between its OWN pane and the active's
   (volatile fields excluded). A persistently nonzero value is a SPLIT
   PANE — the two collectors can see different fleets (asymmetric
@@ -53,7 +56,7 @@ from gpu_feature_discovery_tpu.fleet.collector import (
 from gpu_feature_discovery_tpu.fleet.inventory import (
     FLEET_SNAPSHOT_PATH,
     MAX_INVENTORY_BYTES,
-    parse_inventory,
+    parse_inventory_or_delta,
 )
 from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
 from gpu_feature_discovery_tpu.peering.coordinator import (
@@ -90,24 +93,41 @@ def parse_ha_peers(raw: str) -> List[str]:
     return peers
 
 
+def _strip_volatile(
+    entry: Optional[Dict[str, Any]],
+) -> Optional[Dict[str, Any]]:
+    if entry is None:
+        return None
+    return {k: v for k, v in entry.items() if k not in _DIVERGENCE_EXCLUDE}
+
+
+def _entry_differs(
+    own: Optional[Dict[str, Any]], mirrored: Optional[Dict[str, Any]]
+) -> bool:
+    return _strip_volatile(own) != _strip_volatile(mirrored)
+
+
+def diverging_keys(
+    own: Dict[str, Dict[str, Any]], mirrored: Dict[str, Dict[str, Any]]
+) -> "set":
+    """The inventory keys whose entries differ between two collectors'
+    panes (volatile fields excluded) — the full O(fleet) walk, run on
+    the first comparison and on every full-body resync; steady-state
+    rounds maintain the set incrementally from the two panes' changed
+    keys (HaMonitor.observe_round)."""
+    keys = set(own) | set(mirrored)
+    return {
+        k for k in keys if _entry_differs(own.get(k), mirrored.get(k))
+    }
+
+
 def entries_divergence(
     own: Dict[str, Dict[str, Any]], mirrored: Dict[str, Dict[str, Any]]
 ) -> int:
     """How many inventory entries differ between two collectors' panes
     (volatile fields excluded). 0 means the pair agrees entry for
     entry."""
-
-    def strip(entry: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
-        if entry is None:
-            return None
-        return {
-            k: v for k, v in entry.items() if k not in _DIVERGENCE_EXCLUDE
-        }
-
-    keys = set(own) | set(mirrored)
-    return sum(
-        1 for k in keys if strip(own.get(k)) != strip(mirrored.get(k))
-    )
+    return len(diverging_keys(own, mirrored))
 
 
 class _MirrorCounter:
@@ -162,16 +182,32 @@ class HaMonitor:
         self.role = ROLE_ACTIVE if not self._seniors else ROLE_STANDBY
         self.active_peer: Optional[str] = None
         self.divergence = 0
+        # Incrementally-maintained divergence set: the keys currently
+        # disagreeing with ``_diff_against``. None = no valid baseline
+        # (first comparison, active changed, or a full-body resync
+        # replaced the mirror wholesale) -> next comparison is the full
+        # O(fleet) walk; otherwise only the keys either pane CHANGED
+        # this round are re-verdicted — O(changed) per round.
+        self._diff_keys: Optional["set"] = None
+        self._diff_against: Optional[str] = None
         self.mirror_not_modified = _MirrorCounter()
         obs_metrics.FLEET_HA_ROLE.set(
             1 if self.role == ROLE_ACTIVE else 0
         )
         obs_metrics.FLEET_HA_DIVERGENCE.set(0)
 
-    def observe_round(self, own_slices: Dict[str, Dict[str, Any]]) -> str:
+    def observe_round(
+        self,
+        own_slices: Dict[str, Dict[str, Any]],
+        own_changed: Optional["set"] = None,
+    ) -> str:
         """One role derivation + mirror pass; call after each of the
         collector's scrape rounds with its current per-slice entries
-        (``inventory_payload()['slices']``). Returns the derived role."""
+        (``inventory_payload()['slices']``) and, when known, the set of
+        slice keys that round changed (``poll_round()``'s return) — with
+        both panes' changed keys in hand the divergence gauge updates
+        O(changed); without them it falls back to the full walk.
+        Returns the derived role."""
         role = ROLE_ACTIVE
         active_peer: Optional[str] = None
         mirrored: Optional[Dict[str, Any]] = None
@@ -233,12 +269,41 @@ class HaMonitor:
         self.role = role
         self.active_peer = active_peer
         if mirrored is not None:
-            self.divergence = entries_divergence(
-                own_slices, mirrored.get("slices", {})
-            )
+            mirror_changed: Optional["set"] = None
+            for name, hstate in self._seniors:
+                if name == active_peer and hstate.mirror is not None:
+                    mirror_changed = hstate.mirror.last_changed
+                    break
+            mirrored_slices = mirrored.get("slices", {})
+            if (
+                own_changed is None
+                or mirror_changed is None
+                or self._diff_keys is None
+                or self._diff_against != active_peer
+            ):
+                # No baseline (first comparison, the active moved, a
+                # caller without change tracking) or the mirror was
+                # replaced wholesale (full-body resync): full walk.
+                self._diff_keys = diverging_keys(
+                    own_slices, mirrored_slices
+                )
+            else:
+                for k in set(own_changed) | mirror_changed:
+                    if _entry_differs(
+                        own_slices.get(k), mirrored_slices.get(k)
+                    ):
+                        self._diff_keys.add(k)
+                    else:
+                        self._diff_keys.discard(k)
+            self._diff_against = active_peer
+            self.divergence = len(self._diff_keys)
         else:
             # Active (its own pane IS the pane), or a standby whose
-            # mirror poll missed this round: no fresh comparison.
+            # mirror poll missed this round: no fresh comparison — and
+            # no baseline either (own changes keep landing while the
+            # mirror is dark), so the next comparison re-walks.
+            self._diff_keys = None
+            self._diff_against = None
             self.divergence = 0 if role == ROLE_ACTIVE else self.divergence
         obs_metrics.FLEET_HA_ROLE.set(1 if role == ROLE_ACTIVE else 0)
         obs_metrics.FLEET_HA_DIVERGENCE.set(self.divergence)
@@ -255,10 +320,11 @@ class HaMonitor:
             hstate,
             self.peer_timeout,
             FLEET_SNAPSHOT_PATH,
-            parse_inventory,
+            parse_inventory_or_delta,
             MAX_INVENTORY_BYTES,
             token=self.peer_token,
             not_modified_counter=self.mirror_not_modified,
+            delta=True,
         )
 
     def close(self) -> None:

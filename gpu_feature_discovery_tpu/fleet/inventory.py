@@ -82,11 +82,50 @@ Serialization is the peer layer's exact body format + strong-ETag pair
 inventory; ``/fleet/snapshot`` answers a matching ``If-None-Match`` with
 ``304`` (obs/server.py shares the handler with ``/peer/snapshot``).
 
+**Delta sync** (``GET /fleet/snapshot?since=<generation>``): a consumer
+that already holds generation S may ask for only what moved since. The
+server (collector.delta_response) answers an O(changed) DELTA document::
+
+    {
+      "schema": 1,
+      "peer_schema": 1,
+      "delta": true,            # the dispatch key (absent on full docs)
+      "since": 5,               # the generation this delta starts from
+      "generation": 8,          # ...and the generation it lands on
+      "restored": false,        # the full doc's current restored flag
+      "changed": {              # entries whose per-entry generation
+        "slice-a": {...}        # advanced past `since` — VERBATIM full
+      },                        # entries, never field-level diffs
+      "tombstones": ["slice-b"] # keys dropped since `since`
+      # federation tier only (absent in slices mode):
+      # "regions_changed": {...}, "regions_tombstones": [...]
+    }
+
+served with the CURRENT full body's strong ETag (the header names the
+STATE reached, not the response bytes) — so an in-sync consumer's
+``If-None-Match`` still 304s and the idle-round economy is untouched.
+The full body remains the resync fallback: a ``since`` ahead of the
+server's generation, older than its delta window, or whose
+``If-None-Match`` does not match that generation's recorded ETag
+lineage answers the complete document. ``DeltaMirror`` is the client
+half: it reconstructs the full document from deltas and VERIFIES the
+reconstruction against the served ETag — a client that missed a delta
+(or a tombstone) detects the mismatch and resyncs instead of serving a
+silently-diverged pane.
+
 Persistence (``InventoryStore``) follows sandbox/state.LabelStateStore:
 versioned JSON through the fsync-before-rename writer, all failures
 contained, corrupt/mismatched documents load as "no state" — a collector
 restart then serves the last-good inventory immediately with
 ``restored`` entries until each slice's first live poll replaces it.
+The state doc also carries the delta protocol's continuity fields (all
+OPTIONAL — a pre-delta state file still restores): the generation
+high-water mark (so a restarted collector's counter never moves
+backward and a client's ``since`` ahead of the server is always a
+restart artifact worth a full resync), the ETag-lineage history, and
+the live tombstone set (so a slice dropped from the targets file is
+still announced as a tombstone across the epoch rebuild the reload
+triggers).
 """
 
 from __future__ import annotations
@@ -147,6 +186,39 @@ def build_inventory(
     return doc
 
 
+def build_delta(
+    since: int,
+    generation: int,
+    restored: bool,
+    changed: Dict[str, Dict[str, Any]],
+    tombstones: "list[str]",
+    regions_changed: Optional[Dict[str, Dict[str, Any]]] = None,
+    regions_tombstones: Optional["list[str]"] = None,
+) -> Dict[str, Any]:
+    """One delta document (module docstring): what moved between
+    ``since`` and ``generation``. Entries are carried VERBATIM — the
+    delta's granularity is the entry, never a field-level diff, so a
+    client's reconstruction is a plain dict update."""
+    doc = {
+        "schema": FLEET_SCHEMA_VERSION,
+        "peer_schema": PEER_SCHEMA_VERSION,
+        "delta": True,
+        "since": int(since),
+        "generation": int(generation),
+        "restored": bool(restored),
+        "changed": {name: dict(entry) for name, entry in changed.items()},
+        "tombstones": sorted(tombstones),
+    }
+    if regions_changed is not None:
+        # Federation tier only — same absence discipline as the full
+        # document's upstream/regions keys.
+        doc["regions_changed"] = {
+            name: dict(entry) for name, entry in regions_changed.items()
+        }
+        doc["regions_tombstones"] = sorted(regions_tombstones or ())
+    return doc
+
+
 def serialize_inventory(doc: Dict[str, Any]) -> "tuple[bytes, str]":
     """Wire body + strong ETag — the peer snapshot's exact economy,
     reused: one serialization per distinct inventory, 304s for everyone
@@ -154,11 +226,7 @@ def serialize_inventory(doc: Dict[str, Any]) -> "tuple[bytes, str]":
     return serialize_snapshot(doc)
 
 
-def parse_inventory(body: bytes) -> Dict[str, Any]:
-    """Validate one /fleet/snapshot body (the root collector's read
-    surface, the HA mirror, dashboard clients, tests). ValueError on
-    anything a consumer cannot trust — forward-rejecting on schema, the
-    peering parser's exact discipline."""
+def _load_body(body: bytes) -> Dict[str, Any]:
     if len(body) > MAX_INVENTORY_BYTES:
         raise ValueError(
             f"inventory body {len(body)} bytes exceeds "
@@ -172,21 +240,173 @@ def parse_inventory(body: bytes) -> Dict[str, Any]:
             f"unsupported fleet schema {doc.get('schema')!r} "
             f"(want {FLEET_SCHEMA_VERSION})"
         )
-    if not isinstance(doc.get("slices"), dict) or not all(
-        isinstance(k, str) and isinstance(v, dict)
-        for k, v in doc["slices"].items()
-    ):
-        raise ValueError("inventory slices must be a str->object map")
-    regions = doc.get("regions")
-    if regions is not None and (
-        not isinstance(regions, dict)
-        or not all(
-            isinstance(k, str) and isinstance(v, dict)
-            for k, v in regions.items()
-        )
-    ):
-        raise ValueError("inventory regions must be a str->object map")
     return doc
+
+
+def _validate_entry_map(value: Any, what: str) -> None:
+    if not isinstance(value, dict) or not all(
+        isinstance(k, str) and isinstance(v, dict) for k, v in value.items()
+    ):
+        raise ValueError(f"inventory {what} must be a str->object map")
+
+
+def _validate_full(doc: Dict[str, Any]) -> None:
+    _validate_entry_map(doc.get("slices"), "slices")
+    regions = doc.get("regions")
+    if regions is not None:
+        _validate_entry_map(regions, "regions")
+
+
+def _validate_delta(doc: Dict[str, Any]) -> None:
+    for field in ("since", "generation"):
+        value = doc.get(field)
+        if not isinstance(value, int) or isinstance(value, bool) or value < 0:
+            raise ValueError(f"bad delta {field} {value!r}")
+    if doc["since"] >= doc["generation"]:
+        raise ValueError(
+            f"delta since {doc['since']} must precede its generation "
+            f"{doc['generation']}"
+        )
+    if not isinstance(doc.get("restored"), bool):
+        raise ValueError(f"bad delta restored {doc.get('restored')!r}")
+    _validate_entry_map(doc.get("changed"), "changed")
+    tombstones = doc.get("tombstones")
+    if not isinstance(tombstones, list) or not all(
+        isinstance(k, str) for k in tombstones
+    ):
+        raise ValueError("delta tombstones must be a list of keys")
+    overlap = set(tombstones) & set(doc["changed"])
+    if overlap:
+        raise ValueError(
+            f"delta keys both changed and tombstoned: {sorted(overlap)}"
+        )
+    has_rc = "regions_changed" in doc
+    if has_rc != ("regions_tombstones" in doc):
+        raise ValueError(
+            "delta regions_changed and regions_tombstones must appear "
+            "together"
+        )
+    if has_rc:
+        _validate_entry_map(doc["regions_changed"], "regions_changed")
+        if not isinstance(doc["regions_tombstones"], list) or not all(
+            isinstance(k, str) for k in doc["regions_tombstones"]
+        ):
+            raise ValueError(
+                "delta regions_tombstones must be a list of keys"
+            )
+
+
+def parse_inventory(body: bytes) -> Dict[str, Any]:
+    """Validate one FULL /fleet/snapshot body (the root collector's read
+    surface, the HA mirror, dashboard clients, tests). ValueError on
+    anything a consumer cannot trust — forward-rejecting on schema, the
+    peering parser's exact discipline. A delta document is rejected here
+    (it carries no ``slices`` map): this parser is the delta-unaware
+    client's contract and must never half-accept a shape it does not
+    speak."""
+    doc = _load_body(body)
+    _validate_full(doc)
+    return doc
+
+
+def parse_inventory_or_delta(body: bytes) -> Dict[str, Any]:
+    """The delta-aware consumer's parse: dispatch on the ``delta`` key —
+    full documents get parse_inventory's exact validation, delta
+    documents their own field-strict one. The caller applies a delta
+    through DeltaMirror (never reads it raw)."""
+    doc = _load_body(body)
+    if doc.get("delta"):
+        _validate_delta(doc)
+    else:
+        _validate_full(doc)
+    return doc
+
+
+class DeltaSyncError(ValueError):
+    """A delta document could not be applied onto the client-side
+    mirror: out-of-order, unverifiable, or its reconstruction does not
+    match the ETag the server says this generation hashes to. The
+    caller's recovery is always the same — drop the mirror and refetch
+    the full body."""
+
+
+class DeltaMirror:
+    """The client half of delta sync: a reconstructed full inventory
+    document, advanced by ``apply``-ing each polled body (full or
+    delta). Every delta application is VERIFIED — the reconstruction is
+    re-serialized and its strong ETag compared against the one the
+    server attached (which names the full body at the delta's target
+    generation): byte-identity with a full-body client is checked every
+    round, never assumed. One mirror per upstream host; single-threaded
+    like the poller that owns it."""
+
+    def __init__(self):
+        self.doc: Optional[Dict[str, Any]] = None
+        self.body: Optional[bytes] = None
+        self.generation: Optional[int] = None
+        # What the LAST apply changed: a set of slice keys (empty after
+        # a 304), or None after a full-body replacement (the O(changed)
+        # consumers fall back to a full recompute exactly then).
+        self.last_changed: "Optional[set]" = None
+
+    def note_unchanged(self) -> None:
+        """A 304 round: the mirror is current and nothing moved."""
+        if self.doc is not None:
+            self.last_changed = set()
+
+    def apply(
+        self, doc: Dict[str, Any], etag: Optional[str]
+    ) -> Dict[str, Any]:
+        """Advance the mirror by one polled document and return the full
+        reconstructed inventory. Raises DeltaSyncError when a delta
+        cannot be applied soundly — the caller drops the mirror and the
+        next poll resyncs with a full body."""
+        if not doc.get("delta"):
+            self.doc = doc
+            self.body, _ = serialize_inventory(doc)
+            self.generation = doc.get("generation")
+            self.last_changed = None
+            return doc
+        if self.doc is None:
+            raise DeltaSyncError("delta received with no mirrored base")
+        if doc.get("since") != self.generation:
+            raise DeltaSyncError(
+                f"delta starts at generation {doc.get('since')} but the "
+                f"mirror holds {self.generation}"
+            )
+        if not etag:
+            raise DeltaSyncError(
+                "delta response carried no ETag to verify against"
+            )
+        new_doc = dict(self.doc)
+        slices = dict(self.doc.get("slices", {}))
+        for key in doc.get("tombstones", ()):
+            slices.pop(key, None)
+        slices.update(doc.get("changed", {}))
+        new_doc["slices"] = slices
+        new_doc["generation"] = doc["generation"]
+        new_doc["restored"] = doc["restored"]
+        if "regions_changed" in doc:
+            regions = dict(self.doc.get("regions") or {})
+            for key in doc.get("regions_tombstones", ()):
+                regions.pop(key, None)
+            regions.update(doc["regions_changed"])
+            new_doc["regions"] = regions
+        body, own_etag = serialize_inventory(new_doc)
+        if own_etag != etag:
+            # The reconstruction is NOT what a full-body client holds —
+            # a missed delta, a missed tombstone, or a server that lost
+            # its lineage. Never serve it.
+            raise DeltaSyncError(
+                "reconstructed inventory does not match the served ETag"
+            )
+        self.doc = new_doc
+        self.body = body
+        self.generation = new_doc["generation"]
+        self.last_changed = set(doc.get("changed", {})) | set(
+            doc.get("tombstones", ())
+        )
+        return new_doc
 
 
 class InventoryStore:
@@ -198,7 +418,7 @@ class InventoryStore:
         self._dir = state_dir
         self._path = os.path.join(state_dir, INVENTORY_FILENAME)
         self._save_warned = False
-        self._last_saved: Optional[Dict[str, Any]] = None
+        self._last_saved: Optional["tuple"] = None
 
     @property
     def path(self) -> str:
@@ -216,23 +436,41 @@ class InventoryStore:
         """The persisted ``(slices, regions)`` pair. ``slices`` is None
         on any unusable file; ``regions`` is None when the state was
         written by a slices-mode collector (no regions key)."""
+        state = self.load_state()
+        return state["slices"], state["regions"]
+
+    def load_state(self) -> Dict[str, Any]:
+        """The complete persisted state: the ``(slices, regions)`` pair
+        plus the delta protocol's continuity fields. Every sync field is
+        OPTIONAL and degrades independently — a pre-delta state file (or
+        one whose sync fields are malformed) still restores its entries;
+        only delta continuity starts cold (every delta client then
+        resyncs with one full body, which is always sound)."""
+        blank = {
+            "slices": None,
+            "regions": None,
+            "generation": None,
+            "etag_history": {},
+            "tombstones": {},
+            "region_tombstones": {},
+        }
         try:
             with open(self._path) as f:
                 doc = json.load(f)
         except FileNotFoundError:
-            return None, None
+            return blank
         except (OSError, ValueError) as e:
             log.warning(
                 "ignoring unreadable fleet state file %s: %s", self._path, e
             )
-            return None, None
+            return blank
         if not isinstance(doc, dict) or doc.get("version") != STATE_VERSION:
             log.warning(
                 "ignoring fleet state file %s: unsupported version %r",
                 self._path,
                 doc.get("version") if isinstance(doc, dict) else None,
             )
-            return None, None
+            return blank
         slices = doc.get("slices")
         if not isinstance(slices, dict) or not all(
             isinstance(k, str) and isinstance(v, dict)
@@ -243,7 +481,7 @@ class InventoryStore:
                 "str->object map",
                 self._path,
             )
-            return None, None
+            return blank
         regions = doc.get("regions")
         if not isinstance(regions, dict) or not all(
             isinstance(k, str) and isinstance(v, dict)
@@ -254,26 +492,63 @@ class InventoryStore:
             regions = None
         else:
             regions = {name: dict(entry) for name, entry in regions.items()}
-        return {name: dict(entry) for name, entry in slices.items()}, regions
+        state = dict(blank)
+        state["slices"] = {
+            name: dict(entry) for name, entry in slices.items()
+        }
+        state["regions"] = regions
+        generation = doc.get("generation")
+        if (
+            isinstance(generation, int)
+            and not isinstance(generation, bool)
+            and generation >= 0
+        ):
+            state["generation"] = generation
+        history = doc.get("etag_history")
+        if isinstance(history, dict):
+            # JSON object keys are strings; generations are ints.
+            try:
+                state["etag_history"] = {
+                    int(g): str(etag) for g, etag in history.items()
+                }
+            except (TypeError, ValueError):
+                state["etag_history"] = {}
+        for field in ("tombstones", "region_tombstones"):
+            raw = doc.get(field)
+            if isinstance(raw, dict) and all(
+                isinstance(k, str)
+                and isinstance(g, int)
+                and not isinstance(g, bool)
+                for k, g in raw.items()
+            ):
+                state[field] = dict(raw)
+        return state
 
     def save(
         self,
         slices: Dict[str, Dict[str, Any]],
         regions: Optional[Dict[str, Dict[str, Any]]] = None,
+        generation: Optional[int] = None,
+        etag_history: Optional[Dict[int, str]] = None,
+        tombstones: Optional[Dict[str, int]] = None,
+        region_tombstones: Optional[Dict[str, int]] = None,
     ) -> bool:
         """Persist the per-slice entries (and, at the federation tier,
         the per-region meta) atomically; False (after one warning) on
         failure. Churn-free: an unchanged inventory is not re-fsynced
         every round. Two HA replicas sharing one --state-dir both call
         this — the atomic rename makes it last-writer-wins, never a torn
-        file."""
+        file. The optional delta-continuity fields ride the same doc:
+        the generation high-water mark, the ETag-lineage history (the
+        window a restarted collector can still serve deltas from), and
+        the live tombstones."""
         snapshot = {name: dict(entry) for name, entry in slices.items()}
         region_snapshot = (
             {name: dict(entry) for name, entry in regions.items()}
             if regions is not None
             else None
         )
-        if self._last_saved == (snapshot, region_snapshot):
+        if self._last_saved == (snapshot, region_snapshot, generation):
             return True
         doc = {
             "version": STATE_VERSION,
@@ -282,6 +557,13 @@ class InventoryStore:
         }
         if region_snapshot is not None:
             doc["regions"] = region_snapshot
+        if generation is not None:
+            doc["generation"] = int(generation)
+            doc["etag_history"] = {
+                str(g): etag for g, etag in (etag_history or {}).items()
+            }
+            doc["tombstones"] = dict(tombstones or {})
+            doc["region_tombstones"] = dict(region_tombstones or {})
         try:
             os.makedirs(self._dir, exist_ok=True)
             _write_file_atomically(
@@ -289,7 +571,7 @@ class InventoryStore:
                 json.dumps(doc, sort_keys=True).encode(),
                 INVENTORY_MODE,
             )
-            self._last_saved = (snapshot, region_snapshot)
+            self._last_saved = (snapshot, region_snapshot, generation)
             return True
         except OSError as e:
             if not self._save_warned:

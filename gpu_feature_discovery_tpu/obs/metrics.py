@@ -330,7 +330,9 @@ FLEET_POLLS = REGISTRY.counter(
     "Collector upstream polls (/peer/snapshot in slices mode, "
     "/fleet/snapshot under --upstream-mode=collectors) by outcome: ok "
     "(valid snapshot or 304), error (timeout, HTTP failure, junk body, "
-    "schema mismatch), or skipped (the round budget ran out before "
+    "schema mismatch), oversize (the body hit the tier's size cap and "
+    "was never parsed — a loud anomaly now that deltas make small "
+    "bodies the norm), or skipped (the round budget ran out before "
     "this target).",
     labelnames=("outcome",),
 )
@@ -387,6 +389,45 @@ FLEET_HA_ROLE = REGISTRY.gauge(
     "re-derived every round, no election protocol), 0 while standby. "
     "Meaningful only with --ha-peers set; both replicas scrape and "
     "serve regardless of role.",
+)
+FLEET_ETAG_MISSING = REGISTRY.counter(
+    "tfd_fleet_etag_missing_total",
+    "Upstream 200 responses that carried NO ETag header (a stripping "
+    "proxy in front of the target?): every subsequent poll of that host "
+    "refetches and reparses the full body — the 304 economy is silently "
+    "lost for it. Warned once per host in the log; this counter keeps "
+    "the regression visible on a dashboard. 0 on a healthy fleet.",
+)
+FLEET_DELTA_SERVED = REGISTRY.counter(
+    "tfd_fleet_delta_served_total",
+    "GET /fleet/snapshot?since=<generation> requests this collector "
+    "answered, by outcome: delta (an O(changed) document — only entries "
+    "whose generation advanced past the client's, plus tombstones for "
+    "dropped keys) or resync (the full body instead: the client's "
+    "generation is ahead of ours — a restart artifact — or older than "
+    "the --delta-window lineage history, or its If-None-Match does not "
+    "match that generation's recorded ETag). In-sync clients answer "
+    "from tfd_fleet_inventory_not_modified_total (a 304), not here.",
+    labelnames=("outcome",),
+)
+FLEET_DELTA_POLLS = REGISTRY.counter(
+    "tfd_fleet_delta_polls_total",
+    "Bodies this collector's delta-aware /fleet/snapshot polls (the "
+    "federation scrape and the HA mirror) received, by kind: delta "
+    "(applied onto the client-side mirror and VERIFIED against the "
+    "served ETag) or full (first sync, or a forced resync). Under "
+    "steady churn delta should dominate; persistent full bodies mean "
+    "the upstream keeps refusing the client's ?since lineage.",
+    labelnames=("kind",),
+)
+FLEET_POLL_BODY_BYTES = REGISTRY.counter(
+    "tfd_fleet_poll_body_bytes_total",
+    "Response body bytes this collector's upstream polls received, by "
+    "kind (full documents vs delta documents); 304 header exchanges add "
+    "nothing. The fleet tier's bytes-on-wire: the delta protocol's win "
+    "is this counter's delta/full ratio under churn (the bench gates "
+    "it at a 1,000-slice fleet).",
+    labelnames=("kind",),
 )
 FLEET_HA_DIVERGENCE = REGISTRY.gauge(
     "tfd_fleet_ha_divergence",

@@ -217,6 +217,9 @@ def _make_handler(
     peer_fault: Optional[Callable[[str], bool]] = None,
     peer_token: str = "",
     fleet_snapshot: Optional[Callable[[], "tuple[bytes, str]"]] = None,
+    fleet_delta: Optional[
+        Callable[[int, "Optional[str]"], "tuple[bytes, str]"]
+    ] = None,
 ):
     class _Handler(BaseHTTPRequestHandler):
         # Content-Length is always sent, so keep-alive is safe.
@@ -338,6 +341,19 @@ def _make_handler(
                 return False
             return True
 
+        def _since_param(self) -> "Optional[int]":
+            """The ``since`` query value as a non-negative int, or None
+            when absent/garbled — a malformed ``since`` falls back to
+            the full body (delta is an optimisation, never a 4xx)."""
+            for part in urlsplit(self.path).query.split("&"):
+                if part.startswith("since="):
+                    try:
+                        since = int(part[len("since="):])
+                    except ValueError:
+                        return None
+                    return since if since >= 0 else None
+            return None
+
         def _reply_snapshot(
             self, body: bytes, etag: "Optional[str]", counter
         ):
@@ -386,13 +402,26 @@ def _make_handler(
             elif path == "/fleet/snapshot" and fleet_snapshot is not None:
                 # The collector's aggregated inventory, same token gate
                 # and publish-time-cache economy as the peer surface it
-                # is built over.
+                # is built over. A ``?since=<generation>`` query asks
+                # for the generation-delta document instead; the serving
+                # decision (delta vs full resync) lives with the
+                # collector, which also validates the client's ETag
+                # lineage — this handler only routes.
                 if not self._peer_auth_ok():
                     return
-                self._reply_snapshot(
-                    *fleet_snapshot(),
-                    counter=metrics.FLEET_INVENTORY_NOT_MODIFIED,
-                )
+                since = self._since_param()
+                if since is not None and fleet_delta is not None:
+                    self._reply_snapshot(
+                        *fleet_delta(
+                            since, self.headers.get("If-None-Match")
+                        ),
+                        counter=metrics.FLEET_INVENTORY_NOT_MODIFIED,
+                    )
+                else:
+                    self._reply_snapshot(
+                        *fleet_snapshot(),
+                        counter=metrics.FLEET_INVENTORY_NOT_MODIFIED,
+                    )
             else:
                 self._reply(404, b"not found\n")
 
@@ -514,6 +543,9 @@ class IntrospectionServer:
         peer_fault: Optional[Callable[[str], bool]] = None,
         peer_token: str = "",
         fleet_snapshot: Optional[Callable[[], "tuple[bytes, str]"]] = None,
+        fleet_delta: Optional[
+            Callable[[int, "Optional[str]"], "tuple[bytes, str]"]
+        ] = None,
     ):
         self._httpd = _TrackingHTTPServer(
             (addr, port),
@@ -527,6 +559,7 @@ class IntrospectionServer:
                 peer_fault=peer_fault,
                 peer_token=peer_token,
                 fleet_snapshot=fleet_snapshot,
+                fleet_delta=fleet_delta,
             ),
         )
         self._httpd.daemon_threads = True

@@ -95,6 +95,14 @@ class PeerSnapshotError(ValueError):
     as a failed poll, exactly like not answering at all."""
 
 
+class OversizeBodyError(PeerSnapshotError):
+    """A snapshot body hit the poller's read sentinel (max_bytes + 1):
+    the document is over the tier's size cap and was never parsed.
+    Named (rather than letting ``parse`` choke on the truncated bytes)
+    because a delta protocol makes small bodies the norm — an oversize
+    full body is a loud anomaly worth its own poll outcome."""
+
+
 def strip_snapshot_labels(labels: Dict[str, str]) -> Dict[str, str]:
     """The snapshot view of a written label set: status markers out
     (they describe the cycle that wrote them — cmd/supervisor.py

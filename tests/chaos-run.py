@@ -56,7 +56,14 @@ SIGKILLs the ACTIVE of an HA pair — a real fleet-collector subprocess —
 and asserts the in-process standby serves a complete, non-restored
 inventory within one scrape period with zero entries lost, then
 re-derives itself active within the 2-miss window, no election
-(run_fleet_collector_failover).
+(run_fleet_collector_failover). ``fleet:delta-resync`` (ISSUE 16)
+SIGKILLs a REAL fleet-collector subprocess (--state-dir +
+--delta-window) mid-delta-lineage and restarts it on the same port and
+state dir: a ``?since=<generation>`` delta client must either resume
+the persisted lineage (deltas keep flowing across the restart) or be
+forced through exactly ONE full resync — never an error loop, never a
+silently stale pane — and end byte-identical to a full-body client
+(run_fleet_delta_resync).
 
 ``reconcile:broker-death`` is likewise not a fault spec: it SIGKILLs the
 long-lived broker worker of an EVENT-mode daemon whose sleep interval is
@@ -479,6 +486,8 @@ def run_fleet_chaos(scenario, workdir, timeout_s=None):
         return run_fleet_region_dark(workdir, timeout_s=timeout_s)
     if scenario == "collector-failover":
         return run_fleet_collector_failover(workdir, timeout_s=timeout_s)
+    if scenario == "delta-resync":
+        return run_fleet_delta_resync(workdir, timeout_s=timeout_s)
     if scenario != "slice-dark":
         raise ValueError(f"unknown fleet chaos scenario {scenario!r}")
     budget = timeout_s or 60.0
@@ -963,6 +972,260 @@ def run_fleet_collector_failover(workdir, timeout_s=None):
         "serving_after_kill_s": round(serving_s, 3),
         "failover_s": round(failover_s, 3),
         "labels": len(before),
+    }
+
+
+def run_fleet_delta_resync(workdir, timeout_s=None):
+    """fleet:delta-resync (ISSUE 16): a REAL fleet-collector subprocess
+    (--state-dir + --delta-window) serves ``?since=<generation>`` deltas
+    to an in-process client, is SIGKILLed mid-lineage, and restarts on
+    the same port and state dir. The contract:
+
+      1. pre-kill the delta client rides O(changed) documents: after one
+         slice's verdict moves, a poll carries exactly that key and the
+         reconstructed pane is byte-identical to the served full body;
+         an idle poll is a pure 304;
+      2. across the kill/restart the client's generation + ETag lineage
+         either resumes from the persisted high-water mark (deltas keep
+         flowing, the restored-flag flips arriving AS a delta) or is
+         forced through exactly ONE full resync — never an error loop,
+         never a silently stale pane;
+      3. after a post-restart mutation the client converges
+         byte-identical to the full body again, still over deltas."""
+    import http.client
+    import signal as _signal
+    import subprocess
+    import urllib.request
+
+    import yaml as _yaml
+    from slice_fixture import free_port
+
+    from gpu_feature_discovery_tpu.fleet.collector import (
+        _HostState,
+        drop_connection,
+        request_snapshot,
+    )
+    from gpu_feature_discovery_tpu.fleet.inventory import (
+        FLEET_SNAPSHOT_PATH,
+        MAX_INVENTORY_BYTES,
+        parse_inventory_or_delta,
+    )
+
+    budget = timeout_s or 90.0
+    started = time.monotonic()
+    coords, servers = [], []
+    active = None
+    hstate = None
+    try:
+        coords, servers, targets = _fake_slice_leaders(3, prefix="d")
+        targets_path = os.path.join(workdir, "targets.yaml")
+        with open(targets_path, "w") as f:
+            _yaml.safe_dump(
+                {
+                    "version": "v1",
+                    "slices": [
+                        {"name": t.name, "hosts": list(t.hosts)}
+                        for t in targets
+                    ],
+                },
+                f,
+            )
+        state_dir = os.path.join(workdir, "fleet-state")
+        os.makedirs(state_dir, exist_ok=True)
+        port = free_port()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+        def spawn():
+            return subprocess.Popen(
+                [
+                    sys.executable, "-m", "gpu_feature_discovery_tpu",
+                    "fleet-collector",
+                    "--targets-file", targets_path,
+                    "--metrics-addr", "127.0.0.1",
+                    "--metrics-port", str(port),
+                    "--scrape-interval", "0.1s",
+                    "--peer-timeout", "0.5s",
+                    "--state-dir", state_dir,
+                    "--delta-window", "16",
+                ],
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+
+        def wait_ready(what):
+            deadline = time.monotonic() + budget
+            while time.monotonic() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/readyz", timeout=2
+                    ) as resp:
+                        if resp.status == 200:
+                            return
+                except OSError:
+                    pass
+                time.sleep(0.05)
+            raise AssertionError(f"collector never became ready ({what})")
+
+        def full_body():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{FLEET_SNAPSHOT_PATH}", timeout=2
+            ) as resp:
+                return resp.read()
+
+        def poll():
+            """One delta-aware client poll; returns (doc, kind) where
+            kind is read off the mirror: a full apply clears
+            last_changed, a 304 leaves it empty, a delta names keys.
+            Recreates the connection like the real poller does — a
+            failed request leaves http.client unusable."""
+            if hstate.conn is None:
+                hstate.conn = http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=5
+                )
+            doc = request_snapshot(
+                hstate,
+                5.0,
+                FLEET_SNAPSHOT_PATH,
+                parse_inventory_or_delta,
+                MAX_INVENTORY_BYTES,
+                delta=True,
+            )
+            hstate.last_snapshot = doc
+            changed = hstate.mirror.last_changed
+            if changed is None:
+                return doc, "full"
+            return doc, ("not_modified" if not changed else "delta")
+
+        def degrade(i):
+            coords[i].publish_local(
+                {
+                    "google.com/tpu.count": "4",
+                    "google.com/tpu.chips.healthy": "3",
+                    "google.com/tpu.chips.sick": "1",
+                    "google.com/tpu.slice.role": "leader",
+                    "google.com/tpu.slice.leader": f"d{i}w0",
+                    "google.com/tpu.slice.healthy-hosts": "1",
+                    "google.com/tpu.slice.total-hosts": "2",
+                    "google.com/tpu.slice.degraded": "true",
+                    "google.com/tpu.slice.sick-chips": "1",
+                },
+                "full",
+            )
+
+        active = spawn()
+        wait_ready("first start")
+        hstate = _HostState(host="127.0.0.1", port=port)
+        hstate.conn = http.client.HTTPConnection(
+            "127.0.0.1", port, timeout=5
+        )
+        # First contact: a full body covering the whole fleet.
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            doc, _kind = poll()
+            if len(doc["slices"]) == 3 and all(
+                e.get("healthy_hosts") == 2 and not e.get("restored")
+                for e in doc["slices"].values()
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(f"pane never converged: {doc}")
+        # Idle poll: pure 304 — the client is on the lineage.
+        _doc, kind = poll()
+        assert kind == "not_modified", kind
+        # One slice degrades: the next non-304 poll is a DELTA carrying
+        # exactly that key, and the mirror is byte-identical after.
+        degrade(0)
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            doc, kind = poll()
+            if kind == "delta":
+                break
+            assert kind == "not_modified", (
+                f"pre-kill churn must ride the delta path, got {kind}"
+            )
+            time.sleep(0.05)
+        else:
+            raise AssertionError("delta never arrived pre-kill")
+        assert hstate.mirror.last_changed == {"d0"}, (
+            hstate.mirror.last_changed
+        )
+        assert doc["slices"]["d0"]["healthy_hosts"] == 1, doc
+        assert hstate.mirror.body == full_body()
+        generation_pre_kill = hstate.mirror.generation
+        # SIGKILL mid-lineage — no shutdown path, no final save beyond
+        # the per-commit persistence.
+        os.kill(active.pid, _signal.SIGKILL)
+        active.wait(timeout=10)
+        active = spawn()
+        wait_ready("restart")
+        degrade(1)
+        # The client keeps polling through the restart window; connection
+        # errors on the dead port are part of the exercise.
+        kinds = {"full": 0, "delta": 0, "not_modified": 0}
+        deadline = time.monotonic() + budget
+        converged = False
+        while time.monotonic() < deadline:
+            try:
+                doc, kind = poll()
+            except Exception:
+                drop_connection(hstate)
+                time.sleep(0.05)
+                continue
+            kinds[kind] += 1
+            if (
+                doc["slices"]["d1"].get("healthy_hosts") == 1
+                and not doc["restored"]
+                and not any(
+                    e.get("restored") for e in doc["slices"].values()
+                )
+            ):
+                converged = True
+                break
+            time.sleep(0.05)
+        assert converged, f"pane never re-converged after restart: {doc}"
+        # Exactly-one-resync-at-most: the persisted lineage either
+        # carried the client across (0 fulls) or forced one resync.
+        assert kinds["full"] <= 1, kinds
+        assert hstate.mirror.body == full_body()
+        assert hstate.mirror.generation >= generation_pre_kill
+        assert doc["slices"]["d0"]["healthy_hosts"] == 1, doc
+        # Still on the lineage: post-restart churn rides deltas again.
+        degrade(2)
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            doc, kind = poll()
+            assert kind != "full", (
+                "client fell off the lineage after the restart"
+            )
+            if kind == "delta" and "d2" in hstate.mirror.last_changed:
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("post-restart delta never arrived")
+        assert doc["slices"]["d2"]["healthy_hosts"] == 1, doc
+        assert hstate.mirror.body == full_body()
+    finally:
+        if active is not None and active.poll() is None:
+            active.kill()
+            active.wait(timeout=10)
+        if hstate is not None:
+            drop_connection(hstate)
+        for server in servers:
+            server.close()
+        for coord in coords:
+            coord.close()
+    elapsed = time.monotonic() - started
+    return {
+        "spec": "fleet:delta-resync",
+        "converged_s": round(elapsed, 3),
+        "resyncs_after_restart": kinds["full"],
+        "deltas_after_restart": kinds["delta"],
+        "generation": hstate.mirror.generation,
+        "labels": len(hstate.last_snapshot["slices"]),
     }
 
 

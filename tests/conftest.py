@@ -21,3 +21,11 @@ try:
     pin_virtual_cpu_devices(8)
 except ImportError:  # pragma: no cover - jax-free environment
     pass
+
+
+def pytest_configure(config):
+    # The opt-in scale tiers (tests/test_fleet_scale.py 10k-slice run)
+    # are excluded from tier-1 runs via -m 'not slow'.
+    config.addinivalue_line(
+        "markers", "slow: opt-in scale/burn-in tiers excluded from tier 1"
+    )

@@ -1,0 +1,386 @@
+"""Synthetic fleet-scale harness (ISSUE 16): thousands of mock slice
+leaders behind ONE listening socket, plus the two real aggregation
+tiers (region collectors and a federated root) assembled over them.
+
+The trick that makes 10,000 "servers" cheap on a 1-core container:
+
+- One socket bound to ``0.0.0.0:<ephemeral>`` serves EVERY mock peer.
+  Peer i gets its own loopback destination IP (``127.10.x.y`` — the
+  whole 127/8 block is local on Linux), all sharing the one port; the
+  accepted socket's ``getsockname()`` recovers which peer the client
+  addressed. No per-peer socket, no per-peer thread, no per-peer port.
+- A single ``selectors``-based event-loop thread speaks just enough
+  HTTP/1.1 for the collector's poll protocol: ``GET /peer/snapshot``
+  with ``If-None-Match`` answered 304/200 from each peer's cached
+  body + strong ETag (the real publish-time economy, so the idle-round
+  304 ratio the acceptance gates measures something true).
+- ``keepalive=False`` answers ``Connection: close`` — http.client's
+  ``auto_open`` transparently reconnects on the next poll, so the
+  10k-slice tier's file-descriptor footprint stays bounded by the
+  collectors' fan-out instead of O(fleet) persistent connections
+  (the container's fd ceiling is far below 2 fds x 10k).
+
+Peer documents are REAL peer-snapshot documents
+(peering/snapshot.build_snapshot + build_slice_section), so the region
+collectors parse and aggregate them through the production path;
+``churn()`` republishes a deterministic fraction with a moved verdict,
+``set_dark()`` makes a peer drop connections (a dark slice, confirmed
+over the collector's 2-miss rule).
+
+No jax, no subprocesses: everything runs in-process so the bench can
+meter bytes-on-wire and round latency with plain counters.
+"""
+
+import random
+import selectors
+import socket
+import threading
+
+from gpu_feature_discovery_tpu.fleet import SliceTarget
+from gpu_feature_discovery_tpu.fleet.collector import FleetCollector
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+from gpu_feature_discovery_tpu.obs.server import (
+    IntrospectionServer,
+    IntrospectionState,
+)
+from gpu_feature_discovery_tpu.peering.snapshot import (
+    build_slice_section,
+    build_snapshot,
+    serialize_snapshot,
+)
+
+_CRLF2 = b"\r\n\r\n"
+_MAX_REQUEST = 16 * 1024
+
+
+def _leader_labels(name, healthy=4, total_hosts=2, degraded=False):
+    return {
+        "google.com/tpu.count": "4",
+        "google.com/tpu.chips.healthy": str(healthy),
+        "google.com/tpu.chips.sick": str(4 - healthy),
+        "google.com/tpu.slice.role": "leader",
+        "google.com/tpu.slice.leader": f"{name}-w0",
+        "google.com/tpu.slice.healthy-hosts": str(
+            total_hosts if not degraded else total_hosts - 1
+        ),
+        "google.com/tpu.slice.total-hosts": str(total_hosts),
+        "google.com/tpu.slice.degraded": "true" if degraded else "false",
+        "google.com/tpu.slice.sick-chips": str(4 - healthy),
+    }
+
+
+class _MockPeer:
+    __slots__ = ("name", "ip", "generation", "degraded", "body", "etag",
+                 "dark")
+
+    def __init__(self, name, ip):
+        self.name = name
+        self.ip = ip
+        self.generation = 1
+        self.degraded = False
+        self.dark = False
+        self.body = b""
+        self.etag = ""
+        self.publish()
+
+    def publish(self):
+        labels = _leader_labels(self.name, degraded=self.degraded)
+        doc = build_snapshot(
+            0,
+            f"{self.name}-w0",
+            labels,
+            self.generation,
+            "full",
+            slice_section=build_slice_section(labels),
+        )
+        self.body, self.etag = serialize_snapshot(doc)
+
+
+class _Conn:
+    __slots__ = ("sock", "peer", "inbuf", "outbuf", "close_after")
+
+    def __init__(self, sock, peer):
+        self.sock = sock
+        self.peer = peer
+        self.inbuf = b""
+        self.outbuf = b""
+        self.close_after = False
+
+
+class MockFleet:
+    """See module docstring. ``stats`` counts what actually crossed the
+    wire from the mock tier: full bodies, 304 header exchanges, bytes.
+    """
+
+    def __init__(self, n_slices, keepalive=True, name_prefix="slice"):
+        self.keepalive = keepalive
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind(("0.0.0.0", 0))
+        self._sock.listen(512)
+        self._sock.setblocking(False)
+        self.port = self._sock.getsockname()[1]
+        self.peers = {}
+        self._by_name = {}
+        for i in range(n_slices):
+            # 127.10.x.y, skipping .0/.255 hosts: unique per peer, all
+            # local, all answered by the one 0.0.0.0 bind.
+            ip = f"127.10.{i // 250}.{1 + i % 250}"
+            peer = _MockPeer(f"{name_prefix}-{i}", ip)
+            self.peers[ip] = peer
+            self._by_name[peer.name] = peer
+        self.stats = {"requests": 0, "full": 0, "not_modified": 0,
+                      "bytes": 0, "dropped": 0}
+        self._sel = selectors.DefaultSelector()
+        self._sel.register(self._sock, selectors.EVENT_READ, None)
+        self._wake_r, self._wake_w = socket.socketpair()
+        self._wake_r.setblocking(False)
+        self._sel.register(self._wake_r, selectors.EVENT_READ, "wake")
+        self._closed = False
+        self._lock = threading.Lock()
+        self._thread = threading.Thread(
+            target=self._loop, name="mock-fleet", daemon=True
+        )
+        self._thread.start()
+
+    # -- control surface (test thread) ----------------------------------
+
+    def targets(self):
+        return [
+            SliceTarget(name=p.name, hosts=(f"{p.ip}:{self.port}",))
+            for p in self.peers.values()
+        ]
+
+    def churn(self, fraction, rng=None):
+        """Republish ``fraction`` of the peers with a flipped verdict
+        and a bumped generation. Returns the changed slice names."""
+        rng = rng or random.Random(0)
+        count = max(1, int(len(self.peers) * fraction))
+        chosen = rng.sample(sorted(self._by_name), count)
+        with self._lock:
+            for name in chosen:
+                peer = self._by_name[name]
+                peer.degraded = not peer.degraded
+                peer.generation += 1
+                peer.publish()
+        return chosen
+
+    def set_dark(self, names, dark=True):
+        with self._lock:
+            for name in names:
+                self._by_name[name].dark = dark
+
+    def close(self):
+        self._closed = True
+        try:
+            self._wake_w.send(b"x")
+        except OSError:
+            pass
+        self._thread.join(timeout=10)
+        for key in list(self._sel.get_map().values()):
+            try:
+                key.fileobj.close()
+            except OSError:
+                pass
+        self._sel.close()
+        try:
+            self._wake_w.close()
+        except OSError:
+            pass
+
+    # -- event loop ------------------------------------------------------
+
+    def _loop(self):
+        while not self._closed:
+            for key, events in self._sel.select(timeout=0.5):
+                if key.data == "wake":
+                    return
+                if key.fileobj is self._sock:
+                    self._accept()
+                    continue
+                conn = key.data
+                if events & selectors.EVENT_READ:
+                    self._readable(conn)
+                if conn.sock.fileno() != -1 and (
+                    events & selectors.EVENT_WRITE
+                ):
+                    self._flush(conn)
+
+    def _accept(self):
+        for _ in range(64):
+            try:
+                sock, _addr = self._sock.accept()
+            except (BlockingIOError, OSError):
+                return
+            peer = self.peers.get(sock.getsockname()[0])
+            if peer is None:
+                sock.close()
+                continue
+            sock.setblocking(False)
+            conn = _Conn(sock, peer)
+            self._sel.register(sock, selectors.EVENT_READ, conn)
+
+    def _drop(self, conn):
+        try:
+            self._sel.unregister(conn.sock)
+        except (KeyError, ValueError):
+            pass
+        try:
+            conn.sock.close()
+        except OSError:
+            pass
+
+    def _readable(self, conn):
+        try:
+            chunk = conn.sock.recv(4096)
+        except (BlockingIOError, InterruptedError):
+            return
+        except OSError:
+            self._drop(conn)
+            return
+        if not chunk:
+            self._drop(conn)
+            return
+        conn.inbuf += chunk
+        if len(conn.inbuf) > _MAX_REQUEST:
+            self._drop(conn)
+            return
+        while _CRLF2 in conn.inbuf:
+            head, conn.inbuf = conn.inbuf.split(_CRLF2, 1)
+            self._respond(conn, head)
+            if conn.sock.fileno() == -1:
+                return
+
+    def _respond(self, conn, head):
+        with self._lock:
+            peer = conn.peer
+            self.stats["requests"] += 1
+            if peer.dark:
+                self.stats["dropped"] += 1
+                self._drop(conn)
+                return
+            lines = head.split(b"\r\n")
+            if not lines[0].startswith(b"GET /peer/snapshot"):
+                self._drop(conn)
+                return
+            inm = None
+            for line in lines[1:]:
+                if line.lower().startswith(b"if-none-match:"):
+                    inm = line.split(b":", 1)[1].strip().decode()
+            connection = (
+                b"Connection: keep-alive\r\n"
+                if self.keepalive
+                else b"Connection: close\r\n"
+            )
+            if inm == peer.etag:
+                self.stats["not_modified"] += 1
+                resp = (
+                    b"HTTP/1.1 304 Not Modified\r\n"
+                    + f"ETag: {peer.etag}\r\n".encode()
+                    + b"Content-Length: 0\r\n" + connection + b"\r\n"
+                )
+            else:
+                self.stats["full"] += 1
+                self.stats["bytes"] += len(peer.body)
+                resp = (
+                    b"HTTP/1.1 200 OK\r\n"
+                    b"Content-Type: application/json\r\n"
+                    + f"ETag: {peer.etag}\r\n".encode()
+                    + f"Content-Length: {len(peer.body)}\r\n".encode()
+                    + connection + b"\r\n" + peer.body
+                )
+        conn.outbuf += resp
+        conn.close_after = not self.keepalive
+        self._flush(conn)
+
+    def _flush(self, conn):
+        try:
+            sent = conn.sock.send(conn.outbuf)
+            conn.outbuf = conn.outbuf[sent:]
+        except (BlockingIOError, InterruptedError):
+            sent = 0
+        except OSError:
+            self._drop(conn)
+            return
+        if conn.outbuf:
+            self._sel.modify(
+                conn.sock,
+                selectors.EVENT_READ | selectors.EVENT_WRITE,
+                conn,
+            )
+        else:
+            if conn.close_after:
+                self._drop(conn)
+            else:
+                self._sel.modify(conn.sock, selectors.EVENT_READ, conn)
+
+
+class FleetTiers:
+    """The real aggregation stack over a MockFleet: ``n_regions``
+    slices-mode FleetCollectors (each serving /fleet/snapshot WITH the
+    delta hook, exactly as cmd/fleet.py wires it) and one federated
+    root scraping them. ``round()`` drives one full fleet round
+    bottom-up and returns the root's changed keys."""
+
+    def __init__(
+        self,
+        mock,
+        n_regions,
+        peer_timeout=5.0,
+        wall_clock=None,
+        root_state_dir="",
+    ):
+        targets = mock.targets()
+        wall = {"wall_clock": wall_clock} if wall_clock else {}
+        chunk = (len(targets) + n_regions - 1) // n_regions
+        self.regions = []
+        self.region_servers = []
+        try:
+            for i in range(n_regions):
+                region = FleetCollector(
+                    targets[i * chunk:(i + 1) * chunk],
+                    peer_timeout=peer_timeout,
+                    round_budget=None,
+                    **wall,
+                )
+                server = IntrospectionServer(
+                    obs_metrics.REGISTRY,
+                    IntrospectionState(3600.0),
+                    addr="127.0.0.1",
+                    port=0,
+                    fleet_snapshot=region.inventory_response,
+                    fleet_delta=region.delta_response,
+                )
+                server.start()
+                self.regions.append(region)
+                self.region_servers.append(server)
+            self.root = FleetCollector(
+                [
+                    SliceTarget(
+                        name=f"region-{i}",
+                        hosts=(f"127.0.0.1:{s.port}",),
+                    )
+                    for i, s in enumerate(self.region_servers)
+                ],
+                peer_timeout=peer_timeout,
+                round_budget=None,
+                upstream_mode="collectors",
+                state_dir=root_state_dir,
+                **wall,
+            )
+        except BaseException:
+            self.close()
+            raise
+
+    def round(self):
+        for region in self.regions:
+            region.poll_round()
+        return self.root.poll_round()
+
+    def close(self):
+        if getattr(self, "root", None) is not None:
+            self.root.close()
+        for server in self.region_servers:
+            server.close()
+        for region in self.regions:
+            region.close()

@@ -95,6 +95,14 @@ CHAOS_SPECS = [
     # election).
     "fleet:region-dark",
     "fleet:collector-failover",
+    # Generation-delta sync (ISSUE 16, fleet/inventory.py): SIGKILL a
+    # REAL fleet-collector subprocess (--state-dir + --delta-window)
+    # mid-delta-lineage and restart it on the same port and state dir —
+    # a ?since=<generation> client must resume the persisted lineage
+    # (deltas keep flowing) or pay exactly ONE full resync, never an
+    # error loop or a silently stale pane, and end byte-identical to a
+    # full-body client.
+    "fleet:delta-resync",
     # Event-driven reconcile loop (cmd/events.py, --reconcile): SIGKILL
     # the long-lived broker worker of an event-mode daemon whose sleep
     # interval is pinned at 60s — only the WORKER_DIED wake can explain
@@ -162,6 +170,10 @@ CHAOS_EXPECTATIONS = {
     # scrape round precede the kill; the post-kill bounds themselves
     # are asserted inside the driver.
     "fleet:collector-failover": {"timeout_s": 90.0},
+    # Two REAL subprocess starts (initial + restart) bracket the kill;
+    # the at-most-one-resync and byte-identity bounds are asserted
+    # inside the driver.
+    "fleet:delta-resync": {"timeout_s": 90.0},
     # Startup (first full cycle + broker spawn) can be slow on a loaded
     # host; the kill-to-recovery bound itself is 2x probe-timeout and
     # asserted INSIDE the driver, not via this budget.

@@ -284,6 +284,12 @@ def test_fleet_metric_families_are_registered_and_documented():
         "tfd_fleet_regions_stale": "gauge",
         "tfd_fleet_ha_role": "gauge",
         "tfd_fleet_ha_divergence": "gauge",
+        # Generation-delta sync (ISSUE 16): the wire-economy families
+        # must exist and carry typed rows too.
+        "tfd_fleet_etag_missing_total": "counter",
+        "tfd_fleet_delta_served_total": "counter",
+        "tfd_fleet_delta_polls_total": "counter",
+        "tfd_fleet_poll_body_bytes_total": "counter",
     }
     families = obs_metrics.REGISTRY.families()
     doc = read("observability.md")
@@ -317,3 +323,23 @@ def test_fleet_metric_families_are_registered_and_documented():
         "Token rollout across two hops",
     ):
         assert bit in ops, f"federation runbook missing {bit!r}"
+    assert families["tfd_fleet_delta_served_total"].labelnames == (
+        "outcome",
+    )
+    assert families["tfd_fleet_delta_polls_total"].labelnames == ("kind",)
+    assert families["tfd_fleet_poll_body_bytes_total"].labelnames == (
+        "kind",
+    )
+    # The delta runbook (ISSUE 16): generation semantics, the three
+    # answers, tombstones, the restart lineage, and the resync
+    # diagnosis must all be written down.
+    assert "Delta sync and resync" in ops
+    for bit in (
+        "?since=",
+        "generation",
+        "tombstone",
+        "--delta-window",
+        "resync",
+        "fleet:delta-resync",
+    ):
+        assert bit in ops, f"delta runbook missing {bit!r}"

@@ -1454,3 +1454,548 @@ def test_fleet_collector_over_three_slices_acceptance(tmp_path):
     finally:
         for harness in harnesses:
             harness.stop()
+
+
+# ---------------------------------------------------------------------------
+# generation-delta sync (ISSUE 16)
+# ---------------------------------------------------------------------------
+
+def _serve_fleet(collector, delta=True):
+    """A collector's serving surface with the delta hook wired exactly
+    as cmd/fleet.py wires it (fleet_delta optional for the
+    delta-unaware-server pin)."""
+    server = IntrospectionServer(
+        obs_metrics.REGISTRY,
+        IntrospectionState(60.0),
+        addr="127.0.0.1",
+        port=0,
+        fleet_snapshot=collector.inventory_response,
+        fleet_delta=collector.delta_response if delta else None,
+    )
+    server.start()
+    return server
+
+
+def _fleet_client(port):
+    import http.client
+
+    from gpu_feature_discovery_tpu.fleet.collector import _HostState
+
+    hstate = _HostState(host="127.0.0.1", port=port)
+    hstate.conn = http.client.HTTPConnection("127.0.0.1", port, timeout=5)
+    return hstate
+
+
+def _fleet_poll(hstate, delta=True):
+    from gpu_feature_discovery_tpu.fleet.collector import request_snapshot
+    from gpu_feature_discovery_tpu.fleet.inventory import (
+        FLEET_SNAPSHOT_PATH,
+        MAX_INVENTORY_BYTES,
+        parse_inventory_or_delta,
+    )
+
+    doc = request_snapshot(
+        hstate,
+        5.0,
+        FLEET_SNAPSHOT_PATH,
+        parse_inventory_or_delta if delta else parse_inventory,
+        MAX_INVENTORY_BYTES,
+        delta=delta,
+    )
+    hstate.last_snapshot = doc
+    return doc
+
+
+def test_etag_missing_is_counted_and_warned_once(caplog):
+    """A 200 with no ETag header (a stripping proxy) silently destroys
+    the 304 economy: every such poll counts, the operator hears about it
+    once per host, and the poll itself still succeeds."""
+    import logging as _logging
+
+    from gpu_feature_discovery_tpu.fleet.inventory import (
+        build_inventory,
+        serialize_inventory,
+    )
+
+    body, _ = serialize_inventory(build_inventory({}, 0, False))
+    server = IntrospectionServer(
+        obs_metrics.REGISTRY,
+        IntrospectionState(60.0),
+        addr="127.0.0.1",
+        port=0,
+        fleet_snapshot=lambda: (body, None),
+    )
+    server.start()
+    hstate = _fleet_client(server.port)
+    try:
+        before = obs_metrics.FLEET_ETAG_MISSING.value()
+        with caplog.at_level(_logging.WARNING, logger="tfd.fleet"):
+            doc1 = _fleet_poll(hstate, delta=False)
+            doc2 = _fleet_poll(hstate, delta=False)
+        assert doc1 == doc2
+        assert hstate.etag is None  # nothing to If-None-Match with
+        assert obs_metrics.FLEET_ETAG_MISSING.value() == before + 2
+        warned = [
+            r for r in caplog.records if "no ETag header" in r.getMessage()
+        ]
+        assert len(warned) == 1, warned
+    finally:
+        from gpu_feature_discovery_tpu.fleet.collector import (
+            drop_connection,
+        )
+
+        drop_connection(hstate)
+        server.close()
+
+
+def test_oversize_body_is_a_typed_error_and_named_outcome(tmp_path):
+    """A body over the tier's cap raises the TYPED error at the read
+    sentinel (never a parse failure on truncated bytes) and the poll
+    counts under its own outcome."""
+    from gpu_feature_discovery_tpu.peering.snapshot import (
+        OversizeBodyError,
+    )
+
+    assert issubclass(OversizeBodyError, PeerSnapshotError)
+    coord, server = _serve_coordinator()
+    targets = _targets(tmp_path, {"s0": [f"127.0.0.1:{server.port}"]})
+    collector = FleetCollector(targets, peer_timeout=0.5)
+    # Shrink THIS collector's read cap below the fixture's body.
+    collector._max_body = 64
+    try:
+        before = obs_metrics.FLEET_POLLS.value(outcome="oversize")
+        collector.poll_round()
+        assert (
+            obs_metrics.FLEET_POLLS.value(outcome="oversize") == before + 1
+        )
+        # One miss, not a verdict: the entry reads unreached, not junk.
+        assert (
+            collector.inventory_payload()["slices"]["s0"]["reachable"]
+            is False
+        )
+    finally:
+        collector.close()
+        server.close()
+        coord.close()
+
+
+def test_delta_round_moves_only_changed_entries():
+    """The tentpole property at unit level: after a full-body sync, a
+    changed round moves an O(changed) delta — strictly smaller than the
+    full body — and the client's reconstruction is BYTE-IDENTICAL to
+    what a full-body client fetches."""
+    coords, servers, targets = _serve_slices(4)
+    region = FleetCollector(targets, peer_timeout=0.5)
+    fleet_server = _serve_fleet(region)
+    hstate = _fleet_client(fleet_server.port)
+    try:
+        region.poll_round()
+        doc = _fleet_poll(hstate)  # first contact: the full body
+        assert hstate.mirror.last_changed is None
+        full_len = len(hstate.mirror.body)
+        assert doc == parse_inventory(region.inventory_response()[0])
+        # One slice's verdict moves; everything else idles.
+        changed_labels = dict(LEADER_LABELS)
+        changed_labels["google.com/tpu.slice.healthy-hosts"] = "1"
+        changed_labels["google.com/tpu.slice.degraded"] = "true"
+        coords[0].publish_local(changed_labels, "full")
+        changed = region.poll_round()
+        assert changed == {"s0"}
+        d_before = obs_metrics.FLEET_DELTA_POLLS.value(kind="delta")
+        served_before = obs_metrics.FLEET_DELTA_SERVED.value(
+            outcome="delta"
+        )
+        bytes_before = obs_metrics.FLEET_POLL_BODY_BYTES.value(kind="delta")
+        _fleet_poll(hstate)
+        assert (
+            obs_metrics.FLEET_DELTA_POLLS.value(kind="delta")
+            == d_before + 1
+        )
+        assert (
+            obs_metrics.FLEET_DELTA_SERVED.value(outcome="delta")
+            == served_before + 1
+        )
+        delta_bytes = (
+            obs_metrics.FLEET_POLL_BODY_BYTES.value(kind="delta")
+            - bytes_before
+        )
+        assert 0 < delta_bytes < full_len
+        assert hstate.mirror.last_changed == {"s0"}
+        body, etag = region.inventory_response()
+        assert hstate.mirror.body == body
+        assert hstate.etag == etag
+        entry = hstate.mirror.doc["slices"]["s0"]
+        assert entry["healthy_hosts"] == 1 and entry["degraded"] is True
+    finally:
+        from gpu_feature_discovery_tpu.fleet.collector import (
+            drop_connection,
+        )
+
+        drop_connection(hstate)
+        fleet_server.close()
+        region.close()
+        for s in servers:
+            s.close()
+        for c in coords:
+            c.close()
+
+
+def test_delta_since_equals_generation_is_a_304():
+    """An in-sync delta client's idle poll is still a 304 header
+    exchange: ``?since`` == the server's generation with a matching
+    If-None-Match answers no body at all (the empty-delta equivalent),
+    and the idle-economy counter moves."""
+    coords, servers, targets = _serve_slices(2)
+    region = FleetCollector(targets, peer_timeout=0.5)
+    fleet_server = _serve_fleet(region)
+    hstate = _fleet_client(fleet_server.port)
+    try:
+        region.poll_round()
+        _fleet_poll(hstate)
+        before = obs_metrics.FLEET_INVENTORY_NOT_MODIFIED.value()
+        resync_before = obs_metrics.FLEET_DELTA_SERVED.value(
+            outcome="resync"
+        )
+        doc = _fleet_poll(hstate)
+        assert (
+            obs_metrics.FLEET_INVENTORY_NOT_MODIFIED.value() == before + 1
+        )
+        # An in-sync client is NOT a resync: nothing served, nothing
+        # counted.
+        assert (
+            obs_metrics.FLEET_DELTA_SERVED.value(outcome="resync")
+            == resync_before
+        )
+        assert hstate.mirror.last_changed == set()
+        assert doc == hstate.mirror.doc
+    finally:
+        from gpu_feature_discovery_tpu.fleet.collector import (
+            drop_connection,
+        )
+
+        drop_connection(hstate)
+        fleet_server.close()
+        region.close()
+        for s in servers:
+            s.close()
+        for c in coords:
+            c.close()
+
+
+def test_delta_since_ahead_or_off_lineage_forces_full_resync():
+    """A client claiming a generation the server never published (a
+    lost-state restart artifact) or holding an ETag off the server's
+    recorded lineage must get the FULL body — never a wrong delta."""
+    coords, servers, targets = _serve_slices(2)
+    region = FleetCollector(targets, peer_timeout=0.5)
+    fleet_server = _serve_fleet(region)
+    hstate = _fleet_client(fleet_server.port)
+    try:
+        region.poll_round()
+        _fleet_poll(hstate)
+        gen = region.inventory_payload()["generation"]
+        # Ahead of the server, with a stale ETag that matches nothing.
+        hstate.mirror.generation = gen + 7
+        hstate.etag = '"not-a-real-etag"'
+        resync_before = obs_metrics.FLEET_DELTA_SERVED.value(
+            outcome="resync"
+        )
+        doc = _fleet_poll(hstate)
+        assert (
+            obs_metrics.FLEET_DELTA_SERVED.value(outcome="resync")
+            == resync_before + 1
+        )
+        # Full-body replacement, byte-identical to the server's pane.
+        assert hstate.mirror.last_changed is None
+        assert hstate.mirror.body == region.inventory_response()[0]
+        assert doc["slices"] == region.inventory_payload()["slices"]
+        # The serving hook's decision table, directly: inside the
+        # window but off-lineage is a resync too.
+        body, _ = region.delta_response(gen, '"wrong"')
+        assert body == region.inventory_response()[0]
+        assert not parse_inventory(body).get("delta")
+    finally:
+        from gpu_feature_discovery_tpu.fleet.collector import (
+            drop_connection,
+        )
+
+        drop_connection(hstate)
+        fleet_server.close()
+        region.close()
+        for s in servers:
+            s.close()
+        for c in coords:
+            c.close()
+
+
+def test_delta_client_missing_a_tombstone_resyncs_byte_identical():
+    """The self-verification property: a delta that fails to mention a
+    dropped key reconstructs a pane a full-body client would not hold —
+    the mirror detects the ETag mismatch, refuses the pane, and the
+    full-body resync restores byte-identity."""
+    from gpu_feature_discovery_tpu.fleet import (
+        DeltaMirror,
+        DeltaSyncError,
+        build_delta,
+        build_inventory,
+        serialize_inventory,
+    )
+
+    e = {"reachable": True, "stale": False}
+    base = build_inventory({"s0": e, "s1": e}, 3, False)
+    truth = build_inventory({"s0": dict(e, stale=True)}, 4, False)
+    truth_body, truth_etag = serialize_inventory(truth)
+    mirror = DeltaMirror()
+    mirror.apply(base, None)
+    # The wire delta SHOULD carry tombstones=["s1"]; this one lost it.
+    bad = build_delta(3, 4, False, {"s0": dict(e, stale=True)}, [])
+    with pytest.raises(DeltaSyncError):
+        mirror.apply(bad, truth_etag)
+    # Recovery is the full body — after it, byte-identity holds.
+    mirror2 = DeltaMirror()
+    mirror2.apply(truth, truth_etag)
+    assert mirror2.body == truth_body
+    # And the SOUND delta applies cleanly to a fresh mirror on base.
+    mirror3 = DeltaMirror()
+    mirror3.apply(base, None)
+    good = build_delta(3, 4, False, {"s0": dict(e, stale=True)}, ["s1"])
+    mirror3.apply(good, truth_etag)
+    assert mirror3.body == truth_body
+    assert mirror3.last_changed == {"s0", "s1"}
+
+
+def test_targets_drop_tombstones_across_epoch_rebuild(tmp_path):
+    """A slice dropped from the targets file mid-run (the mtime-watch
+    reload rebuilds the collector epoch on the same --state-dir) is
+    announced to delta clients as a TOMBSTONE riding the persisted
+    generation lineage — the client prunes it without a full resync and
+    stays byte-identical to a full-body client."""
+    coords, servers, targets = _serve_slices(2)
+    state_dir = str(tmp_path)
+    epoch1 = FleetCollector(
+        targets, peer_timeout=0.5, state_dir=state_dir
+    )
+    server1 = _serve_fleet(epoch1)
+    hstate = _fleet_client(server1.port)
+    epoch2 = server2 = None
+    try:
+        epoch1.poll_round()
+        _fleet_poll(hstate)
+        gen1 = epoch1.inventory_payload()["generation"]
+        assert hstate.mirror.generation == gen1
+        server1.close()
+        epoch1.close()
+        # The reload: s1 left the targets file; same state-dir.
+        epoch2 = FleetCollector(
+            targets[:1], peer_timeout=0.5, state_dir=state_dir
+        )
+        epoch2.poll_round()
+        assert epoch2.inventory_payload()["generation"] > gen1
+        server2 = _serve_fleet(epoch2)
+        from gpu_feature_discovery_tpu.fleet.collector import (
+            drop_connection,
+        )
+
+        drop_connection(hstate)
+        import http.client
+
+        hstate.port = server2.port
+        hstate.conn = http.client.HTTPConnection(
+            "127.0.0.1", server2.port, timeout=5
+        )
+        delta_before = obs_metrics.FLEET_DELTA_SERVED.value(
+            outcome="delta"
+        )
+        _fleet_poll(hstate)
+        # The epoch hop was served as a DELTA (the lineage persisted),
+        # s1 arrived as a tombstone, and byte-identity holds.
+        assert (
+            obs_metrics.FLEET_DELTA_SERVED.value(outcome="delta")
+            == delta_before + 1
+        )
+        assert "s1" in hstate.mirror.last_changed
+        assert "s1" not in hstate.mirror.doc["slices"]
+        assert hstate.mirror.body == epoch2.inventory_response()[0]
+    finally:
+        from gpu_feature_discovery_tpu.fleet.collector import (
+            drop_connection,
+        )
+
+        drop_connection(hstate)
+        if server2 is not None:
+            server2.close()
+        if epoch2 is not None:
+            epoch2.close()
+        for s in servers:
+            s.close()
+        for c in coords:
+            c.close()
+
+
+def test_full_body_and_delta_unaware_clients_stay_byte_identical():
+    """The backward-compat pin: the delta protocol adds NOTHING to the
+    full wire body (same keys, same bytes, delta-capable server or
+    not), a delta-unaware client (no ?since) reads today's wire, and a
+    garbled ?since degrades to the full body, never a 4xx."""
+    coords, servers, targets = _serve_slices(2)
+    region = FleetCollector(targets, peer_timeout=0.5)
+    plain = FleetCollector(targets, peer_timeout=0.5, delta_window=0)
+    delta_server = _serve_fleet(region)
+    plain_server = _serve_fleet(plain, delta=False)
+    try:
+        region.poll_round()
+        plain.poll_round()
+        body, etag = region.inventory_response()
+        doc = parse_inventory(body)
+        # The PR 15 key set, exactly — no delta vocabulary on the full
+        # wire (per-entry generations stay INTERNAL).
+        assert set(doc) == {
+            "schema", "peer_schema", "generation", "restored", "slices"
+        }
+        # A delta-window=0 / delta-unwired server serves the same body
+        # a delta-capable one does (the fixtures scrape identical
+        # fleets; the quantized stamps agree inside one quantum).
+        assert plain.inventory_response()[0] == body
+        # Delta-unaware GET (no query) on the delta-capable server.
+        status, wire = http_get(
+            f"http://127.0.0.1:{delta_server.port}/fleet/snapshot"
+        )
+        assert (status, wire) == (200, body)
+        # Garbled ?since: full body, 200.
+        status, wire = http_get(
+            f"http://127.0.0.1:{delta_server.port}/fleet/snapshot"
+            "?since=banana"
+        )
+        assert (status, wire) == (200, body)
+        # ?since on a server whose window is 0: full body (delta
+        # serving disabled, never an error).
+        body0, _ = plain.delta_response(0, etag)
+        assert body0 == body
+    finally:
+        delta_server.close()
+        plain_server.close()
+        region.close()
+        plain.close()
+        for s in servers:
+            s.close()
+        for c in coords:
+            c.close()
+
+
+def test_federation_hop_rides_deltas_and_stays_identical():
+    """The root's region scrape is delta-aware end to end: after first
+    contact the hop moves O(changed) bodies (regions_changed /
+    regions_tombstones included), and the root's merged pane matches
+    what a from-scratch root over the same region builds."""
+    coords, servers, targets = _serve_slices(3)
+    region = FleetCollector(targets, peer_timeout=0.5)
+    region_server = _serve_fleet(region)
+    root = root2 = None
+    try:
+        region.poll_round()
+        root = _root_over([region_server], names=["r0"])
+        root.poll_round()
+        # A changed slice: the next root round crosses the hop as a
+        # delta, not a full region body.
+        changed_labels = dict(LEADER_LABELS)
+        changed_labels["google.com/tpu.slice.sick-chips"] = "2"
+        changed_labels["google.com/tpu.chips.healthy"] = "2"
+        changed_labels["google.com/tpu.chips.sick"] = "2"
+        coords[1].publish_local(changed_labels, "full")
+        region.poll_round()
+        d_before = obs_metrics.FLEET_DELTA_POLLS.value(kind="delta")
+        changed = root.poll_round()
+        assert (
+            obs_metrics.FLEET_DELTA_POLLS.value(kind="delta")
+            == d_before + 1
+        )
+        assert changed == {"region/r0/s1"}
+        # Identity: a fresh root (full-body first contact) over the
+        # same region serves the delta-built root's exact entries.
+        root2 = _root_over([region_server], names=["r0"])
+        root2.poll_round()
+        assert (
+            root.inventory_payload()["slices"]
+            == root2.inventory_payload()["slices"]
+        )
+    finally:
+        if root2 is not None:
+            root2.close()
+        if root is not None:
+            root.close()
+        region_server.close()
+        region.close()
+        for s in servers:
+            s.close()
+        for c in coords:
+            c.close()
+
+
+def test_ha_incremental_divergence_matches_full_walk():
+    """The standby's divergence gauge maintained O(changed) equals the
+    full-walk truth through agree/split/heal transitions, and the
+    mirror poll itself rides the delta protocol."""
+    from gpu_feature_discovery_tpu.fleet import HaMonitor
+    from gpu_feature_discovery_tpu.fleet.ha import entries_divergence
+
+    coords, servers, targets = _serve_slices(3)
+    active = FleetCollector(targets, peer_timeout=0.5)
+    active_server = _serve_fleet(active)
+    # The standby watches only 2 of the 3 slices: a persistent split.
+    standby = FleetCollector(targets[:2], peer_timeout=0.5)
+    ha = HaMonitor(
+        [f"127.0.0.1:{active_server.port}", "standby:9102"],
+        "standby:9102",
+        peer_timeout=0.5,
+    )
+    try:
+        active.poll_round()
+        changed = standby.poll_round()
+        own = standby.inventory_payload()["slices"]
+        assert ha.observe_round(own, own_changed=changed) == "standby"
+        mirrored = ha._seniors[0][1].last_snapshot["slices"]
+        assert ha.divergence == entries_divergence(own, mirrored) == 1
+        # An idle round: the incremental path (both changed-sets empty)
+        # keeps the verdict without re-walking.
+        changed = standby.poll_round()
+        assert changed == set()
+        assert ha.observe_round(own, own_changed=changed) == "standby"
+        assert ha.divergence == 1
+        d_polls = obs_metrics.FLEET_DELTA_POLLS.value(kind="delta")
+        # The split deepens: a shared slice's verdict moves and the
+        # ACTIVE scrapes it while the standby's round misses (its pane
+        # holds the old verdict) — the mirror hop carries the move as a
+        # delta and the incremental divergence picks it up.
+        changed_labels = dict(LEADER_LABELS)
+        changed_labels["google.com/tpu.slice.healthy-hosts"] = "1"
+        changed_labels["google.com/tpu.slice.degraded"] = "true"
+        coords[0].publish_local(changed_labels, "full")
+        active.poll_round()
+        assert ha.observe_round(own, own_changed=set()) == "standby"
+        assert (
+            obs_metrics.FLEET_DELTA_POLLS.value(kind="delta")
+            == d_polls + 1
+        )
+        mirrored = ha._seniors[0][1].last_snapshot["slices"]
+        assert ha.divergence == entries_divergence(own, mirrored) == 2
+        # The standby catches up on its next round: the shared slice
+        # heals and divergence falls back to the structural 1 through
+        # the incremental path.
+        changed = standby.poll_round()
+        assert changed == {"s0"}
+        own = standby.inventory_payload()["slices"]
+        assert ha.observe_round(own, own_changed=changed) == "standby"
+        mirrored = ha._seniors[0][1].last_snapshot["slices"]
+        assert ha.divergence == entries_divergence(own, mirrored) == 1
+        assert (
+            obs_metrics.FLEET_HA_DIVERGENCE.value() == ha.divergence
+        )
+    finally:
+        ha.close()
+        standby.close()
+        active_server.close()
+        active.close()
+        for s in servers:
+            s.close()
+        for c in coords:
+            c.close()

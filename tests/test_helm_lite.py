@@ -682,6 +682,30 @@ def test_fleet_replicas_two_renders_pod_anti_affinity_and_ha_env():
     assert env["TFD_FLEET_HA_SELF"] == "fleet-a:9102"
 
 
+def test_fleet_delta_window_renders_only_when_set():
+    """deltaWindow is invisible at its default (the golden test pins
+    byte-identity) and lands verbatim as TFD_FLEET_DELTA_WINDOW when
+    set — including the string \"0\" that disables the delta path."""
+    docs = render_chart(
+        CHART,
+        values_overrides={
+            "fleetCollector.enabled": True,
+            "fleetCollector.deltaWindow": "0",
+        },
+    )
+    dep = next(
+        d
+        for d in docs
+        if d.get("kind") == "Deployment"
+        and d["metadata"]["name"].endswith("fleet-collector")
+    )
+    env = {
+        e["name"]: e.get("value")
+        for e in dep["spec"]["template"]["spec"]["containers"][0]["env"]
+    }
+    assert env["TFD_FLEET_DELTA_WINDOW"] == "0"
+
+
 def test_fleet_root_renders_the_federation_tier():
     """root.enabled renders the second deployment one tier up:
     upstream-mode=collectors env, its own targets ConfigMap (regions),
