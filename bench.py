@@ -1588,6 +1588,7 @@ def main() -> int:
         scale_rng = _scale_random.Random(16)
         scale_rounds_ms = []
         scale_ratios = []
+        scale_req_before = scale_mock.stats["requests"]
         for _ in range(5):
             scale_mock.churn(0.01, rng=scale_rng)
             hop_before = sum(
@@ -1622,6 +1623,9 @@ def main() -> int:
                 if line.startswith("VmRSS:")
             )
         fleet_scale_rss_mb = round(rss_kb / 1024.0, 1)
+        idle_poll_requests_per_round_pull = round(
+            (scale_mock.stats["requests"] - scale_req_before) / 5.0, 1
+        )
     finally:
         if scale_tiers is not None:
             scale_tiers.close()
@@ -1631,6 +1635,48 @@ def main() -> int:
         f"(1% churn) p50={fleet_scale_root_round_ms}ms, delta/full "
         f"bytes ratio {fleet_delta_bytes_ratio} on the root hop, "
         f"rss {fleet_scale_rss_mb}MB",
+        file=sys.stderr,
+    )
+
+    # Push-on-delta economy (ISSUE 17): the same fleet shape with
+    # --push-notify and a sweep cadence far beyond the bench window.
+    # Each churned mock leader POSTs a real authenticated /peer/notify
+    # hint to its region; the region polls only notified children and
+    # its own NotifySender nudges the root — so the per-round request
+    # count drops from O(children) to O(changed). CI asserts >= 90%
+    # fewer mock-tier polls per 1%-churn round than pull mode above.
+    push_mock = MockFleet(
+        scale_slices,
+        keepalive=scale_slices <= 2000,
+        peer_token="bench-notify",
+    )
+    push_tiers = None
+    try:
+        push_tiers = FleetTiers(
+            push_mock,
+            n_regions=max(2, min(16, scale_slices // 250)),
+            wall_clock=lambda: 1_700_000_000.0,
+            peer_token="bench-notify",
+            push_notify=True,
+            sweep_interval=3600.0,
+        )
+        push_tiers.round()  # cold-start sweep + plants subscriptions
+        push_rng = _scale_random.Random(17)
+        push_req_before = push_mock.stats["requests"]
+        for _ in range(5):
+            push_mock.churn(0.01, rng=push_rng)
+            push_tiers.round()
+        idle_poll_requests_per_round_push = round(
+            (push_mock.stats["requests"] - push_req_before) / 5.0, 1
+        )
+    finally:
+        if push_tiers is not None:
+            push_tiers.close()
+        push_mock.close()
+    print(
+        f"bench: push-on-delta round over {scale_slices} mock slices "
+        f"(1% churn) polls {idle_poll_requests_per_round_push} "
+        f"children/round vs {idle_poll_requests_per_round_pull} pull",
         file=sys.stderr,
     )
 
@@ -1893,6 +1939,15 @@ def main() -> int:
                 "fleet_scale_root_round_ms": fleet_scale_root_round_ms,
                 "fleet_delta_bytes_ratio": fleet_delta_bytes_ratio,
                 "fleet_scale_rss_mb": fleet_scale_rss_mb,
+                # Push-on-delta economy (ISSUE 17): mock-tier poll
+                # requests per 1%-churn round, pull loop vs push with a
+                # long sweep cadence — CI asserts push is >= 90% fewer.
+                "idle_poll_requests_per_round_pull": (
+                    idle_poll_requests_per_round_pull
+                ),
+                "idle_poll_requests_per_round_push": (
+                    idle_poll_requests_per_round_push
+                ),
                 "sleep_interval_ms": round(DEFAULT_SLEEP_INTERVAL * 1e3, 3),
                 # Event-driven reconcile acceptance (ISSUE 9): POST
                 # /probe -> label file mtime change against a 60s sleep

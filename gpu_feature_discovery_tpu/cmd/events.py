@@ -73,6 +73,7 @@ REASON_WORKER_DIED = "worker_died"
 REASON_CONFIG_CHANGED = "config_changed"
 REASON_HEALTH_DELTA = "health_delta"
 REASON_PEER_DELTA = "peer_delta"
+REASON_PEER_NOTIFY = "peer_notify"
 REASON_PROBE_REQUEST = "probe_request"
 REASON_STALENESS_BOUND = "staleness_bound"
 
@@ -284,15 +285,16 @@ def health_subset(labels) -> dict:
 class DeltaTracker:
     """The run loop's own producers: posts ``HEALTH_DELTA`` when the
     health projection of the published labels moves between cycles, and
-    ``PEER_DELTA`` when the slice coordinator's reachable-membership
-    fingerprint moves between polls. The FIRST observation only sets the
-    baseline (a fresh epoch's first cycle defines the picture, it does
-    not chase it)."""
+    ``PEER_DELTA`` when a membership fingerprint moves between polls —
+    slice peer reachability, a fleet collector's per-region reachable
+    set, any scope a caller names. The FIRST observation of each scope
+    only sets its baseline (a fresh epoch's first cycle defines the
+    picture, it does not chase it)."""
 
     def __init__(self, events: EventQueue):
         self._events = events
         self._health: Optional[dict] = None
-        self._peers = None
+        self._memberships: dict = {}
 
     def observe_labels(self, labels) -> None:
         subset = health_subset(labels)
@@ -312,13 +314,63 @@ class DeltaTracker:
     def observe_peers(self, membership) -> None:
         """``membership`` is the coordinator's reachable-peer fingerprint
         (None before its first poll round completes)."""
+        self.observe_membership("slice", membership)
+
+    def observe_membership(self, scope: str, membership) -> None:
+        """Generic membership fingerprint, one independent baseline per
+        ``scope`` (``"slice"`` for peer reachability; a fleet collector
+        uses its region/target names). ``membership`` is any comparable
+        iterable fingerprint; None means "not observed yet" and never
+        moves the baseline."""
         if membership is None:
             return
-        if self._peers is not None and membership != self._peers:
+        if scope in self._memberships and membership != self._memberships[scope]:
             self._events.post(
                 Event(REASON_PEER_DELTA, detail=str(sorted(membership)))
             )
-        self._peers = membership
+        self._memberships[scope] = membership
+
+
+class TokenBucket:
+    """The reconcile storm guard's pacing primitive, extracted so every
+    tier's event-driven wait can share it (the fleet collector's
+    notify-woken rounds reuse it verbatim): ``burst`` tokens refilled at
+    ``rate``/s; taking one admits an event-driven cycle. Single-consumer
+    like the loop that owns it — no internal lock."""
+
+    def __init__(
+        self,
+        rate: float,
+        burst: float,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._rate = float(rate)
+        self._burst = max(float(burst), 1.0)
+        self._clock = clock
+        self._tokens = self._burst
+        self._last_refill = clock()
+
+    def _refill(self) -> None:
+        now = self._clock()
+        self._tokens = min(
+            self._burst, self._tokens + (now - self._last_refill) * self._rate
+        )
+        self._last_refill = now
+
+    def try_take(self) -> bool:
+        """Take one token if available."""
+        self._refill()
+        if self._tokens >= 1.0:
+            self._tokens -= 1.0
+            return True
+        return False
+
+    def seconds_to_token(self) -> float:
+        """How long until try_take could succeed (0 = now)."""
+        self._refill()
+        if self._tokens >= 1.0:
+            return 0.0
+        return (1.0 - self._tokens) / self._rate
 
 
 class ReconcileLoop:
@@ -338,20 +390,8 @@ class ReconcileLoop:
         self._events = events
         self._max_staleness = max(float(max_staleness), 0.001)
         self._debounce = max(float(debounce), 0.0)
-        self._rate = float(max_probe_rate)
-        self._burst = max(float(burst), 1.0)
         self._clock = clock
-        self._tokens = self._burst
-        self._last_refill = clock()
-
-    # -- token bucket ------------------------------------------------------
-
-    def _refill(self) -> None:
-        now = self._clock()
-        self._tokens = min(
-            self._burst, self._tokens + (now - self._last_refill) * self._rate
-        )
-        self._last_refill = now
+        self._bucket = TokenBucket(max_probe_rate, burst, clock)
 
     # -- decisions ---------------------------------------------------------
 
@@ -422,15 +462,13 @@ class ReconcileLoop:
         # deadline dominates (the bound is a guarantee, the guard is
         # pacing).
         while True:
-            self._refill()
-            if self._tokens >= 1.0:
-                self._tokens -= 1.0
+            if self._bucket.try_take():
                 break
             now = self._clock()
             if now >= deadline:
                 reasons.append(REASON_STALENESS_BOUND)
                 break
-            wait = min((1.0 - self._tokens) / self._rate, deadline - now)
+            wait = min(self._bucket.seconds_to_token(), deadline - now)
             event = self._events.get(wait)
             if event is not None:
                 decision = self._decision_for(event)

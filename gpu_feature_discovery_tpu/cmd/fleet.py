@@ -45,6 +45,8 @@ from gpu_feature_discovery_tpu.config.flags import (
 )
 from gpu_feature_discovery_tpu.config.spec import (
     DEFAULT_FLEET_DELTA_WINDOW,
+    PUSH_NOTIFY_AUTO,
+    PUSH_NOTIFY_MODES,
     UPSTREAM_COLLECTORS,
     UPSTREAM_SLICES,
     ConfigError,
@@ -68,6 +70,11 @@ DEFAULT_SCRAPE_INTERVAL = 10.0
 # Round budget as a fraction of the interval: a round must never bleed
 # into the next (the engine's 0.8 * labeler-timeout rationale).
 ROUND_BUDGET_FRACTION = 0.8
+# How long a notify-woken early round waits before starting, so a burst
+# of child notifications (a rollout touching many slices at once)
+# coalesces into one round instead of one round per notification — the
+# daemon tier's reconcile-debounce rationale.
+NOTIFY_DEBOUNCE_S = 0.5
 
 
 @dataclass(frozen=True)
@@ -148,6 +155,35 @@ FLEET_FLAG_DEFS: List[FleetFlag] = [
         "slices' daemons require it once their --peer-token is set) "
         "and required on the collector's own /fleet/snapshot; empty "
         "sends nothing and serves the inventory openly",
+    ),
+    FleetFlag(
+        name="push-notify",
+        env_vars=("TFD_PUSH_NOTIFY",),
+        parse=str,
+        default=PUSH_NOTIFY_AUTO,
+        help="push-on-delta notifications (on | off | auto): 'on' makes "
+        "this collector SUBSCRIBE on the polls it already sends (its "
+        "children POST a small authenticated /peer/notify hint when "
+        "their snapshot changes, and between full confirmation sweeps "
+        "on the --max-staleness cadence a round polls only dirty "
+        "targets) and NOTIFY its own parent the same way when the "
+        "served inventory changes; 'off' is today's poll-everything "
+        "round byte for byte; 'auto' (default) is on exactly when "
+        "--peer-token is set — the notify endpoint never works "
+        "unauthenticated",
+    ),
+    FleetFlag(
+        name="max-staleness",
+        env_vars=("TFD_MAX_STALENESS",),
+        parse=parse_duration,
+        default=0.0,
+        help="the full confirmation-sweep cadence under --push-notify "
+        "(Go duration): between sweeps a round polls only notified-"
+        "dirty targets, and the sweep — the ONLY correctness mechanism "
+        "— repairs lost notifications, dead children that cannot push "
+        "their own death, and rotated tokens within this bound. 0 "
+        "(default) sweeps every round: push adds promptness but the "
+        "idle economy stays pull-shaped until a cadence is set",
     ),
     FleetFlag(
         name="state-dir",
@@ -265,9 +301,13 @@ def run_epoch(values: dict, targets, sigs) -> str:
         IntrospectionServer,
         IntrospectionState,
     )
+    from gpu_feature_discovery_tpu.peering.notify import resolve_push_notify
 
     interval = values["scrape-interval"]
     upstream_mode = values["upstream-mode"]
+    push = resolve_push_notify(
+        values["push-notify"] or PUSH_NOTIFY_AUTO, values["peer-token"]
+    )
     collector = FleetCollector(
         targets,
         # Bare target hosts default to the tier they name: slice daemons
@@ -285,6 +325,11 @@ def run_epoch(values: dict, targets, sigs) -> str:
         state_dir=values["state-dir"],
         upstream_mode=upstream_mode,
         delta_window=values["delta-window"],
+        push_notify=push,
+        # An unset --max-staleness sweeps on the scrape cadence itself
+        # (every round — push adds promptness, not yet economy); a set
+        # cadence makes the rounds between sweeps O(dirty).
+        sweep_interval=values["max-staleness"] or interval,
     )
     ha = None
     if values["ha-peers"]:
@@ -302,6 +347,24 @@ def run_epoch(values: dict, targets, sigs) -> str:
             peer_token=values["peer-token"],
         )
     state = IntrospectionState(interval)
+    events = reconcile_events.EventQueue()
+    peer_notify = notify_subscribe = None
+    if push:
+        def peer_notify(name, generation, etag):
+            # The receive hook runs on a handler thread: mark the child
+            # dirty (name validated against the configured targets) and
+            # post the wake — the run loop decides, under its own storm
+            # damping, whether the next round starts early.
+            if not collector.mark_dirty(name, generation, etag):
+                return False
+            events.post(
+                reconcile_events.Event(
+                    reconcile_events.REASON_PEER_NOTIFY, detail=name
+                )
+            )
+            return True
+
+        notify_subscribe = collector.notify_subscriptions.observe_poll
     server = None
     try:
         server = IntrospectionServer(
@@ -315,6 +378,8 @@ def run_epoch(values: dict, targets, sigs) -> str:
             fleet_snapshot=collector.inventory_response,
             fleet_delta=collector.delta_response,
             peer_token=values["peer-token"],
+            peer_notify=peer_notify,
+            notify_subscribe=notify_subscribe,
         )
     except OSError as e:
         log.error(
@@ -327,16 +392,27 @@ def run_epoch(values: dict, targets, sigs) -> str:
             ha.close()
         collector.close()
         return "error"
+    if push:
+        # The BOUND port (the flag may say 0 = ephemeral) rides the
+        # subscribe headers so children know where to POST back.
+        collector.set_notify_port(server.port)
     server.start()
     log.info(
         "fleet collector serving on %s:%d (%d slices, scrape interval "
-        "%.1fs)",
+        "%.1fs%s)",
         values["metrics-addr"],
         server.port,
         len(targets),
         interval,
+        ", push-on-delta" if push else "",
     )
-    events = reconcile_events.EventQueue()
+    # Storm damping for notify-woken early rounds: a fleet-wide restart
+    # makes every child notify at once, and the damper bounds the extra
+    # rounds to roughly one per interval plus a small burst — the sweep
+    # cadence is never threatened, only supplemented.
+    notify_bucket = reconcile_events.TokenBucket(
+        rate=1.0 / max(interval, 0.001), burst=reconcile_events.PROBE_BURST
+    )
     watcher = reconcile_events.ConfigFileWatcher(
         values["targets-file"], events
     ).start()
@@ -366,21 +442,46 @@ def run_epoch(values: dict, targets, sigs) -> str:
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     break
-                # Two producers, one wait: the OS signal queue decides
+                # Three producers, one wait: the OS signal queue decides
                 # immediately; the targets watcher's CONFIG_CHANGED is
-                # a restart. Bounded sub-waits keep reload latency
-                # under ~0.2s on top of the watcher's own poll.
+                # a restart; a child's accepted /peer/notify starts the
+                # next round early — debounced so a burst coalesces into
+                # ONE early round, token-bucketed so a notify storm
+                # cannot turn the scrape loop into a busy loop (the
+                # scheduled cadence and its sweep are unaffected either
+                # way). Bounded sub-waits keep reload latency under
+                # ~0.2s on top of the watcher's own poll.
                 decision = _check_signal(
                     sigs, timeout=min(0.2, remaining)
                 )
                 if decision is not None:
                     return decision
                 event = events.get_nowait()
-                if event is not None and (
-                    event.reason == reconcile_events.REASON_CONFIG_CHANGED
-                ):
+                if event is None:
+                    continue
+                if event.reason == reconcile_events.REASON_CONFIG_CHANGED:
                     log.info("targets file changed; reloading fleet")
                     return "restart"
+                if (
+                    event.reason == reconcile_events.REASON_PEER_NOTIFY
+                    and remaining > NOTIFY_DEBOUNCE_S
+                    and notify_bucket.try_take()
+                ):
+                    debounce_until = time.monotonic() + NOTIFY_DEBOUNCE_S
+                    while time.monotonic() < debounce_until:
+                        decision = _check_signal(sigs, timeout=0.1)
+                        if decision is not None:
+                            return decision
+                        drain = events.get_nowait()
+                        if drain is not None and (
+                            drain.reason
+                            == reconcile_events.REASON_CONFIG_CHANGED
+                        ):
+                            log.info(
+                                "targets file changed; reloading fleet"
+                            )
+                            return "restart"
+                    break
     finally:
         watcher.stop()
         server.close()
@@ -420,6 +521,11 @@ def main(argv: Optional[list] = None) -> int:
                     "TFD_FLEET_TARGETS"
                 )
                 return 1
+            if values["push-notify"] not in PUSH_NOTIFY_MODES:
+                raise ConfigError(
+                    f"invalid --push-notify {values['push-notify']!r} "
+                    f"(expected one of {', '.join(PUSH_NOTIFY_MODES)})"
+                )
             if bool(values["ha-peers"]) != bool(values["ha-self"]):
                 raise ConfigError(
                     "--ha-peers and --ha-self must be set together "

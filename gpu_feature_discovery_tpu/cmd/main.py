@@ -263,6 +263,8 @@ def start_introspection_server(
     peer_snapshot=None,
     probe_request=None,
     peer_fault=None,
+    peer_notify=None,
+    notify_subscribe=None,
 ):
     """Bind the obs introspection server for a daemon epoch; returns
     ``(server, state)`` or ``(None, None)``. Oneshot NEVER serves (a
@@ -300,6 +302,8 @@ def start_introspection_server(
             # --peer-token: when set, /peer/snapshot requires the shared
             # secret (the coordinator's own poller sends it too).
             peer_token=tfd.peer_token or "",
+            peer_notify=peer_notify,
+            notify_subscribe=notify_subscribe,
         )
     except OSError as e:
         if not quiet:
@@ -656,6 +660,29 @@ def run(
                 reconcile_events.Event(reconcile_events.REASON_PROBE_REQUEST)
             )
 
+    # Push-on-delta receive side (peering/notify.py): a child peer's
+    # authenticated POST /peer/notify marks it dirty (name validated
+    # against the coordinator's own peer set) and — in event mode —
+    # wakes the reconcile loop, which debounces and rate-limits the wake
+    # exactly like PEER_DELTA (the storm damper is the loop's own token
+    # bucket). Interval mode still takes the dirty mark: the next
+    # scheduled round polls O(dirty) instead of everyone.
+    peer_notify = None
+    notify_subscribe = None
+    if coordinator is not None and coordinator.push_notify:
+        def peer_notify(name, generation, etag):
+            if not coordinator.mark_dirty(name, generation, etag):
+                return False
+            if events_q is not None:
+                events_q.post(
+                    reconcile_events.Event(
+                        reconcile_events.REASON_PEER_NOTIFY, detail=name
+                    )
+                )
+            return True
+
+        notify_subscribe = coordinator.notify_subscriptions.observe_poll
+
     if supervised:
         # Broker-worker death watch (sandbox/broker.py): the reaper-side
         # thread marks a dead worker dead AT DEATH TIME — so the next
@@ -698,7 +725,14 @@ def run(
         peer_snapshot=peer_snapshot,
         probe_request=probe_request,
         peer_fault=peer_fault,
+        peer_notify=peer_notify,
+        notify_subscribe=notify_subscribe,
     )
+    if obs_server is not None and coordinator is not None:
+        # The BOUND port (the flag may say 0 = ephemeral) rides this
+        # poller's subscribe headers so its own children know where to
+        # POST notifications back.
+        coordinator.set_notify_port(obs_server.port)
     # Anti-flap hysteresis (--flap-window > 1): per-epoch, daemon only —
     # oneshot publishes exactly what it measured.
     flap = None
@@ -747,7 +781,11 @@ def run(
                     peer_snapshot=peer_snapshot,
                     probe_request=probe_request,
                     peer_fault=peer_fault,
+                    peer_notify=peer_notify,
+                    notify_subscribe=notify_subscribe,
                 )
+                if obs_server is not None and coordinator is not None:
+                    coordinator.set_notify_port(obs_server.port)
             cycle_mode = "full"
             try:
                 with timed("labelgen.total"):

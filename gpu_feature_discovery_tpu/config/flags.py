@@ -21,6 +21,8 @@ from gpu_feature_discovery_tpu.config.spec import (
     PROBE_BROKER_MODES,
     PROBE_ISOLATION_AUTO,
     PROBE_ISOLATION_MODES,
+    PUSH_NOTIFY_AUTO,
+    PUSH_NOTIFY_MODES,
     RECONCILE_AUTO,
     RECONCILE_MODES,
     SLICE_COORDINATION_AUTO,
@@ -667,6 +669,22 @@ FLAG_DEFS: List[FlagDef] = [
         getter=lambda c: _f(c).tfd.peer_token,
     ),
     FlagDef(
+        name="push-notify",
+        env_vars=("TFD_PUSH_NOTIFY",),
+        parse=str,
+        default=PUSH_NOTIFY_AUTO,
+        help="push-on-delta notifications: 'on' POSTs a small "
+        "authenticated /peer/notify hint upward whenever the served "
+        "snapshot moves, so the parent's next round polls only dirty "
+        "children (the full confirmation sweep on the --max-staleness "
+        "cadence remains the only correctness mechanism); 'off' "
+        "reproduces the pull-everything round byte for byte; 'auto' "
+        "(default) is on exactly when --peer-token is set — the notify "
+        "endpoint never works unauthenticated",
+        setter=lambda c, v: setattr(_f(c).tfd, "push_notify", v),
+        getter=lambda c: _f(c).tfd.push_notify,
+    ),
+    FlagDef(
         name="state-dir",
         env_vars=("TFD_STATE_DIR",),
         parse=str,
@@ -769,6 +787,12 @@ def new_config(
         raise ConfigError(
             f"invalid slice-coordination: {coordination!r} "
             f"(want one of {SLICE_COORDINATION_MODES})"
+        )
+    push_notify = config.flags.tfd.push_notify
+    if push_notify not in PUSH_NOTIFY_MODES:
+        raise ConfigError(
+            f"invalid push-notify: {push_notify!r} "
+            f"(want one of {PUSH_NOTIFY_MODES})"
         )
     # Deferred import: config is a leaf layer below resource; the
     # registry import runs only at validation time, never at module

@@ -101,6 +101,23 @@ UPSTREAM_SLICES = "slices"
 UPSTREAM_COLLECTORS = "collectors"
 UPSTREAM_MODES = (UPSTREAM_SLICES, UPSTREAM_COLLECTORS)
 
+# Push-on-delta notification modes (peering/notify.py): `on` makes every
+# child whose served snapshot moves POST a small authenticated
+# /peer/notify hint upward so the parent's next round polls only dirty
+# children (the full sweep on the --max-staleness cadence stays the only
+# correctness mechanism); `off` reproduces today's pull-everything round
+# byte for byte; `auto` (the default) is on exactly when --peer-token is
+# configured — notifications are never accepted unauthenticated on a
+# node-exposed server, so without a token there is nothing to enable.
+PUSH_NOTIFY_ON = "on"
+PUSH_NOTIFY_OFF = "off"
+PUSH_NOTIFY_AUTO = "auto"
+PUSH_NOTIFY_MODES = (
+    PUSH_NOTIFY_ON,
+    PUSH_NOTIFY_OFF,
+    PUSH_NOTIFY_AUTO,
+)
+
 
 @dataclass
 class ReplicatedResource:
@@ -249,6 +266,10 @@ class TfdFlags:
     # collector. "" (the default) keeps the surface open on the node
     # network — byte-identical back-compat.
     peer_token: Optional[str] = None  # "" = /peer/snapshot open
+    # Push-on-delta notifications (peering/notify.py): children POST a
+    # small authenticated change hint upward so parents poll only dirty
+    # children between full confirmation sweeps.
+    push_notify: Optional[str] = None  # auto | on | off
 
 
 @dataclass
@@ -337,6 +358,7 @@ class Config:
                         if self.flags.tfd.peer_token
                         else self.flags.tfd.peer_token
                     ),
+                    "pushNotify": self.flags.tfd.push_notify,
                 },
             },
             "sharing": {
@@ -565,6 +587,7 @@ def parse_config_file(path: str) -> Config:
         )
     config.flags.tfd.probe_token = _opt_str(tfd.get("probeToken"))
     config.flags.tfd.peer_token = _opt_str(tfd.get("peerToken"))
+    config.flags.tfd.push_notify = _opt_str(tfd.get("pushNotify"))
 
     config.resources = raw.get("resources", {}) or {}
     config.sharing = Sharing.from_dict(raw.get("sharing", {}) or {})

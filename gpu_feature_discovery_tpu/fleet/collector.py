@@ -59,6 +59,18 @@ With ``--peer-token`` set the collector sends the shared secret on every
 poll (peering/coordinator.PEER_TOKEN_HEADER — the serving daemons
 require it once configured), and its own ``/fleet/snapshot`` is gated by
 the same token (obs/server.py).
+
+**Push-on-delta** (``--push-notify``, peering/notify.py): with push
+enabled the collector plays BOTH roles of the notification hop. As a
+parent it subscribes on the polls it already sends (the notify headers)
+and, between full confirmation sweeps on the ``--max-staleness``
+cadence, polls only targets a child's authenticated ``/peer/notify``
+marked dirty (plus suspects mid-confirmation) — the sweep, not the
+push, remains the only correctness mechanism. As a child it POSTs the
+same hint upward whenever a commit moves the served inventory's ETag,
+so a root over regions (and a higher root over roots) rides the same
+economy. ``--push-notify=off`` is today's poll-everything round byte
+for byte.
 """
 
 from __future__ import annotations
@@ -104,7 +116,15 @@ from gpu_feature_discovery_tpu.peering.coordinator import (
     PEER_BACKOFF_CAP_S,
     PEER_TOKEN_HEADER,
     STALE_CONN_ERRORS,
+    SUBSCRIPTION_TTL_FLOOR_S,
     split_host_port,
+)
+from gpu_feature_discovery_tpu.peering.notify import (
+    NOTIFY_NAME_HEADER,
+    NOTIFY_PORT_HEADER,
+    SUBSCRIPTION_TTL_SWEEPS,
+    NotifySender,
+    NotifySubscriptions,
 )
 from gpu_feature_discovery_tpu.peering.snapshot import (
     MAX_SNAPSHOT_BYTES,
@@ -273,12 +293,14 @@ def request_snapshot(
     token: str = "",
     not_modified_counter: Any = None,
     delta: bool = False,
+    extra_headers: Optional[Dict[str, str]] = None,
 ) -> Dict[str, Any]:
     """The wire half of one poll: GET ``path`` on ``hstate``'s existing
     connection with If-None-Match (a 304 answers from the cached
-    snapshot), the peer token when configured, and a bounded body read
-    through ``parse``. The caller created ``hstate.conn`` under its own
-    closed-gate before calling.
+    snapshot), the peer token when configured, any caller-supplied
+    ``extra_headers`` (the push-on-delta subscribe headers ride here),
+    and a bounded body read through ``parse``. The caller created
+    ``hstate.conn`` under its own closed-gate before calling.
 
     With ``delta=True`` (the /fleet/snapshot consumers) the poll rides
     the generation-delta protocol: once the host's DeltaMirror holds a
@@ -297,6 +319,8 @@ def request_snapshot(
     headers = {}
     if token:
         headers[PEER_TOKEN_HEADER] = token
+    if extra_headers:
+        headers.update(extra_headers)
     if hstate.etag is not None and hstate.last_snapshot is not None:
         headers["If-None-Match"] = hstate.etag
     request_path = path
@@ -376,6 +400,8 @@ class FleetCollector:
         clock: Callable[[], float] = time.monotonic,
         wall_clock: Callable[[], float] = time.time,
         backoff_factory: Optional[Callable[[], BackoffPolicy]] = None,
+        push_notify: bool = False,
+        sweep_interval: float = 0.0,
     ):
         if upstream_mode not in (UPSTREAM_SLICES, UPSTREAM_COLLECTORS):
             raise ValueError(f"unknown upstream mode {upstream_mode!r}")
@@ -433,6 +459,34 @@ class FleetCollector:
         self._etag: Optional[str] = None
         self._restored = False
         self._closed = False
+        # Push-on-delta (peering/notify.py), the coordinator's exact
+        # split one tier up. PARENT side: target names whose accepted
+        # /peer/notify marked them dirty since the last round; between
+        # full sweeps (the --max-staleness cadence — the ONLY
+        # correctness mechanism) a round polls only dirty ∪ suspect
+        # targets. Cold start (_next_sweep=0) always sweeps first, so a
+        # restarted collector that lost its dirty set repairs itself in
+        # one round. CHILD side: the sender posts upward whenever the
+        # committed inventory's ETag moves; subscribers are whoever
+        # polls our /fleet/snapshot with the notify headers.
+        # push_notify=False constructs none of this and is the
+        # pull-everything round byte for byte.
+        self.push_notify = bool(push_notify)
+        self._sweep_interval = max(float(sweep_interval), 0.0)
+        self._next_sweep = 0.0
+        self._dirty: "set" = set()
+        self._notify_port = 0
+        self.notify_subscriptions: Optional[NotifySubscriptions] = None
+        self.notify_sender: Optional[NotifySender] = None
+        if self.push_notify:
+            ttl = max(
+                SUBSCRIPTION_TTL_FLOOR_S,
+                SUBSCRIPTION_TTL_SWEEPS * self._sweep_interval,
+            )
+            self.notify_subscriptions = NotifySubscriptions(ttl, clock=clock)
+            self.notify_sender = NotifySender(
+                self.notify_subscriptions, token=self.peer_token
+            )
         # Delta-sync bookkeeping (all under _lock with the serving
         # state). Per-key generation stamps and tombstones are INTERNAL
         # — the full wire body stays byte-identical to the pre-delta
@@ -665,6 +719,7 @@ class FleetCollector:
         )
         restored = any(s.restored for s in self._slices.values())
         changed_keys: "set" = set()
+        notify_generation, notify_etag = 0, None
         with self._lock:
             if self._closed:
                 return changed_keys
@@ -708,6 +763,7 @@ class FleetCollector:
                     )
                 )
                 self._etag_history[gen] = self._etag
+                notify_generation, notify_etag = gen, self._etag
                 self._delta_cache.clear()
                 while len(self._etag_history) > max(1, self.delta_window):
                     del self._etag_history[min(self._etag_history)]
@@ -732,7 +788,19 @@ class FleetCollector:
                 tombstones=self._tombstones,
                 region_tombstones=self._region_tombstones,
             )
+        self._notify_upward(notify_generation, notify_etag)
         return changed_keys
+
+    def _notify_upward(
+        self, generation: int, etag: Optional[str]
+    ) -> None:
+        """The child-side push trigger, collector-as-child: a commit
+        re-rendered the served inventory (its ETag moved), so tell any
+        subscribed higher tier — a root over a region, a higher root
+        over a root. Strictly best-effort and strictly non-blocking
+        (peering/notify.NotifySender)."""
+        if self.notify_sender is not None and etag:
+            self.notify_sender.publish(generation, etag)
 
     # -- polling side ------------------------------------------------------
 
@@ -746,7 +814,7 @@ class FleetCollector:
         obs_metrics.FLEET_SCRAPE_ROUNDS.inc()
         started = time.perf_counter()
         budget = Budget(self.round_budget, time.perf_counter)
-        names = list(self._slices)
+        names = self._round_targets()
         offset = self._round_offset % len(names) if names else 0
         self._round_offset += 1
         rotated = names[offset:] + names[:offset]
@@ -761,6 +829,77 @@ class FleetCollector:
             time.perf_counter() - started
         )
         return changed
+
+    def _round_targets(self) -> List[str]:
+        """Which target names this round polls. Pull mode (push_notify
+        off): every target, always — byte-identical to the pre-push
+        round. Push mode: a full CONFIRMATION SWEEP of every target when
+        the sweep deadline passed (the only correctness mechanism — it
+        catches dropped notifications, dead children that cannot push
+        their own death, rotated tokens, and a restarted collector whose
+        cold _next_sweep=0 forces an immediate sweep); otherwise only
+        dirty ∪ suspect targets, where a suspect has a chain member with
+        a failure streak in progress (so the 2-miss confirmation and the
+        confirmed-dead backoff cadence advance exactly as they would
+        under pull) or was never attempted AT ALL (a fresh targets-file
+        add must not age until the sweep). A chain member the walk
+        deliberately skips — everyone past the leader — is NOT suspect:
+        it has no failure streak and its target was reached, and
+        treating it as one would re-poll every multi-host slice every
+        round, which is exactly the idle cost push exists to shed."""
+        names = list(self._slices)
+        if not self.push_notify:
+            return names
+        now = self._clock()
+        with self._lock:
+            dirty = set(self._dirty)
+            self._dirty.clear()
+            obs_metrics.DIRTY_CHILDREN.set(0)
+        if now >= self._next_sweep:
+            self._next_sweep = now + self._sweep_interval
+            return names
+        return [
+            name
+            for name in names
+            if name in dirty
+            or any(
+                h.consecutive_failures > 0
+                for h in self._slices[name].hosts
+            )
+            or not any(
+                h.ever_reached for h in self._slices[name].hosts
+            )
+        ]
+
+    def set_notify_port(self, port: int) -> None:
+        """The obs server's BOUND port (cmd/fleet wires it once the
+        server exists — the flag may say 0 = ephemeral): advertised in
+        this poller's subscribe headers so children know where to POST
+        their notifications back."""
+        with self._lock:
+            self._notify_port = int(port or 0)
+
+    def mark_dirty(self, name: str, generation: int = 0, etag: str = "") -> bool:
+        """The POST /peer/notify receive hook: mark the named child
+        dirty for the next round. ``name`` is validated against this
+        collector's OWN configured targets (never the connection address
+        — NAT and shared-address harnesses would lie); an unknown name
+        returns False and dirties nothing, so a stale subscription or a
+        mis-pointed child cannot steer the poll loop. The generation and
+        etag are advisory (logged, never trusted): the poll itself is
+        the only fact-bearing channel."""
+        if name not in self._slices:
+            return False
+        with self._lock:
+            if self._closed:
+                return False
+            self._dirty.add(name)
+            obs_metrics.DIRTY_CHILDREN.set(len(self._dirty))
+        log.debug(
+            "target %s notified delta (generation %s, etag %s)",
+            name, generation, etag,
+        )
+        return True
 
     def _poll_target(self, state: _TargetState, budget: Budget) -> None:
         """Walk one target's chain. In slices mode the walk stops at the
@@ -788,7 +927,7 @@ class FleetCollector:
             if remaining is not None:
                 timeout = min(timeout, remaining)
             try:
-                snapshot = self._fetch(hstate, timeout)
+                snapshot = self._fetch(hstate, timeout, state.target.name)
             except OversizeBodyError as e:
                 # Still one miss, but its own outcome: a body over the
                 # tier's cap is a named anomaly (junk upstream, or an
@@ -974,15 +1113,16 @@ class FleetCollector:
     # -- the HTTP fetch (the peer tier's persistent-connection shape) ------
 
     def _fetch(
-        self, hstate: _HostState, timeout: float
+        self, hstate: _HostState, timeout: float, name: str
     ) -> Dict[str, Any]:
         return fetch_with_stale_retry(
-            hstate, partial(self._request, hstate, timeout)
+            hstate, partial(self._request, hstate, timeout, name)
         )
 
     def _request(
-        self, hstate: _HostState, timeout: float
+        self, hstate: _HostState, timeout: float, name: str
     ) -> Dict[str, Any]:
+        extra_headers = None
         with self._lock:
             # Same closed-gate discipline as the peer poller's _request:
             # a straggler round racing close() must not reopen a dropped
@@ -993,6 +1133,15 @@ class FleetCollector:
                 hstate.conn = http.client.HTTPConnection(
                     hstate.host, hstate.port, timeout=timeout
                 )
+            if self.push_notify and self._notify_port:
+                # Subscribe on the poll we already send: advertise our
+                # notify port and the name we know this child by (the
+                # targets-file entry — echoed back so mark_dirty can
+                # validate it against the configured target set).
+                extra_headers = {
+                    NOTIFY_PORT_HEADER: str(self._notify_port),
+                    NOTIFY_NAME_HEADER: name,
+                }
         return request_snapshot(
             hstate,
             timeout,
@@ -1006,6 +1155,7 @@ class FleetCollector:
             # O(changed). Peer snapshots are per-node and tiny — no
             # delta below the fleet tier.
             delta=self._federated,
+            extra_headers=extra_headers,
         )
 
     def close(self) -> None:
@@ -1014,6 +1164,10 @@ class FleetCollector:
         collector — a dropped slice must not stay latched stale)."""
         with self._lock:
             self._closed = True
+            self._dirty.clear()
+        if self.notify_sender is not None:
+            self.notify_sender.close()
+        obs_metrics.DIRTY_CHILDREN.set(0)
         self._fanout.shutdown(wait=False)
         for state in self._slices.values():
             for hstate in state.hosts:

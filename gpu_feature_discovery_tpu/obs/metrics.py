@@ -439,6 +439,38 @@ FLEET_HA_DIVERGENCE = REGISTRY.gauge(
     "operator must diagnose before trusting either.",
 )
 
+NOTIFY_SENT = REGISTRY.counter(
+    "tfd_notify_sent_total",
+    "Push-on-delta notifications this process attempted upward, by "
+    "outcome: ok (202 accepted), rejected (any non-202 answer — auth "
+    "mismatch, unknown name, parent mid-restart), error (connection "
+    "failed after the capped-backoff retries), dropped (a newer "
+    "generation superseded this one before it could be sent, or the "
+    "notify.drop fault site consumed it). Notifications are lossy hints "
+    "by design: every non-ok outcome is repaired by the parent's next "
+    "confirmation sweep, never by the child blocking its publish path.",
+    labelnames=("outcome",),
+)
+NOTIFY_RECEIVED = REGISTRY.counter(
+    "tfd_notify_received_total",
+    "POST /peer/notify requests this parent's introspection server "
+    "answered, by outcome: ok (202 — the named child was marked dirty "
+    "and the reconcile loop woken), unauthorized (missing or mismatched "
+    "token — the hook is never invoked, so an attacker cannot wake the "
+    "poll loop), unknown (a name outside this parent's child set), "
+    "invalid (unparseable body), disabled (push disabled or no "
+    "subscription hook wired — answered 404), rejected (the "
+    "notify.reject fault site answered 503 — chaos rows only).",
+    labelnames=("outcome",),
+)
+DIRTY_CHILDREN = REGISTRY.gauge(
+    "tfd_dirty_children",
+    "Children currently marked dirty by an accepted /peer/notify hint "
+    "and not yet re-polled. Drains to 0 after every round; a value that "
+    "never drains means the poll loop is wedged while notifications "
+    "keep arriving.",
+)
+
 HTTP_ERRORS = REGISTRY.counter(
     "tfd_http_errors_total",
     "Introspection endpoint handlers that raised; the response is a 500 "

@@ -51,6 +51,22 @@ Endpoint semantics:
   rate-guards like any other wake. 404 without an event loop, 403
   without a configured token (never unauthenticated — the server is
   node-network exposed), 401 on a mismatch.
+- ``POST /peer/notify`` — the push-on-delta hop (peering/notify.py): a
+  CHILD whose served snapshot moved posts a small ``{schema, name,
+  generation, etag}`` hint; this parent marks the named child dirty and
+  wakes its reconcile loop, so the next poll round fetches only dirty
+  children between the full confirmation sweeps that remain the only
+  correctness mechanism. Authenticated by ``--peer-token`` with the
+  same transport and vocabulary as ``POST /probe`` — 404 without a
+  notify hook (push disabled or not a parent), 403 without a configured
+  token (a notification can wake the poll loop, so the endpoint never
+  works unauthenticated), 401 on a mismatch, 400 on an unparseable
+  body, 404 on a name outside this parent's child set, 202 accepted.
+  Parents SUBSCRIBE by adding ``X-TFD-Notify-Port``/``X-TFD-Notify-Name``
+  headers to the snapshot polls they already send; the child records
+  the poll connection's source address plus the advertised port/name
+  with a TTL each poll refreshes — addressing rides the existing poll
+  direction, so nothing new points upward.
 
 ``HEAD`` is answered for every GET endpoint with the same status and
 headers (Content-Length states the GET body's size) and no body — load
@@ -185,6 +201,7 @@ _KNOWN_ENDPOINTS = (
     "/peer/snapshot",
     "/fleet/snapshot",
     "/probe",
+    "/peer/notify",
 )
 
 # Largest POST /probe body the handler drains to keep the keep-alive
@@ -206,6 +223,13 @@ def _endpoint_label(path: str) -> str:
 # to. Flat-mode pollers send no header.
 _POLL_TIER_HEADER = "X-TFD-Poll-Tier"
 
+# The parent's notify-subscription markers (peering/notify.py
+# NOTIFY_PORT_HEADER / NOTIFY_NAME_HEADER — restated here for the same
+# no-peering-import reason): a snapshot poll carrying both asks the
+# served child to POST /peer/notify back at the poll's source address.
+_NOTIFY_PORT_HEADER = "X-TFD-Notify-Port"
+_NOTIFY_NAME_HEADER = "X-TFD-Notify-Name"
+
 
 def _make_handler(
     registry: Registry,
@@ -220,6 +244,8 @@ def _make_handler(
     fleet_delta: Optional[
         Callable[[int, "Optional[str]"], "tuple[bytes, str]"]
     ] = None,
+    peer_notify: Optional[Callable[[str, int, str], bool]] = None,
+    notify_subscribe: Optional[Callable[[str, int, str], None]] = None,
 ):
     class _Handler(BaseHTTPRequestHandler):
         # Content-Length is always sent, so keep-alive is safe.
@@ -265,6 +291,9 @@ def _make_handler(
                     self.close_connection = True
 
         def _dispatch_post(self, path: str):
+            if path == "/peer/notify":
+                self._handle_notify()
+                return
             if path != "/probe" or probe_request is None:
                 # The hook only exists under --reconcile=event (daemon
                 # mode): without an event loop there is nothing a probe
@@ -294,9 +323,68 @@ def _make_handler(
             # the result surface.
             self._reply(202, b"probe scheduled\n")
 
-        def _drain_body(self):
-            """Consume the request body so keep-alive framing survives;
-            an oversized body closes the connection instead."""
+        def _handle_notify(self):
+            """POST /peer/notify: mark the named child dirty. The token
+            gate mirrors POST /probe exactly — a notification wakes the
+            poll loop, so the endpoint NEVER works unauthenticated, and
+            an auth failure returns before the hook is ever invoked (a
+            forged notification cannot wake the parent)."""
+            body = self._read_body()
+            if peer_notify is None:
+                # Push disabled (or this daemon is nobody's parent):
+                # same 404 the absent-hook /probe path answers.
+                metrics.NOTIFY_RECEIVED.labels(outcome="disabled").inc()
+                self._reply(404, b"not found\n")
+                return
+            if not peer_token:
+                metrics.NOTIFY_RECEIVED.labels(outcome="unauthorized").inc()
+                self._reply(
+                    403, b"notify endpoint disabled: --peer-token not set\n"
+                )
+                return
+            if not hmac.compare_digest(
+                self._provided_token().encode(), peer_token.encode()
+            ):
+                metrics.NOTIFY_RECEIVED.labels(outcome="unauthorized").inc()
+                self._reply(401, b"unauthorized\n")
+                return
+            from gpu_feature_discovery_tpu.utils import faults
+
+            if faults.consume("notify.slow"):
+                # Stall past the child sender's timeout — its retries
+                # and give-up must never delay the child's publish path.
+                time.sleep(PEER_SLOW_DELAY_S)
+            if faults.consume("notify.reject"):
+                # An authenticated parent refusing valid notifications
+                # (mid-restart, shedding load): the child must count a
+                # rejection and lean on the sweep, never retry-storm.
+                metrics.NOTIFY_RECEIVED.labels(outcome="rejected").inc()
+                self._reply(503, b"notify rejected\n")
+                return
+            try:
+                doc = json.loads(body.decode("utf-8"))
+                name = str(doc["name"])
+                generation = int(doc.get("generation", 0))
+                etag = str(doc.get("etag", ""))
+            except (ValueError, KeyError, UnicodeDecodeError):
+                metrics.NOTIFY_RECEIVED.labels(outcome="invalid").inc()
+                self._reply(400, b"invalid notify body\n")
+                return
+            if not peer_notify(name, generation, etag):
+                # A name outside this parent's child set: a stale
+                # subscription or a mis-pointed child. Not dirtying
+                # anything is the safe answer — the sweep owns truth.
+                metrics.NOTIFY_RECEIVED.labels(outcome="unknown").inc()
+                self._reply(404, b"unknown child\n")
+                return
+            metrics.NOTIFY_RECEIVED.labels(outcome="ok").inc()
+            # 202: the hint is QUEUED — the next poll round (debounced
+            # and rate-guarded like any other wake) is the result.
+            self._reply(202, b"notify accepted\n")
+
+        def _read_body(self) -> bytes:
+            """Consume and return the request body so keep-alive framing
+            survives; an oversized body closes the connection instead."""
             try:
                 length = int(self.headers.get("Content-Length") or 0)
             except ValueError:
@@ -304,8 +392,12 @@ def _make_handler(
             if length > _MAX_PROBE_BODY:
                 self.close_connection = True
                 length = 0
-            if length:
-                self.rfile.read(length)
+            return self.rfile.read(length) if length else b""
+
+        def _drain_body(self):
+            """Discard the request body (POST /probe carries none worth
+            reading)."""
+            self._read_body()
 
         def _provided_token(self) -> str:
             """The shared-secret transport both authenticated surfaces
@@ -390,6 +482,7 @@ def _make_handler(
                 # silently partition the slice.
                 if not self._peer_auth_ok():
                     return
+                self._observe_notify_subscription()
                 if self._peer_fault():
                     return
                 # The hook (SliceCoordinator.snapshot_response) returns
@@ -409,6 +502,7 @@ def _make_handler(
                 # lineage — this handler only routes.
                 if not self._peer_auth_ok():
                     return
+                self._observe_notify_subscription()
                 since = self._since_param()
                 if since is not None and fleet_delta is not None:
                     self._reply_snapshot(
@@ -424,6 +518,24 @@ def _make_handler(
                     )
             else:
                 self._reply(404, b"not found\n")
+
+        def _observe_notify_subscription(self):
+            """Record an AUTHENTICATED poller's notify subscription. The
+            callback address is the poll connection's source — never a
+            client-asserted host — plus the advertised port and the name
+            the parent knows this child by (echoed back in the notify
+            body so the parent can validate against its child set)."""
+            if notify_subscribe is None:
+                return
+            name = self.headers.get(_NOTIFY_NAME_HEADER, "")
+            raw_port = self.headers.get(_NOTIFY_PORT_HEADER, "")
+            if not name or not raw_port:
+                return
+            try:
+                port = int(raw_port)
+            except ValueError:
+                return
+            notify_subscribe(self.client_address[0], port, name)
 
         def _peer_fault(self) -> bool:
             """Enact an armed peer.* fault (utils/faults.py): the chaos
@@ -546,6 +658,8 @@ class IntrospectionServer:
         fleet_delta: Optional[
             Callable[[int, "Optional[str]"], "tuple[bytes, str]"]
         ] = None,
+        peer_notify: Optional[Callable[[str, int, str], bool]] = None,
+        notify_subscribe: Optional[Callable[[str, int, str], None]] = None,
     ):
         self._httpd = _TrackingHTTPServer(
             (addr, port),
@@ -560,6 +674,8 @@ class IntrospectionServer:
                 peer_token=peer_token,
                 fleet_snapshot=fleet_snapshot,
                 fleet_delta=fleet_delta,
+                peer_notify=peer_notify,
+                notify_subscribe=notify_subscribe,
             ),
         )
         self._httpd.daemon_threads = True

@@ -104,6 +104,14 @@ from gpu_feature_discovery_tpu.peering.cohort import (
     cohort_partition,
     resolve_cohort_size,
 )
+from gpu_feature_discovery_tpu.peering.notify import (
+    NOTIFY_NAME_HEADER,
+    NOTIFY_PORT_HEADER,
+    NotifySender,
+    NotifySubscriptions,
+    SUBSCRIPTION_TTL_SWEEPS,
+    resolve_push_notify,
+)
 from gpu_feature_discovery_tpu.peering.snapshot import (
     MAX_SNAPSHOT_BYTES,
     PEER_SNAPSHOT_PATH,
@@ -170,6 +178,11 @@ PEER_TOKEN_HEADER = "X-TFD-Probe-Token"
 # peer is noticed within a few cycles even on a long-interval daemon.
 PEER_BACKOFF_BASE_S = 1.0
 PEER_BACKOFF_CAP_S = 30.0
+
+# Notify-subscription TTL floor: with a sub-second sweep cadence (the
+# hermetic harnesses, or an operator who left --max-staleness tiny) the
+# 3-sweeps TTL would expire a live parent between its own polls.
+SUBSCRIPTION_TTL_FLOOR_S = 90.0
 
 
 @dataclass
@@ -309,6 +322,8 @@ class SliceCoordinator:
         fanout: Optional[int] = None,
         cohort_size: int = 0,
         peer_token: str = "",
+        push_notify: bool = False,
+        sweep_interval: float = 0.0,
     ):
         if not 0 <= worker_id < len(hostnames):
             raise ValueError(
@@ -413,6 +428,33 @@ class SliceCoordinator:
         # via serving_fault().
         self.force_tier_partition = False
         self.force_cohort_leader_dead = False
+        # Push-on-delta (peering/notify.py). PARENT side: ids an accepted
+        # /peer/notify marked dirty since the last round; between full
+        # sweeps (the --max-staleness cadence — the ONLY correctness
+        # mechanism) a round polls only dirty ∪ suspect peers.
+        # sweep_interval 0 sweeps EVERY round — push off the hot path
+        # entirely; cold start (_next_sweep=0) always sweeps first, so a
+        # restarted parent that lost its dirty set repairs itself in one
+        # round. CHILD side: the sender posts upward whenever the served
+        # snapshot's ETag moves; subscribers are whoever polls us with
+        # the notify headers. push_notify=False constructs none of this
+        # and is the pull-everything round byte for byte.
+        self.push_notify = bool(push_notify)
+        self._sweep_interval = max(float(sweep_interval), 0.0)
+        self._next_sweep = 0.0
+        self._dirty: set = set()
+        self._notify_port = 0
+        self.notify_subscriptions: Optional[NotifySubscriptions] = None
+        self.notify_sender: Optional[NotifySender] = None
+        if self.push_notify:
+            ttl = max(
+                SUBSCRIPTION_TTL_FLOOR_S,
+                SUBSCRIPTION_TTL_SWEEPS * self._sweep_interval,
+            )
+            self.notify_subscriptions = NotifySubscriptions(ttl, clock=clock)
+            self.notify_sender = NotifySender(
+                self.notify_subscriptions, token=self.peer_token
+            )
 
     def _new_state(self, owns_gauge: bool = True) -> _PeerState:
         state = _PeerState(owns_gauge=owns_gauge)
@@ -462,6 +504,8 @@ class SliceCoordinator:
             # the slice family from the snapshot's label map.
             self._slice_section = build_slice_section(labels)
             self._render_snapshot_locked()
+            generation, etag = self._generation, self._snapshot_etag
+        self._notify_upward(generation, etag)
 
     def _render_snapshot_locked(self) -> None:
         doc = build_snapshot(
@@ -485,12 +529,23 @@ class SliceCoordinator:
         by ETag, and bumping the generation here would feed the
         aggregate's own self-entry back into the body and re-render
         every round forever."""
+        generation, etag = 0, None
         with self._lock:
             if aggregate == self._cohort_aggregate:
                 return
             self._cohort_aggregate = aggregate
             if self._snapshot_body is not None:
                 self._render_snapshot_locked()
+                generation, etag = self._generation, self._snapshot_etag
+        self._notify_upward(generation, etag)
+
+    def _notify_upward(self, generation: int, etag: Optional[str]) -> None:
+        """The child-side push trigger: the served snapshot's ETag moved
+        (a distinct publish OR an aggregate re-render — the parent polls
+        on ETag movement, not generation). Strictly best-effort and
+        strictly non-blocking (peering/notify.NotifySender)."""
+        if self.notify_sender is not None and etag:
+            self.notify_sender.publish(generation, etag)
 
     def snapshot_payload(self) -> Dict[str, Any]:
         with self._lock:
@@ -596,9 +651,10 @@ class SliceCoordinator:
             self._poll_hier()
             return
         round_started = time.perf_counter()
-        offset = self._round_offset % len(self._peers) if self._peers else 0
+        targets = self._round_targets()
+        offset = self._round_offset % len(targets) if targets else 0
         self._round_offset += 1
-        rotated = self._peers[offset:] + self._peers[:offset]
+        rotated = targets[offset:] + targets[:offset]
         self._fanout.run(
             [partial(self._poll_peer, peer, round_started) for peer in rotated]
         )
@@ -625,6 +681,14 @@ class SliceCoordinator:
         fan-out pool."""
         round_started = time.perf_counter()
         obs_metrics.COHORT_POLL_ROUNDS.labels(tier=TIER_COHORT).inc()
+        if self.push_notify:
+            # Hierarchical rounds stay FULL polls of their planes —
+            # dirty-only filtering is a flat-plane economy (the cohort
+            # fan-in already bounds the leader's table). Drain the dirty
+            # set so the gauge cannot grow without bound.
+            with self._lock:
+                self._dirty.clear()
+                obs_metrics.DIRTY_CHILDREN.set(0)
         siblings = self._sibling_peers()
         offset = self._round_offset % len(siblings) if siblings else 0
         self._round_offset += 1
@@ -1016,6 +1080,70 @@ class SliceCoordinator:
             obs_metrics.COHORT_DEGRADED.set(len(view.degraded_cohorts))
             obs_metrics.COHORT_LEADERS.set(live_leaders)
 
+    def set_notify_port(self, port: int) -> None:
+        """The obs server's BOUND port (cmd/main wires it once the
+        server exists — the flag may say 0 = ephemeral): advertised in
+        this poller's subscribe headers so children know where to POST
+        their notifications back."""
+        with self._lock:
+            self._notify_port = int(port or 0)
+
+    def mark_dirty(self, name: str, generation: int = 0, etag: str = "") -> bool:
+        """The POST /peer/notify receive hook: mark the named child
+        dirty for the next round. ``name`` is validated against this
+        coordinator's OWN peer set (never the connection address — NAT
+        and shared-address harnesses would lie); an unknown name returns
+        False and dirties nothing, so a stale subscription or a
+        mis-pointed child cannot steer the poll loop. The generation and
+        etag are advisory (logged, never trusted): the poll itself is
+        the only fact-bearing channel."""
+        try:
+            wid = int(name)
+        except ValueError:
+            return False
+        if wid not in self._peer_by_id:
+            return False
+        with self._lock:
+            if self._closed:
+                return False
+            self._dirty.add(wid)
+            obs_metrics.DIRTY_CHILDREN.set(len(self._dirty))
+        log.debug(
+            "peer %d notified delta (generation %s, etag %s)",
+            wid, generation, etag,
+        )
+        return True
+
+    def _round_targets(self) -> List[PeerEndpoint]:
+        """Which peers this flat round polls. Pull mode (push_notify
+        off): every peer, always — byte-identical to the pre-push round.
+        Push mode: a full CONFIRMATION SWEEP of every peer when the
+        sweep deadline passed (the only correctness mechanism — it
+        catches dropped notifications, dead children that cannot push
+        their own death, rotated tokens, and a restarted parent whose
+        cold _next_sweep=0 forces an immediate sweep); otherwise only
+        dirty ∪ suspect peers, where a suspect has a failure streak in
+        progress or was never reached — so the 2-miss confirmation and
+        the confirmed-dead backoff cadence advance exactly as they would
+        under pull."""
+        if not self.push_notify:
+            return self._peers
+        now = self._clock()
+        with self._lock:
+            dirty = set(self._dirty)
+            self._dirty.clear()
+            obs_metrics.DIRTY_CHILDREN.set(0)
+        if now >= self._next_sweep:
+            self._next_sweep = now + self._sweep_interval
+            return self._peers
+        return [
+            p
+            for p in self._peers
+            if p.worker_id in dirty
+            or self._peer_state[p.worker_id].consecutive_failures > 0
+            or not self._peer_state[p.worker_id].ever_reached
+        ]
+
     def membership_token(self) -> Optional[frozenset]:
         """Reachable-peer fingerprint as of the last poll round (None
         before the first round completes). A moved fingerprint is the
@@ -1181,6 +1309,14 @@ class SliceCoordinator:
         headers = {}
         if self.peer_token:
             headers[PEER_TOKEN_HEADER] = self.peer_token
+        if self.push_notify and self._notify_port:
+            # Subscribe: ask this child to POST /peer/notify back at the
+            # poll connection's source address + our server port when
+            # its snapshot moves. The name is what WE know the child by
+            # (its worker id) — echoed back so mark_dirty can validate
+            # it against the peer set.
+            headers[NOTIFY_PORT_HEADER] = str(self._notify_port)
+            headers[NOTIFY_NAME_HEADER] = str(peer.worker_id)
         if state.etag is not None and state.last_snapshot is not None:
             headers["If-None-Match"] = state.etag
         if tier is not None:
@@ -1385,6 +1521,10 @@ class SliceCoordinator:
             # gauge write is zeroed below) or sees _closed and no-ops —
             # it can never re-latch a gauge after the reset.
             self._closed = True
+            self._dirty.clear()
+        if self.notify_sender is not None:
+            self.notify_sender.close()
+        obs_metrics.DIRTY_CHILDREN.set(0)
         self._fanout.shutdown(wait=False)
         for peer in self._peers:
             self._drop_connection(self._peer_state[peer.worker_id])
@@ -1419,8 +1559,10 @@ def new_slice_coordinator(config, host_info=None) -> Optional[SliceCoordinator]:
     from gpu_feature_discovery_tpu.config.flags import (
         DEFAULT_LABELER_TIMEOUT,
         DEFAULT_PEER_TIMEOUT,
+        DEFAULT_SLEEP_INTERVAL,
     )
     from gpu_feature_discovery_tpu.config.spec import (
+        PUSH_NOTIFY_AUTO,
         SLICE_COORDINATION_AUTO,
         SLICE_COORDINATION_OFF,
         SLICE_COORDINATION_ON,
@@ -1498,6 +1640,19 @@ def new_slice_coordinator(config, host_info=None) -> Optional[SliceCoordinator]:
         # --peer-token: the serving side requires it (obs/server.py), so
         # this poller must send it or the slice partitions itself.
         peer_token=tfd.peer_token or "",
+        # Push-on-delta: auto = on exactly when the token is configured
+        # (the notify endpoint never works unauthenticated). The sweep
+        # cadence is --max-staleness with the same 0-tracks-the-interval
+        # demotion the reconcile loop applies — between sweeps a round
+        # polls only notified/suspect peers.
+        push_notify=resolve_push_notify(
+            tfd.push_notify or PUSH_NOTIFY_AUTO, tfd.peer_token or ""
+        ),
+        sweep_interval=(
+            tfd.max_staleness
+            or tfd.sleep_interval
+            or DEFAULT_SLEEP_INTERVAL
+        ),
     )
     log.info(
         "slice coordination on: worker %d of %d (%s), peer timeout "

@@ -1,0 +1,302 @@
+"""Push-on-delta notification plumbing (child side of every tier).
+
+The coordination hierarchy pulls: a slice leader polls its peers, a
+region collector polls its slice leaders, a root polls its regions. The
+idle cost of that pull is O(children) requests per round even when every
+answer is a 304 — the scaling bound once fleets reach thousands of
+slices. This module inverts the idle path WITHOUT making correctness
+depend on it: a child whose served snapshot ETag/generation moves POSTs
+a small authenticated ``/peer/notify`` hint to every subscribed parent,
+the parent marks that child dirty and polls only dirty children next
+round, and the existing full sweep on the ``--max-staleness`` cadence
+remains the ONLY correctness mechanism. A lost notification, a dead
+child that cannot push its own death, a rotated token, a parent restart
+that forgot its dirty set — all of them are repaired by the next sweep,
+none of them by the push path.
+
+Addressing rides the existing poll direction, so no new config points
+upward: a parent SUBSCRIBES by adding ``X-TFD-Notify-Port`` (its own
+introspection-server port) and ``X-TFD-Notify-Name`` (the name it knows
+the child by — the targets-file entry at the fleet tiers, the worker id
+at the peer tier) to the snapshot polls it already sends. The child
+records (source address of the poll connection, advertised port, name)
+with a TTL a few sweeps long; every poll refreshes it, so subscriptions
+outlive lost notifications but not a retired parent. The notify POST
+echoes the subscribed name and the parent validates it against its own
+child set — name-based, never address-based, so NAT and many-children-
+behind-one-address topologies (the MockFleet rig) stay correct.
+
+Delivery is strictly best-effort and strictly off the publish path: the
+sender runs one daemon thread, coalesces to the LATEST generation (a
+burst of publishes collapses to one notification), spaces connection
+retries with the shared ``utils/retry.BackoffPolicy``, and gives up
+after a bounded attempt budget. ``publish()`` never blocks and never
+raises — a wedged parent cannot delay or fail a child's label cycle.
+"""
+
+from __future__ import annotations
+
+import http.client
+import json
+import logging
+import threading
+import time
+from typing import Callable, Dict, List, Optional, Tuple
+
+from gpu_feature_discovery_tpu.config.spec import (
+    PUSH_NOTIFY_AUTO,
+    PUSH_NOTIFY_OFF,
+    PUSH_NOTIFY_ON,
+)
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+from gpu_feature_discovery_tpu.utils import faults
+from gpu_feature_discovery_tpu.utils.retry import BackoffPolicy
+
+log = logging.getLogger(__name__)
+
+# Subscription headers a parent adds to its existing snapshot polls.
+# obs/server.py restates these names locally (it must not import
+# peering, same as X-TFD-Poll-Tier); tests pin the two spellings equal.
+NOTIFY_PORT_HEADER = "X-TFD-Notify-Port"
+NOTIFY_NAME_HEADER = "X-TFD-Notify-Name"
+
+NOTIFY_PATH = "/peer/notify"
+NOTIFY_SCHEMA = 1
+
+# How many sweep periods a subscription survives without being refreshed
+# by a poll: generous enough that one slow round never unsubscribes a
+# live parent, small enough that a retired parent stops costing retries
+# within a few sweeps.
+SUBSCRIPTION_TTL_SWEEPS = 3.0
+
+# Connection-failure retry budget per notification per subscriber. The
+# schedule is the shared BackoffPolicy; with the default base this caps
+# the lost-parent cost at a few seconds of one daemon thread, and the
+# sweep repairs whatever the budget abandons.
+NOTIFY_MAX_ATTEMPTS = 3
+
+# Per-request connect/read timeout. Notifications are tiny and a parent
+# that cannot answer in this budget will learn from its own sweep.
+NOTIFY_TIMEOUT_S = 2.0
+
+
+def resolve_push_notify(mode: str, peer_token: str) -> bool:
+    """The effective push-on-delta switch for a configured mode.
+
+    ``auto`` is on exactly when a peer token is configured: the notify
+    endpoint hard-refuses unauthenticated POSTs (it can wake a poll
+    loop), so without a token there is nothing to enable — and the
+    tokenless deployment keeps today's pull rounds byte for byte.
+    """
+    if mode == PUSH_NOTIFY_ON:
+        return True
+    if mode == PUSH_NOTIFY_OFF:
+        return False
+    if mode == PUSH_NOTIFY_AUTO:
+        return bool(peer_token)
+    raise ValueError(f"invalid push-notify mode: {mode!r}")
+
+
+class NotifySubscriptions:
+    """Child-side registry of parents that asked to be notified.
+
+    Keyed by (host, port, name): host is the POLL connection's source
+    address (never client-asserted), port and name come from the
+    subscription headers. Every poll refreshes the expiry; ``targets()``
+    prunes lapsed entries, so a parent that stops polling stops being
+    notified within ``ttl_s`` without any unsubscribe protocol.
+    """
+
+    def __init__(self, ttl_s: float, clock: Callable[[], float] = time.monotonic):
+        self._ttl = max(ttl_s, 0.0)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._subs: Dict[Tuple[str, int, str], float] = {}
+
+    def observe_poll(self, host: str, port: int, name: str) -> None:
+        if not host or port <= 0 or not name:
+            return
+        with self._lock:
+            self._subs[(host, port, name)] = self._clock() + self._ttl
+
+    def targets(self) -> List[Tuple[str, int, str]]:
+        now = self._clock()
+        with self._lock:
+            lapsed = [k for k, exp in self._subs.items() if exp <= now]
+            for k in lapsed:
+                del self._subs[k]
+            return sorted(self._subs)
+
+    def __len__(self) -> int:
+        return len(self.targets())
+
+
+class NotifySender:
+    """Best-effort upward notifier: one daemon thread, latest-wins.
+
+    ``publish(generation, etag)`` records the newest served state and
+    wakes the worker; it never blocks and never raises. The worker
+    delivers the LATEST pending notification to every live subscriber,
+    retrying connection failures on the shared backoff schedule. A newer
+    publish supersedes an in-flight delivery at the next retry boundary
+    (the superseded one counts as ``dropped`` — the parent only ever
+    needs the newest hint). Authoritative non-202 answers are counted
+    ``rejected`` and not retried: the parent heard us and said no; only
+    its sweep semantics apply.
+    """
+
+    def __init__(
+        self,
+        subscriptions: NotifySubscriptions,
+        token: str = "",
+        timeout: float = NOTIFY_TIMEOUT_S,
+        max_attempts: int = NOTIFY_MAX_ATTEMPTS,
+        backoff: Optional[BackoffPolicy] = None,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self.subscriptions = subscriptions
+        self._token = token
+        self._timeout = timeout
+        self._max_attempts = max(1, max_attempts)
+        self._backoff = backoff or BackoffPolicy()
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._pending: Optional[Tuple[int, str]] = None
+        self._seq = 0  # bumps per publish; lets retries detect supersession
+        self._busy = False  # worker is mid-delivery (flush() waits on it)
+        self._closed = False
+        self._thread: Optional[threading.Thread] = None
+
+    # -- publish path (called under the child's serving lock — cheap) ----
+
+    def publish(self, generation: int, etag: str) -> None:
+        """Record the newest served (generation, etag) and wake the
+        worker. Coalescing is latest-wins: an unsent older pending is
+        replaced and counted ``dropped``."""
+        with self._cond:
+            if self._closed:
+                return
+            if self._pending is not None:
+                obs_metrics.NOTIFY_SENT.labels(outcome="dropped").inc()
+            self._pending = (generation, etag)
+            self._seq += 1
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name="tfd-notify", daemon=True
+                )
+                self._thread.start()
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+        thread = self._thread
+        if thread is not None:
+            thread.join(timeout=self._timeout + 1.0)
+
+    def flush(self, timeout: float = 5.0) -> bool:
+        """Test/bench hook: block until queued work has been delivered
+        (or abandoned) and the worker is idle, or ``timeout`` elapses.
+        Production code never calls this — delivery is fire-and-forget
+        by design; harnesses use it to drive rounds deterministically.
+        """
+        deadline = time.monotonic() + timeout
+        with self._cond:
+            while self._pending is not None or self._busy:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    return False
+                self._cond.wait(timeout=remaining)
+        return True
+
+    # -- worker ----------------------------------------------------------
+
+    def _run(self) -> None:
+        while True:
+            with self._cond:
+                while self._pending is None and not self._closed:
+                    self._cond.wait()
+                if self._pending is None:  # closed with nothing queued
+                    return
+                pending = self._pending
+                seq = self._seq
+                self._pending = None
+                self._busy = True
+            try:
+                self._deliver(pending, seq)
+            finally:
+                with self._cond:
+                    self._busy = False
+                    self._cond.notify_all()
+            with self._cond:
+                if self._closed and self._pending is None:
+                    return
+
+    def _superseded_or_closed(self, seq: int) -> bool:
+        with self._cond:
+            return self._closed or self._seq != seq
+
+    def _deliver(self, pending: Tuple[int, str], seq: int) -> None:
+        generation, etag = pending
+        targets = self.subscriptions.targets()
+        if not targets:
+            return
+        # The child-side lossy-wire fault: the notification is simply
+        # never sent — exactly what a dropped packet looks like to the
+        # parent, whose sweep must repair it. Consumed only when there
+        # IS a wire (live subscribers): a subscriber-less sender must
+        # not eat an armed drop meant for a sibling's delivery.
+        if faults.consume("notify.drop"):
+            obs_metrics.NOTIFY_SENT.labels(outcome="dropped").inc()
+            return
+        for host, port, name in targets:
+            self._notify_one(host, port, name, generation, etag, seq)
+
+    def _notify_one(
+        self, host: str, port: int, name: str, generation: int, etag: str, seq: int
+    ) -> None:
+        body = json.dumps(
+            {
+                "schema": NOTIFY_SCHEMA,
+                "name": name,
+                "generation": generation,
+                "etag": etag,
+            }
+        ).encode()
+        headers = {"Content-Type": "application/json"}
+        if self._token:
+            headers["X-TFD-Probe-Token"] = self._token
+        for attempt in range(self._max_attempts):
+            conn = http.client.HTTPConnection(host, port, timeout=self._timeout)
+            try:
+                conn.request("POST", NOTIFY_PATH, body=body, headers=headers)
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status == 202:
+                    obs_metrics.NOTIFY_SENT.labels(outcome="ok").inc()
+                else:
+                    # An authoritative answer: the parent heard us and
+                    # refused (bad token, unknown name, push disabled).
+                    # Retrying cannot change its mind — count and move
+                    # on; its sweep still covers us.
+                    obs_metrics.NOTIFY_SENT.labels(outcome="rejected").inc()
+                    log.debug(
+                        "notify to %s:%d rejected: %d %s",
+                        host, port, resp.status, resp.reason,
+                    )
+                return
+            except (OSError, http.client.HTTPException) as e:
+                if attempt + 1 >= self._max_attempts:
+                    obs_metrics.NOTIFY_SENT.labels(outcome="error").inc()
+                    log.debug("notify to %s:%d failed: %s", host, port, e)
+                    return
+                with self._cond:
+                    self._cond.wait(timeout=self._backoff.delay(attempt))
+                if self._superseded_or_closed(seq):
+                    # A newer generation replaced this one mid-retry:
+                    # abandon — the parent only needs the newest hint.
+                    obs_metrics.NOTIFY_SENT.labels(outcome="dropped").inc()
+                    return
+            finally:
+                conn.close()

@@ -65,12 +65,29 @@ Instrumented sites:
                        before answering (the poll-timeout miss path)
     peer.junk          the snapshot handler answers 200 with a non-JSON
                        body (the parse-rejection miss path)
+    notify.drop        push-on-delta (peering/notify.py): the CHILD's
+                       next upward change notification is silently never
+                       sent — exactly what a dropped packet looks like
+                       to the parent, whose --max-staleness confirmation
+                       sweep must repair the convergence
+    notify.slow        the parent's POST /peer/notify handler stalls
+                       before answering (the child's bounded notify
+                       timeout gives up; its publish path is never
+                       delayed — delivery runs off-thread)
+    notify.reject      the parent's POST /peer/notify handler answers
+                       503 — an authoritative rejection the child never
+                       retries (outcome=rejected; the sweep still
+                       covers it)
 
-The ``probe.*``, ``broker.*``, ``chip.*`` and ``peer.*`` sites are
-BEHAVIORAL. The ``peer.*`` family is consumed AND enacted in the SERVING
-daemon's obs handler (obs/server.py) — the injection lives where the
-misbehavior lives, and the polling side exercises its real network-error
-paths against it. The rest are consumed parent-side: the
+The ``probe.*``, ``broker.*``, ``chip.*``, ``peer.*`` and ``notify.*``
+sites are BEHAVIORAL. The ``peer.*`` family — and the receiving half of
+``notify.*`` (``notify.slow``/``notify.reject``) — is consumed AND
+enacted in the SERVING daemon's obs handler (obs/server.py) — the
+injection lives where the misbehavior lives, and the polling side
+exercises its real network-error paths against it. ``notify.drop`` is
+the exception that proves the rule: the lossy wire is the CHILD's
+misbehavior, so it is consumed in the child's NotifySender at send
+time. The rest are consumed parent-side: the
 driver consumes them with ``consume()`` (countdown without raising) in
 the PARENT process and enacts the behavior in/around the forked child —
 a child-side countdown would decrement only the child's fork-copied

@@ -65,6 +65,18 @@ forced through exactly ONE full resync — never an error loop, never a
 silently stale pane — and end byte-identical to a full-body client
 (run_fleet_delta_resync).
 
+``fleet:notify-lost`` (ISSUE 17) drops a push-on-delta notification at
+the child's sender (the armed notify.drop fault) under a push-enabled
+collector: the lost hint must leave the parent clean (no early poll, no
+pane movement) yet the change converges within ONE --max-staleness
+sweep window — the sweep, never the push path, is the correctness
+mechanism — while a second, un-dropped change converges fast
+(run_fleet_notify_lost). ``fleet:notify-storm`` fires 50 republishes in
+a burst at one child: the parent's real snapshot polls to the stormed
+child stay bounded at a handful (latest-wins coalescing + dirty-set
+dedup), idle siblings take zero polls, and the pane lands on the LAST
+verdict (run_fleet_notify_storm).
+
 ``reconcile:broker-death`` is likewise not a fault spec: it SIGKILLs the
 long-lived broker worker of an EVENT-mode daemon whose sleep interval is
 pinned at 60s — only the WORKER_DIED wake (cmd/events.py) can explain a
@@ -488,6 +500,10 @@ def run_fleet_chaos(scenario, workdir, timeout_s=None):
         return run_fleet_collector_failover(workdir, timeout_s=timeout_s)
     if scenario == "delta-resync":
         return run_fleet_delta_resync(workdir, timeout_s=timeout_s)
+    if scenario == "notify-lost":
+        return run_fleet_notify_lost(workdir, timeout_s=timeout_s)
+    if scenario == "notify-storm":
+        return run_fleet_notify_storm(workdir, timeout_s=timeout_s)
     if scenario != "slice-dark":
         raise ValueError(f"unknown fleet chaos scenario {scenario!r}")
     budget = timeout_s or 60.0
@@ -1226,6 +1242,303 @@ def run_fleet_delta_resync(workdir, timeout_s=None):
         "deltas_after_restart": kinds["delta"],
         "generation": hstate.mirror.generation,
         "labels": len(hstate.last_snapshot["slices"]),
+    }
+
+
+_PUSH_TOKEN = "chaos-notify-token"
+
+
+def _leader_verdict(prefix, i, healthy_hosts=2):
+    return {
+        "google.com/tpu.count": "4",
+        "google.com/tpu.chips.healthy": "4",
+        "google.com/tpu.chips.sick": "0",
+        "google.com/tpu.slice.role": "leader",
+        "google.com/tpu.slice.leader": f"{prefix}{i}w0",
+        "google.com/tpu.slice.healthy-hosts": str(healthy_hosts),
+        "google.com/tpu.slice.total-hosts": "2",
+        "google.com/tpu.slice.degraded": (
+            "false" if healthy_hosts == 2 else "true"
+        ),
+        "google.com/tpu.slice.sick-chips": "0",
+    }
+
+
+def _push_slice_leaders(n, prefix, sweep_interval):
+    """_fake_slice_leaders with the push-on-delta CHILD side wired:
+    each leader carries a NotifySender + subscription registry, its obs
+    server feeds poll-header subscriptions back, and snapshot polls are
+    counted per leader (the storm row's bound is real HTTP polls, not
+    an internal proxy)."""
+    from gpu_feature_discovery_tpu.fleet import SliceTarget
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+    from gpu_feature_discovery_tpu.obs.server import (
+        IntrospectionServer,
+        IntrospectionState,
+    )
+    from gpu_feature_discovery_tpu.peering import SliceCoordinator
+
+    coords, servers, targets, poll_counts = [], [], [], []
+    for i in range(n):
+        coord = SliceCoordinator(
+            0,
+            ["h0:1", "h1:1"],
+            default_port=1,
+            peer_timeout=0.5,
+            peer_token=_PUSH_TOKEN,
+            push_notify=True,
+            sweep_interval=sweep_interval,
+        )
+        coord.publish_local(_leader_verdict(prefix, i), "full")
+        counter = {"polls": 0}
+
+        def counted(_coord=coord, _counter=counter):
+            _counter["polls"] += 1
+            return _coord.snapshot_response()
+
+        server = IntrospectionServer(
+            obs_metrics.REGISTRY,
+            IntrospectionState(60.0),
+            addr="127.0.0.1",
+            port=0,
+            peer_snapshot=counted,
+            peer_token=_PUSH_TOKEN,
+            notify_subscribe=coord.notify_subscriptions.observe_poll,
+        )
+        server.start()
+        coords.append(coord)
+        servers.append(server)
+        poll_counts.append(counter)
+        targets.append(
+            SliceTarget(
+                name=f"{prefix}{i}", hosts=(f"127.0.0.1:{server.port}",)
+            )
+        )
+    return coords, servers, targets, poll_counts
+
+
+def _push_collector_stack(targets, sweep_interval):
+    """A push-enabled FleetCollector plus the introspection server that
+    receives its children's /peer/notify POSTs (peer_notify ->
+    mark_dirty), with the advertised notify port wired — the parent
+    side of cmd/fleet.py, in-process."""
+    from gpu_feature_discovery_tpu.fleet import FleetCollector
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+    from gpu_feature_discovery_tpu.obs.server import (
+        IntrospectionServer,
+        IntrospectionState,
+    )
+
+    collector = FleetCollector(
+        targets,
+        peer_timeout=0.5,
+        peer_token=_PUSH_TOKEN,
+        push_notify=True,
+        sweep_interval=sweep_interval,
+    )
+    server = IntrospectionServer(
+        obs_metrics.REGISTRY,
+        IntrospectionState(60.0),
+        addr="127.0.0.1",
+        port=0,
+        peer_token=_PUSH_TOKEN,
+        peer_notify=collector.mark_dirty,
+    )
+    server.start()
+    collector.set_notify_port(server.port)
+    return collector, server
+
+
+def run_fleet_notify_lost(workdir, timeout_s=None):
+    """fleet:notify-lost (ISSUE 17): a push-enabled collector over three
+    in-process slice leaders, with the first change's upward
+    notification DROPPED at the child's sender (the armed notify.drop
+    fault — the lossy wire made literal). The contract:
+
+      1. the lost notification leaves the parent clean: no dirty mark,
+         no early poll, the pane unmoved before the sweep;
+      2. the change still converges within ONE --max-staleness window —
+         the confirmation sweep, not the push path, is the correctness
+         mechanism;
+      3. a second change with the wire healthy converges FAST (well
+         inside the sweep period): the push path works when it works.
+    """
+    from gpu_feature_discovery_tpu.utils import faults
+
+    budget = timeout_s or 60.0
+    sweep_s = 2.0
+    started = time.monotonic()
+    coords, servers, poll_counts = [], [], []
+    collector = parent_server = None
+    try:
+        coords, servers, targets, poll_counts = _push_slice_leaders(
+            3, "nl", sweep_s
+        )
+        collector, parent_server = _push_collector_stack(targets, sweep_s)
+
+        def entry(name):
+            return collector.inventory_payload()["slices"][name]
+
+        # Cold start: the first round is a full sweep (a restarted
+        # parent repairs itself) and plants the subscriptions.
+        collector.poll_round()
+        assert all(
+            entry(f"nl{i}")["healthy_hosts"] == 2 for i in range(3)
+        ), collector.inventory_payload()
+        assert all(len(c.notify_subscriptions) == 1 for c in coords), (
+            "cold sweep must subscribe the parent at every child"
+        )
+        swept_at = time.monotonic()
+        # Drain every in-flight delivery (the collector is itself a
+        # push-mode child whose commit publishes upward, and the cold
+        # publishes may still be queued) BEFORE arming the drop: the
+        # fault must land on coords[1]'s next notification and nothing
+        # else.
+        collector.notify_sender.flush()
+        for coord in coords:
+            coord.notify_sender.flush()
+        # The lossy wire: the NEXT notification is dropped at the
+        # sender. The republish moves the child's ETag but the parent
+        # never hears about it.
+        registry = faults.load_fault_spec("notify.drop:fail:1")
+        coords[1].publish_local(_leader_verdict("nl", 1, 1), "full")
+        coords[1].notify_sender.flush()
+        assert "notify.drop" not in registry.armed_sites(), (
+            "the armed drop must have consumed the notification"
+        )
+        # Before the sweep comes due the parent stays clean: non-sweep
+        # rounds poll nobody (no dirty marks, no suspects) and the pane
+        # keeps the stale verdict.
+        collector.poll_round()
+        assert entry("nl1")["healthy_hosts"] == 2, (
+            "a dropped notification must not reach the pane early"
+        )
+        # Converge: within one sweep window the cadence-driven full
+        # sweep repairs the loss.
+        deadline = swept_at + sweep_s + budget
+        while time.monotonic() < deadline:
+            collector.poll_round()
+            if entry("nl1")["healthy_hosts"] == 1:
+                break
+            time.sleep(0.05)
+        lost_converged_s = time.monotonic() - swept_at
+        assert entry("nl1")["healthy_hosts"] == 1, (
+            collector.inventory_payload()
+        )
+        assert lost_converged_s <= sweep_s + 1.0, (
+            f"lost notification must converge within one sweep window, "
+            f"took {lost_converged_s:.2f}s against {sweep_s}s"
+        )
+        # The healthy wire: the next change's notification flows, the
+        # parent polls ONLY the dirty child, and the pane moves well
+        # before the next sweep could.
+        flowed_at = time.monotonic()
+        coords[1].publish_local(_leader_verdict("nl", 1, 2), "full")
+        coords[1].notify_sender.flush()
+        deadline = flowed_at + budget
+        while time.monotonic() < deadline:
+            collector.poll_round()
+            if entry("nl1")["healthy_hosts"] == 2:
+                break
+            time.sleep(0.02)
+        pushed_converged_s = time.monotonic() - flowed_at
+        assert entry("nl1")["healthy_hosts"] == 2, (
+            collector.inventory_payload()
+        )
+        assert pushed_converged_s < sweep_s, (
+            f"the push path must beat the sweep cadence, took "
+            f"{pushed_converged_s:.2f}s against {sweep_s}s"
+        )
+    finally:
+        faults.reset()
+        if collector is not None:
+            collector.close()
+        if parent_server is not None:
+            parent_server.close()
+        for server in servers:
+            server.close()
+        for coord in coords:
+            coord.close()
+    elapsed = time.monotonic() - started
+    return {
+        "spec": "fleet:notify-lost",
+        "converged_s": round(elapsed, 3),
+        "labels": 3,  # pane slices held through drop + sweep + push
+        "lost_converged_s": round(lost_converged_s, 3),
+        "pushed_converged_s": round(pushed_converged_s, 3),
+    }
+
+
+def run_fleet_notify_storm(workdir, timeout_s=None):
+    """fleet:notify-storm (ISSUE 17): one child republishes 50 times in
+    a tight burst while two siblings stay idle. The contract:
+
+      1. the parent's polling stays BOUNDED: the stormed child costs at
+         most a handful of real snapshot polls (latest-wins coalescing
+         at the sender + dirty-set dedup at the parent), never one poll
+         per notification;
+      2. the idle siblings are not collateral damage — zero polls for
+         them between sweeps;
+      3. the pane converges to the LAST published verdict (newest hint
+         wins, nothing replayed out of order)."""
+    budget = timeout_s or 60.0
+    storm = 50
+    started = time.monotonic()
+    coords, servers, poll_counts = [], [], []
+    collector = parent_server = None
+    try:
+        # Sweep far beyond the row's runtime: every post-cold-start poll
+        # below is push-driven, none can be explained by the cadence.
+        coords, servers, targets, poll_counts = _push_slice_leaders(
+            3, "ns", 300.0
+        )
+        collector, parent_server = _push_collector_stack(targets, 300.0)
+        collector.poll_round()  # cold sweep + subscriptions
+        baseline = [c["polls"] for c in poll_counts]
+        # The storm: 50 republishes alternating the verdict, ending on
+        # degraded (healthy-hosts 1) — distinct ETag movement each time.
+        for k in range(storm):
+            coords[0].publish_local(
+                _leader_verdict("ns", 0, 2 if k % 2 == 0 else 1), "full"
+            )
+        coords[0].notify_sender.flush()
+        deadline = time.monotonic() + budget
+        rounds = 0
+        while time.monotonic() < deadline:
+            collector.poll_round()
+            rounds += 1
+            entry = collector.inventory_payload()["slices"]["ns0"]
+            if entry["healthy_hosts"] == 1 and rounds >= 3:
+                break
+            time.sleep(0.02)
+        entry = collector.inventory_payload()["slices"]["ns0"]
+        assert entry["healthy_hosts"] == 1, entry
+        storm_polls = poll_counts[0]["polls"] - baseline[0]
+        assert 1 <= storm_polls <= 5, (
+            f"storm of {storm} notifications must coalesce to a "
+            f"handful of polls, saw {storm_polls}"
+        )
+        for i in (1, 2):
+            assert poll_counts[i]["polls"] == baseline[i], (
+                f"idle sibling ns{i} polled during the storm: "
+                f"{poll_counts[i]['polls']} vs {baseline[i]}"
+            )
+    finally:
+        if collector is not None:
+            collector.close()
+        if parent_server is not None:
+            parent_server.close()
+        for server in servers:
+            server.close()
+        for coord in coords:
+            coord.close()
+    elapsed = time.monotonic() - started
+    return {
+        "spec": "fleet:notify-storm",
+        "converged_s": round(elapsed, 3),
+        "labels": 3,  # pane slices held through the burst
+        "storm_polls": storm_polls,
+        "storm_notifications": storm,
     }
 
 

@@ -27,6 +27,15 @@ collectors parse and aggregate them through the production path;
 ``set_dark()`` makes a peer drop connections (a dark slice, confirmed
 over the collector's 2-miss rule).
 
+Push-on-delta (ISSUE 17) rides the same rig: region polls carry the
+``X-TFD-Notify-Port``/``X-TFD-Notify-Name`` subscribe headers, each
+mock peer records its subscribers, and ``churn()`` POSTs real
+authenticated ``/peer/notify`` hints upward — so the leader->region hop
+exercises the production endpoint while staying synchronous and
+deterministic. The region->root hop uses the REAL child-side
+``NotifySender`` (regions are genuine FleetCollectors), flushed between
+tiers inside ``round()``.
+
 No jax, no subprocesses: everything runs in-process so the bench can
 meter bytes-on-wire and round latency with plain counters.
 """
@@ -71,7 +80,7 @@ def _leader_labels(name, healthy=4, total_hosts=2, degraded=False):
 
 class _MockPeer:
     __slots__ = ("name", "ip", "generation", "degraded", "body", "etag",
-                 "dark")
+                 "dark", "subs")
 
     def __init__(self, name, ip):
         self.name = name
@@ -81,6 +90,9 @@ class _MockPeer:
         self.dark = False
         self.body = b""
         self.etag = ""
+        # Subscribers recorded from poll headers:
+        # (host, port) -> name-as-the-parent-knows-us.
+        self.subs = {}
         self.publish()
 
     def publish(self):
@@ -112,8 +124,10 @@ class MockFleet:
     wire from the mock tier: full bodies, 304 header exchanges, bytes.
     """
 
-    def __init__(self, n_slices, keepalive=True, name_prefix="slice"):
+    def __init__(self, n_slices, keepalive=True, name_prefix="slice",
+                 peer_token=""):
         self.keepalive = keepalive
+        self.peer_token = peer_token
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind(("0.0.0.0", 0))
@@ -130,7 +144,7 @@ class MockFleet:
             self.peers[ip] = peer
             self._by_name[peer.name] = peer
         self.stats = {"requests": 0, "full": 0, "not_modified": 0,
-                      "bytes": 0, "dropped": 0}
+                      "bytes": 0, "dropped": 0, "notifies": 0}
         self._sel = selectors.DefaultSelector()
         self._sel.register(self._sock, selectors.EVENT_READ, None)
         self._wake_r, self._wake_w = socket.socketpair()
@@ -151,19 +165,58 @@ class MockFleet:
             for p in self.peers.values()
         ]
 
-    def churn(self, fraction, rng=None):
+    def churn(self, fraction, rng=None, notify=True):
         """Republish ``fraction`` of the peers with a flipped verdict
-        and a bumped generation. Returns the changed slice names."""
+        and a bumped generation. When ``notify`` is true (and polls
+        carried subscribe headers), each changed peer POSTs a real
+        authenticated ``/peer/notify`` hint to its recorded
+        subscribers — the lossy upward wire, driven synchronously so
+        tests stay deterministic. Returns the changed slice names."""
         rng = rng or random.Random(0)
         count = max(1, int(len(self.peers) * fraction))
         chosen = rng.sample(sorted(self._by_name), count)
+        pending = []
         with self._lock:
             for name in chosen:
                 peer = self._by_name[name]
                 peer.degraded = not peer.degraded
                 peer.generation += 1
                 peer.publish()
+                for (host, port), subname in peer.subs.items():
+                    pending.append(
+                        (host, port, subname, peer.generation, peer.etag)
+                    )
+        if notify:
+            for host, port, subname, gen, etag in pending:
+                self._post_notify(host, port, subname, gen, etag)
         return chosen
+
+    def _post_notify(self, host, port, name, generation, etag):
+        import http.client
+        import json
+
+        body = json.dumps(
+            {"schema": 1, "name": name, "generation": generation,
+             "etag": etag}
+        ).encode()
+        headers = {"Content-Type": "application/json"}
+        if self.peer_token:
+            headers["X-TFD-Probe-Token"] = self.peer_token
+        try:
+            conn = http.client.HTTPConnection(host, port, timeout=5)
+            try:
+                conn.request(
+                    "POST", "/peer/notify", body=body, headers=headers
+                )
+                resp = conn.getresponse()
+                resp.read()
+                if resp.status == 202:
+                    with self._lock:
+                        self.stats["notifies"] += 1
+            finally:
+                conn.close()
+        except OSError:
+            pass  # best-effort by design: the sweep owns correctness
 
     def set_dark(self, names, dark=True):
         with self._lock:
@@ -263,10 +316,24 @@ class MockFleet:
             if not lines[0].startswith(b"GET /peer/snapshot"):
                 self._drop(conn)
                 return
-            inm = None
+            inm = nport = nname = None
             for line in lines[1:]:
-                if line.lower().startswith(b"if-none-match:"):
+                low = line.lower()
+                if low.startswith(b"if-none-match:"):
                     inm = line.split(b":", 1)[1].strip().decode()
+                elif low.startswith(b"x-tfd-notify-port:"):
+                    nport = line.split(b":", 1)[1].strip()
+                elif low.startswith(b"x-tfd-notify-name:"):
+                    nname = line.split(b":", 1)[1].strip()
+            if nport and nname:
+                # Record the poll's subscribe hint exactly as a real
+                # leader would: the poll's source address + advertised
+                # port, keyed so a re-poll refreshes in place.
+                try:
+                    src = conn.sock.getpeername()[0]
+                    peer.subs[(src, int(nport))] = nname.decode()
+                except (OSError, ValueError, UnicodeDecodeError):
+                    pass
             connection = (
                 b"Connection: keep-alive\r\n"
                 if self.keepalive
@@ -329,18 +396,29 @@ class FleetTiers:
         peer_timeout=5.0,
         wall_clock=None,
         root_state_dir="",
+        peer_token="",
+        push_notify=False,
+        sweep_interval=0.0,
     ):
         targets = mock.targets()
         wall = {"wall_clock": wall_clock} if wall_clock else {}
+        push = (
+            {"push_notify": True, "sweep_interval": sweep_interval}
+            if push_notify
+            else {}
+        )
         chunk = (len(targets) + n_regions - 1) // n_regions
         self.regions = []
         self.region_servers = []
+        self.root_server = None
         try:
             for i in range(n_regions):
                 region = FleetCollector(
                     targets[i * chunk:(i + 1) * chunk],
                     peer_timeout=peer_timeout,
                     round_budget=None,
+                    peer_token=peer_token,
+                    **push,
                     **wall,
                 )
                 server = IntrospectionServer(
@@ -350,8 +428,22 @@ class FleetTiers:
                     port=0,
                     fleet_snapshot=region.inventory_response,
                     fleet_delta=region.delta_response,
+                    peer_token=peer_token,
+                    peer_notify=(
+                        region.mark_dirty if push_notify else None
+                    ),
+                    notify_subscribe=(
+                        region.notify_subscriptions.observe_poll
+                        if push_notify
+                        else None
+                    ),
                 )
                 server.start()
+                if push_notify:
+                    # The port the region advertises to its mock
+                    # children AND the surface its parent (the root)
+                    # notifies, so both hops ride the same endpoint.
+                    region.set_notify_port(server.port)
                 self.regions.append(region)
                 self.region_servers.append(server)
             self.root = FleetCollector(
@@ -366,8 +458,21 @@ class FleetTiers:
                 round_budget=None,
                 upstream_mode="collectors",
                 state_dir=root_state_dir,
+                peer_token=peer_token,
+                **push,
                 **wall,
             )
+            if push_notify:
+                self.root_server = IntrospectionServer(
+                    obs_metrics.REGISTRY,
+                    IntrospectionState(3600.0),
+                    addr="127.0.0.1",
+                    port=0,
+                    peer_token=peer_token,
+                    peer_notify=self.root.mark_dirty,
+                )
+                self.root_server.start()
+                self.root.set_notify_port(self.root_server.port)
         except BaseException:
             self.close()
             raise
@@ -375,11 +480,19 @@ class FleetTiers:
     def round(self):
         for region in self.regions:
             region.poll_round()
+        if self.root_server is not None:
+            # Let the region->root hints land before the root decides
+            # its targets, so push rounds are deterministic in tests.
+            for region in self.regions:
+                if region.notify_sender is not None:
+                    region.notify_sender.flush()
         return self.root.poll_round()
 
     def close(self):
         if getattr(self, "root", None) is not None:
             self.root.close()
+        if getattr(self, "root_server", None) is not None:
+            self.root_server.close()
         for server in self.region_servers:
             server.close()
         for region in self.regions:

@@ -103,6 +103,18 @@ CHAOS_SPECS = [
     # error loop or a silently stale pane, and end byte-identical to a
     # full-body client.
     "fleet:delta-resync",
+    # Push-on-delta (ISSUE 17, peering/notify.py). notify-lost: a
+    # change's upward notification is DROPPED at the child's sender
+    # (the armed notify.drop fault) — the parent must stay clean (no
+    # early poll, no pane movement) yet converge within ONE
+    # --max-staleness sweep window, while an un-dropped follow-up
+    # change converges fast via the push path. notify-storm: 50
+    # republishes in a burst at one child must coalesce to a handful of
+    # real snapshot polls (never one per notification), with idle
+    # siblings taking zero polls and the pane landing on the LAST
+    # verdict.
+    "fleet:notify-lost",
+    "fleet:notify-storm",
     # Event-driven reconcile loop (cmd/events.py, --reconcile): SIGKILL
     # the long-lived broker worker of an event-mode daemon whose sleep
     # interval is pinned at 60s — only the WORKER_DIED wake can explain
@@ -174,6 +186,11 @@ CHAOS_EXPECTATIONS = {
     # the at-most-one-resync and byte-identity bounds are asserted
     # inside the driver.
     "fleet:delta-resync": {"timeout_s": 90.0},
+    # In-process leaders (cheap), but the lost-notify row deliberately
+    # WAITS OUT a 2s sweep window before its convergence can happen,
+    # plus a second push-path convergence wait.
+    "fleet:notify-lost": {"timeout_s": 60.0},
+    "fleet:notify-storm": {"timeout_s": 60.0},
     # Startup (first full cycle + broker spawn) can be slow on a loaded
     # host; the kill-to-recovery bound itself is 2x probe-timeout and
     # asserted INSIDE the driver, not via this budget.

@@ -53,6 +53,7 @@ FILE_KEYS = {
     "cohort-size": ("tfd", "cohortSize"),
     "backends": ("tfd", "backends"),
     "reconcile": ("tfd", "reconcile"),
+    "push-notify": ("tfd", "pushNotify"),
     "max-staleness": ("tfd", "maxStaleness"),
     "reconcile-debounce": ("tfd", "reconcileDebounce"),
     "max-probe-rate": ("tfd", "maxProbeRate"),
@@ -84,6 +85,7 @@ VALUE_PAIRS = {
     # generic "/value-a" str fallback does not apply.
     "backends": ("tpu,cpu", "cpu"),
     "reconcile": ("interval", "event"),
+    "push-notify": ("on", "off"),
     "max-staleness": ("30s", "45s"),
     "reconcile-debounce": ("0.2s", "0.4s"),
     "max-probe-rate": ("2", "4"),

@@ -129,6 +129,94 @@ def test_thousand_slice_fleet_delta_rounds():
         mock.close()
 
 
+def test_push_mode_idle_rounds_poll_only_changed_plus_sweep():
+    """ISSUE 17 acceptance: with push-on-delta and a long sweep
+    cadence, idle/low-churn rounds cost O(changed) requests instead of
+    O(children) — >= 90% fewer mock-tier polls at 1% churn."""
+    mock = MockFleet(400, peer_token="fleet-secret")
+    tiers = None
+    try:
+        tiers = FleetTiers(
+            mock,
+            n_regions=4,
+            wall_clock=lambda: FROZEN_WALL,
+            peer_token="fleet-secret",
+            push_notify=True,
+            sweep_interval=3600.0,
+        )
+        # Cold start sweeps everything (the only way a restarted parent
+        # recovers) and plants the subscriptions via poll headers.
+        tiers.round()
+        assert len(tiers.root.inventory_payload()["slices"]) == 400
+        assert all(p.subs for p in mock.peers.values())
+        # Pure idle push round: no notifications, so no mock polls at
+        # all until the sweep cadence comes due.
+        mock.stats.update(requests=0, not_modified=0, full=0, bytes=0)
+        changed = tiers.round()
+        assert changed == set()
+        assert mock.stats["requests"] == 0
+        # 1% churn: each changed peer notifies its region, the region
+        # polls exactly the dirty children, re-renders, and its OWN
+        # NotifySender nudges the root — which polls only the dirty
+        # regions. The change still arrives end to end.
+        changed_names = mock.churn(0.01)
+        assert len(changed_names) == 4
+        assert mock.stats["notifies"] == 4
+        mock.stats.update(requests=0, not_modified=0, full=0, bytes=0)
+        changed = tiers.round()
+        by_name = {}
+        for i, region in enumerate(tiers.regions):
+            for name in region.inventory_payload()["slices"]:
+                by_name[name] = f"region/region-{i}/{name}"
+        assert changed == {by_name[n] for n in changed_names}
+        # The economy: pull mode would have cost 400 requests this
+        # round; push costs the changed children only.
+        assert mock.stats["requests"] <= len(changed_names)
+        assert mock.stats["requests"] <= 0.1 * 400
+        pane = tiers.root.inventory_payload()["slices"]
+        for name in changed_names:
+            assert pane[by_name[name]]["healthy_hosts"] == 1
+    finally:
+        if tiers is not None:
+            tiers.close()
+        mock.close()
+
+
+def test_push_off_is_byte_identical_to_pull():
+    """--push-notify=off pins today's economy: no subscribe headers on
+    the wire, no notify POSTs, and the same per-round request count and
+    byte movement as the pre-push collector."""
+    mock = MockFleet(60)
+    tiers = None
+    try:
+        tiers = FleetTiers(
+            mock, n_regions=2, wall_clock=lambda: FROZEN_WALL
+        )
+        tiers.round()
+        # Pull-mode polls never carried a subscribe header, so no mock
+        # peer recorded a subscriber and churn() has nobody to notify.
+        assert all(not p.subs for p in mock.peers.values())
+        mock.stats.update(requests=0, not_modified=0, full=0, bytes=0)
+        tiers.round()
+        assert mock.stats["requests"] == 60
+        assert mock.stats["notifies"] == 0
+        changed_names = mock.churn(0.05)
+        mock.stats.update(requests=0, not_modified=0, full=0, bytes=0)
+        changed = tiers.round()
+        assert len(changed) == len(changed_names)
+        # Every round still polls every child: the off-mode loop is the
+        # seed's pull loop, request for request.
+        assert mock.stats["requests"] == 60
+        assert mock.stats["notifies"] == 0
+        # And no push machinery was even constructed.
+        assert tiers.root.notify_sender is None
+        assert all(r.notify_subscriptions is None for r in tiers.regions)
+    finally:
+        if tiers is not None:
+            tiers.close()
+        mock.close()
+
+
 @pytest.mark.slow
 def test_ten_thousand_slice_fleet_connection_close_tier():
     """The opt-in 10k tier: Connection: close at the mock tier (fd

@@ -1,0 +1,639 @@
+"""Push-on-delta notification tests (peering/notify.py + the obs-server
+receive hook + the parent-side dirty/sweep targeting).
+
+The contract under test, at every layer: the push path is a lossy HINT
+— auth failures never wake a parent, a wedged parent never delays a
+child's publish, and with push OFF the poll loop is byte-identical to
+the pull-everything round. The --max-staleness confirmation sweep is the
+only correctness mechanism; these tests pin that the hint machinery can
+neither replace it nor break it.
+"""
+
+import http.server
+import json
+import socket
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from gpu_feature_discovery_tpu.cmd import events as reconcile_events
+from gpu_feature_discovery_tpu.config.spec import (
+    PUSH_NOTIFY_AUTO,
+    PUSH_NOTIFY_MODES,
+    PUSH_NOTIFY_OFF,
+    PUSH_NOTIFY_ON,
+)
+from gpu_feature_discovery_tpu.fleet.collector import FleetCollector
+from gpu_feature_discovery_tpu.fleet.targets import SliceTarget
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+from gpu_feature_discovery_tpu.obs import server as obs_server
+from gpu_feature_discovery_tpu.obs.registry import Registry
+from gpu_feature_discovery_tpu.obs.server import (
+    IntrospectionServer,
+    IntrospectionState,
+)
+from gpu_feature_discovery_tpu.peering import notify
+from gpu_feature_discovery_tpu.peering.coordinator import SliceCoordinator
+from gpu_feature_discovery_tpu.utils import faults
+from gpu_feature_discovery_tpu.utils.retry import BackoffPolicy
+
+
+# ---------------------------------------------------------------------------
+# mode resolution + wire-vocabulary pins
+# ---------------------------------------------------------------------------
+
+def test_resolve_push_notify_modes():
+    """auto is on exactly when a peer token is configured — the notify
+    endpoint never works unauthenticated, so tokenless auto keeps
+    today's pull rounds."""
+    assert notify.resolve_push_notify(PUSH_NOTIFY_ON, "") is True
+    assert notify.resolve_push_notify(PUSH_NOTIFY_OFF, "tok") is False
+    assert notify.resolve_push_notify(PUSH_NOTIFY_AUTO, "tok") is True
+    assert notify.resolve_push_notify(PUSH_NOTIFY_AUTO, "") is False
+    with pytest.raises(ValueError):
+        notify.resolve_push_notify("sometimes", "tok")
+    assert set(PUSH_NOTIFY_MODES) == {
+        PUSH_NOTIFY_ON, PUSH_NOTIFY_OFF, PUSH_NOTIFY_AUTO
+    }
+
+
+def test_header_spellings_pinned_across_layers():
+    """obs/server.py restates the subscribe-header names locally (it
+    must not import peering, same as X-TFD-Poll-Tier); the two spellings
+    must never drift."""
+    assert obs_server._NOTIFY_PORT_HEADER == notify.NOTIFY_PORT_HEADER
+    assert obs_server._NOTIFY_NAME_HEADER == notify.NOTIFY_NAME_HEADER
+    assert notify.NOTIFY_PATH == "/peer/notify"
+
+
+# ---------------------------------------------------------------------------
+# NotifySubscriptions: poll-refreshed TTL registry
+# ---------------------------------------------------------------------------
+
+def test_subscriptions_ttl_and_refresh():
+    now = [0.0]
+    subs = notify.NotifySubscriptions(10.0, clock=lambda: now[0])
+    subs.observe_poll("10.0.0.1", 9101, "slice-a")
+    subs.observe_poll("10.0.0.2", 9102, "slice-a")
+    assert len(subs.targets()) == 2
+    now[0] = 9.0
+    subs.observe_poll("10.0.0.1", 9101, "slice-a")  # refresh one
+    now[0] = 11.0
+    assert subs.targets() == [("10.0.0.1", 9101, "slice-a")]
+    now[0] = 25.0
+    assert subs.targets() == []
+
+
+def test_subscriptions_reject_unusable_entries():
+    subs = notify.NotifySubscriptions(10.0)
+    subs.observe_poll("", 9101, "a")
+    subs.observe_poll("10.0.0.1", 0, "a")
+    subs.observe_poll("10.0.0.1", -1, "a")
+    subs.observe_poll("10.0.0.1", 9101, "")
+    assert subs.targets() == []
+
+
+# ---------------------------------------------------------------------------
+# NotifySender: never blocks, coalesces, gives up
+# ---------------------------------------------------------------------------
+
+class _NotifyParent:
+    """A tiny real parent endpoint recording /peer/notify POSTs."""
+
+    def __init__(self, status=202):
+        self.received = []
+        self.status = status
+        outer = self
+
+        class Handler(http.server.BaseHTTPRequestHandler):
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                body = self.rfile.read(length)
+                outer.received.append(
+                    (self.path, json.loads(body.decode()),
+                     self.headers.get("X-TFD-Probe-Token", ""))
+                )
+                self.send_response(outer.status)
+                self.send_header("Content-Length", "0")
+                self.end_headers()
+
+            def log_message(self, *a):
+                pass
+
+        self.httpd = http.server.ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.httpd.server_address[1]
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.httpd.shutdown()
+        self.httpd.server_close()
+
+
+def _wait_for(predicate, timeout=5.0):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(0.01)
+    return predicate()
+
+
+def test_sender_delivers_with_token_and_schema():
+    parent = _NotifyParent()
+    subs = notify.NotifySubscriptions(60.0)
+    subs.observe_poll("127.0.0.1", parent.port, "slice-a")
+    sender = notify.NotifySender(subs, token="sekrit")
+    try:
+        sender.publish(7, '"abc"')
+        assert _wait_for(lambda: len(parent.received) >= 1)
+        path, doc, token = parent.received[0]
+        assert path == notify.NOTIFY_PATH
+        assert doc == {
+            "schema": notify.NOTIFY_SCHEMA,
+            "name": "slice-a",
+            "generation": 7,
+            "etag": '"abc"',
+        }
+        assert token == "sekrit"
+    finally:
+        sender.close()
+        parent.close()
+
+
+def test_sender_publish_never_blocks_on_hung_parent():
+    """Satellite: a child's notify backoff must NEVER delay its label
+    publish. The parent here accepts the TCP connection and then never
+    answers — publish() must return immediately anyway, because delivery
+    (including all retries and the give-up) runs on the daemon worker
+    thread."""
+    hung = socket.socket()
+    hung.bind(("127.0.0.1", 0))
+    hung.listen(1)  # accepts the connection, never reads or answers
+    port = hung.getsockname()[1]
+    subs = notify.NotifySubscriptions(60.0)
+    subs.observe_poll("127.0.0.1", port, "slice-a")
+    sender = notify.NotifySender(
+        subs,
+        token="sekrit",
+        timeout=0.5,
+        backoff=BackoffPolicy(base=0.05, cap=0.1, jitter=0.0),
+    )
+    try:
+        started = time.monotonic()
+        for generation in range(1, 6):
+            sender.publish(generation, f'"e{generation}"')
+        elapsed = time.monotonic() - started
+        # Five publishes against a wedged parent: well under one single
+        # connect/read timeout, let alone the retry schedule.
+        assert elapsed < 0.4, f"publish blocked {elapsed:.3f}s"
+    finally:
+        sender.close()
+        hung.close()
+
+
+def test_sender_coalesces_to_latest_and_counts_dropped():
+    """A burst of publishes while the worker is busy collapses to the
+    newest hint; superseded pendings count outcome=dropped."""
+    parent = _NotifyParent()
+    subs = notify.NotifySubscriptions(60.0)
+    subs.observe_poll("127.0.0.1", parent.port, "slice-a")
+    sender = notify.NotifySender(subs, token="t")
+    before = obs_metrics.NOTIFY_SENT.value(outcome="dropped")
+    try:
+        # Publish a burst before the worker thread can drain: at least
+        # the replaced pendings are dropped, and the LAST generation is
+        # always among what arrives.
+        for generation in range(1, 21):
+            sender.publish(generation, f'"e{generation}"')
+        assert _wait_for(
+            lambda: any(d["generation"] == 20 for _, d, _t in parent.received)
+        )
+        assert _wait_for(lambda: sender._pending is None)
+        delivered = len(parent.received)
+        dropped = obs_metrics.NOTIFY_SENT.value(outcome="dropped") - before
+        assert delivered + dropped >= 20
+        assert delivered < 20  # the burst did coalesce
+    finally:
+        sender.close()
+        parent.close()
+
+
+def test_sender_rejection_is_not_retried():
+    parent = _NotifyParent(status=503)
+    subs = notify.NotifySubscriptions(60.0)
+    subs.observe_poll("127.0.0.1", parent.port, "slice-a")
+    sender = notify.NotifySender(subs, token="t")
+    before = obs_metrics.NOTIFY_SENT.value(outcome="rejected")
+    try:
+        sender.publish(1, '"e"')
+        assert _wait_for(
+            lambda: obs_metrics.NOTIFY_SENT.value(outcome="rejected")
+            == before + 1
+        )
+        time.sleep(0.1)  # any retry would land a second POST
+        assert len(parent.received) == 1
+    finally:
+        sender.close()
+        parent.close()
+
+
+def test_notify_drop_fault_loses_the_notification():
+    """notify.drop: the child simply never sends — the lossy wire the
+    chaos row models; the parent's sweep owns the repair."""
+    parent = _NotifyParent()
+    subs = notify.NotifySubscriptions(60.0)
+    subs.observe_poll("127.0.0.1", parent.port, "slice-a")
+    sender = notify.NotifySender(subs, token="t")
+    try:
+        faults.load_fault_spec("notify.drop:fail:1")
+        sender.publish(1, '"e1"')
+        # Drain the dropped delivery before the second publish:
+        # latest-wins coalescing would otherwise merge the two and
+        # hand the armed drop the WRONG (newest) notification.
+        assert sender.flush()
+        sender.publish(2, '"e2"')  # the shot is spent; this one flows
+        assert _wait_for(
+            lambda: any(d["generation"] == 2 for _, d, _t in parent.received)
+        )
+        assert not any(d["generation"] == 1 for _, d, _t in parent.received)
+    finally:
+        faults.reset()
+        sender.close()
+        parent.close()
+
+
+# ---------------------------------------------------------------------------
+# POST /peer/notify: the auth ladder (satellite — failure modes)
+# ---------------------------------------------------------------------------
+
+def _post_notify(port, headers=None, body=None):
+    if body is None:
+        body = json.dumps(
+            {"schema": 1, "name": "7", "generation": 3, "etag": '"x"'}
+        ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/peer/notify",
+        data=body,
+        method="POST",
+        headers=headers or {},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            return resp.status, resp.read().decode()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+
+
+def test_post_notify_auth_ladder_never_wakes_parent_on_failure():
+    """The exact /probe ladder: no hook = 404; hook but no token = hard
+    403 (the endpoint NEVER works unauthenticated — it can steer a poll
+    loop); wrong token = 401. In every failure leg the hook is never
+    invoked, so a forged notification cannot wake the parent."""
+    state = IntrospectionState(60.0)
+    woken = []
+
+    server = IntrospectionServer(Registry(), state, addr="127.0.0.1", port=0)
+    server.start()
+    try:
+        assert _post_notify(server.port)[0] == 404
+    finally:
+        server.close()
+
+    server = IntrospectionServer(
+        Registry(), state, addr="127.0.0.1", port=0,
+        peer_notify=lambda n, g, e: woken.append(n) or True,
+        peer_token="",
+    )
+    server.start()
+    try:
+        code, body = _post_notify(server.port)
+        assert code == 403 and "peer-token" in body
+        assert woken == []
+    finally:
+        server.close()
+
+    server = IntrospectionServer(
+        Registry(), state, addr="127.0.0.1", port=0,
+        peer_notify=lambda n, g, e: woken.append(n) or True,
+        peer_token="sekrit",
+    )
+    server.start()
+    try:
+        assert _post_notify(server.port)[0] == 401
+        assert _post_notify(
+            server.port, {"X-TFD-Probe-Token": "wrong"}
+        )[0] == 401
+        assert woken == []
+
+        # The happy path, both token transports.
+        code, body = _post_notify(
+            server.port, {"X-TFD-Probe-Token": "sekrit"}
+        )
+        assert code == 202 and "accepted" in body
+        code, _ = _post_notify(
+            server.port, {"Authorization": "Bearer sekrit"}
+        )
+        assert code == 202
+        assert woken == ["7", "7"]
+
+        # Junk body: 400, no wake.
+        code, _ = _post_notify(
+            server.port, {"X-TFD-Probe-Token": "sekrit"}, body=b"not json"
+        )
+        assert code == 400
+        assert woken == ["7", "7"]
+    finally:
+        server.close()
+
+
+def test_post_notify_unknown_child_answers_404():
+    """A hook refusing the name (a stale subscription, a mis-pointed
+    child) answers 404 unknown child — nothing dirtied."""
+    server = IntrospectionServer(
+        Registry(), IntrospectionState(60.0), addr="127.0.0.1", port=0,
+        peer_notify=lambda n, g, e: False,
+        peer_token="sekrit",
+    )
+    server.start()
+    try:
+        code, body = _post_notify(
+            server.port, {"X-TFD-Probe-Token": "sekrit"}
+        )
+        assert code == 404 and "unknown child" in body
+    finally:
+        server.close()
+
+
+def test_post_notify_reject_fault_answers_503():
+    woken = []
+    server = IntrospectionServer(
+        Registry(), IntrospectionState(60.0), addr="127.0.0.1", port=0,
+        peer_notify=lambda n, g, e: woken.append(n) or True,
+        peer_token="sekrit",
+    )
+    server.start()
+    try:
+        faults.load_fault_spec("notify.reject:fail:1")
+        code, body = _post_notify(
+            server.port, {"X-TFD-Probe-Token": "sekrit"}
+        )
+        assert code == 503 and "rejected" in body
+        assert woken == []
+        # Shot spent: the next one is accepted.
+        assert _post_notify(
+            server.port, {"X-TFD-Probe-Token": "sekrit"}
+        )[0] == 202
+        assert woken == ["7"]
+    finally:
+        faults.reset()
+        server.close()
+
+
+def test_snapshot_poll_with_headers_subscribes():
+    """The addressing rides the poll direction: a GET /peer/snapshot
+    carrying the notify headers registers (source address, advertised
+    port, name) through the subscribe hook; a poll without them does
+    not."""
+    seen = []
+    server = IntrospectionServer(
+        Registry(), IntrospectionState(60.0), addr="127.0.0.1", port=0,
+        peer_snapshot=lambda: (b'{"schema": 1}', '"e"'),
+        notify_subscribe=lambda host, port, name: seen.append(
+            (host, port, name)
+        ),
+    )
+    server.start()
+    try:
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/peer/snapshot",
+            headers={
+                notify.NOTIFY_PORT_HEADER: "9150",
+                notify.NOTIFY_NAME_HEADER: "3",
+            },
+        )
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            assert resp.status == 200
+        assert seen == [("127.0.0.1", 9150, "3")]
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/peer/snapshot", timeout=5
+        ) as resp:
+            assert resp.status == 200
+        assert seen == [("127.0.0.1", 9150, "3")]  # no headers, no sub
+    finally:
+        server.close()
+
+
+# ---------------------------------------------------------------------------
+# parent-side targeting: dirty ∪ suspects between sweeps, sweep on cadence
+# ---------------------------------------------------------------------------
+
+def _push_coordinator(clock, sweep_interval=100.0):
+    coord = SliceCoordinator(
+        0,
+        ["w0", "w1", "w2"],
+        default_port=1,
+        peer_timeout=0.1,
+        clock=clock,
+        push_notify=True,
+        sweep_interval=sweep_interval,
+    )
+    return coord
+
+
+def test_coordinator_round_targets_dirty_and_sweep():
+    now = [0.0]
+    coord = _push_coordinator(lambda: now[0])
+    try:
+        all_ids = sorted(p.worker_id for p in coord._peers)
+        # Cold start: the first round is ALWAYS a sweep (a restarted
+        # parent lost its dirty set; one full round repairs it).
+        assert sorted(
+            p.worker_id for p in coord._round_targets()
+        ) == all_ids
+        # Mark every peer reached so none is a suspect.
+        for wid, state in coord._peer_state.items():
+            state.ever_reached = True
+            state.consecutive_failures = 0
+        now[0] = 1.0
+        assert coord._round_targets() == []  # idle: nothing to poll
+        assert coord.mark_dirty("2") is True
+        assert sorted(
+            p.worker_id for p in coord._round_targets()
+        ) == [2]
+        # Draining the dirty set is per-round: the next round is empty.
+        assert coord._round_targets() == []
+        # A peer mid-confirmation (failure streak) stays polled even
+        # without a notification — the 2-miss confirmation and the
+        # confirmed-dead backoff advance exactly as under pull.
+        coord._peer_state[1].consecutive_failures = 1
+        assert sorted(
+            p.worker_id for p in coord._round_targets()
+        ) == [1]
+        # The sweep deadline passed: everyone again.
+        now[0] = 101.0
+        assert sorted(
+            p.worker_id for p in coord._round_targets()
+        ) == all_ids
+    finally:
+        coord.close()
+
+
+def test_coordinator_mark_dirty_validates_names():
+    coord = _push_coordinator(time.monotonic)
+    try:
+        assert coord.mark_dirty("not-a-worker") is False
+        assert coord.mark_dirty("99") is False  # not in this slice
+        assert coord.mark_dirty("1") is True
+    finally:
+        coord.close()
+    assert coord.mark_dirty("1") is False  # closed: never dirties
+
+
+def test_pull_mode_constructs_no_push_machinery():
+    """--push-notify=off is today's loop byte for byte: no subscription
+    registry, no sender thread, and every round targets every peer."""
+    coord = SliceCoordinator(0, ["w0", "w1"], default_port=1, peer_timeout=0.1)
+    try:
+        assert coord.push_notify is False
+        assert coord.notify_subscriptions is None
+        assert coord.notify_sender is None
+        for _ in range(3):
+            assert coord._round_targets() is coord._peers
+    finally:
+        coord.close()
+
+
+def test_collector_round_targets_dirty_and_sweep():
+    """The fleet tier mirrors the peer tier's targeting rule over target
+    NAMES (regions/slices), with the same cold-start sweep."""
+    now = [0.0]
+    targets = [
+        SliceTarget(name=f"s{i}", hosts=(f"127.0.0.1:{9000 + i}",))
+        for i in range(3)
+    ]
+    collector = FleetCollector(
+        targets,
+        push_notify=True,
+        sweep_interval=100.0,
+        clock=lambda: now[0],
+    )
+    try:
+        assert collector._round_targets() == ["s0", "s1", "s2"]  # cold sweep
+        for state in collector._slices.values():
+            for hstate in state.hosts:
+                hstate.ever_reached = True
+        now[0] = 1.0
+        assert collector._round_targets() == []
+        assert collector.mark_dirty("nope") is False
+        assert collector.mark_dirty("s1") is True
+        assert collector._round_targets() == ["s1"]
+        assert collector._round_targets() == []
+        collector._slices["s2"].hosts[0].consecutive_failures = 1
+        assert collector._round_targets() == ["s2"]
+        now[0] = 101.0
+        assert collector._round_targets() == ["s0", "s1", "s2"]
+    finally:
+        collector.close()
+
+
+def test_collector_chain_tail_is_not_a_perpetual_suspect():
+    """The chain walk stops at the first leader-bearing host, so in any
+    multi-host slice the members past the leader are never ATTEMPTED —
+    ever_reached stays False with a zero failure streak. They must not
+    count as suspects (that would re-poll every multi-host slice every
+    round, shedding none of the idle cost push exists to shed); only a
+    target with NO host ever reached — a fresh targets-file add — is
+    polled before its first sweep."""
+    now = [0.0]
+    targets = [
+        SliceTarget(
+            name="multi",
+            hosts=("127.0.0.1:9100", "127.0.0.1:9101", "127.0.0.1:9102"),
+        ),
+        SliceTarget(name="fresh", hosts=("127.0.0.1:9103",)),
+    ]
+    collector = FleetCollector(
+        targets,
+        push_notify=True,
+        sweep_interval=100.0,
+        clock=lambda: now[0],
+    )
+    try:
+        assert collector._round_targets() == ["multi", "fresh"]  # cold
+        # The walk reached multi's leader and stopped; the tail was
+        # never attempted. fresh was never attempted at all.
+        collector._slices["multi"].hosts[0].ever_reached = True
+        now[0] = 1.0
+        assert collector._round_targets() == ["fresh"]
+        collector._slices["fresh"].hosts[0].ever_reached = True
+        assert collector._round_targets() == []  # idle at last
+        # A failure streak anywhere in the chain still suspects the
+        # target — confirmation and backoff advance exactly as under
+        # pull.
+        collector._slices["multi"].hosts[1].consecutive_failures = 1
+        assert collector._round_targets() == ["multi"]
+    finally:
+        collector.close()
+
+
+def test_collector_pull_mode_polls_everyone():
+    targets = [
+        SliceTarget(name=f"s{i}", hosts=(f"127.0.0.1:{9000 + i}",))
+        for i in range(2)
+    ]
+    collector = FleetCollector(targets)
+    try:
+        assert collector.push_notify is False
+        assert collector.notify_sender is None
+        for _ in range(3):
+            assert collector._round_targets() == ["s0", "s1"]
+    finally:
+        collector.close()
+
+
+# ---------------------------------------------------------------------------
+# DeltaTracker.observe_membership (satellite: scoped fingerprints)
+# ---------------------------------------------------------------------------
+
+def test_delta_tracker_membership_scopes_baseline_independently():
+    """The generalized membership fingerprint: the FIRST observation in
+    any scope baselines silently (a fleet booting up must not wake on
+    discovering itself); scopes change independently."""
+    q = reconcile_events.EventQueue()
+    tracker = reconcile_events.DeltaTracker(q)
+    tracker.observe_membership("slice", frozenset({0, 1}))
+    tracker.observe_membership("region", frozenset({"r1", "r2"}))
+    assert q.get_nowait() is None  # baselines never wake
+
+    tracker.observe_membership("slice", frozenset({0, 1}))
+    tracker.observe_membership("region", frozenset({"r1", "r2"}))
+    assert q.get_nowait() is None  # unchanged never wakes
+
+    tracker.observe_membership("region", frozenset({"r1"}))
+    event = q.get_nowait()
+    assert event is not None
+    assert event.reason == reconcile_events.REASON_PEER_DELTA
+    assert q.get_nowait() is None  # slice scope unaffected
+
+    # An EMPTY baseline is still a baseline (dict-membership, not
+    # truthiness): observing {} first, then members, wakes exactly once.
+    tracker2 = reconcile_events.DeltaTracker(q)
+    tracker2.observe_membership("fleet", frozenset())
+    assert q.get_nowait() is None
+    tracker2.observe_membership("fleet", frozenset({"a"}))
+    assert q.get_nowait() is not None
+
+
+def test_delta_tracker_observe_peers_still_routes_to_slice_scope():
+    q = reconcile_events.EventQueue()
+    tracker = reconcile_events.DeltaTracker(q)
+    tracker.observe_peers(frozenset({0, 1}))
+    assert q.get_nowait() is None
+    tracker.observe_peers(frozenset({0}))
+    assert q.get_nowait() is not None
+    tracker.observe_peers(None)  # pre-first-round: ignored, no reset
+    tracker.observe_peers(frozenset({0}))
+    assert q.get_nowait() is None
