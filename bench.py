@@ -1011,6 +1011,43 @@ def main() -> int:
         file=sys.stderr,
     )
 
+    # Verdict-actuation convergence (ISSUE 19): full cycles from a
+    # confirmed sick verdict first appearing to the advice family
+    # landing in the emitted label set, at the default
+    # --actuation-window. A cycle count, not wall-clock, so it is
+    # deadline-free and CI-stable; CI asserts <= 2 (the hysteresis
+    # window is the ONLY latency actuation adds on top of the verdict's
+    # own confirmation).
+    from gpu_feature_discovery_tpu.actuation.engine import (
+        ActuationEngine,
+        advice_present,
+    )
+    from gpu_feature_discovery_tpu.config.flags import DEFAULT_ACTUATION_WINDOW
+    from gpu_feature_discovery_tpu.config.spec import ACTUATION_ENFORCE
+    from gpu_feature_discovery_tpu.lm.health import CHIPS_SICK
+
+    actuation_engine = ActuationEngine(
+        mode=ACTUATION_ENFORCE,
+        window=DEFAULT_ACTUATION_WINDOW,
+        fraction=0.25,
+        lease_ttl=60.0,
+    )
+    sick_cycle = {"google.com/tpu.count": "4", CHIPS_SICK: "1"}
+    actuation_convergence_cycles = None
+    for cycle in range(1, 11):
+        projected = actuation_engine.project(Labels(sick_cycle), "full")
+        if advice_present(projected):
+            actuation_convergence_cycles = cycle
+            break
+    assert actuation_convergence_cycles is not None, (
+        "confirmed verdict never produced actuation advice"
+    )
+    print(
+        f"bench: actuation convergence window={DEFAULT_ACTUATION_WINDOW} "
+        f"actuation_convergence_cycles={actuation_convergence_cycles}",
+        file=sys.stderr,
+    )
+
     # Slice aggregation cost (ISSUE 7): one leader poll round over the
     # live /peer/snapshot endpoints of 3 serving peers (a 4-worker
     # slice) + the aggregation itself — exactly what the slice label
@@ -1890,6 +1927,12 @@ def main() -> int:
                 # in between) — None would mean it never recovered.
                 "recovery_cycles_to_labels": recovery_cycles,
                 "recovery_injected_init_failures": injected_init_failures,
+                # Verdict-actuation acceptance (ISSUE 19): full cycles
+                # from a confirmed sick verdict to the advice family in
+                # the emitted set at the default --actuation-window —
+                # CI asserts <= 2 (the advice hysteresis is the only
+                # latency actuation adds).
+                "actuation_convergence_cycles": actuation_convergence_cycles,
                 # Slice coordination acceptance (ISSUE 7): one leader
                 # poll round over 3 live peer snapshot endpoints + the
                 # aggregation — CI asserts it is far under the sleep

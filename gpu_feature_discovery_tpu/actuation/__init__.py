@@ -1,0 +1,23 @@
+"""Fail-safe verdict actuation (ISSUE 19): confirmed health verdicts
+projected into scheduler-consumable advice labels through the existing
+features.d file, with confirmation gating, a slice-wide blast-radius
+budget, TTL'd fail-static leases, and a dry-run-first mode ladder. See
+engine.py's module docstring for the safety-rail contract."""
+
+from gpu_feature_discovery_tpu.actuation.engine import (  # noqa: F401
+    ACTUATION_LEASE_LABEL,
+    ADVICE_LABELS,
+    CORDON_ADVICE_LABEL,
+    DRAIN_ADVICE_LABEL,
+    LEASE_TTL_FACTOR,
+    REASON_SICK_CHIPS,
+    REASON_STRAGGLER,
+    SCHEDULABLE_LABEL,
+    WOULD_CORDON_LABEL,
+    ActuationEngine,
+    advice_present,
+    budget_allowance,
+    drop_lapsed_advice,
+    lease_expiry,
+    new_actuation_engine,
+)

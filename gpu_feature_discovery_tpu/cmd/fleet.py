@@ -512,6 +512,13 @@ def main(argv: Optional[list] = None) -> int:
     from gpu_feature_discovery_tpu.fleet.targets import parse_targets_file
 
     sigs = new_os_watcher()
+    # The last successfully parsed target set, carried across epochs: a
+    # targets file caught mid-rewrite (a torn os.replace race, a config
+    # tool's truncated temp copy, plain invalid YAML) must not error the
+    # epoch — the collector keeps scraping the roster it already trusts
+    # and the watcher fires again when the write completes. Only a FIRST
+    # load with nothing to fall back on is fatal.
+    last_good_targets = None
     while True:
         try:
             values = resolve_flags(ns)
@@ -545,10 +552,25 @@ def main(argv: Optional[list] = None) -> int:
                         f"--ha-self {values['ha-self']!r} is not an "
                         "entry of --ha-peers"
                     )
-            targets = parse_targets_file(values["targets-file"])
         except ConfigError as e:
             log.error("unable to load fleet collector config: %s", e)
             return 1
+        try:
+            targets = parse_targets_file(values["targets-file"])
+        except ConfigError as e:
+            if last_good_targets is None:
+                log.error("unable to load fleet collector config: %s", e)
+                return 1
+            obs_metrics.FLEET_TARGETS_RELOAD_FAILURES.inc()
+            log.warning(
+                "targets file reload failed (%s); keeping the last-good "
+                "%d-target set",
+                e,
+                len(last_good_targets),
+            )
+            targets = last_good_targets
+        else:
+            last_good_targets = targets
         if not targets:
             log.warning("targets file names no slices; serving an empty "
                         "inventory until it does")

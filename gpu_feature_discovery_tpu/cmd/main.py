@@ -746,6 +746,17 @@ def run(
             else DEFAULT_FLAP_WINDOW
         )
         flap = FlapDamper(window)
+    # Fail-safe verdict actuation (actuation/engine.py): daemon epochs
+    # only, one engine per config epoch — a SIGHUP reload rebuilds it, so
+    # advise->enforce->off transitions apply cleanly and streak state
+    # never outlives the config that parameterized it. None at
+    # --actuation=off (the default): the projection call below is the
+    # ONLY touch point, so off keeps the label path byte for byte.
+    actuation = None
+    if supervised:
+        from gpu_feature_discovery_tpu.actuation import new_actuation_engine
+
+        actuation = new_actuation_engine(config, coordinator)
     try:
         timestamp_labeler = new_timestamp_labeler(config)
         if restored_served is not None:
@@ -903,6 +914,15 @@ def run(
                     # change that has not held --flap-window cycles
                     # re-serves the previous set + tfd.flapping.
                     labels = flap.observe(labels)
+
+                if actuation is not None:
+                    # AFTER the flap damper (the advice family has its
+                    # own hysteresis; stacking windows would double-damp)
+                    # and BEFORE the write: what goes on disk is the
+                    # verdict-projected set. Returns a new object when
+                    # advice changes — the damper's remembered baseline
+                    # is never mutated.
+                    labels = actuation.project(labels, cycle_mode)
 
                 log.info(
                     "Writing labels to output file %s", output_file or "<stdout>"

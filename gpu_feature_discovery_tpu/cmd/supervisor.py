@@ -223,7 +223,16 @@ class Supervisor:
         restored = self._state_store.load()
         if restored is None:
             return None
-        cleaned = self._strip_markers(restored)
+        # Lapsed actuation advice must NOT resurrect across a restart: a
+        # SIGKILLed daemon's cordon advice outliving its lease in the
+        # state file is exactly the frozen-cordon failure the TTL
+        # exists to prevent. Still-leased advice restores as-is (under
+        # its ORIGINAL stamp) and ages out like any re-serve.
+        from gpu_feature_discovery_tpu.actuation.engine import (
+            drop_lapsed_advice,
+        )
+
+        cleaned = drop_lapsed_advice(self._strip_markers(restored))
         if not cleaned:
             return None
         self._last_good = cleaned
@@ -252,8 +261,16 @@ class Supervisor:
         backend must not strip the node), and the marker says so."""
         if not self._restored or self._last_good is None:
             return labels
+        from gpu_feature_discovery_tpu.actuation.engine import (
+            drop_lapsed_advice,
+        )
+
         merged = Labels(self._last_good)
         merged.update(labels)
+        # Restored advice rides the overlay only while its lease holds
+        # (TTL'd fail-static: the previous process's verdicts age out,
+        # they are never refreshed by a cycle that measured nothing).
+        merged = drop_lapsed_advice(merged)
         merged[RESTORED_LABEL] = "true"
         return merged
 
@@ -365,7 +382,19 @@ class Supervisor:
         nothing cached, so the counter alone goes out — the file still
         exists and still converges (chaos contract: full or degraded,
         never absent)."""
-        labels = Labels(self._last_good) if self._last_good is not None else Labels()
+        if self._last_good is not None:
+            # Failed-cycle re-serves bypass the actuation projection, so
+            # the fail-static lease check lands here: cached advice ages
+            # out of BOTH the re-serve and the cache (one warn, not one
+            # per failed cycle) once its lease lapses.
+            from gpu_feature_discovery_tpu.actuation.engine import (
+                drop_lapsed_advice,
+            )
+
+            self._last_good = drop_lapsed_advice(self._last_good)
+            labels = Labels(self._last_good)
+        else:
+            labels = Labels()
         labels[UNHEALTHY_CYCLES_LABEL] = str(self._consecutive_failures)
         if self.degraded:
             labels[DEGRADED_LABEL] = "true"

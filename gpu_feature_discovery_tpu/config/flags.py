@@ -15,6 +15,8 @@ from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Sequence
 
 from gpu_feature_discovery_tpu.config.spec import (
+    ACTUATION_MODES,
+    ACTUATION_OFF,
     Config,
     ConfigError,
     PROBE_BROKER_AUTO,
@@ -125,6 +127,18 @@ DEFAULT_COHORT_SIZE = "0"
 DEFAULT_MAX_STALENESS = 0.0
 DEFAULT_RECONCILE_DEBOUNCE = 0.5
 DEFAULT_MAX_PROBE_RATE = 1.0
+# Fail-safe verdict actuation (actuation/engine.py). The window is the
+# actuation layer's OWN hysteresis on top of the verdict machinery's
+# confirmation (burn-in per-chip verdicts, the StragglerDetector's
+# 2-consecutive-probe streak): a confirmed verdict must hold this many
+# consecutive full cycles before advice fires, and stay clean as long
+# before it clears — one marginal probe never cordons a node. The
+# fraction is the slice-wide blast-radius cap: a systemic false
+# positive (a bad libtpu rollout reading every chip sick) actuates at
+# most ceil(fraction * hosts) of the slice and raises
+# tfd_actuation_budget_exhausted on the rest, instead of draining it.
+DEFAULT_ACTUATION_WINDOW = 2
+DEFAULT_MAX_ACTUATED_FRACTION = 0.25
 
 _DURATION_RE = re.compile(r"(\d+(?:\.\d+)?)(ns|us|µs|ms|s|m|h)")
 _DURATION_UNITS = {
@@ -685,6 +699,53 @@ FLAG_DEFS: List[FlagDef] = [
         getter=lambda c: _f(c).tfd.push_notify,
     ),
     FlagDef(
+        name="actuation",
+        env_vars=("TFD_ACTUATION",),
+        parse=str,
+        default=ACTUATION_OFF,
+        help="fail-safe verdict actuation (actuation/): 'enforce' "
+        "projects confirmed health verdicts into scheduler-consumable "
+        "advice labels (google.com/tpu.schedulable=false, "
+        "tfd.cordon-advice=<reason>, tfd.drain-advice=true on a "
+        "confirmed straggler) through the same features.d file, gated "
+        "by --actuation-window hysteresis, the --max-actuated-fraction "
+        "slice budget, and a TTL'd lease that lets a dead actuator's "
+        "advice lapse to NO advice; 'advise' is the dry run, emitting "
+        "only tfd.would-cordon=<reason>; 'off' (default) constructs "
+        "none of it — label output is byte-identical to before",
+        setter=lambda c, v: setattr(_f(c).tfd, "actuation", v),
+        getter=lambda c: _f(c).tfd.actuation,
+    ),
+    FlagDef(
+        name="actuation-window",
+        env_vars=("TFD_ACTUATION_WINDOW",),
+        parse=_parse_positive_int,
+        default=DEFAULT_ACTUATION_WINDOW,
+        help="with --actuation on, how many consecutive FULL cycles a "
+        "confirmed verdict must hold before advice fires — and stay "
+        "clean before it clears (hysteresis on top of the verdict "
+        "machinery's own confirmation, so one bad probe never cordons "
+        "a node)",
+        setter=lambda c, v: setattr(_f(c).tfd, "actuation_window", v),
+        getter=lambda c: _f(c).tfd.actuation_window,
+    ),
+    FlagDef(
+        name="max-actuated-fraction",
+        env_vars=("TFD_MAX_ACTUATED_FRACTION",),
+        parse=_parse_fraction,
+        default=DEFAULT_MAX_ACTUATED_FRACTION,
+        help="with --actuation on, fraction in (0, 1): at most "
+        "ceil(fraction * slice hosts) members of one slice may carry "
+        "actuation advice at once, derived identically by every member "
+        "from the peer snapshot plane (lowest verdict-carrying "
+        "worker-ids win; no election, no new wire surface); the "
+        "suppressed rest raise tfd_actuation_budget_exhausted — a "
+        "systemic false positive caps at a bounded fraction instead "
+        "of draining the slice",
+        setter=lambda c, v: setattr(_f(c).tfd, "max_actuated_fraction", v),
+        getter=lambda c: _f(c).tfd.max_actuated_fraction,
+    ),
+    FlagDef(
         name="state-dir",
         env_vars=("TFD_STATE_DIR",),
         parse=str,
@@ -793,6 +854,12 @@ def new_config(
         raise ConfigError(
             f"invalid push-notify: {push_notify!r} "
             f"(want one of {PUSH_NOTIFY_MODES})"
+        )
+    actuation = config.flags.tfd.actuation
+    if actuation not in ACTUATION_MODES:
+        raise ConfigError(
+            f"invalid actuation: {actuation!r} "
+            f"(want one of {ACTUATION_MODES})"
         )
     # Deferred import: config is a leaf layer below resource; the
     # registry import runs only at validation time, never at module

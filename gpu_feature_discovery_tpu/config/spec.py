@@ -118,6 +118,23 @@ PUSH_NOTIFY_MODES = (
     PUSH_NOTIFY_AUTO,
 )
 
+# Verdict actuation modes (actuation/engine.py): `off` (the default)
+# constructs none of the actuation machinery — label output stays
+# byte-identical to the pre-actuation daemon; `advise` is the dry run,
+# emitting only tfd.would-cordon=<reason> (plus the lease) so operators
+# can watch what WOULD be actuated; `enforce` emits the real advice
+# family (google.com/tpu.schedulable=false, tfd.cordon-advice,
+# tfd.drain-advice). The rollout order is off -> advise -> enforce
+# (docs/operations.md "Acting on verdicts safely").
+ACTUATION_OFF = "off"
+ACTUATION_ADVISE = "advise"
+ACTUATION_ENFORCE = "enforce"
+ACTUATION_MODES = (
+    ACTUATION_OFF,
+    ACTUATION_ADVISE,
+    ACTUATION_ENFORCE,
+)
+
 
 @dataclass
 class ReplicatedResource:
@@ -270,6 +287,13 @@ class TfdFlags:
     # small authenticated change hint upward so parents poll only dirty
     # children between full confirmation sweeps.
     push_notify: Optional[str] = None  # auto | on | off
+    # Fail-safe verdict actuation (actuation/engine.py): confirmed
+    # health verdicts projected into scheduler-consumable advice labels
+    # with confirmation hysteresis, a slice-wide blast-radius budget,
+    # and TTL'd fail-static leases.
+    actuation: Optional[str] = None  # off | advise | enforce
+    actuation_window: Optional[int] = None  # consecutive confirming cycles
+    max_actuated_fraction: Optional[float] = None  # (0, 1) exclusive
 
 
 @dataclass
@@ -359,6 +383,9 @@ class Config:
                         else self.flags.tfd.peer_token
                     ),
                     "pushNotify": self.flags.tfd.push_notify,
+                    "actuation": self.flags.tfd.actuation,
+                    "actuationWindow": self.flags.tfd.actuation_window,
+                    "maxActuatedFraction": self.flags.tfd.max_actuated_fraction,
                 },
             },
             "sharing": {
@@ -588,6 +615,15 @@ def parse_config_file(path: str) -> Config:
     config.flags.tfd.probe_token = _opt_str(tfd.get("probeToken"))
     config.flags.tfd.peer_token = _opt_str(tfd.get("peerToken"))
     config.flags.tfd.push_notify = _opt_str(tfd.get("pushNotify"))
+    config.flags.tfd.actuation = _opt_str(tfd.get("actuation"))
+    if tfd.get("actuationWindow") is not None:
+        config.flags.tfd.actuation_window = parse_positive_int(
+            tfd["actuationWindow"]
+        )
+    if tfd.get("maxActuatedFraction") is not None:
+        config.flags.tfd.max_actuated_fraction = parse_fraction(
+            tfd["maxActuatedFraction"]
+        )
 
     config.resources = raw.get("resources", {}) or {}
     config.sharing = Sharing.from_dict(raw.get("sharing", {}) or {})

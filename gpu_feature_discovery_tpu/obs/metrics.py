@@ -307,6 +307,42 @@ COHORT_POLL_ROUNDS = REGISTRY.counter(
     "flat mode.",
     labelnames=("tier",),
 )
+# -- verdict actuation (actuation/engine.py, --actuation) -------------------
+
+ACTUATION_ADVICE = REGISTRY.gauge(
+    "tfd_actuation_advice",
+    "1 while this daemon's label file carries actuation advice "
+    "(schedulable=false / cordon-advice / would-cordon), 0 otherwise. "
+    "Sums across a slice to the hosts currently advised — bounded by "
+    "ceil(--max-actuated-fraction * hosts) by construction.",
+)
+ACTUATION_BUDGET_EXHAUSTED = REGISTRY.gauge(
+    "tfd_actuation_budget_exhausted",
+    "1 while this daemon holds a confirmed verdict that WANTS advice but "
+    "the slice blast-radius budget (--max-actuated-fraction over the "
+    "peer snapshot plane) suppresses it. A slice-wide sum near the host "
+    "count is the systemic-false-positive signature: every member reads "
+    "sick at once, and the budget — not the scheduler — is what kept "
+    "the slice alive.",
+)
+ACTUATION_TRANSITIONS = REGISTRY.counter(
+    "tfd_actuation_transitions_total",
+    "Actuation state changes, by action: fired (advice published after "
+    "the window held), cleared (verdicts converged clean for a full "
+    "window), budget-suppressed (desire arrived but the slice budget "
+    "said no), lease-lapsed (cached or restored advice outlived its "
+    "lease without a fresh confirmation and was dropped — the "
+    "fail-static path doing its job).",
+    labelnames=("action",),
+)
+ACTUATION_CONVERGENCE_CYCLES = REGISTRY.gauge(
+    "tfd_actuation_convergence_cycles",
+    "Consecutive confirmed cycles the last advice firing waited for "
+    "before publishing — the engine's self-reported verdict-to-advice "
+    "latency in cycles. Equals --actuation-window when hysteresis is "
+    "the only delay; the bench gates it at 2.",
+)
+
 # -- fleet aggregation service (fleet/, the fleet-collector mode) -----------
 
 FLEET_SLICES = REGISTRY.gauge(
@@ -428,6 +464,14 @@ FLEET_POLL_BODY_BYTES = REGISTRY.counter(
     "is this counter's delta/full ratio under churn (the bench gates "
     "it at a 1,000-slice fleet).",
     labelnames=("kind",),
+)
+FLEET_TARGETS_RELOAD_FAILURES = REGISTRY.counter(
+    "tfd_fleet_targets_reload_failures_total",
+    "Epoch reloads of the --targets-file that failed to parse (torn "
+    "write, partial copy, invalid YAML) while a last-good target set "
+    "existed to keep serving. The collector polls the stale roster and "
+    "warns instead of erroring the epoch; only a first load with no "
+    "prior targets is fatal.",
 )
 FLEET_HA_DIVERGENCE = REGISTRY.gauge(
     "tfd_fleet_ha_divergence",

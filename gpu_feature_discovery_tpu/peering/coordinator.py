@@ -1497,6 +1497,40 @@ class SliceCoordinator:
             sick_chips=0,
         )
 
+    def actuation_signals(self) -> "tuple[int, Dict[int, bool]]":
+        """(total slice hosts, {peer worker_id: wants-advice}) for the
+        actuation budget (actuation/engine.py). A peer "wants advice"
+        when its last snapshot carries a confirmed verdict — a nonzero
+        pre-extracted sick-chip count or the straggler label. These are
+        the UNDERLYING verdicts already on the wire; the advice family
+        itself is stripped from snapshots (peering/snapshot.py), so
+        every member derives the same candidate ranking from the same
+        inputs — no election, and no advice echo.
+
+        Confirmed-down peers contribute nothing: a dark peer's stale
+        verdict must not consume budget a live sick host needs. In
+        cohort mode _peer_state holds only this member's cohort
+        siblings, so the budget is enforced cohort-scoped — a cap per
+        visibility domain, conservative in the right direction (each
+        cohort independently stays under the fraction).
+
+        Reads _peer_state snapshot refs without the serving lock — the
+        same single-writer pattern as the round's view derivation:
+        refs are replaced wholesale by the engine thread, never
+        mutated in place."""
+        from gpu_feature_discovery_tpu.lm.health import STRAGGLER_CHIP
+
+        desires: Dict[int, bool] = {}
+        for wid, state in self._peer_state.items():
+            snapshot = state.last_snapshot
+            if snapshot is None or state.confirmed_down:
+                continue
+            labels = snapshot.get("labels") or {}
+            desires[wid] = bool(
+                _sick_from(snapshot) or STRAGGLER_CHIP in labels
+            )
+        return self.total_hosts, desires
+
     def _sum_sick_chips(self, reachable_peers: List[PeerEndpoint]) -> int:
         total = _sick_from(self.snapshot_payload())
         for peer in reachable_peers:

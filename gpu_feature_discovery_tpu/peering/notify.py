@@ -188,12 +188,27 @@ class NotifySender:
             self._cond.notify_all()
 
     def close(self) -> None:
+        """Epoch end: wake the worker so it sees ``_closed``, then join
+        it with a bounded wait. The bound matters both ways: a sender
+        mid-POST to a wedged parent must not stall a SIGHUP reload, and
+        a reload storm must not accumulate a sender thread per epoch —
+        the join reaps the common case, and the rare straggler (daemon
+        thread, dies with its socket timeout) is abandoned WITH a warn
+        so an operator watching a reload storm can see the leak that
+        didn't happen silently."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
         thread = self._thread
         if thread is not None:
             thread.join(timeout=self._timeout + 1.0)
+            if thread.is_alive():
+                log.warning(
+                    "notify sender thread still delivering after the "
+                    "%.1fs close bound; abandoning it (daemon thread — "
+                    "it dies with its socket timeout)",
+                    self._timeout + 1.0,
+                )
 
     def flush(self, timeout: float = 5.0) -> bool:
         """Test/bench hook: block until queued work has been delivered

@@ -124,6 +124,7 @@ def strip_snapshot_labels(labels: Dict[str, str]) -> Dict[str, str]:
         is_cohort_label,
     )
     from gpu_feature_discovery_tpu.sandbox.flap import FLAPPING_LABEL
+    from gpu_feature_discovery_tpu.actuation.engine import ADVICE_LABELS
 
     dropped = {
         DEGRADED_LABEL,
@@ -135,6 +136,13 @@ def strip_snapshot_labels(labels: Dict[str, str]) -> Dict[str, str]:
         # cycle-description rationale as DEGRADED_LABEL.
         *FAMILY_DEGRADED_LABELS.values(),
         *SLICE_COORD_LABELS,
+        # Actuation advice out: peers exchange the UNDERLYING verdicts
+        # (the pre-extracted chips verdict + the straggler label) and
+        # each derives the budget locally — shipping the advice itself
+        # would echo derived state back into its own inputs, and the
+        # per-cycle lease stamp would churn snapshot ETags the 304/
+        # delta economy exists to avoid.
+        *ADVICE_LABELS,
     }
     # is_cohort_label: the per-index slice.cohort.<i>.degraded markers
     # are a dynamic family no exact-key set can enumerate.

@@ -1669,10 +1669,178 @@ def run_reconcile_chaos(scenario, workdir, timeout_s=None):
     }
 
 
+def run_actuation_chaos(scenario, workdir, timeout_s=None):
+    """One verdict-actuation chaos scenario (ISSUE 19)."""
+    if scenario == "sick-chip-cordon":
+        return run_sick_chip_cordon(workdir, timeout_s=timeout_s)
+    if scenario == "budget-storm":
+        return run_budget_storm(workdir, timeout_s=timeout_s)
+    raise ValueError(f"unknown actuation chaos scenario {scenario!r}")
+
+
+def run_sick_chip_cordon(workdir, timeout_s=None):
+    """actuation:sick-chip-cordon: a REAL sick chip (the chip.3.sick
+    fault on the sharded burn-in probe, two shots so the verdict holds
+    the 2-cycle actuation window) under --actuation=enforce. The
+    contract:
+
+      1. the confirmed verdict fires the advice family —
+         ``schedulable=false`` + ``cordon-advice=sick-chips`` are
+         OBSERVED in the label file — within --actuation-window=2
+         confirming cycles (the convergence gauge the bench also gates);
+      2. once the fault drains and the verdict clears, every advice
+         label is GONE from the converged set (advice is hysteretic,
+         not sticky);
+      3. the node-local non-advice labels converge byte-identical to
+         the healthy pre-fault set — actuation adds and removes its own
+         family only, it never perturbs the measurement labels."""
+    from gpu_feature_discovery_tpu.actuation.engine import (
+        ADVICE_LABELS,
+        CORDON_ADVICE_LABEL,
+        REASON_SICK_CHIPS,
+        SCHEDULABLE_LABEL,
+    )
+    from gpu_feature_discovery_tpu.lm.health import HEALTH_PROBE_MS
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+    # Start the shared registry clean: the convergence-cycles gauge read
+    # below must be THIS scenario's firing, not a leftover.
+    obs_metrics.reset_for_tests()
+    result = run_chaos(
+        "chip.3.sick:fail:2",
+        workdir,
+        timeout_s=timeout_s or 90.0,
+        extra_cli={"actuation": "enforce", "actuation-window": "2"},
+        expect_transient=[
+            f"{SCHEDULABLE_LABEL}=false",
+            f"{CORDON_ADVICE_LABEL}={REASON_SICK_CHIPS}",
+        ],
+        expect_final=[
+            "google.com/tpu.chip.3.ok=true",
+            "google.com/tpu.chips.sick=0",
+        ],
+        expect_absent=list(ADVICE_LABELS),
+        capture_labels=True,
+    )
+    cycles = obs_metrics.ACTUATION_CONVERGENCE_CYCLES.value()
+    assert 0 < cycles <= 2, (
+        f"advice fired after {cycles} confirming cycles — outside the "
+        f"2-cycle window the scenario (and the bench) gate"
+    )
+    armed = result.pop("armed_labels")
+    converged = result.pop("converged_labels")
+    assert armed is not None, "healthy pre-fault snapshot never captured"
+    # probe-ms is a per-probe timing measurement (the armed-time probe
+    # paid the XLA compile) — volatile by design, not actuation fallout.
+    volatile = set(ADVICE_LABELS) | {HEALTH_PROBE_MS}
+    baseline = {k: v for k, v in armed.items() if k not in volatile}
+    non_advice = {k: v for k, v in converged.items() if k not in volatile}
+    assert non_advice == baseline, (
+        f"non-advice labels moved across the cordon/uncordon round trip: "
+        f"{sorted(set(baseline.items()) ^ set(non_advice.items()))}"
+    )
+    result["spec"] = "actuation:sick-chip-cordon"
+    result["convergence_cycles"] = int(cycles)
+    return result
+
+
+def run_budget_storm(workdir, timeout_s=None):
+    """actuation:budget-storm: EVERY chip of a 6-worker hermetic slice
+    reads sick at once (the sick_workers overlay — a systemic false
+    positive, e.g. a bad libtpu rollout) under --actuation=enforce with
+    the default --max-actuated-fraction=0.25. The contract:
+
+      1. at most ceil(0.25 * 6) = 2 hosts ever settle with advice — the
+         two lowest worker-ids, derived identically by every member
+         from the peer snapshot plane with no election;
+      2. the suppressed rest raise tfd_actuation_budget_exhausted
+         instead of draining the slice;
+      3. no daemon exits, and SIGTERM still lands clean on all 6."""
+    from slice_fixture import SliceHarness
+
+    from gpu_feature_discovery_tpu.actuation.engine import (
+        advice_present,
+        budget_allowance,
+    )
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+    budget = timeout_s or 90.0
+    workers = 6
+    allowed = budget_allowance(workers, 0.25)
+    assert allowed == 2, f"budget arithmetic drifted: {allowed}"
+    started = time.monotonic()
+    # Window 3 gives the first peer-poll rounds time to propagate every
+    # member's verdict before anyone's streak matures, so the allowed
+    # set derives from the full candidate list (the cap is re-derived
+    # every cycle either way — a transient over-admit self-corrects).
+    harness = SliceHarness(
+        workdir,
+        workers=workers,
+        sleep_interval="0.05s",
+        extra_cli={
+            "actuation": "enforce",
+            "actuation-window": "3",
+            "max-actuated-fraction": "0.25",
+        },
+        sick_workers=tuple(range(workers)),
+    ).start()
+
+    def advised(snapshots):
+        return sorted(
+            wid for wid, s in snapshots.items() if advice_present(s)
+        )
+
+    try:
+        harness.wait_for(
+            lambda s: advised(s) == list(range(allowed)),
+            timeout=budget,
+            what=f"advice settled on the {allowed} lowest worker ids",
+        )
+        # The cap is an invariant, not a race winner: ride out several
+        # more cycles and re-assert it held and nobody died.
+        deadline = time.monotonic() + 0.5
+        while time.monotonic() < deadline:
+            now_advised = advised(
+                {w.worker_id: w.labels() for w in harness.workers}
+            )
+            assert len(now_advised) <= allowed, (
+                f"budget overrun: {now_advised} hosts carry advice "
+                f"(allowed {allowed})"
+            )
+            time.sleep(0.05)
+        assert now_advised == list(range(allowed)), (
+            f"advised set drifted after convergence: {now_advised}"
+        )
+        assert obs_metrics.ACTUATION_BUDGET_EXHAUSTED.value() == 1, (
+            "suppressed members never raised tfd_actuation_budget_exhausted"
+        )
+        assert (
+            obs_metrics.ACTUATION_TRANSITIONS.value(
+                action="budget-suppressed"
+            )
+            >= 1
+        ), "no budget-suppressed transition recorded"
+        for worker in harness.workers:
+            assert worker.alive, (
+                f"worker {worker.worker_id} exited under the storm"
+            )
+        final = harness.workers[0].labels()
+    finally:
+        harness.stop()
+    elapsed = time.monotonic() - started
+    return {
+        "spec": "actuation:budget-storm",
+        "converged_s": round(elapsed, 3),
+        "advised": allowed,
+        "labels": len(final),
+    }
+
+
 def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
               assert_probe_kills=None, expect_transient=None,
               expect_final=None, expect_absent=None, timeout_s=None,
-              backends=None, require_always=None):
+              backends=None, require_always=None, extra_cli=None,
+              capture_labels=False):
     """Execute one chaos scenario; returns a result dict (raises
     AssertionError on contract violations).
 
@@ -1708,7 +1876,14 @@ def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
     hermetic suite (tests/test_registry.py). ``require_always``
     ("key=value" strings) must hold in EVERY non-empty label-file
     observation — the multi-backend row pins the healthy family
-    publishing continuously while its sibling is degraded."""
+    publishing continuously while its sibling is degraded.
+
+    ``extra_cli`` merges additional --flag values into the daemon's
+    config (the actuation cordon row rides the chip machinery with
+    ``--actuation=enforce``); ``capture_labels`` adds the label set
+    observed at fault-arm time (``armed_labels``) and the converged set
+    (``converged_labels``) to the result, so wrapper scenarios can pin
+    byte-level invariants across the fault."""
     import gpu_feature_discovery_tpu.cmd.main as cmd_main
     from gpu_feature_discovery_tpu.cmd.main import run
     from gpu_feature_discovery_tpu.cmd.supervisor import (
@@ -1739,6 +1914,13 @@ def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
         # hermetic slice fixtures with one slice's whole leadership
         # chain killed for real.
         return run_fleet_chaos(
+            spec.partition(":")[2], workdir, timeout_s=timeout_s
+        )
+    if spec.startswith("actuation:"):
+        # Verdict-actuation chaos (ISSUE 19): the cordon row rides the
+        # chip-fault machinery below (via extra_cli), the budget-storm
+        # row the hermetic slice harness.
+        return run_actuation_chaos(
             spec.partition(":")[2], workdir, timeout_s=timeout_s
         )
     chip_faults = any(
@@ -1799,6 +1981,8 @@ def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
                 "labeler-timeout": "60s",
             }
         )
+    if extra_cli:
+        cli_values.update(extra_cli)
     degraded_markers = [DEGRADED_LABEL, UNHEALTHY_CYCLES_LABEL]
     full_keys = ["google.com/tpu.count"]
     if backends:
@@ -1871,6 +2055,7 @@ def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
         ever_present = False
         ever_degraded = False
         armed = not chip_faults
+        armed_snapshot = None
         seen_transient = set()
         converged = None
         while time.monotonic() < deadline:
@@ -1878,7 +2063,10 @@ def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
             if labels:
                 ever_present = True
                 if not armed and "google.com/tpu.health.ok" in labels:
-                    # First probe done, daemon healthy: inject now.
+                    # First probe done, daemon healthy: inject now (and
+                    # remember the healthy pre-fault set — the actuation
+                    # cordon row pins it byte-untouched at convergence).
+                    armed_snapshot = dict(labels)
                     faults.load_fault_spec(spec)
                     armed = True
                 if DEGRADED_LABEL in labels:
@@ -1985,11 +2173,15 @@ def run_chaos(spec, workdir, backend="mock:v4-8", probe_timeout="0.5s",
     assert not t.is_alive(), "daemon did not honor SIGTERM"
     assert result.get("restart") is False
     assert not os.path.exists(out), "clean shutdown must remove the file"
-    return {
+    result = {
         "spec": spec,
         "converged_s": round(elapsed, 3),
         "labels": len(converged),
     }
+    if capture_labels:
+        result["armed_labels"] = armed_snapshot
+        result["converged_labels"] = converged
+    return result
 
 
 def main(argv=None):

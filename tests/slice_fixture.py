@@ -102,6 +102,31 @@ def _slowed(hook, delay_s):
     return slow_hook
 
 
+class _SickOverlay:
+    """Wraps a worker's interconnect labeler with the confirmed
+    chips.sick verdict (``sick_workers``): the overlay rides the normal
+    engine merge, so the snapshot layer pre-extracts it and the
+    actuation budget sees it exactly as it would a burn-in verdict —
+    injection at the measurement boundary, real path everywhere after.
+    ``sick`` is mutable mid-scenario (heal by setting 0)."""
+
+    def __init__(self, inner, sick=1):
+        self._inner = inner
+        self.sick = sick
+
+    def labels(self):
+        from gpu_feature_discovery_tpu.lm.health import (
+            CHIPS_HEALTHY,
+            CHIPS_SICK,
+        )
+
+        labels = self._inner.labels()
+        if self.sick:
+            labels[CHIPS_SICK] = str(self.sick)
+            labels[CHIPS_HEALTHY] = labels.get(CHIPS_HEALTHY, "0")
+        return labels
+
+
 class SliceWorker:
     """One in-process daemon: its run() thread, signal queue, config,
     and (with coordination on) its injected SliceCoordinator."""
@@ -163,6 +188,8 @@ class SliceHarness:
         cohort_size=0,
         tier_partitioned_workers=(),
         peer_token="",
+        extra_cli=None,
+        sick_workers=(),
     ):
         """``slow_workers``/``slow_delay_s`` arm the peer.slow behavior
         on SPECIFIC workers' serving surfaces (the chaos slow-peer-storm
@@ -189,7 +216,17 @@ class SliceHarness:
         (--peer-token) on every worker's serving side AND its
         coordinator's poller — the tokened-slice acceptance
         (tests/test_fleet.py) pins that coordination keeps working
-        while anonymous scrapes are rejected."""
+        while anonymous scrapes are rejected.
+
+        ``extra_cli`` merges additional --flag values into EVERY
+        worker's config (the actuation chaos rows pass
+        {"actuation": "enforce", ...}); ``sick_workers`` overlays the
+        confirmed chips.sick verdict onto the named workers' label
+        sources at the measurement boundary (their interconnect
+        labeler), so the verdict flows the REAL downstream path —
+        engine merge, peer snapshot pre-extraction, actuation budget —
+        without stacking N in-process burn-in probe stacks (jax is
+        process-global; the harness cannot run one per worker)."""
         import os
 
         from gpu_feature_discovery_tpu.config import new_config
@@ -218,31 +255,31 @@ class SliceHarness:
             machine = os.path.join(workdir, "machine-type")
             with open(machine, "w") as f:
                 f.write("Google Compute Engine\n")
-            config = new_config(
-                cli_values={
-                    "oneshot": False,
-                    "output-file": os.path.join(workdir, "tfd"),
-                    "machine-type-file": machine,
-                    "tpu-topology-strategy": "single",
-                    "sleep-interval": sleep_interval,
-                    "init-backoff-max": "0.02s",
-                    "init-retries": "50",
-                    "max-consecutive-failures": "50",
-                    "metrics-addr": "127.0.0.1",
-                    "metrics-port": str(ports[i]),
-                    "state-dir": os.path.join(workdir, "state"),
-                    # Process-wide sandbox/broker teardown at one
-                    # daemon's epoch end must not hit its siblings
-                    # (module docstring).
-                    "probe-isolation": "none",
-                    "probe-broker": "off",
-                    "slice-coordination": coordination,
-                    "peer-timeout": peer_timeout,
-                    "cohort-size": str(cohort_size),
-                    "peer-token": peer_token,
-                },
-                environ={},
-            )
+            cli_values = {
+                "oneshot": False,
+                "output-file": os.path.join(workdir, "tfd"),
+                "machine-type-file": machine,
+                "tpu-topology-strategy": "single",
+                "sleep-interval": sleep_interval,
+                "init-backoff-max": "0.02s",
+                "init-retries": "50",
+                "max-consecutive-failures": "50",
+                "metrics-addr": "127.0.0.1",
+                "metrics-port": str(ports[i]),
+                "state-dir": os.path.join(workdir, "state"),
+                # Process-wide sandbox/broker teardown at one
+                # daemon's epoch end must not hit its siblings
+                # (module docstring).
+                "probe-isolation": "none",
+                "probe-broker": "off",
+                "slice-coordination": coordination,
+                "peer-timeout": peer_timeout,
+                "cohort-size": str(cohort_size),
+                "peer-token": peer_token,
+            }
+            if extra_cli:
+                cli_values.update(extra_cli)
+            config = new_config(cli_values=cli_values, environ={})
             coordinator = None
             if coordination == "on":
                 coordinator = SliceCoordinator(
@@ -267,6 +304,8 @@ class SliceHarness:
                 pci=MockGooglePCI(),
                 provider=StaticProvider(host_info_from_mapping(env)),
             )
+            if i in sick_workers:
+                interconnect = _SickOverlay(interconnect)
             self.workers.append(
                 SliceWorker(i, config, coordinator, interconnect, ports[i])
             )

@@ -1,0 +1,583 @@
+"""Fail-safe verdict actuation (actuation/engine.py) acceptance.
+
+Three tiers: pure policy units (budget arithmetic at exact-fraction
+boundaries, the fire/clear hysteresis, lease half-life renewal and
+fail-static lapse), the supervisor's re-serve paths (a warm --state-dir
+restart must NOT resurrect expired advice), and hermetic daemon
+integration through the SliceHarness — real run() loops, the confirmed
+verdict injected at the measurement boundary (sick_workers), advice
+flowing the real engine-merge -> snapshot -> budget -> label-file path.
+The blast-radius chaos scenarios (sick-chip-cordon, budget-storm) have
+their live rows in tests/chaos-run.py; this file owns the edges the
+rows cannot pin deterministically."""
+
+import json
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(__file__))
+
+from slice_fixture import SliceHarness  # noqa: E402
+
+from gpu_feature_discovery_tpu.actuation.engine import (  # noqa: E402
+    ACTUATION_LEASE_LABEL,
+    ADVICE_LABELS,
+    CORDON_ADVICE_LABEL,
+    DRAIN_ADVICE_LABEL,
+    SCHEDULABLE_LABEL,
+    WOULD_CORDON_LABEL,
+    ActuationEngine,
+    advice_present,
+    budget_allowance,
+    drop_lapsed_advice,
+    new_actuation_engine,
+)
+from gpu_feature_discovery_tpu.config.flags import new_config  # noqa: E402
+from gpu_feature_discovery_tpu.lm.health import (  # noqa: E402
+    CHIPS_HEALTHY,
+    CHIPS_SICK,
+    STRAGGLER_CHIP,
+)
+from gpu_feature_discovery_tpu.lm.labels import Labels  # noqa: E402
+from gpu_feature_discovery_tpu.obs import metrics as obs_metrics  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def _clean_registry():
+    obs_metrics.reset_for_tests()
+    yield
+
+
+def _sick(n=3):
+    return Labels({CHIPS_HEALTHY: str(8 - n), CHIPS_SICK: str(n)})
+
+
+def _healthy():
+    return Labels({CHIPS_HEALTHY: "8", CHIPS_SICK: "0"})
+
+
+# ---------------------------------------------------------------------------
+# budget arithmetic at exact-fraction boundaries (satellite)
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize(
+    "hosts,fraction,allowed",
+    [
+        # 0.25 * 4 == 1.0 exactly: float noise must not round a SECOND
+        # host into the budget (ceil(1.0 + eps) would say 2).
+        (4, 0.25, 1),
+        # 0.25 * 3 == 0.75: rounds UP to 1, not down to 0 — a slice
+        # always gets at least one actuator.
+        (3, 0.25, 1),
+        (6, 0.25, 2),          # the chaos budget-storm bound
+        (64, 0.25, 16),        # exact again at scale
+        (64, 0.5, 32),
+        (4, 0.5, 2),
+        (3, 0.5, 2),           # 1.5 -> 2 (ceil, not floor)
+        (1, 0.25, 1),          # single host may always self-advise
+        (8, 0.125, 1),         # exact 1.0 once more, different shape
+        (100, 0.99, 99),       # fraction < 1 can never cover the slice
+    ],
+)
+def test_budget_allowance_exact_boundaries(hosts, fraction, allowed):
+    assert budget_allowance(hosts, fraction) == allowed
+
+
+def test_budget_allowance_never_zero():
+    for hosts in (1, 2, 3, 7, 64):
+        assert budget_allowance(hosts, 0.0001) == 1
+
+
+# ---------------------------------------------------------------------------
+# the fire/clear hysteresis and mode ladder
+# ---------------------------------------------------------------------------
+
+def _engine(mode="enforce", window=2, fraction=0.25, ttl=120.0, **kw):
+    clock = kw.pop("clock", None) or (lambda: 1000.0)
+    return ActuationEngine(mode, window, fraction, ttl, clock=clock, **kw)
+
+
+def test_advice_fires_only_after_window_holds():
+    e = _engine(window=3)
+    for _ in range(2):
+        out = e.project(_sick(), "full")
+        assert not advice_present(out), "fired before the window held"
+    out = e.project(_sick(), "full")
+    assert out[SCHEDULABLE_LABEL] == "false"
+    assert out[CORDON_ADVICE_LABEL] == "sick-chips"
+    assert DRAIN_ADVICE_LABEL not in out, "drain is straggler-only"
+    assert ACTUATION_LEASE_LABEL in out
+    assert obs_metrics.ACTUATION_CONVERGENCE_CYCLES.value() == 3
+
+
+def test_advice_clears_only_after_clean_window():
+    e = _engine(window=2)
+    e.project(_sick(), "full")
+    e.project(_sick(), "full")
+    out = e.project(_healthy(), "full")
+    assert advice_present(out), "one clean cycle must not uncordon"
+    out = e.project(_healthy(), "full")
+    assert not advice_present(out)
+    assert dict(out) == dict(_healthy()), "clear must leave no residue"
+
+
+def test_one_bad_cycle_between_streaks_does_not_fire():
+    e = _engine(window=2)
+    e.project(_sick(), "full")
+    # The clean cycle resets nothing until IT holds a window, but the
+    # desire streak keeps counting only consecutive sick cycles.
+    out = e.project(_healthy(), "full")
+    assert not advice_present(out)
+
+
+def test_straggler_verdict_adds_drain_advice():
+    e = _engine(window=1)
+    out = e.project(Labels({STRAGGLER_CHIP: "chip.3"}), "full")
+    assert out[CORDON_ADVICE_LABEL] == "straggler"
+    assert out[DRAIN_ADVICE_LABEL] == "true"
+
+
+def test_advise_mode_emits_would_cordon_only():
+    e = _engine(mode="advise", window=1)
+    out = e.project(_sick(), "full")
+    assert out[WOULD_CORDON_LABEL] == "sick-chips"
+    assert SCHEDULABLE_LABEL not in out
+    assert CORDON_ADVICE_LABEL not in out
+    assert DRAIN_ADVICE_LABEL not in out
+    assert ACTUATION_LEASE_LABEL in out, "dry-run advice still leases"
+
+
+def test_project_never_mutates_the_input_set():
+    """The flap damper may hand project() its remembered baseline;
+    mutating it would corrupt the damper's idea of what it published."""
+    e = _engine(window=1)
+    sick = _sick()
+    before = dict(sick)
+    out = e.project(sick, "full")
+    assert advice_present(out)
+    assert dict(sick) == before
+    assert out is not sick
+
+
+def test_project_returns_input_object_when_nothing_changes():
+    e = _engine(window=2)
+    base = _healthy()
+    assert e.project(base, "full") is base
+
+
+# ---------------------------------------------------------------------------
+# lease stamping, renewal, fail-static lapse
+# ---------------------------------------------------------------------------
+
+def test_lease_renews_at_half_life_not_every_cycle():
+    t = [1000.0]
+    e = _engine(window=1, ttl=100.0, clock=lambda: t[0])
+    first = e.project(_sick(), "full")[ACTUATION_LEASE_LABEL]
+    t[0] += 10  # well inside the first half
+    assert e.project(_sick(), "full")[ACTUATION_LEASE_LABEL] == first, (
+        "a steady verdict must not rewrite the label file every cycle"
+    )
+    t[0] += 45  # past half-life
+    renewed = e.project(_sick(), "full")[ACTUATION_LEASE_LABEL]
+    assert int(renewed) > int(first)
+
+
+def test_degraded_cycles_reapply_advice_under_original_lease():
+    t = [1000.0]
+    e = _engine(window=1, ttl=100.0, clock=lambda: t[0])
+    lease = e.project(_sick(), "full")[ACTUATION_LEASE_LABEL]
+    t[0] += 60  # past half-life: a FULL cycle would renew here
+    out = e.project(_healthy(), "degraded")
+    assert out[ACTUATION_LEASE_LABEL] == lease, (
+        "a cycle that measured nothing must never renew the lease"
+    )
+
+
+def test_advice_lapses_on_degraded_cycles_past_lease():
+    t = [1000.0]
+    e = _engine(window=1, ttl=50.0, clock=lambda: t[0])
+    assert advice_present(e.project(_sick(), "full"))
+    t[0] += 60
+    out = e.project(_healthy(), "degraded")
+    assert not advice_present(out), "dead verdicts must age advice out"
+    assert (
+        obs_metrics.ACTUATION_TRANSITIONS.value(action="lease-lapsed") == 1
+    )
+
+
+def test_stale_source_cycles_do_not_advance_streaks():
+    from gpu_feature_discovery_tpu.lm.engine import STALE_SOURCES_LABEL
+
+    e = _engine(window=2)
+    sick = _sick()
+    sick[STALE_SOURCES_LABEL] = "tpu"
+    e.project(sick, "full")
+    e.project(sick, "full")
+    e.project(sick, "full")
+    assert not advice_present(e.project(sick, "full")), (
+        "re-served stale verdicts are not measurements and must not "
+        "confirm toward a cordon"
+    )
+
+
+# ---------------------------------------------------------------------------
+# blast-radius budget over the snapshot plane
+# ---------------------------------------------------------------------------
+
+def test_budget_suppresses_out_of_allowance_worker():
+    signals = lambda: (4, {0: True, 1: True})  # noqa: E731
+    e = _engine(window=1, worker_id=2, signals=signals)
+    out = e.project(_sick(), "full")
+    assert not advice_present(out)
+    assert obs_metrics.ACTUATION_BUDGET_EXHAUSTED.value() == 1
+    assert (
+        obs_metrics.ACTUATION_TRANSITIONS.value(action="budget-suppressed")
+        == 1
+    )
+
+
+def test_budget_permits_lowest_ranked_candidate():
+    signals = lambda: (4, {2: True, 3: True})  # noqa: E731
+    e = _engine(window=1, worker_id=0, signals=signals)
+    assert advice_present(e.project(_sick(), "full"))
+    assert obs_metrics.ACTUATION_BUDGET_EXHAUSTED.value() == 0
+
+
+def test_budget_withdraws_advice_when_reranked_out():
+    """The cap is an invariant, not an admission gate: a lower
+    worker-id's verdict arriving later re-ranks this host out of the
+    allowance and its advice is withdrawn."""
+    desires = {}
+    e = _engine(window=1, worker_id=1, signals=lambda: (4, dict(desires)))
+    assert advice_present(e.project(_sick(), "full"))
+    desires[0] = True  # a lower-ranked host's verdict lands
+    out = e.project(_sick(), "full")
+    assert not advice_present(out)
+    assert obs_metrics.ACTUATION_BUDGET_EXHAUSTED.value() == 1
+
+
+def test_uncoordinated_engine_always_permitted():
+    e = _engine(window=1, signals=None)
+    assert advice_present(e.project(_sick(), "full"))
+
+
+# ---------------------------------------------------------------------------
+# construction: the off mode builds nothing
+# ---------------------------------------------------------------------------
+
+def test_off_constructs_no_engine():
+    assert new_actuation_engine(new_config({})) is None
+    assert (
+        new_actuation_engine(new_config({"actuation": "off"})) is None
+    )
+
+
+def test_invalid_actuation_mode_rejected():
+    from gpu_feature_discovery_tpu.config.spec import ConfigError
+
+    with pytest.raises(ConfigError):
+        new_config({"actuation": "bogus"})
+    with pytest.raises(ConfigError):
+        new_config({"max-actuated-fraction": "1.5"})
+    with pytest.raises(ConfigError):
+        new_config({"actuation-window": "0"})
+
+
+def test_engine_built_from_flags_and_staleness_bound():
+    config = new_config(
+        {
+            "actuation": "enforce",
+            "actuation-window": "4",
+            "max-actuated-fraction": "0.5",
+            "max-staleness": "30s",
+        }
+    )
+    e = new_actuation_engine(config)
+    assert e.mode == "enforce"
+    assert e._window == 4
+    assert e._fraction == 0.5
+    assert e._lease_ttl == 60.0  # LEASE_TTL_FACTOR * max-staleness
+
+
+# ---------------------------------------------------------------------------
+# warm-state restart: expired advice must not resurrect (satellite)
+# ---------------------------------------------------------------------------
+
+def _write_state(state_dir, labels):
+    os.makedirs(state_dir, exist_ok=True)
+    with open(os.path.join(state_dir, "last-good-labels.json"), "w") as f:
+        json.dump({"version": 1, "labels": labels}, f)
+
+
+def _advised_state(lease):
+    return {
+        "google.com/tpu.health.ok": "false",
+        CHIPS_SICK: "3",
+        "google.com/tpu-2x2x1.count": "1",
+        SCHEDULABLE_LABEL: "false",
+        CORDON_ADVICE_LABEL: "sick-chips",
+        ACTUATION_LEASE_LABEL: str(lease),
+    }
+
+
+def test_restore_drops_expired_advice_keeps_inventory(tmp_path):
+    from gpu_feature_discovery_tpu.cmd.supervisor import Supervisor
+
+    state_dir = str(tmp_path / "state")
+    _write_state(state_dir, _advised_state(lease=int(time.time()) - 10))
+    supervisor = Supervisor(new_config({"state-dir": state_dir}))
+    restored = supervisor.restore_last_good()
+    assert restored is not None
+    assert not advice_present(restored), (
+        "a SIGKILLed daemon's cordon advice outlived its lease in the "
+        "state file and resurrected — the frozen-cordon failure"
+    )
+    assert restored[CHIPS_SICK] == "3", "only advice is dropped"
+
+
+def test_restore_keeps_still_leased_advice(tmp_path):
+    from gpu_feature_discovery_tpu.cmd.supervisor import Supervisor
+
+    state_dir = str(tmp_path / "state")
+    lease = int(time.time()) + 3600
+    _write_state(state_dir, _advised_state(lease=lease))
+    supervisor = Supervisor(new_config({"state-dir": state_dir}))
+    restored = supervisor.restore_last_good()
+    assert restored[SCHEDULABLE_LABEL] == "false"
+    assert restored[ACTUATION_LEASE_LABEL] == str(lease), (
+        "restore must re-serve under the ORIGINAL stamp, never renew"
+    )
+
+
+def test_reserve_labels_age_advice_out_of_failed_cycle_reserves():
+    from gpu_feature_discovery_tpu.cmd.supervisor import Supervisor
+
+    supervisor = Supervisor(new_config({}))
+    served = Labels(_advised_state(lease=int(time.time()) - 5))
+    supervisor.cycle_succeeded(served, mode="full")
+    reserve = supervisor.reserve_labels()
+    assert not advice_present(reserve), (
+        "failed-cycle re-serves bypass the projection; the lease check "
+        "must land in the reserve path"
+    )
+
+
+def test_drop_lapsed_advice_passthrough_is_byte_free():
+    """No advice keys -> the SAME object back: the --actuation=off
+    restore path adds zero work and zero difference."""
+    labels = Labels({"google.com/tpu.health.ok": "true"})
+    assert drop_lapsed_advice(labels) is labels
+
+
+def test_drop_lapsed_advice_unparseable_lease_reads_as_lapsed():
+    labels = Labels(
+        {SCHEDULABLE_LABEL: "false", ACTUATION_LEASE_LABEL: "not-a-stamp"}
+    )
+    assert not advice_present(drop_lapsed_advice(labels))
+
+
+# ---------------------------------------------------------------------------
+# hermetic daemon integration (SliceHarness — real run() loops)
+# ---------------------------------------------------------------------------
+
+def _advice_absent_forever(worker, cycles=0.5):
+    """Watch the worker's label file for ``cycles`` seconds; fail if any
+    advice label ever appears."""
+    deadline = time.monotonic() + cycles
+    while time.monotonic() < deadline:
+        labels = worker.labels()
+        hit = [k for k in ADVICE_LABELS if k in labels]
+        assert not hit, f"advice appeared at --actuation=off: {hit}"
+        time.sleep(0.02)
+
+
+def test_daemon_off_emits_no_advice_despite_confirmed_verdict(tmp_path):
+    """The byte-identity pin's hermetic half: a confirmed sick verdict
+    under the DEFAULT --actuation=off changes nothing — no advice keys,
+    no lease churn (the golden-file suites pin the full byte identity
+    of the off output; this pins that a verdict cannot leak advice)."""
+    with SliceHarness(
+        tmp_path, workers=1, coordination="off", sick_workers=(0,)
+    ) as harness:
+        worker = harness.workers[0]
+        harness.wait_for(
+            lambda snap: snap[0].get(CHIPS_SICK) == "1",
+            what="the injected sick verdict to publish",
+        )
+        _advice_absent_forever(worker)
+
+
+def test_daemon_enforce_fires_within_window_and_clears(tmp_path):
+    with SliceHarness(
+        tmp_path,
+        workers=1,
+        coordination="off",
+        sick_workers=(0,),
+        extra_cli={"actuation": "enforce", "actuation-window": "2"},
+    ) as harness:
+        worker = harness.workers[0]
+        harness.wait_for(
+            lambda snap: snap[0].get(SCHEDULABLE_LABEL) == "false"
+            and snap[0].get(CORDON_ADVICE_LABEL) == "sick-chips"
+            and ACTUATION_LEASE_LABEL in snap[0],
+            what="cordon advice to fire on the confirmed verdict",
+        )
+        assert obs_metrics.ACTUATION_CONVERGENCE_CYCLES.value() <= 2, (
+            "advice must fire within --actuation-window cycles"
+        )
+        # Heal at the measurement boundary: the verdict clears, and the
+        # advice follows after the clean window.
+        worker.interconnect.sick = 0
+        harness.wait_for(
+            lambda snap: not any(k in snap[0] for k in ADVICE_LABELS)
+            and snap[0].get(CHIPS_SICK, "0") in ("0", ""),
+            what="advice to clear after the verdict converged clean",
+        )
+
+
+def test_daemon_mode_transitions_across_sighup_epochs(tmp_path):
+    """advise -> enforce -> off across SIGHUP reload epochs: each epoch
+    rebuilds the engine from the (changed) config — would-cordon under
+    advise, the real family under enforce, nothing at off; no advice
+    state leaks across the reload boundary."""
+    harness = SliceHarness(
+        tmp_path,
+        workers=1,
+        coordination="off",
+        sick_workers=(0,),
+        extra_cli={"actuation": "advise", "actuation-window": "1"},
+    )
+    worker = harness.workers[0]
+    try:
+        harness.start()
+        harness.wait_for(
+            lambda snap: snap[0].get(WOULD_CORDON_LABEL) == "sick-chips"
+            and SCHEDULABLE_LABEL not in snap[0],
+            what="dry-run advice under advise",
+        )
+        # SIGHUP: run() returns restart (what start() maps to a config
+        # re-read); the harness restarts the worker with the new mode.
+        worker.sigs.put(signal.SIGHUP)
+        worker.thread.join(timeout=10)
+        assert worker.result.get("restart") is True
+        worker.config.flags.tfd.actuation = "enforce"
+        harness.start_worker(0)
+        harness.wait_for(
+            lambda snap: snap[0].get(SCHEDULABLE_LABEL) == "false"
+            and WOULD_CORDON_LABEL not in snap[0],
+            what="real advice after the enforce reload",
+        )
+        worker.sigs.put(signal.SIGHUP)
+        worker.thread.join(timeout=10)
+        assert worker.result.get("restart") is True
+        worker.config.flags.tfd.actuation = "off"
+        harness.start_worker(0)
+        harness.wait_for(
+            lambda snap: snap[0].get(CHIPS_SICK) == "1"
+            and not any(k in snap[0] for k in ADVICE_LABELS),
+            what="the emergency off rollback to clear all advice",
+        )
+        _advice_absent_forever(worker)
+    finally:
+        harness.stop()
+
+
+def test_daemon_warm_restart_does_not_resurrect_expired_advice(tmp_path):
+    """The SIGKILL/warm-state acceptance: advice fires and persists with
+    its lease; the daemon 'dies' long enough for the lease to lapse (the
+    state file is aged in place — the same bytes a SIGKILL leaves); the
+    restarted epoch restores the inventory but NEVER the advice."""
+    harness = SliceHarness(
+        tmp_path,
+        workers=1,
+        coordination="off",
+        sick_workers=(0,),
+        extra_cli={"actuation": "enforce", "actuation-window": "1"},
+    )
+    state_path = os.path.join(
+        str(tmp_path), "worker-0", "state", "last-good-labels.json"
+    )
+    try:
+        harness.start()
+        harness.wait_for(
+            lambda snap: snap[0].get(SCHEDULABLE_LABEL) == "false",
+            what="advice to fire before the kill",
+        )
+        # Let a full advised cycle persist (save rides cycle_succeeded).
+        harness.wait_for(
+            lambda snap: os.path.exists(state_path)
+            and SCHEDULABLE_LABEL
+            in (json.load(open(state_path)).get("labels") or {}),
+            what="the advised label set to persist to --state-dir",
+        )
+    finally:
+        harness.stop()
+    # Age the persisted lease past expiry in place: the restart-after-
+    # death timeline without the wall-clock wait.
+    doc = json.load(open(state_path))
+    assert ACTUATION_LEASE_LABEL in doc["labels"]
+    doc["labels"][ACTUATION_LEASE_LABEL] = str(int(time.time()) - 30)
+    with open(state_path, "w") as f:
+        json.dump(doc, f)
+    # Restart warm, verdict healed (the sick chip was serviced while the
+    # daemon was dead): the restore must serve the inventory WITHOUT the
+    # expired advice, and no live cycle re-fires it.
+    harness2 = SliceHarness(
+        tmp_path,
+        workers=1,
+        coordination="off",
+        extra_cli={"actuation": "enforce", "actuation-window": "1"},
+    )
+    try:
+        harness2.start()
+        harness2.wait_for(
+            lambda snap: snap[0].get("google.com/tpu.health.ok") == "true"
+            or snap[0].get(CHIPS_SICK, "0") == "0"
+            or "google.com/tpu.tfd.restored" in snap[0],
+            what="the restarted epoch to serve labels",
+        )
+        _advice_absent_forever(harness2.workers[0])
+    finally:
+        harness2.stop()
+
+
+def test_slice_budget_caps_advised_hosts_end_to_end(tmp_path):
+    """Three coordinated workers, ALL carrying the confirmed verdict:
+    allowance(3, 0.25) == 1, so exactly worker 0 (lowest id) converges
+    to advice and the suppressed rest raise the budget gauge — the
+    hermetic twin of the chaos budget-storm row, small enough for
+    tier-1."""
+    with SliceHarness(
+        tmp_path,
+        workers=3,
+        coordination="on",
+        sick_workers=(0, 1, 2),
+        extra_cli={"actuation": "enforce", "actuation-window": "3"},
+    ) as harness:
+
+        def converged(snap):
+            advised = [
+                wid
+                for wid, labels in snap.items()
+                if SCHEDULABLE_LABEL in labels
+            ]
+            return advised == [0]
+
+        harness.wait_for(
+            converged,
+            timeout=30,
+            what="exactly the budget-allowed worker to carry advice",
+        )
+        # Stability: the budget is an invariant — observe a few more
+        # cycles and the advised set must not grow.
+        time.sleep(0.5)
+        snap = {w.worker_id: w.labels() for w in harness.workers}
+        advised = [w for w, ls in snap.items() if SCHEDULABLE_LABEL in ls]
+        assert advised == [0], f"budget cap violated: {advised}"
+        assert obs_metrics.ACTUATION_BUDGET_EXHAUSTED.value() == 1, (
+            "suppressed workers must raise tfd_actuation_budget_exhausted"
+        )

@@ -123,6 +123,17 @@ CHAOS_SPECS = [
     # watch marks the client dead at death time, so the wake's cycle
     # respawns and serves instead of failing on a dead pipe first).
     "reconcile:broker-death",
+    # Verdict actuation (ISSUE 19, actuation/). sick-chip-cordon: a
+    # REAL sick chip (two sharded-probe shots, so the verdict holds the
+    # 2-cycle actuation window) under --actuation=enforce must fire
+    # schedulable=false + cordon-advice=sick-chips within the window,
+    # clear every advice label once the fault drains, and leave the
+    # non-advice labels byte-identical to the healthy pre-fault set.
+    # budget-storm: all 6 workers of a hermetic slice read sick at once
+    # — at most ceil(0.25*6)=2 hosts settle with advice, the suppressed
+    # rest raise tfd_actuation_budget_exhausted, and no daemon exits.
+    "actuation:sick-chip-cordon",
+    "actuation:budget-storm",
 ]
 
 # Per-spec label expectations + convergence budgets beyond the generic
@@ -195,6 +206,11 @@ CHAOS_EXPECTATIONS = {
     # host; the kill-to-recovery bound itself is 2x probe-timeout and
     # asserted INSIDE the driver, not via this budget.
     "reconcile:broker-death": {"timeout_s": 30.0},
+    # The cordon row rides the chip machinery (real XLA compiles — the
+    # chip rows' 90s rationale); the storm row is 6 hermetic daemon
+    # loops with TWO waits (convergence + the invariant ride-out).
+    "actuation:sick-chip-cordon": {"timeout_s": 90.0},
+    "actuation:budget-storm": {"timeout_s": 90.0},
 }
 
 

@@ -343,3 +343,85 @@ def test_fleet_metric_families_are_registered_and_documented():
         "fleet:delta-resync",
     ):
         assert bit in ops, f"delta runbook missing {bit!r}"
+
+
+def test_actuation_families_are_registered_and_documented():
+    """ISSUE 19 drift guard, both directions and explicit: the verdict
+    actuation metric families must exist in the live registry with the
+    right kind AND carry a typed docs/observability.md table row, every
+    advice label must have its docs/labels.md family row, and the
+    rollout runbook the flags point at must exist."""
+    from gpu_feature_discovery_tpu.actuation.engine import ADVICE_LABELS
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+    expected = {
+        "tfd_actuation_advice": "gauge",
+        "tfd_actuation_budget_exhausted": "gauge",
+        "tfd_actuation_transitions_total": "counter",
+        "tfd_actuation_convergence_cycles": "gauge",
+        # The reload-robustness satellite rides the same PR: the
+        # torn-targets fallback counter must exist and be documented.
+        "tfd_fleet_targets_reload_failures_total": "counter",
+    }
+    families = obs_metrics.REGISTRY.families()
+    doc = read("observability.md")
+    for name, kind in expected.items():
+        assert name in families, f"actuation metric {name} missing"
+        assert families[name].kind == kind, name
+        row = next(
+            (
+                line
+                for line in doc.splitlines()
+                if line.startswith(f"| `{name}`")
+            ),
+            "",
+        )
+        assert kind in row, f"{name}: no doc table row stating {kind!r}"
+    assert families["tfd_actuation_transitions_total"].labelnames == (
+        "action",
+    )
+    # Every transition action the engine can emit must be named in the
+    # counter's doc row — an action added to the engine without a doc
+    # mention fails here.
+    transitions_row = next(
+        line
+        for line in doc.splitlines()
+        if line.startswith("| `tfd_actuation_transitions_total`")
+    )
+    for action in ("fired", "cleared", "budget-suppressed", "lease-lapsed"):
+        assert action in transitions_row, (
+            f"transition action {action!r} undocumented"
+        )
+
+    # The advice family: every label the engine owns gets a labels.md
+    # table row (none of them is golden-pinned — --actuation=off emits
+    # nothing — so the generic goldens-driven guard never sees them).
+    labels_doc = read("labels.md")
+    assert "Actuation advice labels" in labels_doc
+    for label in ADVICE_LABELS:
+        row = next(
+            (
+                line
+                for line in labels_doc.splitlines()
+                if line.startswith(f"| `{label}`")
+            ),
+            "",
+        )
+        assert row, f"advice label {label} has no labels.md table row"
+
+    # The rollout runbook: staged modes, the rails, and the rollback
+    # must all be written down.
+    ops = read("operations.md")
+    assert "Acting on verdicts safely" in ops
+    for bit in (
+        "--actuation=advise",
+        "--actuation=enforce",
+        "--actuation-window",
+        "--max-actuated-fraction",
+        "tfd_actuation_budget_exhausted",
+        "lease",
+        "actuation:sick-chip-cordon",
+        "actuation:budget-storm",
+        "--actuation=off",
+    ):
+        assert bit in ops, f"actuation runbook missing {bit!r}"

@@ -59,6 +59,9 @@ FILE_KEYS = {
     "max-probe-rate": ("tfd", "maxProbeRate"),
     "probe-token": ("tfd", "probeToken"),
     "peer-token": ("tfd", "peerToken"),
+    "actuation": ("tfd", "actuation"),
+    "actuation-window": ("tfd", "actuationWindow"),
+    "max-actuated-fraction": ("tfd", "maxActuatedFraction"),
 }
 
 # Two distinct valid raw values per flag (a wins the dominance checks).
@@ -89,6 +92,11 @@ VALUE_PAIRS = {
     "max-staleness": ("30s", "45s"),
     "reconcile-debounce": ("0.2s", "0.4s"),
     "max-probe-rate": ("2", "4"),
+    # Verdict actuation (actuation/engine.py): mode tokens must parse,
+    # the window is a positive int, the fraction lives in (0, 1).
+    "actuation": ("advise", "enforce"),
+    "actuation-window": ("3", "5"),
+    "max-actuated-fraction": ("0.5", "0.75"),
 }
 
 

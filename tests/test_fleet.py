@@ -634,6 +634,93 @@ def test_fleet_run_epoch_serves_reloads_and_shuts_down(tmp_path):
         coord.close()
 
 
+def test_fleet_main_keeps_last_good_targets_on_torn_reload(
+    tmp_path, monkeypatch
+):
+    """Reload robustness (ISSUE 19 satellite): a targets file caught
+    mid-rewrite — here a config tool's truncated temp copy, unparseable
+    YAML — must NOT error the epoch. The collector keeps scraping the
+    last-good target set, warns, and counts the failure on
+    tfd_fleet_targets_reload_failures_total; the next complete rewrite
+    reloads normally."""
+    import queue
+    import signal
+    import threading
+
+    import gpu_feature_discovery_tpu.cmd.main as cmd_main
+    from gpu_feature_discovery_tpu.cmd import fleet as cmd_fleet
+
+    coord, server = _serve_coordinator()
+    targets_path = write_targets(
+        tmp_path, [{"name": "s0", "hosts": [f"127.0.0.1:{server.port}"]}]
+    )
+    sigs = queue.Queue()
+    monkeypatch.setattr(cmd_main, "new_os_watcher", lambda: sigs)
+    failures_before = obs_metrics.FLEET_TARGETS_RELOAD_FAILURES.value()
+    result = {}
+
+    def run():
+        result["rc"] = cmd_fleet.main(
+            [
+                "--targets-file", targets_path,
+                "--scrape-interval", "0.1s",
+                "--metrics-addr", "127.0.0.1",
+                "--metrics-port", "0",
+            ]
+        )
+
+    t = threading.Thread(target=run, daemon=True)
+    t.start()
+    try:
+        time.sleep(0.5)  # epoch 1 up and scraping
+        # The torn write: a truncated temp copy lands where the targets
+        # file lives. The mtime watcher restarts the epoch; the reload
+        # parse fails.
+        with open(targets_path, "w") as f:
+            f.write("slices:\n  - name: s0\n    hosts: [")
+        deadline = time.monotonic() + 10
+        while (
+            obs_metrics.FLEET_TARGETS_RELOAD_FAILURES.value()
+            == failures_before
+            and time.monotonic() < deadline
+        ):
+            time.sleep(0.02)
+        assert (
+            obs_metrics.FLEET_TARGETS_RELOAD_FAILURES.value()
+            == failures_before + 1
+        ), "torn reload never counted on the failure counter"
+        assert t.is_alive(), (
+            "collector exited on a torn targets reload instead of "
+            "keeping the last-good set"
+        )
+        sigs.put(signal.SIGTERM)
+        t.join(timeout=10)
+        assert result.get("rc") == 0, result
+    finally:
+        server.close()
+        coord.close()
+
+
+def test_fleet_main_first_load_failure_is_still_fatal(tmp_path):
+    """The last-good fallback has nothing to fall back on at FIRST
+    load: a collector started against an unparseable targets file must
+    exit 1 (a misconfigured deployment fails loudly, it does not serve
+    an empty inventory forever)."""
+    from gpu_feature_discovery_tpu.cmd import fleet as cmd_fleet
+
+    targets_path = os.path.join(str(tmp_path), "targets.yaml")
+    with open(targets_path, "w") as f:
+        f.write("slices:\n  - name: s0\n    hosts: [")
+    rc = cmd_fleet.main(
+        [
+            "--targets-file", targets_path,
+            "--metrics-addr", "127.0.0.1",
+            "--metrics-port", "0",
+        ]
+    )
+    assert rc == 1
+
+
 def test_console_entry_dispatches_fleet_collector():
     """The installed console script and ``python -m`` share ONE entry
     (cmd.main.main): `tpu-feature-discovery fleet-collector --help` must

@@ -213,3 +213,72 @@ def test_sighup_closes_broker_gracefully_no_respawn_storm(tmp_path, monkeypatch)
     assert not [s for s in out.split() if s.startswith("Z")], (
         "broker workers left zombies across reload epochs"
     )
+
+
+# ---------------------------------------------------------------------------
+# epoch close vs the notify sender thread (ISSUE 19 satellite)
+# ---------------------------------------------------------------------------
+
+def test_notify_sender_close_joins_idle_thread(caplog):
+    """The common epoch-close case: the sender thread has drained its
+    queue and close() must JOIN it — a reload storm that abandoned one
+    thread per epoch would be a slow leak — silently (no abandon warn
+    for a thread that exited inside the bound)."""
+    import logging as stdlib_logging
+
+    from gpu_feature_discovery_tpu.peering.notify import (
+        NotifySender,
+        NotifySubscriptions,
+    )
+
+    sender = NotifySender(NotifySubscriptions(ttl_s=10.0), timeout=0.2)
+    sender.publish(1, "etag-1")  # no subscribers: delivered to nobody
+    assert sender.flush(timeout=5.0), "sender never went idle"
+    with caplog.at_level(
+        stdlib_logging.WARNING, logger="gpu_feature_discovery_tpu.peering.notify"
+    ):
+        sender.close()
+    assert sender._thread is not None and not sender._thread.is_alive(), (
+        "close() must join the drained sender thread at epoch end"
+    )
+    assert "abandoning" not in caplog.text, (
+        "a cleanly joined thread must not raise the abandon warn"
+    )
+
+
+def test_notify_sender_close_abandons_wedged_thread_with_warn(caplog):
+    """The rare epoch-close case: a delivery wedged past the close
+    bound (a parent accepting the connection but never answering) must
+    not stall the SIGHUP reload — close() gives up after its bounded
+    join and WARNS, so the leak-that-didn't-happen is visible instead
+    of silent. The daemon thread then dies with its socket timeout."""
+    import logging as stdlib_logging
+    import threading
+
+    from gpu_feature_discovery_tpu.peering.notify import (
+        NotifySender,
+        NotifySubscriptions,
+    )
+
+    sender = NotifySender(NotifySubscriptions(ttl_s=10.0), timeout=0.05)
+    wedge = threading.Event()
+    sender._deliver = lambda pending, seq: wedge.wait(30.0)
+    sender.publish(1, "etag-1")
+    assert _wait_until(lambda: sender._busy), "delivery never started"
+    started = time.monotonic()
+    with caplog.at_level(
+        stdlib_logging.WARNING, logger="gpu_feature_discovery_tpu.peering.notify"
+    ):
+        sender.close()
+    elapsed = time.monotonic() - started
+    try:
+        assert sender._thread.is_alive(), (
+            "the wedged thread cannot have exited while blocked"
+        )
+        assert elapsed < 5.0, "close() must stay bounded on a wedged sender"
+        assert "abandoning" in caplog.text, (
+            "an abandoned sender thread must be warned about, not silent"
+        )
+    finally:
+        wedge.set()  # release the thread so it exits with the test
+        sender._thread.join(timeout=5.0)
