@@ -1717,6 +1717,114 @@ def main() -> int:
         file=sys.stderr,
     )
 
+    # Fleet-scale query surface (ISSUE 20): a served root over the same
+    # fleet shape with 200 keep-alive consumers pinned to ~20 distinct
+    # filtered /fleet/snapshot views, polling conditionally — the load
+    # the per-filter ETag economy exists for. CI asserts >= 90% of
+    # steady-state filtered polls are 304 header exchanges
+    # (filtered_idle_not_modified_ratio), >= 90% of view lookups are
+    # pure cache hits with zero re-serialization
+    # (filter_cache_hit_ratio), and a parked ?watch= long-poll answers
+    # its filtered delta within 1s of generation movement
+    # (watch_wake_to_delta_ms p50).
+    import json as _qjson
+
+    from fleet_scale import ConsumerPool, consumer_filters, fleet_get
+
+    query_mock = MockFleet(scale_slices, keepalive=scale_slices <= 2000)
+    query_tiers = None
+    query_pool = None
+    try:
+        query_regions = max(2, min(16, scale_slices // 250))
+        query_tiers = FleetTiers(
+            query_mock,
+            n_regions=query_regions,
+            wall_clock=lambda: 1_700_000_000.0,
+            serve_root=True,
+        )
+        query_tiers.round()  # warm: full bodies + connections
+        query_port = query_tiers.root_query_server.port
+        query_pool = ConsumerPool(
+            query_port, 200, consumer_filters(query_regions)
+        )
+        query_pool.poll_all()  # warm: every consumer takes a full body
+        query_pool.reset()
+        hit_before = obs_metrics.FLEET_FILTER_CACHE.value(outcome="hit")
+        miss_before = obs_metrics.FLEET_FILTER_CACHE.value(outcome="miss")
+        for _ in range(3):
+            query_tiers.round()  # idle: no generation movement
+            query_pool.poll_all()
+        idle_stats = dict(query_pool.stats)
+        hits = obs_metrics.FLEET_FILTER_CACHE.value(outcome="hit") - hit_before
+        misses = (
+            obs_metrics.FLEET_FILTER_CACHE.value(outcome="miss") - miss_before
+        )
+        filtered_idle_not_modified_ratio = round(
+            idle_stats["not_modified"] / idle_stats["requests"]
+            if idle_stats["requests"]
+            else 0.0,
+            3,
+        )
+        filter_cache_hit_ratio = round(
+            hits / (hits + misses) if (hits + misses) else 0.0, 3
+        )
+        # Watch wake latency: park a watcher on a filtered view at the
+        # root, churn the mock tier, run one bottom-up round, and time
+        # from the round kicking off to the filtered delta landing at
+        # the client — an upper bound that still charges the full
+        # commit hop to the watcher.
+        watch_rng = _scale_random.Random(20)
+        watch_samples_ms = []
+        for _ in range(5):
+            status, body, etag = fleet_get(query_port, "degraded=true")
+            assert status == 200, f"watch bench seed GET: {status}"
+            since = _qjson.loads(body.decode())["generation"]
+            watch_result = {}
+
+            def _watch(since=since, etag=etag, result=watch_result):
+                result["resp"] = fleet_get(
+                    query_port,
+                    f"degraded=true&since={since}&watch=10",
+                    etag=etag,
+                )
+                result["t"] = time.perf_counter()
+
+            watch_thread = threading.Thread(target=_watch)
+            watch_thread.start()
+            park_deadline = time.monotonic() + 10
+            while (
+                obs_metrics.FLEET_WATCHERS.value() < 1
+                and time.monotonic() < park_deadline
+            ):
+                time.sleep(0.002)
+            query_mock.churn(0.01, rng=watch_rng)
+            t0 = time.perf_counter()
+            query_tiers.round()
+            watch_thread.join(timeout=30)
+            status, body, _ = watch_result["resp"]
+            assert status == 200, f"watch bench wake: {status}"
+            assert _qjson.loads(body.decode()).get("filter"), (
+                "watch bench answer is not a filtered doc"
+            )
+            watch_samples_ms.append((watch_result["t"] - t0) * 1e3)
+        watch_wake_to_delta_ms = round(
+            statistics.median(watch_samples_ms), 3
+        )
+    finally:
+        if query_pool is not None:
+            query_pool.close()
+        if query_tiers is not None:
+            query_tiers.close()
+        query_mock.close()
+    print(
+        f"bench: filtered query surface over {scale_slices} mock slices "
+        f"(200 consumers, ~20 filters) idle 304 ratio "
+        f"{filtered_idle_not_modified_ratio}, cache hit ratio "
+        f"{filter_cache_hit_ratio}, watch wake-to-delta "
+        f"p50={watch_wake_to_delta_ms}ms",
+        file=sys.stderr,
+    )
+
     # Event-driven reconcile latency (ISSUE 9): POST /probe on the obs
     # server -> label file mtime change, with the sleep interval at 60s
     # so only the event path (cmd/events.py PROBE_REQUEST wake) can
@@ -1991,6 +2099,18 @@ def main() -> int:
                 "idle_poll_requests_per_round_push": (
                     idle_poll_requests_per_round_push
                 ),
+                # Fleet-scale query surface (ISSUE 20): 200 keep-alive
+                # consumers over ~20 filtered views of the same fleet —
+                # CI asserts steady-state filtered polls are >= 90% 304
+                # header exchanges, view lookups are >= 90% pure cache
+                # hits (zero re-serialization while generations hold),
+                # and a parked ?watch= long-poll answers its filtered
+                # delta in under 1s of generation movement.
+                "filtered_idle_not_modified_ratio": (
+                    filtered_idle_not_modified_ratio
+                ),
+                "filter_cache_hit_ratio": filter_cache_hit_ratio,
+                "watch_wake_to_delta_ms": watch_wake_to_delta_ms,
                 "sleep_interval_ms": round(DEFAULT_SLEEP_INTERVAL * 1e3, 3),
                 # Event-driven reconcile acceptance (ISSUE 9): POST
                 # /probe -> label file mtime change against a 60s sleep

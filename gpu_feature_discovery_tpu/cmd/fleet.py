@@ -44,7 +44,11 @@ from gpu_feature_discovery_tpu.config.flags import (
     parse_duration,
 )
 from gpu_feature_discovery_tpu.config.spec import (
+    DEFAULT_FILTER_CACHE_SIZE,
     DEFAULT_FLEET_DELTA_WINDOW,
+    DEFAULT_MAX_INFLIGHT,
+    DEFAULT_MAX_WATCHERS,
+    DEFAULT_WATCH_TIMEOUT_S,
     PUSH_NOTIFY_AUTO,
     PUSH_NOTIFY_MODES,
     UPSTREAM_COLLECTORS,
@@ -52,6 +56,7 @@ from gpu_feature_discovery_tpu.config.spec import (
     ConfigError,
     parse_delta_window,
     parse_nonneg_int,
+    parse_positive_int,
     parse_upstream_mode,
 )
 from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
@@ -226,6 +231,52 @@ FLEET_FLAG_DEFS: List[FleetFlag] = [
         "body)",
     ),
     FleetFlag(
+        name="filter-cache-size",
+        env_vars=("TFD_FLEET_FILTER_CACHE_SIZE",),
+        parse=parse_positive_int,
+        default=DEFAULT_FILTER_CACHE_SIZE,
+        help="how many distinct filtered /fleet/snapshot views the "
+        "collector keeps rendered (LRU; evictions counted in "
+        "tfd_fleet_filter_cache_total{outcome=\"evict\"}); each "
+        "distinct canonical filter gets its own serialize-once/"
+        "strong-ETag/304 economy, so size this at the number of "
+        "distinct dashboard/scheduler filters — the unfiltered pane "
+        "is cached separately and never evicted",
+    ),
+    FleetFlag(
+        name="watch-timeout",
+        env_vars=("TFD_FLEET_WATCH_TIMEOUT",),
+        parse=parse_duration,
+        default=DEFAULT_WATCH_TIMEOUT_S,
+        help="upper bound on how long one /fleet/snapshot?watch= "
+        "long-poll may park before answering 304 (Go duration); a "
+        "client asking for longer is clamped — bounded parks keep "
+        "restarts and LB idle-timeouts predictable",
+    ),
+    FleetFlag(
+        name="max-watchers",
+        env_vars=("TFD_FLEET_MAX_WATCHERS",),
+        parse=parse_nonneg_int,
+        default=DEFAULT_MAX_WATCHERS,
+        help="how many /fleet/snapshot?watch= long-polls may park "
+        "concurrently; past the cap a watch is answered 503 + "
+        "Retry-After (counted in tfd_fleet_watch_total"
+        "{outcome=\"rejected\"}) and the client falls back to "
+        "polling. 0 rejects every watch",
+    ),
+    FleetFlag(
+        name="max-inflight-requests",
+        env_vars=("TFD_MAX_INFLIGHT_REQUESTS",),
+        parse=parse_nonneg_int,
+        default=DEFAULT_MAX_INFLIGHT,
+        help="how many HTTP requests the collector's server works "
+        "concurrently; past the cap a request is answered 503 + "
+        "Retry-After immediately (tfd_http_rejected_total) instead "
+        "of piling a thread on — parked watchers release their slot "
+        "and are bounded by --max-watchers alone. 0 (default) is "
+        "unlimited, the historical behavior",
+    ),
+    FleetFlag(
         name="ha-peers",
         env_vars=("TFD_FLEET_HA_PEERS",),
         parse=str,
@@ -330,6 +381,9 @@ def run_epoch(values: dict, targets, sigs) -> str:
         # (every round — push adds promptness, not yet economy); a set
         # cadence makes the rounds between sweeps O(dirty).
         sweep_interval=values["max-staleness"] or interval,
+        filter_cache_size=values["filter-cache-size"],
+        watch_timeout=values["watch-timeout"],
+        max_watchers=values["max-watchers"],
     )
     ha = None
     if values["ha-peers"]:
@@ -376,10 +430,11 @@ def run_epoch(values: dict, targets, sigs) -> str:
             # /debug/labels serves the per-slice summary below.
             debug_endpoints=True,
             fleet_snapshot=collector.inventory_response,
-            fleet_delta=collector.delta_response,
+            fleet_query=collector.query_response,
             peer_token=values["peer-token"],
             peer_notify=peer_notify,
             notify_subscribe=notify_subscribe,
+            max_inflight=values["max-inflight-requests"],
         )
     except OSError as e:
         log.error(

@@ -490,6 +490,25 @@ def parse_delta_window(value: Any) -> int:
     return parse_nonneg_int(value)
 
 
+# The fleet query surface (fleet/query.py) defaults. The filter cache
+# holds one rendered view (body + ETag + one-step delta state) per
+# distinct canonical filter a consumer has asked for: 64 covers a
+# dashboard fleet's realistic filter vocabulary (per-region x a few
+# verdict slices) while bounding a hostile client's mintable state.
+DEFAULT_FILTER_CACHE_SIZE = 64
+# Ceiling on one long-poll watch park (?watch= is clamped to it): long
+# enough that an idle watcher costs ~2 requests a minute, short enough
+# that a dead client's slot frees itself promptly.
+DEFAULT_WATCH_TIMEOUT_S = 30.0
+# Watch admission cap: parked watchers hold a handler thread each, so
+# the cap bounds thread population; past it the server answers 503 +
+# Retry-After and the client degrades to plain ?since polling.
+DEFAULT_MAX_WATCHERS = 64
+# Inflight-request admission cap for the introspection server; 0 keeps
+# the historical unbounded ThreadingHTTPServer behavior.
+DEFAULT_MAX_INFLIGHT = 0
+
+
 def parse_upstream_mode(value: Any) -> str:
     """Strict ``--upstream-mode`` grammar: ``slices`` | ``collectors``.
     A typo must fail the collector's startup loudly — scraping the wrong

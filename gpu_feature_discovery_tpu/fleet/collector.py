@@ -84,7 +84,10 @@ from functools import partial
 from typing import Any, Callable, Dict, List, Optional
 
 from gpu_feature_discovery_tpu.config.spec import (
+    DEFAULT_FILTER_CACHE_SIZE,
     DEFAULT_FLEET_DELTA_WINDOW,
+    DEFAULT_MAX_WATCHERS,
+    DEFAULT_WATCH_TIMEOUT_S,
     UPSTREAM_COLLECTORS,
     UPSTREAM_SLICES,
 )
@@ -97,6 +100,15 @@ from gpu_feature_discovery_tpu.fleet.inventory import (
     build_inventory,
     parse_inventory_or_delta,
     serialize_inventory,
+)
+from gpu_feature_discovery_tpu.fleet.query import (
+    VIEW_HISTORY_DEPTH,
+    FilteredView,
+    FilteredViewCache,
+    FleetQuery,
+    QueryError,
+    filter_entries,
+    parse_fleet_query,
 )
 from gpu_feature_discovery_tpu.fleet.targets import SliceTarget
 from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
@@ -155,6 +167,12 @@ _BUDGET_GRACE_S = 0.05
 # FREEZES at the last success; the age only needs to answer "minutes or
 # days", which 5-minute granularity does.
 LAST_SEEN_QUANTUM_S = 300
+
+# What a 503 at the --max-watchers admission cap tells the client to
+# wait before retrying: one second — slots churn on the watch-timeout
+# cadence, and a rejected watcher degrading to plain ?since polling at
+# 1 Hz costs header exchanges only.
+WATCH_RETRY_AFTER_S = 1
 
 
 @dataclass
@@ -402,6 +420,9 @@ class FleetCollector:
         backoff_factory: Optional[Callable[[], BackoffPolicy]] = None,
         push_notify: bool = False,
         sweep_interval: float = 0.0,
+        filter_cache_size: int = DEFAULT_FILTER_CACHE_SIZE,
+        watch_timeout: float = DEFAULT_WATCH_TIMEOUT_S,
+        max_watchers: int = DEFAULT_MAX_WATCHERS,
     ):
         if upstream_mode not in (UPSTREAM_SLICES, UPSTREAM_COLLECTORS):
             raise ValueError(f"unknown upstream mode {upstream_mode!r}")
@@ -459,6 +480,18 @@ class FleetCollector:
         self._etag: Optional[str] = None
         self._restored = False
         self._closed = False
+        # The query surface (fleet/query.py): per-filter rendered views
+        # in a bounded LRU (the unfiltered pane above never lives
+        # there), plus the long-poll watch hub. The watch condition is
+        # SEPARATE from the serving lock: parked watchers wait on it
+        # with no lock held, and _commit notifies it after releasing
+        # the serving lock — a parked fleet can never block a scrape.
+        self._filter_views = FilteredViewCache(filter_cache_size)
+        self.watch_timeout = max(float(watch_timeout), 0.0)
+        self.max_watchers = max(0, int(max_watchers))
+        self._watch_cond = threading.Condition()
+        self._watchers = 0
+        self._watch_rev = 0
         # Push-on-delta (peering/notify.py), the coordinator's exact
         # split one tier up. PARENT side: target names whose accepted
         # /peer/notify marked them dirty since the last round; between
@@ -609,72 +642,337 @@ class FleetCollector:
         CURRENT full body's strong ETag — it names the state reached,
         so an in-sync client still 304s and the idle economy holds."""
         with self._lock:
-            full = (self._body, self._etag)
-            if since is None or self.delta_window <= 0:
-                return full
-            if since == self._generation:
-                # In sync: a matching If-None-Match becomes a 304 in
-                # the handler (the 304-equivalent of an empty delta); a
-                # mismatched one means the client's state is NOT what
-                # it claims — full resync.
-                if if_none_match != self._etag:
-                    obs_metrics.FLEET_DELTA_SERVED.labels(
-                        outcome="resync"
-                    ).inc()
-                return full
-            lineage = self._etag_history.get(since)
-            if (
-                since > self._generation
-                or lineage is None
-                or if_none_match != lineage
-            ):
-                obs_metrics.FLEET_DELTA_SERVED.labels(outcome="resync").inc()
-                return full
-            body = self._delta_cache.get(since)
-            if body is None:
-                entries, regions = self._published
-                changed = {
-                    key: entry
-                    for key, entry in entries.items()
-                    if self._entry_gens.get(key, self._generation) > since
+            return self._delta_locked(since, if_none_match)
+
+    def _delta_locked(
+        self, since: Optional[int], if_none_match: Optional[str]
+    ) -> "tuple[bytes, str]":
+        full = (self._body, self._etag)
+        if since is None or self.delta_window <= 0:
+            return full
+        if since == self._generation:
+            # In sync: a matching If-None-Match becomes a 304 in
+            # the handler (the 304-equivalent of an empty delta); a
+            # mismatched one means the client's state is NOT what
+            # it claims — full resync.
+            if if_none_match != self._etag:
+                obs_metrics.FLEET_DELTA_SERVED.labels(
+                    outcome="resync"
+                ).inc()
+            return full
+        lineage = self._etag_history.get(since)
+        if (
+            since > self._generation
+            or lineage is None
+            or if_none_match != lineage
+        ):
+            obs_metrics.FLEET_DELTA_SERVED.labels(outcome="resync").inc()
+            return full
+        body = self._delta_cache.get(since)
+        if body is None:
+            entries, regions = self._published
+            changed = {
+                key: entry
+                for key, entry in entries.items()
+                if self._entry_gens.get(key, self._generation) > since
+            }
+            tombstones = [
+                key
+                for key, gen in self._tombstones.items()
+                if gen > since
+            ]
+            regions_changed = regions_tombstones = None
+            if regions is not None:
+                regions_changed = {
+                    key: meta
+                    for key, meta in regions.items()
+                    if self._region_gens.get(key, self._generation)
+                    > since
                 }
-                tombstones = [
+                regions_tombstones = [
                     key
-                    for key, gen in self._tombstones.items()
+                    for key, gen in self._region_tombstones.items()
                     if gen > since
                 ]
-                regions_changed = regions_tombstones = None
-                if regions is not None:
-                    regions_changed = {
-                        key: meta
-                        for key, meta in regions.items()
-                        if self._region_gens.get(key, self._generation)
-                        > since
-                    }
-                    regions_tombstones = [
-                        key
-                        for key, gen in self._region_tombstones.items()
-                        if gen > since
-                    ]
-                body, _ = serialize_inventory(
-                    build_delta(
-                        since,
-                        self._generation,
-                        self._restored,
-                        changed,
-                        tombstones,
-                        regions_changed=regions_changed,
-                        regions_tombstones=regions_tombstones,
-                    )
+            body, _ = serialize_inventory(
+                build_delta(
+                    since,
+                    self._generation,
+                    self._restored,
+                    changed,
+                    tombstones,
+                    regions_changed=regions_changed,
+                    regions_tombstones=regions_tombstones,
                 )
-                if len(self._delta_cache) >= 32:
-                    # Clients cluster on the current generation minus
-                    # one; a handful of stragglers is normal, an
-                    # unbounded spread is not worth caching.
-                    self._delta_cache.clear()
-                self._delta_cache[since] = body
-            obs_metrics.FLEET_DELTA_SERVED.labels(outcome="delta").inc()
-            return body, self._etag
+            )
+            if len(self._delta_cache) >= 32:
+                # Clients cluster on the current generation minus
+                # one; a handful of stragglers is normal, an
+                # unbounded spread is not worth caching.
+                self._delta_cache.clear()
+            self._delta_cache[since] = body
+        obs_metrics.FLEET_DELTA_SERVED.labels(outcome="delta").inc()
+        return body, self._etag
+
+    # -- the query surface (fleet/query.py) --------------------------------
+
+    def query_response(
+        self,
+        raw_query: str,
+        if_none_match: Optional[str],
+        allow_watch: bool = True,
+        on_park: Optional[Callable[[], None]] = None,
+    ) -> "tuple[int, bytes, Optional[str], Optional[int], bool]":
+        """The ``GET /fleet/snapshot?<query>`` serving hook: filtered
+        views, per-view delta sync, and the long-poll watch. Returns
+        ``(status, body, etag, retry_after_s, filtered)`` — a 200 rides
+        the handler's If-None-Match/304 machinery exactly like the
+        unfiltered hooks; 400 (a query outside the grammar) and 503
+        (the ``--max-watchers`` admission cap) are terminal.
+
+        ``allow_watch=False`` (HEAD requests) answers the current state
+        immediately — a prober must never park a handler thread.
+        ``on_park`` runs once, just after the watcher is admitted: the
+        obs server releases its ``--max-inflight-requests`` slot there,
+        so parked watchers are accounted by the watch cap alone and
+        cannot starve plain GETs."""
+        try:
+            query = parse_fleet_query(raw_query)
+        except QueryError as e:
+            obs_metrics.FLEET_QUERY_REJECTED.inc()
+            return 400, f"bad fleet query: {e}\n".encode(), None, None, False
+        with self._lock:
+            body, etag, filtered = self._answer_locked(query, if_none_match)
+        if (
+            not allow_watch
+            or query.watch_s is None
+            or not etag
+            or if_none_match != etag
+        ):
+            # Not a watch, or the client is out of sync: answer NOW
+            # (a fresh body, a delta, or — matching If-None-Match —
+            # the handler's 304).
+            return 200, body, etag, None, filtered
+        # In sync and watching: park until the view's generation moves
+        # or the window closes. Deadlines are real wall progress
+        # (time.monotonic, never the injectable scrape clock): watch
+        # semantics are a promise to the network peer holding the
+        # socket open.
+        deadline = time.monotonic() + min(query.watch_s, self.watch_timeout)
+        with self._watch_cond:
+            if self._closed or self._watchers >= self.max_watchers:
+                obs_metrics.FLEET_WATCH.labels(outcome="rejected").inc()
+                return (
+                    503,
+                    b"watch slots exhausted\n",
+                    None,
+                    WATCH_RETRY_AFTER_S,
+                    filtered,
+                )
+            self._watchers += 1
+            obs_metrics.FLEET_WATCHERS.set(self._watchers)
+        try:
+            if on_park is not None:
+                on_park()
+            while True:
+                with self._watch_cond:
+                    rev = self._watch_rev
+                with self._lock:
+                    closed = self._closed
+                    body, etag, filtered = self._answer_locked(
+                        query, if_none_match
+                    )
+                if etag and etag != if_none_match:
+                    obs_metrics.FLEET_WATCH.labels(outcome="delta").inc()
+                    return 200, body, etag, None, filtered
+                remaining = deadline - time.monotonic()
+                if remaining <= 0 or closed:
+                    # Window expired idle (or the epoch is ending): the
+                    # matching If-None-Match becomes the handler's 304
+                    # and the client re-arms its watch.
+                    obs_metrics.FLEET_WATCH.labels(outcome="timeout").inc()
+                    return 200, body, etag, None, filtered
+                with self._watch_cond:
+                    # Re-check the revision under the condition: a
+                    # commit that landed between computing the answer
+                    # and parking must not be slept through.
+                    if self._watch_rev == rev and not self._closed:
+                        self._watch_cond.wait(remaining)
+        finally:
+            with self._watch_cond:
+                self._watchers -= 1
+                obs_metrics.FLEET_WATCHERS.set(self._watchers)
+
+    def _answer_locked(
+        self, query: FleetQuery, if_none_match: Optional[str]
+    ) -> "tuple[bytes, Optional[str], bool]":
+        """One query's (body, etag, filtered) under the serving lock:
+        the unfiltered pane rides the existing publish-seam/delta state
+        BYTE-IDENTICALLY; a filtered query resolves (and lazily
+        revalidates) its view first."""
+        if not query.filtered:
+            if query.since is None:
+                return self._body, self._etag, False
+            body, etag = self._delta_locked(query.since, if_none_match)
+            return body, etag, False
+        view = self._view_locked(query)
+        if query.since is None:
+            return view.body, view.etag, True
+        body, etag = self._view_delta_locked(view, query.since, if_none_match)
+        return body, etag, True
+
+    def _view_locked(self, query: FleetQuery) -> FilteredView:
+        """Resolve one canonical filter's rendered view, revalidating
+        lazily: the first access after the global generation moved (or,
+        for max-age views, after the quantized clock crossed a
+        boundary) recomputes the filtered entry set — dict work — and
+        re-serializes ONLY when the content actually differs. That is
+        the whole per-filter economy: at most one serialization per
+        distinct filter per generation, zero on idle access."""
+        now_q = self._now_quantized() if query.max_age_s is not None else None
+        view = self._filter_views.get(query.canonical)
+        if (
+            view is not None
+            and view.validated_gen == self._generation
+            and view.eval_now == now_q
+        ):
+            obs_metrics.FLEET_FILTER_CACHE.labels(outcome="hit").inc()
+            return view
+        entries, regions = (
+            self._published if self._published is not None else ({}, None)
+        )
+        fentries, fregions = filter_entries(query, entries, regions, now_q)
+        published = (fentries, fregions, self._restored)
+        if view is None:
+            obs_metrics.FLEET_FILTER_CACHE.labels(outcome="miss").inc()
+            body, etag = self._render_view(
+                query.canonical, published, self._generation
+            )
+            view = FilteredView(
+                query=query,
+                view_gen=self._generation,
+                body=body,
+                etag=etag,
+                published=published,
+                validated_gen=self._generation,
+                eval_now=now_q,
+            )
+            view.etag_history[self._generation] = etag
+            self._filter_views.put(view)
+            return view
+        obs_metrics.FLEET_FILTER_CACHE.labels(outcome="hit").inc()
+        if published != view.published:
+            if self._generation == view.view_gen:
+                # Membership moved with NO generation movement: entries
+                # aged across the max-age horizon between commits.
+                # There is no generation to stamp the change with, so
+                # the view's delta lineage resets — every delta client
+                # of this view resyncs ONCE with the (small) full
+                # filtered body, and the watch hub still wakes on the
+                # revision bump below.
+                view.etag_history.clear()
+                view.prev_gen = None
+                view.prev_published = None
+            else:
+                view.prev_gen = view.view_gen
+                view.prev_published = view.published
+            view.view_gen = self._generation
+            view.body, view.etag = self._render_view(
+                query.canonical, published, self._generation
+            )
+            view.published = published
+            view.etag_history[view.view_gen] = view.etag
+            while len(view.etag_history) > VIEW_HISTORY_DEPTH:
+                del view.etag_history[min(view.etag_history)]
+            view.delta_bodies.clear()
+            view.revision += 1
+        view.validated_gen = self._generation
+        view.eval_now = now_q
+        return view
+
+    def _render_view(
+        self, canonical: str, published: "tuple", generation: int
+    ) -> "tuple[bytes, str]":
+        """Serialize one filtered view: the same schema-versioned
+        inventory document plus a ``filter`` key naming the canonical
+        query (DeltaMirror carries extra keys through reconstruction,
+        so filtered delta clients verify against this exact body)."""
+        entries, regions, restored = published
+        doc = build_inventory(entries, generation, restored, regions=regions)
+        doc["filter"] = canonical
+        obs_metrics.FLEET_FILTER_RENDERS.inc()
+        return serialize_inventory(doc)
+
+    def _view_delta_locked(
+        self,
+        view: FilteredView,
+        since: int,
+        if_none_match: Optional[str],
+    ) -> "tuple[bytes, str]":
+        """The filtered twin of _delta_locked, over the view's own
+        generation lineage (view generations are the SUBSET of global
+        generations at which this filter's content changed — the
+        ``?since`` a client echoes back is whatever its last filtered
+        document said). Delta content is one step deep: a client on the
+        view's previous generation (If-None-Match verified) gets the
+        O(changed) diff; everyone else resyncs with the full filtered
+        body, which the filter already made small."""
+        full = (view.body, view.etag)
+        if self.delta_window <= 0:
+            return full
+        if since == view.view_gen:
+            if if_none_match != view.etag:
+                obs_metrics.FLEET_DELTA_SERVED.labels(outcome="resync").inc()
+            return full
+        lineage = view.etag_history.get(since)
+        if (
+            since > view.view_gen
+            or lineage is None
+            or if_none_match != lineage
+            or since != view.prev_gen
+            or view.prev_published is None
+        ):
+            obs_metrics.FLEET_DELTA_SERVED.labels(outcome="resync").inc()
+            return full
+        body = view.delta_bodies.get(since)
+        if body is None:
+            prev_entries, prev_regions, _ = view.prev_published
+            entries, regions, restored = view.published
+            changed = {
+                key: entry
+                for key, entry in entries.items()
+                if prev_entries.get(key) != entry
+            }
+            tombstones = [
+                key for key in prev_entries if key not in entries
+            ]
+            regions_changed = regions_tombstones = None
+            if regions is not None:
+                prev_region_map = prev_regions or {}
+                regions_changed = {
+                    key: meta
+                    for key, meta in regions.items()
+                    if prev_region_map.get(key) != meta
+                }
+                regions_tombstones = [
+                    key for key in prev_region_map if key not in regions
+                ]
+            doc = build_delta(
+                since,
+                view.view_gen,
+                restored,
+                changed,
+                tombstones,
+                regions_changed=regions_changed,
+                regions_tombstones=regions_tombstones,
+            )
+            doc["filter"] = view.query.canonical
+            obs_metrics.FLEET_FILTER_RENDERS.inc()
+            body, _ = serialize_inventory(doc)
+            view.delta_bodies.clear()
+            view.delta_bodies[since] = body
+        obs_metrics.FLEET_DELTA_SERVED.labels(outcome="delta").inc()
+        return body, view.etag
 
     def _current_entries(
         self,
@@ -789,6 +1087,15 @@ class FleetCollector:
                 region_tombstones=self._region_tombstones,
             )
         self._notify_upward(notify_generation, notify_etag)
+        if notify_etag:
+            # The inventory moved: wake every parked watcher (outside
+            # the serving lock — waking must never block a scrape).
+            # Each wakes, revalidates ITS view lazily, and either
+            # answers its filtered delta or re-parks if the movement
+            # missed its filter.
+            with self._watch_cond:
+                self._watch_rev += 1
+                self._watch_cond.notify_all()
         return changed_keys
 
     def _notify_upward(
@@ -1165,6 +1472,11 @@ class FleetCollector:
         with self._lock:
             self._closed = True
             self._dirty.clear()
+            self._filter_views.clear()
+        with self._watch_cond:
+            # Parked watchers must observe the close and answer out —
+            # an epoch teardown cannot wait out their watch windows.
+            self._watch_cond.notify_all()
         if self.notify_sender is not None:
             self.notify_sender.close()
         obs_metrics.DIRTY_CHILDREN.set(0)

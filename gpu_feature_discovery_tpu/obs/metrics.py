@@ -473,6 +473,69 @@ FLEET_TARGETS_RELOAD_FAILURES = REGISTRY.counter(
     "warns instead of erroring the epoch; only a first load with no "
     "prior targets is fatal.",
 )
+FLEET_FILTER_VIEWS = REGISTRY.gauge(
+    "tfd_fleet_filter_views",
+    "Rendered filtered views currently held in the bounded LRU behind "
+    "GET /fleet/snapshot?<filter> (--filter-cache-size). Each view is "
+    "one canonicalized filter's serialize-once body + strong ETag; the "
+    "unfiltered pane is the collector's own publish-seam cache and "
+    "never counts here.",
+)
+FLEET_FILTER_CACHE = REGISTRY.counter(
+    "tfd_fleet_filter_cache_total",
+    "Filtered-view cache traffic, by outcome: hit (the canonical filter "
+    "already had a rendered view — possibly revalidated against a moved "
+    "generation, which is dict work, not serialization), miss (first "
+    "sight of this filter: filter + render + insert), evict (the LRU "
+    "crossed --filter-cache-size and dropped its coldest view; steady "
+    "eviction means the cache is sized below the live filter "
+    "population and every cycle re-renders).",
+    labelnames=("outcome",),
+)
+FLEET_FILTER_RENDERS = REGISTRY.counter(
+    "tfd_fleet_filter_renders_total",
+    "Filtered-view bodies actually serialized (full bodies and delta "
+    "documents). The per-filter economy's hard gate: at most one full "
+    "render per distinct filter per generation that CHANGED its "
+    "content — an idle filter re-renders nothing, ever (the bench and "
+    "the scale harness pin this at zero across idle rounds).",
+)
+FLEET_FILTERED_NOT_MODIFIED = REGISTRY.counter(
+    "tfd_fleet_filtered_not_modified_total",
+    "Filtered /fleet/snapshot requests answered 304 Not Modified (the "
+    "consumer's If-None-Match matched its view's cached ETag): no "
+    "filtering, no serialization, no body. The filtered twin of "
+    "tfd_fleet_inventory_not_modified_total — on an idle fleet this "
+    "should dominate filtered traffic (the bench gates >= 90%).",
+)
+FLEET_QUERY_REJECTED = REGISTRY.counter(
+    "tfd_fleet_query_rejected_total",
+    "GET /fleet/snapshot queries rejected 400: unknown or duplicated "
+    "params, malformed values, a non-integer or negative ?since=, or "
+    "?watch= without ?since=. A typo'd dashboard answered 400 is "
+    "LOUD; silently serving it the full pane would defeat the delta "
+    "and filter economies invisibly. Growth here is a misconfigured "
+    "consumer to hunt down.",
+)
+FLEET_WATCHERS = REGISTRY.gauge(
+    "tfd_fleet_watchers",
+    "Long-poll watch requests currently parked on "
+    "/fleet/snapshot?since=<gen>&watch=<s> waiting for their filtered "
+    "view's generation to move. Bounded by --max-watchers (the "
+    "admission cap answers 503 + Retry-After past it); parked watchers "
+    "release their --max-inflight-requests slot, so they never starve "
+    "plain GETs.",
+)
+FLEET_WATCH = REGISTRY.counter(
+    "tfd_fleet_watch_total",
+    "Completed watch requests, by outcome: delta (the view's "
+    "generation moved and the watcher was answered the O(changed) "
+    "document — the wake-to-delta push), timeout (the watch window "
+    "expired idle; answered 304 and the client re-arms), rejected "
+    "(--max-watchers admission cap full: 503 + Retry-After, the "
+    "watcher never parked).",
+    labelnames=("outcome",),
+)
 FLEET_HA_DIVERGENCE = REGISTRY.gauge(
     "tfd_fleet_ha_divergence",
     "Inventory entries differing between this STANDBY's own pane and "
@@ -522,6 +585,23 @@ HTTP_ERRORS = REGISTRY.counter(
     "request paths collapse into endpoint=\"other\" — the label is never "
     "client-chosen.",
     labelnames=("endpoint",),
+)
+HTTP_INFLIGHT = REGISTRY.gauge(
+    "tfd_http_inflight",
+    "Requests the introspection server is answering right now (every "
+    "method, every endpoint). ThreadingHTTPServer spawns one handler "
+    "thread per connection with no ceiling of its own; "
+    "--max-inflight-requests caps this gauge — a parked watch releases "
+    "its slot (counted in tfd_fleet_watchers instead), so the cap "
+    "governs work-in-progress, not connections held open on purpose.",
+)
+HTTP_REJECTED = REGISTRY.counter(
+    "tfd_http_rejected_total",
+    "Requests shed 503 + Retry-After at the --max-inflight-requests "
+    "admission gate before any handler ran. Steady growth means the "
+    "consumer population outruns the cap — raise it, or point "
+    "dashboards at filtered views so each request costs a header "
+    "exchange instead of a pane.",
 )
 
 # -- label engine (lm/engine.py) --------------------------------------------

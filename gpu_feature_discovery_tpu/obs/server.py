@@ -43,7 +43,17 @@ Endpoint semantics:
   schema-versioned, ETag-cached discipline, it is ALSO a valid upstream:
   a federation root (``--upstream-mode=collectors``) and an HA standby's
   mirror both poll this endpoint with If-None-Match, so an idle
-  federated hop is a 304 header exchange too.
+  federated hop is a 304 header exchange too. A request with NO query
+  string is the pinned unfiltered pane, byte- and ETag-identical across
+  releases; any query string routes through the collector's query
+  surface (fleet/query.py): server-side filters (``?region=``,
+  ``?degraded=``, ``?stale=``, ``?sick-chips=``, ``?max-age=``, AND
+  semantics, each canonical filter with its own serialize-once/strong-
+  ETag/304 economy, 400 on unknown/duplicate/malformed params including
+  a garbled ``?since=``), the generation-delta protocol scoped to the
+  filtered view's lineage, and ``?since=<gen>&watch=<seconds>``
+  long-poll parking (bounded by ``--watch-timeout``; past
+  ``--max-watchers`` answers 503 + Retry-After; HEAD never parks).
 - ``POST /probe`` — on-demand reconcile wake (``--reconcile=event``,
   cmd/events.py): authenticated by the ``--probe-token`` shared secret
   (``X-TFD-Probe-Token`` header or ``Authorization: Bearer``), answers
@@ -76,6 +86,15 @@ to fall through to the 404 path.
 An exception inside any endpoint handler answers 500 with the error
 class name (and counts in ``tfd_http_errors_total{endpoint}``) instead
 of tearing the connection down with no response.
+
+``--max-inflight-requests`` bounds concurrent handler WORK:
+ThreadingHTTPServer spawns a thread per connection unconditionally, so
+past the cap the request is answered 503 + Retry-After immediately and
+the thread exits instead of piling on (``tfd_http_inflight`` gauges the
+moment, ``tfd_http_rejected_total`` counts the sheds). A parked fleet
+watcher releases its inflight slot — watchers are accounted by
+``--max-watchers`` alone and can never starve plain GETs. The default
+(0) is unlimited, the historical behavior.
 
 The server is bound by cmd/main.run for daemon epochs only (oneshot
 never serves; ``--metrics-port 0`` disables) and closed at epoch end, so
@@ -208,6 +227,44 @@ _KNOWN_ENDPOINTS = (
 # connection parseable; anything bigger closes the connection instead.
 _MAX_PROBE_BODY = 65536
 
+# What a 503 at the --max-inflight-requests gate tells the client to
+# wait: inflight slots turn over in milliseconds on a healthy server,
+# so one second is generous; a saturated server wants backoff, not a
+# precise ETA.
+_INFLIGHT_RETRY_AFTER_S = 1
+
+
+class _InflightGate:
+    """The --max-inflight-requests admission gate: a counted semaphore
+    that REJECTS instead of queueing (ThreadingHTTPServer already
+    spawned the handler thread — the gate bounds concurrent WORK, and a
+    request past the cap is answered 503 + Retry-After immediately so
+    the thread exits instead of piling on). ``limit`` 0 = unlimited:
+    the gauge still tracks, nothing is ever shed — the historical
+    behavior, byte for byte. A parked fleet watcher releases its slot
+    (obs server hands the release into the fleet query hook), so
+    watchers are accounted by --max-watchers alone and can never starve
+    plain GETs out of the inflight budget."""
+
+    def __init__(self, limit: int):
+        self.limit = max(0, int(limit))
+        self._lock = threading.Lock()
+        self._count = 0
+
+    def enter(self) -> bool:
+        with self._lock:
+            if self.limit and self._count >= self.limit:
+                metrics.HTTP_REJECTED.inc()
+                return False
+            self._count += 1
+            metrics.HTTP_INFLIGHT.set(self._count)
+            return True
+
+    def leave(self) -> None:
+        with self._lock:
+            self._count = max(0, self._count - 1)
+            metrics.HTTP_INFLIGHT.set(self._count)
+
 
 def _endpoint_label(path: str) -> str:
     """Clamp a client-requested path to the known endpoint set: the
@@ -241,11 +298,10 @@ def _make_handler(
     peer_fault: Optional[Callable[[str], bool]] = None,
     peer_token: str = "",
     fleet_snapshot: Optional[Callable[[], "tuple[bytes, str]"]] = None,
-    fleet_delta: Optional[
-        Callable[[int, "Optional[str]"], "tuple[bytes, str]"]
-    ] = None,
+    fleet_query: Optional[Callable[..., "tuple"]] = None,
     peer_notify: Optional[Callable[[str, int, str], bool]] = None,
     notify_subscribe: Optional[Callable[[str, int, str], None]] = None,
+    inflight: Optional[_InflightGate] = None,
 ):
     class _Handler(BaseHTTPRequestHandler):
         # Content-Length is always sent, so keep-alive is safe.
@@ -253,6 +309,8 @@ def _make_handler(
 
         def do_GET(self):  # noqa: N802 - BaseHTTPRequestHandler API
             path = urlsplit(self.path).path
+            if not self._enter_inflight():
+                return
             try:
                 self._dispatch(path)
             except Exception as e:  # noqa: BLE001 - handler containment
@@ -270,6 +328,8 @@ def _make_handler(
                     # The connection itself is gone (client hung up
                     # mid-reply); nothing left to answer on.
                     self.close_connection = True
+            finally:
+                self._release_inflight()
 
         def do_HEAD(self):  # noqa: N802 - BaseHTTPRequestHandler API
             # Same dispatch as GET; _reply suppresses the body for HEAD
@@ -280,6 +340,8 @@ def _make_handler(
 
         def do_POST(self):  # noqa: N802 - BaseHTTPRequestHandler API
             path = urlsplit(self.path).path
+            if not self._enter_inflight():
+                return
             try:
                 self._dispatch_post(path)
             except Exception as e:  # noqa: BLE001 - handler containment
@@ -289,6 +351,35 @@ def _make_handler(
                     self._reply(500, f"{type(e).__name__}\n".encode())
                 except OSError:
                     self.close_connection = True
+            finally:
+                self._release_inflight()
+
+        def _enter_inflight(self) -> bool:
+            """Acquire one --max-inflight-requests slot, or answer the
+            503 + Retry-After shed. True = proceed. Always resets the
+            per-request release latch: handler instances persist across
+            keep-alive requests."""
+            self._inflight_held = False
+            if inflight is None:
+                return True
+            if not inflight.enter():
+                self._reply(
+                    503,
+                    b"server busy: inflight request cap reached\n",
+                    retry_after=_INFLIGHT_RETRY_AFTER_S,
+                )
+                return False
+            self._inflight_held = True
+            return True
+
+        def _release_inflight(self) -> None:
+            """Release the slot exactly once — called both at request
+            end AND by the fleet watch hook when a watcher parks (a
+            parked watcher holds a socket on purpose; it must not hold
+            an inflight slot)."""
+            if getattr(self, "_inflight_held", False):
+                self._inflight_held = False
+                inflight.leave()
 
         def _dispatch_post(self, path: str):
             if path == "/peer/notify":
@@ -433,19 +524,6 @@ def _make_handler(
                 return False
             return True
 
-        def _since_param(self) -> "Optional[int]":
-            """The ``since`` query value as a non-negative int, or None
-            when absent/garbled — a malformed ``since`` falls back to
-            the full body (delta is an optimisation, never a 4xx)."""
-            for part in urlsplit(self.path).query.split("&"):
-                if part.startswith("since="):
-                    try:
-                        since = int(part[len("since="):])
-                    except ValueError:
-                        return None
-                    return since if since >= 0 else None
-            return None
-
         def _reply_snapshot(
             self, body: bytes, etag: "Optional[str]", counter
         ):
@@ -495,22 +573,45 @@ def _make_handler(
             elif path == "/fleet/snapshot" and fleet_snapshot is not None:
                 # The collector's aggregated inventory, same token gate
                 # and publish-time-cache economy as the peer surface it
-                # is built over. A ``?since=<generation>`` query asks
-                # for the generation-delta document instead; the serving
-                # decision (delta vs full resync) lives with the
-                # collector, which also validates the client's ETag
-                # lineage — this handler only routes.
+                # is built over. A request with NO query string stays on
+                # the untouched publish-seam path — its body and ETag
+                # are pinned byte-identical across releases. Any query
+                # string (filters, ``since``, ``watch``) routes through
+                # the collector's query surface (fleet/query.py), which
+                # owns parsing (400 on anything outside the grammar),
+                # the per-filter view economy, delta-vs-resync, and
+                # watch parking — this handler only routes and frames.
                 if not self._peer_auth_ok():
                     return
                 self._observe_notify_subscription()
-                since = self._since_param()
-                if since is not None and fleet_delta is not None:
-                    self._reply_snapshot(
-                        *fleet_delta(
-                            since, self.headers.get("If-None-Match")
-                        ),
-                        counter=metrics.FLEET_INVENTORY_NOT_MODIFIED,
+                raw_query = urlsplit(self.path).query
+                if raw_query and fleet_query is not None:
+                    status, body, etag, retry_after, filtered = fleet_query(
+                        raw_query,
+                        self.headers.get("If-None-Match"),
+                        # HEAD must never park: the prober wants headers
+                        # now, and a parked HEAD would pin a thread with
+                        # no delta to deliver.
+                        self.command != "HEAD",
+                        self._release_inflight,
                     )
+                    if status == 200:
+                        # Rides the shared INM/304 machinery: a filtered
+                        # idle poll counts in its own 304 series so the
+                        # unfiltered pane's economy stays measurable.
+                        self._reply_snapshot(
+                            body,
+                            etag,
+                            counter=(
+                                metrics.FLEET_FILTERED_NOT_MODIFIED
+                                if filtered
+                                else metrics.FLEET_INVENTORY_NOT_MODIFIED
+                            ),
+                        )
+                    else:
+                        # Terminal 400/503 — no ETag, optionally a
+                        # Retry-After (watch admission shed).
+                        self._reply(status, body, retry_after=retry_after)
                 else:
                     self._reply_snapshot(
                         *fleet_snapshot(),
@@ -580,12 +681,18 @@ def _make_handler(
             body: bytes,
             ctype: str = "text/plain",
             etag: "Optional[str]" = None,
+            retry_after: "Optional[int]" = None,
         ):
             self.send_response(code)
             self.send_header("Content-Type", ctype)
             self.send_header("Content-Length", str(len(body)))
             if etag:
                 self.send_header("ETag", etag)
+            if retry_after is not None:
+                # The 503 shed paths (inflight cap, watch admission)
+                # tell the client when to come back instead of letting
+                # it hammer.
+                self.send_header("Retry-After", str(int(retry_after)))
             self.end_headers()
             if self.command != "HEAD":
                 # HEAD gets status + headers only; Content-Length above
@@ -655,11 +762,10 @@ class IntrospectionServer:
         peer_fault: Optional[Callable[[str], bool]] = None,
         peer_token: str = "",
         fleet_snapshot: Optional[Callable[[], "tuple[bytes, str]"]] = None,
-        fleet_delta: Optional[
-            Callable[[int, "Optional[str]"], "tuple[bytes, str]"]
-        ] = None,
+        fleet_query: Optional[Callable[..., "tuple"]] = None,
         peer_notify: Optional[Callable[[str, int, str], bool]] = None,
         notify_subscribe: Optional[Callable[[str, int, str], None]] = None,
+        max_inflight: int = 0,
     ):
         self._httpd = _TrackingHTTPServer(
             (addr, port),
@@ -673,9 +779,10 @@ class IntrospectionServer:
                 peer_fault=peer_fault,
                 peer_token=peer_token,
                 fleet_snapshot=fleet_snapshot,
-                fleet_delta=fleet_delta,
+                fleet_query=fleet_query,
                 peer_notify=peer_notify,
                 notify_subscribe=notify_subscribe,
+                inflight=_InflightGate(max_inflight),
             ),
         )
         self._httpd.daemon_threads = True

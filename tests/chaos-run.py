@@ -63,7 +63,14 @@ state dir: a ``?since=<generation>`` delta client must either resume
 the persisted lineage (deltas keep flowing across the restart) or be
 forced through exactly ONE full resync — never an error loop, never a
 silently stale pane — and end byte-identical to a full-body client
-(run_fleet_delta_resync).
+(run_fleet_delta_resync). ``fleet:watch-failover`` (ISSUE 20) parks
+filtered ``?watch=`` long-poll consumers on the same subprocess shape,
+SIGKILLs the collector mid-park, and restarts it on the same port and
+state dir: every watcher must reconnect and resume its filtered view
+via ``?since=`` with at most ONE full resync each, post-restart churn
+must ride filtered deltas again, and each watcher's DeltaMirror
+reconstruction must end byte-identical to a fresh filtered full body
+(run_fleet_watch_failover).
 
 ``fleet:notify-lost`` (ISSUE 17) drops a push-on-delta notification at
 the child's sender (the armed notify.drop fault) under a push-enabled
@@ -500,6 +507,8 @@ def run_fleet_chaos(scenario, workdir, timeout_s=None):
         return run_fleet_collector_failover(workdir, timeout_s=timeout_s)
     if scenario == "delta-resync":
         return run_fleet_delta_resync(workdir, timeout_s=timeout_s)
+    if scenario == "watch-failover":
+        return run_fleet_watch_failover(workdir, timeout_s=timeout_s)
     if scenario == "notify-lost":
         return run_fleet_notify_lost(workdir, timeout_s=timeout_s)
     if scenario == "notify-storm":
@@ -1242,6 +1251,308 @@ def run_fleet_delta_resync(workdir, timeout_s=None):
         "deltas_after_restart": kinds["delta"],
         "generation": hstate.mirror.generation,
         "labels": len(hstate.last_snapshot["slices"]),
+    }
+
+
+def run_fleet_watch_failover(workdir, timeout_s=None):
+    """fleet:watch-failover (ISSUE 20): two consumers hold filtered
+    ``?degraded=true`` panes against a REAL fleet-collector subprocess
+    (--state-dir) and park in ``?watch=`` long-polls between changes.
+    The collector is SIGKILLed mid-park and restarted on the same port
+    and state dir. The contract:
+
+      1. pre-kill a parked watcher is woken by generation movement and
+         answered the FILTERED delta (the doc names the filter, carries
+         only the changed key), applied through a verifying DeltaMirror;
+      2. across the kill/restart every watcher reconnects and resumes
+         via ``?since=`` with at most ONE full resync each — never an
+         error loop, never a silently stale filtered pane — ending
+         byte-identical to a fresh filtered full body;
+      3. post-restart churn rides filtered deltas again (zero further
+         resyncs)."""
+    import http.client
+    import json as _json
+    import signal as _signal
+    import subprocess
+    import threading as _threading
+    import urllib.request
+
+    import yaml as _yaml
+    from slice_fixture import free_port
+
+    from gpu_feature_discovery_tpu.fleet.inventory import (
+        FLEET_SNAPSHOT_PATH,
+        DeltaMirror,
+        DeltaSyncError,
+    )
+
+    budget = timeout_s or 90.0
+    started = time.monotonic()
+    coords, servers = [], []
+    active = None
+    stop = _threading.Event()
+    threads = []
+    n_watchers = 2
+    mirrors = [None] * n_watchers
+    counts = [
+        {"full": 0, "delta": 0, "errors": 0} for _ in range(n_watchers)
+    ]
+    try:
+        coords, servers, targets = _fake_slice_leaders(3, prefix="w")
+        targets_path = os.path.join(workdir, "targets.yaml")
+        with open(targets_path, "w") as f:
+            _yaml.safe_dump(
+                {
+                    "version": "v1",
+                    "slices": [
+                        {"name": t.name, "hosts": list(t.hosts)}
+                        for t in targets
+                    ],
+                },
+                f,
+            )
+        state_dir = os.path.join(workdir, "fleet-state")
+        os.makedirs(state_dir, exist_ok=True)
+        port = free_port()
+        repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        env = dict(os.environ)
+        env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+
+        def spawn():
+            return subprocess.Popen(
+                [
+                    sys.executable, "-m", "gpu_feature_discovery_tpu",
+                    "fleet-collector",
+                    "--targets-file", targets_path,
+                    "--metrics-addr", "127.0.0.1",
+                    "--metrics-port", str(port),
+                    "--scrape-interval", "0.1s",
+                    "--peer-timeout", "0.5s",
+                    "--state-dir", state_dir,
+                    "--delta-window", "16",
+                    "--watch-timeout", "2s",
+                ],
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.DEVNULL,
+            )
+
+        def wait_ready(what):
+            deadline = time.monotonic() + budget
+            while time.monotonic() < deadline:
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/readyz", timeout=2
+                    ) as resp:
+                        if resp.status == 200:
+                            return
+                except OSError:
+                    pass
+                time.sleep(0.05)
+            raise AssertionError(f"collector never became ready ({what})")
+
+        def filtered_full_body():
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{FLEET_SNAPSHOT_PATH}"
+                "?degraded=true",
+                timeout=2,
+            ) as resp:
+                return resp.read()
+
+        def parked_watchers():
+            """Scrape the collector's REAL /metrics for the parked-
+            watcher gauge — proves the long-polls are held open, not
+            polling fast."""
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=2
+                ) as resp:
+                    text = resp.read().decode()
+            except OSError:
+                return -1
+            for line in text.splitlines():
+                if line.startswith("tfd_fleet_watchers"):
+                    return int(float(line.split()[-1]))
+            return 0
+
+        def degrade(i):
+            coords[i].publish_local(
+                {
+                    "google.com/tpu.count": "4",
+                    "google.com/tpu.chips.healthy": "3",
+                    "google.com/tpu.chips.sick": "1",
+                    "google.com/tpu.slice.role": "leader",
+                    "google.com/tpu.slice.leader": f"w{i}w0",
+                    "google.com/tpu.slice.healthy-hosts": "1",
+                    "google.com/tpu.slice.total-hosts": "2",
+                    "google.com/tpu.slice.degraded": "true",
+                    "google.com/tpu.slice.sick-chips": "1",
+                },
+                "full",
+            )
+
+        def watcher_loop(idx):
+            """A filtered-pane consumer: full body once, then parked
+            ?since=&watch= long-polls, applying every answer through a
+            verifying DeltaMirror. Connection errors on the dead port
+            are part of the exercise; a DeltaSyncError drops the mirror
+            for ONE counted full resync."""
+            mirror = DeltaMirror()
+            mirrors[idx] = mirror
+            etag = None
+            conn = None
+            while not stop.is_set():
+                try:
+                    if conn is None:
+                        conn = http.client.HTTPConnection(
+                            "127.0.0.1", port, timeout=10
+                        )
+                    if mirror.doc is None:
+                        path = f"{FLEET_SNAPSHOT_PATH}?degraded=true"
+                        headers = {}
+                    else:
+                        path = (
+                            f"{FLEET_SNAPSHOT_PATH}?degraded=true"
+                            f"&since={mirror.generation}&watch=30"
+                        )
+                        headers = (
+                            {"If-None-Match": etag} if etag else {}
+                        )
+                    conn.request("GET", path, headers=headers)
+                    resp = conn.getresponse()
+                    body = resp.read()
+                except Exception:
+                    if conn is not None:
+                        conn.close()
+                    conn = None
+                    time.sleep(0.05)
+                    continue
+                if resp.status == 304:
+                    mirror.note_unchanged()
+                    continue
+                if resp.status != 200:
+                    counts[idx]["errors"] += 1
+                    time.sleep(0.05)
+                    continue
+                doc = _json.loads(body.decode())
+                resp_etag = resp.headers.get("ETag")
+                try:
+                    mirror.apply(doc, resp_etag)
+                except DeltaSyncError:
+                    mirror = DeltaMirror()
+                    mirrors[idx] = mirror
+                    etag = None
+                    continue
+                etag = resp_etag
+                counts[idx]["delta" if doc.get("delta") else "full"] += 1
+
+        def wait_mirrors(pred, what):
+            deadline = time.monotonic() + budget
+            while time.monotonic() < deadline:
+                if all(
+                    m is not None and m.doc is not None and pred(m)
+                    for m in mirrors
+                ):
+                    return
+                time.sleep(0.05)
+            raise AssertionError(
+                f"watchers never converged ({what}): "
+                f"{[m.doc if m else None for m in mirrors]}"
+            )
+
+        active = spawn()
+        wait_ready("first start")
+        for idx in range(n_watchers):
+            thread = _threading.Thread(target=watcher_loop, args=(idx,))
+            thread.start()
+            threads.append(thread)
+        # Both consumers take the (empty) filtered pane and PARK.
+        wait_mirrors(lambda m: True, "first filtered body")
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline and parked_watchers() < 2:
+            time.sleep(0.05)
+        assert parked_watchers() >= 2, "watchers never parked pre-kill"
+        # Generation movement wakes the parked watchers with the
+        # FILTERED delta: w0 enters the degraded=true pane.
+        degrade(0)
+        wait_mirrors(
+            lambda m: "w0" in m.doc["slices"]
+            and m.doc.get("filter") == "degraded=true",
+            "pre-kill wake",
+        )
+        assert all(c["delta"] >= 1 for c in counts), counts
+        pre_kill = [dict(c) for c in counts]
+        # Re-park, then SIGKILL mid-park — the held long-polls die with
+        # the process; no shutdown path runs.
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline and parked_watchers() < 2:
+            time.sleep(0.05)
+        assert parked_watchers() >= 2, "watchers never re-parked"
+        os.kill(active.pid, _signal.SIGKILL)
+        active.wait(timeout=10)
+        active = spawn()
+        wait_ready("restart")
+        degrade(1)
+        wait_mirrors(
+            lambda m: "w1" in m.doc["slices"]
+            and "w0" in m.doc["slices"]
+            and not m.doc.get("restored"),
+            "post-restart convergence",
+        )
+        # At most ONE full resync per watcher across the restart.
+        resyncs = [
+            counts[i]["full"] - pre_kill[i]["full"]
+            for i in range(n_watchers)
+        ]
+        assert all(r <= 1 for r in resyncs), counts
+        # Byte-identity: each reconstructed filtered pane matches a
+        # fresh filtered full body at the same generation.
+        deadline = time.monotonic() + budget
+        while time.monotonic() < deadline:
+            reference = filtered_full_body()
+            if all(m.body == reference for m in mirrors):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError(
+                f"filtered mirrors never matched the served pane: "
+                f"{[m.generation for m in mirrors]}"
+            )
+        # Still on the lineage: further churn rides filtered deltas,
+        # zero additional resyncs.
+        post_restart = [dict(c) for c in counts]
+        degrade(2)
+        wait_mirrors(
+            lambda m: "w2" in m.doc["slices"], "post-restart delta"
+        )
+        assert all(
+            counts[i]["full"] == post_restart[i]["full"]
+            for i in range(n_watchers)
+        ), counts
+        assert all(
+            counts[i]["delta"] > post_restart[i]["delta"]
+            for i in range(n_watchers)
+        ), counts
+        assert all(c["errors"] == 0 for c in counts), counts
+    finally:
+        stop.set()
+        if active is not None and active.poll() is None:
+            active.kill()
+            active.wait(timeout=10)
+        for thread in threads:
+            thread.join(timeout=15)
+        for server in servers:
+            server.close()
+        for coord in coords:
+            coord.close()
+    elapsed = time.monotonic() - started
+    return {
+        "spec": "fleet:watch-failover",
+        "converged_s": round(elapsed, 3),
+        "watchers": n_watchers,
+        "resyncs_after_restart": max(resyncs),
+        "deltas": [c["delta"] for c in counts],
+        "labels": len(mirrors[0].doc["slices"]),
     }
 
 

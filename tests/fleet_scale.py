@@ -382,12 +382,133 @@ class MockFleet:
                 self._sel.modify(conn.sock, selectors.EVENT_READ, conn)
 
 
+def consumer_filters(n_regions):
+    """~20 distinct canonical filters a dashboard/scheduler population
+    holds against the root pane: verdict panes, freshness panes,
+    per-region panes, and scheduler-style combinations."""
+    filters = [
+        "degraded=true",
+        "degraded=false",
+        "stale=true",
+        "stale=false",
+        "sick-chips=true",
+        "sick-chips=false",
+        "max-age=600",
+        "max-age=900",
+        "degraded=true&stale=false",
+        "degraded=true&sick-chips=true",
+        "degraded=false&sick-chips=false",
+        "max-age=600&stale=false",
+    ]
+    for i in range(n_regions):
+        filters.append(f"region=region-{i}")
+        filters.append(f"degraded=true&region=region-{i}")
+    return filters
+
+
+def fleet_get(port, query="", etag=None, token="", timeout=30):
+    """One GET against a served /fleet/snapshot on a fresh connection:
+    (status, body, etag). Long default timeout so watch parks (which
+    answer at --watch-timeout) can ride it from a thread."""
+    import http.client
+
+    headers = {}
+    if etag:
+        headers["If-None-Match"] = etag
+    if token:
+        headers["X-TFD-Probe-Token"] = token
+    path = f"/fleet/snapshot?{query}" if query else "/fleet/snapshot"
+    conn = http.client.HTTPConnection("127.0.0.1", port, timeout=timeout)
+    try:
+        conn.request("GET", path, headers=headers)
+        resp = conn.getresponse()
+        body = resp.read()
+        return resp.status, body, resp.headers.get("ETag")
+    finally:
+        conn.close()
+
+
+class ConsumerPool:
+    """N keep-alive consumers (dashboards, schedulers) pinned to
+    filtered /fleet/snapshot views, polling with If-None-Match exactly
+    like real clients — the serving-side load the per-filter ETag
+    economy exists for. ``stats`` mirrors MockFleet's: what crossed the
+    wire TO the consumers."""
+
+    def __init__(self, port, n_clients, filters, token=""):
+        import http.client
+
+        self.token = token
+        self.clients = [
+            {
+                "conn": http.client.HTTPConnection(
+                    "127.0.0.1", port, timeout=10
+                ),
+                "query": filters[i % len(filters)],
+                "etag": None,
+            }
+            for i in range(n_clients)
+        ]
+        self.stats = {
+            "requests": 0, "full": 0, "not_modified": 0, "bytes": 0,
+            "errors": 0,
+        }
+
+    def reset(self):
+        self.stats.update(
+            requests=0, full=0, not_modified=0, bytes=0, errors=0
+        )
+
+    def poll_all(self):
+        """One conditional poll from every consumer. Returns a copy of
+        the cumulative stats."""
+        import http.client
+
+        for client in self.clients:
+            headers = {}
+            if client["etag"]:
+                headers["If-None-Match"] = client["etag"]
+            if self.token:
+                headers["X-TFD-Probe-Token"] = self.token
+            self.stats["requests"] += 1
+            try:
+                client["conn"].request(
+                    "GET",
+                    f"/fleet/snapshot?{client['query']}",
+                    headers=headers,
+                )
+                resp = client["conn"].getresponse()
+                body = resp.read()
+            except (OSError, http.client.HTTPException):
+                client["conn"].close()
+                self.stats["errors"] += 1
+                continue
+            if resp.status == 304:
+                self.stats["not_modified"] += 1
+            elif resp.status == 200:
+                self.stats["full"] += 1
+                self.stats["bytes"] += len(body)
+                client["etag"] = resp.headers.get("ETag") or client["etag"]
+            else:
+                self.stats["errors"] += 1
+        return dict(self.stats)
+
+    def close(self):
+        for client in self.clients:
+            try:
+                client["conn"].close()
+            except OSError:
+                pass
+
+
 class FleetTiers:
     """The real aggregation stack over a MockFleet: ``n_regions``
     slices-mode FleetCollectors (each serving /fleet/snapshot WITH the
-    delta hook, exactly as cmd/fleet.py wires it) and one federated
+    query hook, exactly as cmd/fleet.py wires it) and one federated
     root scraping them. ``round()`` drives one full fleet round
-    bottom-up and returns the root's changed keys."""
+    bottom-up and returns the root's changed keys. ``serve_root=True``
+    additionally exposes the ROOT's pane over its own server (the
+    consumer-facing surface ConsumerPool and the watch tests drive)."""
 
     def __init__(
         self,
@@ -399,6 +520,9 @@ class FleetTiers:
         peer_token="",
         push_notify=False,
         sweep_interval=0.0,
+        serve_root=False,
+        max_inflight=0,
+        root_collector_kwargs=None,
     ):
         targets = mock.targets()
         wall = {"wall_clock": wall_clock} if wall_clock else {}
@@ -411,6 +535,7 @@ class FleetTiers:
         self.regions = []
         self.region_servers = []
         self.root_server = None
+        self.root_query_server = None
         try:
             for i in range(n_regions):
                 region = FleetCollector(
@@ -427,7 +552,7 @@ class FleetTiers:
                     addr="127.0.0.1",
                     port=0,
                     fleet_snapshot=region.inventory_response,
-                    fleet_delta=region.delta_response,
+                    fleet_query=region.query_response,
                     peer_token=peer_token,
                     peer_notify=(
                         region.mark_dirty if push_notify else None
@@ -461,7 +586,20 @@ class FleetTiers:
                 peer_token=peer_token,
                 **push,
                 **wall,
+                **(root_collector_kwargs or {}),
             )
+            if serve_root:
+                self.root_query_server = IntrospectionServer(
+                    obs_metrics.REGISTRY,
+                    IntrospectionState(3600.0),
+                    addr="127.0.0.1",
+                    port=0,
+                    fleet_snapshot=self.root.inventory_response,
+                    fleet_query=self.root.query_response,
+                    peer_token=peer_token,
+                    max_inflight=max_inflight,
+                )
+                self.root_query_server.start()
             if push_notify:
                 self.root_server = IntrospectionServer(
                     obs_metrics.REGISTRY,
@@ -491,6 +629,8 @@ class FleetTiers:
     def close(self):
         if getattr(self, "root", None) is not None:
             self.root.close()
+        if getattr(self, "root_query_server", None) is not None:
+            self.root_query_server.close()
         if getattr(self, "root_server", None) is not None:
             self.root_server.close()
         for server in self.region_servers:

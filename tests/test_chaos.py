@@ -103,6 +103,15 @@ CHAOS_SPECS = [
     # error loop or a silently stale pane, and end byte-identical to a
     # full-body client.
     "fleet:delta-resync",
+    # Fleet-scale query surface (ISSUE 20, fleet/query.py): consumers
+    # parked in filtered ?watch= long-polls when the serving collector
+    # is SIGKILLed mid-park and restarted on the same port + state dir
+    # — every watcher must reconnect and resume its filtered view via
+    # ?since= with at most ONE full resync each (post-restart churn
+    # rides filtered deltas again), each DeltaMirror reconstruction
+    # ending byte-identical to a fresh filtered full body — never an
+    # error loop, never a silently stale filtered pane.
+    "fleet:watch-failover",
     # Push-on-delta (ISSUE 17, peering/notify.py). notify-lost: a
     # change's upward notification is DROPPED at the child's sender
     # (the armed notify.drop fault) — the parent must stay clean (no
@@ -197,6 +206,11 @@ CHAOS_EXPECTATIONS = {
     # the at-most-one-resync and byte-identity bounds are asserted
     # inside the driver.
     "fleet:delta-resync": {"timeout_s": 90.0},
+    # Two REAL subprocess starts bracket the kill (the delta-resync
+    # rationale) plus THREE convergence waits (pre-kill wake,
+    # post-restart resync, post-restart delta), each gated on parked
+    # watchers observed via live /metrics scrapes.
+    "fleet:watch-failover": {"timeout_s": 90.0},
     # In-process leaders (cheap), but the lost-notify row deliberately
     # WAITS OUT a 2s sweep window before its convergence can happen,
     # plus a second push-path convergence wait.

@@ -425,3 +425,98 @@ def test_actuation_families_are_registered_and_documented():
         "--actuation=off",
     ):
         assert bit in ops, f"actuation runbook missing {bit!r}"
+
+
+def test_query_surface_families_are_registered_and_documented():
+    """ISSUE 20 drift guard, both directions and explicit: the filtered
+    query-surface and overload-guard metric families must exist in the
+    live registry with the right kind AND carry a typed
+    docs/observability.md table row, the endpoint reference must spell
+    the filter/watch grammar, and the serving runbook the flags point at
+    must exist with its sizing + overload vocabulary."""
+    from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
+
+    expected = {
+        "tfd_fleet_filter_views": "gauge",
+        "tfd_fleet_filter_cache_total": "counter",
+        "tfd_fleet_filter_renders_total": "counter",
+        "tfd_fleet_filtered_not_modified_total": "counter",
+        "tfd_fleet_query_rejected_total": "counter",
+        "tfd_fleet_watchers": "gauge",
+        "tfd_fleet_watch_total": "counter",
+        "tfd_http_inflight": "gauge",
+        "tfd_http_rejected_total": "counter",
+    }
+    families = obs_metrics.REGISTRY.families()
+    doc = read("observability.md")
+    for name, kind in expected.items():
+        assert name in families, f"query-surface metric {name} missing"
+        assert families[name].kind == kind, name
+        row = next(
+            (
+                line
+                for line in doc.splitlines()
+                if line.startswith(f"| `{name}`")
+            ),
+            "",
+        )
+        assert kind in row, f"{name}: no doc table row stating {kind!r}"
+    assert families["tfd_fleet_filter_cache_total"].labelnames == (
+        "outcome",
+    )
+    assert families["tfd_fleet_watch_total"].labelnames == ("outcome",)
+    # Every outcome the serving path can emit must be named in its
+    # counter's doc row.
+    for name, outcomes in (
+        ("tfd_fleet_filter_cache_total", ("hit", "miss", "evict")),
+        ("tfd_fleet_watch_total", ("delta", "timeout", "rejected")),
+    ):
+        row = next(
+            line
+            for line in doc.splitlines()
+            if line.startswith(f"| `{name}`")
+        )
+        for outcome in outcomes:
+            assert outcome in row, (
+                f"{name} outcome {outcome!r} undocumented"
+            )
+    # The endpoint reference spells the filter + watch grammar on the
+    # /fleet/snapshot row.
+    endpoint_row = next(
+        line
+        for line in doc.splitlines()
+        if line.startswith("| `/fleet/snapshot`")
+    )
+    for bit in (
+        "?region=",
+        "degraded=true",
+        "sick-chips",
+        "max-age",
+        "watch=",
+        "400",
+        "Retry-After",
+    ):
+        assert bit in endpoint_row, (
+            f"/fleet/snapshot endpoint row missing {bit!r}"
+        )
+    # The serving runbook: grammar, cache sizing, watch semantics, the
+    # failover contract, and both overload guards must all be written
+    # down.
+    ops = read("operations.md")
+    assert "Serving dashboards and schedulers at scale" in ops
+    for bit in (
+        "?degraded=true",
+        "max-age",
+        "canonicalized",
+        "--filter-cache-size",
+        "&watch=",
+        "--watch-timeout",
+        "--max-watchers",
+        "--max-inflight-requests",
+        "Retry-After",
+        "fleet:watch-failover",
+        "tfd_fleet_filter_views",
+        "tfd_fleet_watchers",
+        "tfd_http_rejected_total",
+    ):
+        assert bit in ops, f"query-surface runbook missing {bit!r}"

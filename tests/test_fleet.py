@@ -1548,8 +1548,8 @@ def test_fleet_collector_over_three_slices_acceptance(tmp_path):
 # ---------------------------------------------------------------------------
 
 def _serve_fleet(collector, delta=True):
-    """A collector's serving surface with the delta hook wired exactly
-    as cmd/fleet.py wires it (fleet_delta optional for the
+    """A collector's serving surface with the query hook wired exactly
+    as cmd/fleet.py wires it (fleet_query optional for the
     delta-unaware-server pin)."""
     server = IntrospectionServer(
         obs_metrics.REGISTRY,
@@ -1557,7 +1557,7 @@ def _serve_fleet(collector, delta=True):
         addr="127.0.0.1",
         port=0,
         fleet_snapshot=collector.inventory_response,
-        fleet_delta=collector.delta_response if delta else None,
+        fleet_query=collector.query_response if delta else None,
     )
     server.start()
     return server
@@ -1923,7 +1923,11 @@ def test_full_body_and_delta_unaware_clients_stay_byte_identical():
     """The backward-compat pin: the delta protocol adds NOTHING to the
     full wire body (same keys, same bytes, delta-capable server or
     not), a delta-unaware client (no ?since) reads today's wire, and a
-    garbled ?since degrades to the full body, never a 4xx."""
+    garbled ?since is REJECTED with 400 on the query-wired server — it
+    must never silently degrade to the full body (a consumer that
+    thinks it is delta-polling would re-download the pane every round
+    and nobody would notice) — while a query-unaware server ignores
+    the query string entirely, the historical wire."""
     coords, servers, targets = _serve_slices(2)
     region = FleetCollector(targets, peer_timeout=0.5)
     plain = FleetCollector(targets, peer_timeout=0.5, delta_window=0)
@@ -1948,9 +1952,23 @@ def test_full_body_and_delta_unaware_clients_stay_byte_identical():
             f"http://127.0.0.1:{delta_server.port}/fleet/snapshot"
         )
         assert (status, wire) == (200, body)
-        # Garbled ?since: full body, 200.
+        # Garbled ?since on the query-wired server: 400, both
+        # malformations (satellite pin, both directions — a valid
+        # since still answers below, a garbled one never serves).
         status, wire = http_get(
             f"http://127.0.0.1:{delta_server.port}/fleet/snapshot"
+            "?since=banana"
+        )
+        assert status == 400
+        status, wire = http_get(
+            f"http://127.0.0.1:{delta_server.port}/fleet/snapshot"
+            "?since=-3"
+        )
+        assert status == 400
+        # ...while the same garbled query on a query-UNWIRED server is
+        # ignored wholesale: full body, 200 (the historical wire).
+        status, wire = http_get(
+            f"http://127.0.0.1:{plain_server.port}/fleet/snapshot"
             "?since=banana"
         )
         assert (status, wire) == (200, body)

@@ -11,9 +11,11 @@ footprint stays bounded by collector fan-out instead of O(fleet)
 persistent connections.
 """
 
+import json
+
 import pytest
 
-from fleet_scale import FleetTiers, MockFleet
+from fleet_scale import ConsumerPool, FleetTiers, MockFleet, consumer_filters
 from gpu_feature_discovery_tpu.obs import metrics as obs_metrics
 
 FROZEN_WALL = 1_700_000_000.0
@@ -124,6 +126,104 @@ def test_thousand_slice_fleet_delta_rounds():
             assert pane[by_name[name]]["stale"] is True
             assert pane[by_name[name]]["healthy_hosts"] is not None
     finally:
+        if tiers is not None:
+            tiers.close()
+        mock.close()
+
+
+def _serving():
+    """The consumer-facing serving counters (cumulative — diff them)."""
+    return {
+        "renders": obs_metrics.FLEET_FILTER_RENDERS.value(),
+        "cache_hit": obs_metrics.FLEET_FILTER_CACHE.value(outcome="hit"),
+        "cache_miss": obs_metrics.FLEET_FILTER_CACHE.value(outcome="miss"),
+        "cache_evict": obs_metrics.FLEET_FILTER_CACHE.value(
+            outcome="evict"
+        ),
+        "filtered_304": obs_metrics.FLEET_FILTERED_NOT_MODIFIED.value(),
+    }
+
+
+def _serving_diff(before):
+    after = _serving()
+    return {k: after[k] - before[k] for k in before}
+
+
+def test_consumer_load_filtered_views_steady_state():
+    """ISSUE 20 acceptance at test scale: 200 keep-alive consumers over
+    20 distinct filters against a 1,000-slice root — after warm-up an
+    idle steady state is >= 90% 304s with ZERO serializations, and a
+    churn round serializes at most once per distinct filter."""
+    filters = consumer_filters(4)
+    assert len(filters) == 20
+    mock = MockFleet(1000)
+    tiers = pool = None
+    try:
+        tiers = FleetTiers(
+            mock, n_regions=4, wall_clock=lambda: FROZEN_WALL,
+            serve_root=True,
+        )
+        tiers.round()
+        port = tiers.root_query_server.port
+        pool = ConsumerPool(port, 200, filters)
+        # Warm-up: every consumer pulls its filtered view. 200 requests
+        # cost at most ONE render per distinct filter — the whole
+        # point of the canonical-filter cache identity.
+        before = _serving()
+        pool.poll_all()
+        warm = _serving_diff(before)
+        assert pool.stats["errors"] == 0
+        assert pool.stats["full"] == 200
+        assert warm["renders"] == len(filters)
+        assert warm["cache_miss"] == len(filters)
+        assert warm["cache_evict"] == 0
+        # Idle steady state: two full consumer rounds (an idle fleet
+        # round between them) are header exchanges only — every poll a
+        # 304, zero new serializations, every view served from cache.
+        tiers.round()
+        pool.reset()
+        before = _serving()
+        pool.poll_all()
+        pool.poll_all()
+        idle = _serving_diff(before)
+        assert pool.stats["errors"] == 0
+        ratio = pool.stats["not_modified"] / pool.stats["requests"]
+        assert ratio >= 0.9, pool.stats
+        assert idle["renders"] == 0, idle
+        assert idle["filtered_304"] == pool.stats["not_modified"]
+        hits = idle["cache_hit"] / (idle["cache_hit"] + idle["cache_miss"])
+        assert hits >= 0.9, idle
+        # Churn: the pane moves ONE generation; 200 consumers re-poll
+        # and the collector serializes each distinct filter at most
+        # once — renders are bounded by filters, never by consumers.
+        mock.churn(0.02)
+        changed = tiers.round()
+        assert changed
+        pool.reset()
+        before = _serving()
+        pool.poll_all()
+        churned = _serving_diff(before)
+        assert pool.stats["errors"] == 0
+        assert churned["renders"] <= len(filters), churned
+        assert pool.stats["full"] + pool.stats["not_modified"] == 200
+        # And the filtered documents are honest: a degraded=true
+        # consumer's pane carries only degraded entries, stamped with
+        # the canonical filter.
+        from fleet_scale import fleet_get
+
+        status, body, _etag = fleet_get(port, "degraded=true")
+        assert status == 200
+        doc = json.loads(body)
+        assert doc["filter"] == "degraded=true"
+        assert doc["slices"]
+        assert all(e["degraded"] for e in doc["slices"].values())
+        full_doc = tiers.root.inventory_payload()
+        assert set(doc["slices"]) == {
+            k for k, e in full_doc["slices"].items() if e["degraded"]
+        }
+    finally:
+        if pool is not None:
+            pool.close()
         if tiers is not None:
             tiers.close()
         mock.close()
